@@ -1,0 +1,23 @@
+"""Test configuration: force an 8-device virtual CPU platform BEFORE any
+backend initializes, so mesh/pjit code paths are exercised without TPU
+hardware (SURVEY.md §4 "Transfer to the build").
+
+Note: the environment's TPU plugin selects itself via a
+``jax.config.update("jax_platforms", ...)`` at interpreter startup, which
+overrides the ``JAX_PLATFORMS`` env var — so the config update below is the
+one that actually takes effect; the env vars are set too for any
+subprocesses tests may spawn.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

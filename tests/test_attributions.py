@@ -1,0 +1,186 @@
+"""Attribution metric tests on the analytic ``max_model`` fixture.
+
+Ground-truth values are hand-derivable from the fixture's weights (see
+torchpruner_tpu/models/analytic.py); they match the reference's expected
+values (reference tests/test_attributions.py:93-175) because the math is
+framework-independent.  Shapley is asserted statistically (sv_samples=1000),
+as in the reference (:128-137).
+"""
+
+import numpy as np
+import pytest
+
+from torchpruner_tpu.attributions import (
+    APoZAttributionMetric,
+    RandomAttributionMetric,
+    SensitivityAttributionMetric,
+    ShapleyAttributionMetric,
+    TaylorAttributionMetric,
+    WeightNormAttributionMetric,
+)
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.segment import SegmentedModel, init_model
+from torchpruner_tpu.models.analytic import max_model, max_model_batches
+from torchpruner_tpu.utils.losses import mse_loss
+from torchpruner_tpu.utils.reductions import mean_plus_2std
+
+ALL_METRICS = [
+    RandomAttributionMetric,
+    WeightNormAttributionMetric,
+    APoZAttributionMetric,
+    SensitivityAttributionMetric,
+    TaylorAttributionMetric,
+    ShapleyAttributionMetric,
+]
+
+
+def make(metric_cls, version=1, **kw):
+    model, params, _, _ = max_model(version)
+    data = max_model_batches(batch_size=1)
+    return metric_cls(model, params, data, mse_loss, **kw)
+
+
+def test_random_shape():
+    attr = make(RandomAttributionMetric).run("fc1")
+    assert attr.shape == (4,)
+
+
+def test_weight_norm():
+    attr = make(WeightNormAttributionMetric).run("fc1")
+    np.testing.assert_array_almost_equal(attr, [1, 2, 2, 2])
+
+
+def test_apoz():
+    attr = make(APoZAttributionMetric).run("fc1")
+    np.testing.assert_array_almost_equal(attr, [0.5, 0.5, 1, 1])
+
+
+def test_sensitivity_zero_at_perfect_solution():
+    attr = make(SensitivityAttributionMetric).run("fc1")
+    np.testing.assert_array_almost_equal(attr, [0, 0, 0, 0])
+
+
+def test_taylor_zero_at_perfect_solution():
+    attr = make(TaylorAttributionMetric).run("fc1")
+    np.testing.assert_array_almost_equal(attr, [0, 0, 0, 0])
+
+
+def test_sensitivity_version2():
+    # A carries weight 1 active half the time; B weight .5 active half;
+    # C weight .5 always active; D weight .1 always active -> [.2,.1,.2,.04]
+    attr = make(SensitivityAttributionMetric, version=2).run("fc1")
+    np.testing.assert_array_almost_equal(attr, [0.2, 0.1, 0.2, 0.04])
+
+
+def test_taylor_version2():
+    attr = make(TaylorAttributionMetric, version=2).run("fc1")
+    np.testing.assert_array_almost_equal(attr, [0.1, 0.1, 0.5, 0.1])
+
+
+def test_taylor_version2_signed():
+    attr = make(TaylorAttributionMetric, version=2, signed=True).run("fc1")
+    np.testing.assert_array_almost_equal(attr, [0.1, 0.1, 0.5, -0.1])
+
+
+def test_shapley_statistical():
+    # Monte-Carlo estimate converges to the analytic Shapley values
+    # (reference tests/test_attributions.py:128-137: sv_samples=1000, 1dp)
+    attr = make(ShapleyAttributionMetric, sv_samples=1000).run("fc1")
+    np.testing.assert_array_almost_equal(attr, [0.37, 0.37, 1.7, 0.0], decimal=1)
+
+
+def test_shapley_slow_path_matches_fast_path():
+    m_fast = make(ShapleyAttributionMetric, sv_samples=20, seed=7)
+    m_slow = make(ShapleyAttributionMetric, sv_samples=20, seed=7,
+                  use_partial=False)
+    a = m_fast.run("fc1")
+    b = m_slow.run("fc1")
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_eval_layer_shifting_rules():
+    # data-driven metrics shift past BN+activation; weight-based don't
+    # (reference tests/test_attributions.py:177-201)
+    model = SegmentedModel(
+        (L.Dense("fc1", 4), L.BatchNorm("bn"), L.Activation("r", "relu"),
+         L.Dense("fc2", 1)),
+        (3,),
+    )
+    params, state = init_model(model)
+    data = max_model_batches()
+    for cls in [TaylorAttributionMetric, SensitivityAttributionMetric,
+                ShapleyAttributionMetric, APoZAttributionMetric]:
+        metric = cls(model, params, data, mse_loss, state=state)
+        assert metric.find_evaluation_layer("fc1", True) == "r"
+    for cls in [WeightNormAttributionMetric, RandomAttributionMetric]:
+        metric = cls(model, params, data, mse_loss, state=state)
+        assert metric.find_evaluation_layer("fc1", True) == "fc1"
+
+
+def test_shift_invariance_through_relu():
+    # attribution before/after a ReLU is identical for these metrics on the
+    # fixture (reference tests/test_attributions.py:203-216)
+    for cls in [TaylorAttributionMetric, SensitivityAttributionMetric,
+                APoZAttributionMetric, WeightNormAttributionMetric]:
+        metric = make(cls)
+        a = metric.run("fc1", find_best_evaluation_layer=False)
+        b = metric.run("fc1", find_best_evaluation_layer=True)
+        np.testing.assert_array_almost_equal(a, b)
+
+
+@pytest.mark.parametrize("cls", ALL_METRICS)
+def test_all_metrics_run_with_shifting(cls):
+    # smoke: every metric runs with find_best_evaluation_layer=True
+    # (reference tests/test_attributions.py:218-229)
+    attr = make(cls).run("fc1", find_best_evaluation_layer=True)
+    assert attr.shape == (4,)
+
+
+def test_reductions():
+    metric = make(TaylorAttributionMetric, version=2, reduction="none")
+    rows = metric.run("fc1")
+    assert rows.shape == (4, 4)  # (examples, units)
+    m_sum = make(TaylorAttributionMetric, version=2, reduction="sum")
+    np.testing.assert_allclose(m_sum.run("fc1"), rows.sum(0), rtol=1e-5)
+    m_custom = make(TaylorAttributionMetric, version=2,
+                    reduction=mean_plus_2std)
+    np.testing.assert_allclose(
+        m_custom.run("fc1"), rows.mean(0) + 2 * rows.std(0), rtol=1e-5
+    )
+
+
+def test_non_prunable_layer_rejected():
+    metric = make(TaylorAttributionMetric)
+    with pytest.raises(TypeError):
+        metric.run("act1")
+
+
+def test_batch_size_invariance_apoz():
+    # accumulating per-example rows must not depend on batching
+    model, params, _, _ = max_model()
+    a = APoZAttributionMetric(model, params, max_model_batches(1), mse_loss)
+    b = APoZAttributionMetric(model, params, max_model_batches(2), mse_loss)
+    np.testing.assert_array_almost_equal(a.run("fc1"), b.run("fc1"))
+
+
+def test_conv_metrics_smoke():
+    # metrics run on a conv layer with spatial reduction
+    from torchpruner_tpu.models import fmnist_convnet
+    import jax
+
+    model = fmnist_convnet()
+    params, state = init_model(model, seed=0)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 28, 28, 1))
+    y = np.zeros((4,), dtype=np.int32)
+    from torchpruner_tpu.utils.losses import cross_entropy_loss
+
+    data = [(x, y)]
+    for cls in [APoZAttributionMetric, SensitivityAttributionMetric,
+                TaylorAttributionMetric]:
+        metric = cls(model, params, data, cross_entropy_loss, state=state)
+        attr = metric.run("conv1", find_best_evaluation_layer=True)
+        assert attr.shape == (32,)
+    sv = ShapleyAttributionMetric(model, params, data, cross_entropy_loss,
+                                  state=state, sv_samples=2)
+    attr = sv.run("conv1", find_best_evaluation_layer=True)
+    assert attr.shape == (32,)

@@ -1,0 +1,101 @@
+"""Core layer / SegmentedModel behavior."""
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.segment import SegmentedModel, init_model
+from torchpruner_tpu.models import fmnist_convnet, max_model, mnist_fc, vgg16_bn
+from torchpruner_tpu.models.analytic import max_model_batches
+
+
+def test_max_model_forward_is_max():
+    model, params, x, y = max_model()
+    out, _ = model.apply(params, x)
+    np.testing.assert_array_almost_equal(np.asarray(out), np.asarray(y))
+
+
+def test_shape_inference_matches_eval_shape():
+    for model in [mnist_fc(), fmnist_convnet(), vgg16_bn()]:
+        params, state = init_model(model, seed=0)
+        x = jnp.zeros((2,) + tuple(model.input_shape))
+        out = jax.eval_shape(
+            lambda p, s, x: model.apply(p, x, state=s)[0], params, state, x
+        )
+        assert tuple(out.shape) == (2,) + model.out_shape()
+
+
+def test_prefix_suffix_compose():
+    model = fmnist_convnet()
+    params, state = init_model(model, seed=1)
+    x = jax.random.normal(jax.random.PRNGKey(0), (3, 28, 28, 1))
+    full, _ = model.apply(params, x, state=state)
+    for cut in ["conv1", "pool1", "flatten", "fc1", "act3"]:
+        z, _ = model.apply(params, x, state=state, to_layer=cut)
+        rest, _ = model.apply(params, z, state=state, from_layer=cut)
+        np.testing.assert_allclose(
+            np.asarray(rest), np.asarray(full), rtol=1e-5, atol=1e-5
+        )
+
+
+def test_unit_mask_zeroes_units():
+    model, params, x, _ = max_model()
+    mask = jnp.array([1.0, 1.0, 0.0, 1.0])
+    z, _ = model.apply(params, x, to_layer="fc1", unit_mask=("fc1", mask))
+    assert np.all(np.asarray(z)[:, 2] == 0)
+    # masking pre-activation == masking post-relu for these inputs
+    full_masked, _ = model.apply(params, x, unit_mask=("fc1", mask))
+    z2, _ = model.apply(params, x, to_layer="fc1")
+    manual, _ = model.apply(params, z2 * mask, from_layer="fc1")
+    np.testing.assert_allclose(np.asarray(full_masked), np.asarray(manual))
+
+
+def test_batchnorm_train_updates_state_eval_uses_it():
+    model = SegmentedModel(
+        (L.Dense("fc", 4), L.BatchNorm("bn")), input_shape=(3,)
+    )
+    params, state = init_model(model, seed=0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 3))
+    _, new_state = model.apply(params, x, state=state, train=True)
+    assert not np.allclose(
+        np.asarray(new_state["bn"]["mean"]), np.asarray(state["bn"]["mean"])
+    )
+    # eval mode leaves state untouched
+    _, state2 = model.apply(params, x, state=new_state, train=False)
+    np.testing.assert_array_equal(
+        np.asarray(state2["bn"]["mean"]), np.asarray(new_state["bn"]["mean"])
+    )
+
+
+def test_dropout_train_vs_eval():
+    model = SegmentedModel(
+        (L.Dense("fc", 50), L.Dropout("drop", 0.5)), input_shape=(10,)
+    )
+    params, _ = init_model(model, seed=0)
+    x = jnp.ones((4, 10))
+    y_eval, _ = model.apply(params, x)
+    y_tr, _ = model.apply(params, x, train=True, rng=jax.random.PRNGKey(0))
+    assert np.any(np.asarray(y_tr) == 0.0) or not np.allclose(
+        np.asarray(y_tr), np.asarray(y_eval)
+    )
+
+
+def test_widths_and_replace_layer():
+    model = mnist_fc()
+    assert model.widths() == {"fc1": 2024, "fc2": 2024, "out": 10}
+    m2 = model.replace_layer("fc1", L.with_features(model.layer("fc1"), 100))
+    assert m2.widths()["fc1"] == 100
+    assert model.widths()["fc1"] == 2024  # original untouched
+
+
+def test_model_is_hashable_jit_key():
+    m1, m2 = mnist_fc(), mnist_fc()
+    assert hash(m1) == hash(m2) and m1 == m2
+    assert m1 != m1.replace_layer("fc1", L.with_features(m1.layer("fc1"), 5))
+
+
+def test_duplicate_names_rejected():
+    with pytest.raises(ValueError):
+        SegmentedModel((L.Dense("a", 3), L.Dense("a", 4)), (2,))

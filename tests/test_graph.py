@@ -1,0 +1,137 @@
+"""Pruning-graph inference + evaluation-point shifting + NaN oracle.
+
+Mirrors the reference's cascade-discovery tests (reference
+tests/test_pruner.py:72-121) but validates the STATIC graph against the
+NaN-propagation oracle instead of relying on the oracle for pruning.
+"""
+
+import numpy as np
+import pytest
+
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.graph import (
+    find_best_evaluation_layer,
+    nan_cascade_oracle,
+    pruning_graph,
+    group_for,
+)
+from torchpruner_tpu.core.plan import expand_keep, keep_indices
+from torchpruner_tpu.core.segment import SegmentedModel, init_model
+from torchpruner_tpu.models import fmnist_convnet, vgg16_bn
+
+
+def test_linear_linear_graph():
+    m = SegmentedModel(
+        (L.Dense("a", 8), L.Activation("r", "relu"), L.Dense("b", 4)), (6,)
+    )
+    (g,) = pruning_graph(m)
+    assert g.target == "a"
+    assert [c.layer for c in g.consumers] == ["b"]
+    assert g.consumers[0].axis == 0 and g.consumers[0].fan_out == 1
+
+
+def test_linear_bn_linear_graph():
+    m = SegmentedModel(
+        (L.Dense("a", 8), L.BatchNorm("bn"), L.Activation("r", "relu"),
+         L.Dense("b", 4)),
+        (6,),
+    )
+    (g,) = pruning_graph(m)
+    assert [b.layer for b in g.attached_bn] == ["bn"]
+    assert g.attached_bn[0].fan_out == 1
+
+
+def test_conv_flatten_linear_fanout():
+    # one conv channel fans out into spatial-many inputs of the dense
+    # consumer (reference tests/test_pruner.py:83-92)
+    m = SegmentedModel(
+        (L.Conv("c", 3, (3, 3), padding="SAME"), L.Flatten("f"),
+         L.Dense("d", 5)),
+        (4, 4, 1),
+    )
+    (g,) = pruning_graph(m)
+    c = g.consumers[0]
+    assert c.layer == "d" and c.fan_out == 16  # 4*4 spatial positions
+
+
+def test_conv_pool_flatten_linear_fanout():
+    # max-pool shrinks the spatial fan-out (reference test_pruner.py:94-107)
+    m = SegmentedModel(
+        (L.Conv("c", 3, (3, 3), padding="SAME"), L.Pool("p", "max", (2, 2)),
+         L.Flatten("f"), L.Dense("d", 5)),
+        (4, 4, 1),
+    )
+    (g,) = pruning_graph(m)
+    assert g.consumers[0].fan_out == 4  # 2*2 after pooling
+
+
+def test_bn_after_flatten_gets_fanout():
+    m = SegmentedModel(
+        (L.Conv("c", 4, (3, 3), padding="SAME"), L.Flatten("f"),
+         L.BatchNorm("bn"), L.Dense("d", 5)),
+        (4, 4, 1),
+    )
+    (g,) = pruning_graph(m)
+    assert g.attached_bn[0].fan_out == 16
+    assert g.consumers[0].fan_out == 16
+
+
+def test_vgg_graph_has_15_groups():
+    groups = pruning_graph(vgg16_bn())
+    assert len(groups) == 15  # 13 convs + fc1 + fc2; 'out' excluded
+    assert groups[-1].target == "fc2"
+    # dropout after fc1 attaches to fc1's group
+    fc1 = group_for(vgg16_bn(), "fc1")
+    assert fc1.attached_dropout == ("drop1",)
+
+
+def test_find_best_evaluation_layer():
+    m = SegmentedModel(
+        (L.Dense("a", 8), L.BatchNorm("bn"), L.Activation("r", "relu"),
+         L.Dense("b", 4)),
+        (6,),
+    )
+    # shift past BN + ReLU (reference tests/test_attributions.py:177-201)
+    assert find_best_evaluation_layer(m, "a") == "r"
+    # a pool stops the walk
+    m2 = fmnist_convnet()
+    assert find_best_evaluation_layer(m2, "conv1") == "act1"
+    assert find_best_evaluation_layer(m2, "fc1") == "act3"
+
+
+@pytest.mark.parametrize("model_fn,target,drop", [
+    (fmnist_convnet, "conv1", [0, 5]),
+    (fmnist_convnet, "conv2", [1, 2, 63]),
+    (fmnist_convnet, "fc1", [0, 100, 4095]),
+])
+def test_static_graph_matches_nan_oracle(model_fn, target, drop):
+    """The static fan-out maps must reproduce exactly the indices the NaN
+    trick discovers (reference pruner.py:21-57 as ground truth)."""
+    model = model_fn()
+    params, state = init_model(model, seed=0)
+    report = nan_cascade_oracle(model, params, state, target, drop)
+    group = group_for(model, target)
+    n = model.layer(target).features
+    dropped = np.setdiff1d(np.arange(n), keep_indices(n, drop))
+
+    for c in group.consumers:
+        # expected tainted input positions under the static fan-out map
+        expected = np.sort(
+            (np.arange(c.fan_out)[:, None] * n + dropped[None, :]).ravel()
+        )
+        got, orig_len = report[c.layer]
+        np.testing.assert_array_equal(np.sort(got), expected)
+        assert orig_len == n * c.fan_out
+    for bn in group.attached_bn:
+        expected = np.sort(
+            (np.arange(bn.fan_out)[:, None] * n + dropped[None, :]).ravel()
+        )
+        got, _ = report[bn.layer]
+        np.testing.assert_array_equal(np.sort(got), expected)
+
+
+def test_expand_keep_strided_map():
+    keep = keep_indices(4, [1])
+    np.testing.assert_array_equal(
+        expand_keep(keep, 4, 3), [0, 2, 3, 4, 6, 7, 8, 10, 11]
+    )

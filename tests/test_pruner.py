@@ -1,0 +1,244 @@
+"""Functional pruner tests — ports the reference's property-style pruner
+suite (reference tests/test_pruner.py) to the functional API: shapes after
+slicing, cascades through Flatten/Pool/BN, end-to-end forward after pruning,
+dropout rescaling, and optimizer-state slicing (generalized to optax)."""
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+import optax
+import pytest
+
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.pruner import Pruner, prune, prune_by_scores
+from torchpruner_tpu.core.segment import SegmentedModel, init_model
+from torchpruner_tpu.models import fmnist_convnet
+from torchpruner_tpu.utils.losses import cross_entropy_loss
+
+
+def small_mlp():
+    return SegmentedModel(
+        (L.Dense("fc1", 8), L.Activation("r1", "relu"), L.Dense("fc2", 4)),
+        (6,),
+    )
+
+
+def test_out_prune_shapes():
+    m = small_mlp()
+    p, _ = init_model(m)
+    res = prune(m, p, "fc1", [0, 3, 7])
+    assert res.model.layer("fc1").features == 5
+    assert res.params["fc1"]["w"].shape == (6, 5)
+    assert res.params["fc1"]["b"].shape == (5,)
+    assert res.params["fc2"]["w"].shape == (5, 4)  # consumer in-pruned
+    # kept rows are the untouched ones
+    np.testing.assert_array_equal(
+        np.asarray(res.params["fc1"]["w"]),
+        np.asarray(p["fc1"]["w"][:, [1, 2, 4, 5, 6]]),
+    )
+
+
+def test_duplicate_drop_indices_are_deduped():
+    m = small_mlp()
+    p, _ = init_model(m)
+    res = prune(m, p, "fc1", [2, 2, 2])
+    assert res.model.layer("fc1").features == 7
+    assert res.params["fc1"]["w"].shape == (6, 7)
+
+
+def test_pruned_forward_equals_submatrix_forward():
+    """Pruning must be exactly equivalent to removing the units: the pruned
+    model's output equals the original with those units forced to zero
+    (ReLU net, so zeroing the unit kills its contribution)."""
+    m = small_mlp()
+    p, _ = init_model(m, seed=3)
+    x = jax.random.normal(jax.random.PRNGKey(0), (5, 6))
+    drop = [1, 4]
+    mask = jnp.ones(8).at[jnp.asarray(drop)].set(0.0)
+    expected, _ = m.apply(p, x, unit_mask=("fc1", mask))
+    res = prune(m, p, "fc1", drop)
+    got, _ = res.model.apply(res.params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_conv_flatten_linear_cascade_forward():
+    # reference test_pruner.py:83-92 (fan-out through flatten), with the
+    # equivalence check instead of shape-only assertions
+    m = SegmentedModel(
+        (L.Conv("c", 3, (3, 3), padding="SAME"), L.Activation("r", "relu"),
+         L.Flatten("f"), L.Dense("d", 5)),
+        (4, 4, 2),
+    )
+    p, _ = init_model(m, seed=1)
+    x = jax.random.normal(jax.random.PRNGKey(2), (3, 4, 4, 2))
+    mask = jnp.ones(3).at[1].set(0.0)
+    expected, _ = m.apply(p, x, unit_mask=("c", mask))
+    res = prune(m, p, "c", [1])
+    assert res.params["c"]["w"].shape == (3, 3, 2, 2)
+    assert res.params["d"]["w"].shape == (32, 5)  # (4*4*2 flattened)
+    got, _ = res.model.apply(res.params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_conv_pool_flatten_cascade_forward():
+    # reference test_pruner.py:94-107
+    m = SegmentedModel(
+        (L.Conv("c", 4, (3, 3), padding="SAME"), L.Activation("r", "relu"),
+         L.Pool("p", "max", (2, 2)), L.Flatten("f"), L.Dense("d", 5)),
+        (4, 4, 1),
+    )
+    p, _ = init_model(m, seed=1)
+    x = jax.random.normal(jax.random.PRNGKey(2), (3, 4, 4, 1))
+    mask = jnp.ones(4).at[jnp.asarray([0, 2])].set(0.0)
+    expected, _ = m.apply(p, x, unit_mask=("c", mask))
+    res = prune(m, p, "c", [0, 2])
+    got, _ = res.model.apply(res.params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_linear_bn_linear_cascade():
+    # reference test_pruner.py:109-121 + BN-buffer resize (:153-158)
+    m = SegmentedModel(
+        (L.Dense("a", 8), L.BatchNorm("bn"), L.Activation("r", "relu"),
+         L.Dense("b", 4)),
+        (6,),
+    )
+    p, s = init_model(m, seed=0)
+    res = prune(m, p, "a", [0, 7], state=s)
+    assert res.params["bn"]["scale"].shape == (6,)
+    assert res.state["bn"]["mean"].shape == (6,)
+    assert res.state["bn"]["var"].shape == (6,)
+    x = jax.random.normal(jax.random.PRNGKey(0), (3, 6))
+    out, _ = res.model.apply(res.params, x, state=res.state)
+    assert out.shape == (3, 4)
+
+
+def test_dropout_rescaled():
+    # 0.5 -> 0.4 when 20% of units are pruned (reference test_pruner.py:162-176)
+    m = SegmentedModel(
+        (L.Dense("a", 10), L.Activation("r", "relu"), L.Dropout("dr", 0.5),
+         L.Dense("b", 4)),
+        (6,),
+    )
+    p, _ = init_model(m)
+    res = prune(m, p, "a", [0, 1])
+    assert res.model.layer("dr").rate == pytest.approx(0.4)
+
+
+def test_fmnist_convnet_end_to_end_prune():
+    m = fmnist_convnet()
+    p, s = init_model(m, seed=0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 28, 28, 1))
+    res = prune(m, p, "conv2", list(range(0, 64, 2)), state=s)
+    out, _ = res.model.apply(res.params, x, state=res.state)
+    assert out.shape == (2, 10)
+    assert res.model.layer("conv2").features == 32
+    assert res.params["fc1"]["w"].shape[0] == 7 * 7 * 32
+
+
+@pytest.mark.parametrize("tx_name", ["sgd_momentum", "adam", "sgd_plain"])
+def test_optimizer_state_sliced_and_training_continues(tx_name):
+    """Train step -> prune -> train step must work, with momentum/Adam
+    moments sliced consistently (reference test_pruner.py:180-228 is
+    SGD-momentum only; optax generality per SURVEY.md §7)."""
+    tx = {
+        "sgd_momentum": optax.sgd(1e-2, momentum=0.9),
+        "adam": optax.adam(1e-3),
+        "sgd_plain": optax.sgd(1e-2),
+    }[tx_name]
+    m = small_mlp()
+    p, _ = init_model(m, seed=0)
+    opt_state = tx.init(p)
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 6))
+    y = jnp.zeros((16,), dtype=jnp.int32)
+
+    def loss(p_):
+        out, _ = m.apply(p_, x)
+        return jnp.mean(cross_entropy_loss(out, y))
+
+    g = jax.grad(loss)(p)
+    up, opt_state = tx.update(g, opt_state, p)
+    p = optax.apply_updates(p, up)
+
+    res = prune(m, p, "fc1", [0, 5], opt_state=opt_state)
+    m2, p2, opt_state2 = res.model, res.params, res.opt_state
+
+    # every params-shaped leaf of the optimizer state must match new shapes
+    flat_p = jax.tree_util.tree_leaves(p2)
+    for leaf in jax.tree_util.tree_leaves(opt_state2):
+        if hasattr(leaf, "shape") and leaf.ndim >= 1:
+            assert any(leaf.shape == q.shape for q in flat_p), leaf.shape
+
+    def loss2(p_):
+        out, _ = m2.apply(p_, x)
+        return jnp.mean(cross_entropy_loss(out, y))
+
+    g2 = jax.grad(loss2)(p2)
+    up2, _ = tx.update(g2, opt_state2, p2)
+    p3 = optax.apply_updates(p2, up2)
+    assert jax.tree_util.tree_structure(p3) == jax.tree_util.tree_structure(p2)
+
+
+def test_momentum_values_sliced_not_reset():
+    tx = optax.sgd(1e-2, momentum=0.9)
+    m = small_mlp()
+    p, _ = init_model(m, seed=0)
+    opt_state = tx.init(p)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 6))
+    y = jnp.zeros((4,), dtype=jnp.int32)
+    g = jax.grad(
+        lambda p_: jnp.mean(cross_entropy_loss(m.apply(p_, x)[0], y))
+    )(p)
+    _, opt_state = tx.update(g, opt_state, p)
+    trace_before = opt_state[0].trace["fc1"]["w"]
+    res = prune(m, p, "fc1", [2], opt_state=opt_state)
+    trace_after = res.opt_state[0].trace["fc1"]["w"]
+    keep = [0, 1, 3, 4, 5, 6, 7]
+    np.testing.assert_array_equal(
+        np.asarray(trace_after), np.asarray(trace_before[:, keep])
+    )
+
+
+def test_prune_by_scores_policies():
+    m = small_mlp()
+    p, _ = init_model(m)
+    scores = np.array([-1.0, 2.0, -0.5, 3.0, 1.0, 0.5, -2.0, 4.0])
+    res = prune_by_scores(m, p, "fc1", scores, policy="negative")
+    assert res.model.layer("fc1").features == 5
+    res2 = prune_by_scores(m, p, "fc1", scores, policy="fraction", fraction=0.25)
+    assert res2.model.layer("fc1").features == 6
+    # custom callable policy
+    res3 = prune_by_scores(m, p, "fc1", scores, policy=lambda s: np.array([0]))
+    assert res3.model.layer("fc1").features == 7
+
+
+def test_all_negative_never_empties_layer():
+    m = small_mlp()
+    p, _ = init_model(m)
+    res = prune_by_scores(m, p, "fc1", -np.ones(8), policy="negative")
+    assert res.model.layer("fc1").features >= 1
+
+
+def test_pruner_class_wrapper():
+    m = small_mlp()
+    p, _ = init_model(m)
+    pr = Pruner(m, p)
+    pr.prune_model("fc1", [0])
+    pr.prune_model("fc1", [0])
+    assert pr.model.layer("fc1").features == 6
+    x = jnp.ones((2, 6))
+    out, _ = pr.model.apply(pr.params, x)
+    assert out.shape == (2, 4)
+
+
+def test_bad_plan_path_raises():
+    from torchpruner_tpu.core.plan import Consumer, PruneGroup
+
+    m = small_mlp()
+    p, _ = init_model(m)
+    bad = PruneGroup(target="fc1", consumers=(Consumer(layer="nope"),))
+    with pytest.raises(KeyError):
+        prune(m, p, bad, [0])

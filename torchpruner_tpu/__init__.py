@@ -1,0 +1,61 @@
+"""torchpruner_tpu — a TPU-native (JAX/XLA/pjit) structured-pruning framework.
+
+A ground-up re-design of the capabilities of TorchPruner
+(reference: /root/reference, see SURVEY.md) for TPU hardware:
+
+- Models are :class:`~torchpruner_tpu.core.segment.SegmentedModel` specs —
+  immutable, hashable layer pipelines whose ``prefix``/``suffix`` sub-programs
+  compile to single XLA computations (replacing the reference's
+  ``forward_partial`` convention, reference attributions.py:70-89).
+- Attribution metrics (reference torchpruner/attributions/) are functional
+  scorers built on ``jax.vjp``/``vmap``/``lax.scan`` instead of
+  forward/backward hooks.
+- Pruning (reference torchpruner/pruner/pruner.py) is functional
+  re-instantiation: ``prune`` maps ``(model, params, state, opt_state)`` to new,
+  smaller pytrees plus an updated static model spec; XLA recompiles at the new
+  shapes ("on-the-fly" pruning, the XLA-honest way).
+- Distribution is a first-class mesh layer (``torchpruner_tpu.parallel``):
+  data-parallel attribution scoring and DP/FSDP fine-tuning via
+  ``jax.sharding`` — collectives ride ICI, inserted by XLA.
+"""
+
+from torchpruner_tpu.core.segment import SegmentedModel, init_model
+from torchpruner_tpu.core import layers
+from torchpruner_tpu.core.graph import (
+    pruning_graph,
+    find_best_evaluation_layer,
+    nan_cascade_oracle,
+)
+from torchpruner_tpu.core.plan import PruneGroup, Consumer, PrunePlan
+from torchpruner_tpu.core.pruner import prune, prune_by_scores, Pruner
+from torchpruner_tpu.attributions import (
+    RandomAttributionMetric,
+    WeightNormAttributionMetric,
+    APoZAttributionMetric,
+    SensitivityAttributionMetric,
+    TaylorAttributionMetric,
+    ShapleyAttributionMetric,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "SegmentedModel",
+    "init_model",
+    "layers",
+    "pruning_graph",
+    "find_best_evaluation_layer",
+    "nan_cascade_oracle",
+    "PruneGroup",
+    "Consumer",
+    "PrunePlan",
+    "prune",
+    "prune_by_scores",
+    "Pruner",
+    "RandomAttributionMetric",
+    "WeightNormAttributionMetric",
+    "APoZAttributionMetric",
+    "SensitivityAttributionMetric",
+    "TaylorAttributionMetric",
+    "ShapleyAttributionMetric",
+]

@@ -1,0 +1,32 @@
+"""Attribution metrics (pruning criteria).
+
+Six metrics with the uniform API of the reference
+(torchpruner/attributions/__init__.py:1-7, README.md:55-90), re-expressed as
+jit-compiled functional scorers::
+
+    metric = ShapleyAttributionMetric(model, params, data, loss_fn,
+                                      state=state, sv_samples=5)
+    scores = metric.run("fc1", find_best_evaluation_layer=True)
+"""
+
+from torchpruner_tpu.attributions.base import AttributionMetric
+from torchpruner_tpu.attributions.simple import (
+    RandomAttributionMetric,
+    WeightNormAttributionMetric,
+)
+from torchpruner_tpu.attributions.activation import (
+    APoZAttributionMetric,
+    SensitivityAttributionMetric,
+    TaylorAttributionMetric,
+)
+from torchpruner_tpu.attributions.shapley import ShapleyAttributionMetric
+
+__all__ = [
+    "AttributionMetric",
+    "RandomAttributionMetric",
+    "WeightNormAttributionMetric",
+    "APoZAttributionMetric",
+    "SensitivityAttributionMetric",
+    "TaylorAttributionMetric",
+    "ShapleyAttributionMetric",
+]

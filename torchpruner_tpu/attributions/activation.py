@@ -1,0 +1,91 @@
+"""Forward/backward activation metrics: APoZ, Sensitivity, Taylor.
+
+The reference implements these with forward/backward hooks accumulating
+numpy on host per batch (reference apoz.py / sensitivity.py / taylor.py).
+Here each is one jit row function; gradients w.r.t. the evaluation-point
+activation come from ``jax.grad`` through the model *suffix* only — no
+full-model backward, no host round-trips inside the pass.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+from torchpruner_tpu.attributions.base import (
+    AttributionMetric,
+    prefix_fn,
+    suffix_loss_fn,
+    spatial_sum,
+)
+
+
+@functools.lru_cache(maxsize=512)
+def grad_rows_fn(model, eval_layer, loss_fn, mode: str):
+    """jit: (params, state, x, y) -> (batch, n_units) rows for one of
+    ``mode in {"apoz", "sensitivity", "taylor", "taylor_signed"}``.
+
+    The gradient is of the *batch-mean* loss, matching the reference's
+    ``loss.backward()`` on a mean criterion (reference attributions.py:58-68) —
+    per-example grads therefore carry the 1/batch factor, and examples are
+    exactly separable because scoring runs in eval mode.
+    """
+    suffix = suffix_loss_fn(model, eval_layer, loss_fn)
+
+    @jax.jit
+    def fn(params, state, x, y):
+        z, _ = model.apply(
+            params, x, state=state, train=False, to_layer=eval_layer
+        )
+        if mode == "apoz":
+            return spatial_sum((z > 0).astype(jnp.float32))
+
+        def mean_loss(z_):
+            return jnp.mean(suffix(params, state, z_, y))
+
+        g = jax.grad(mean_loss)(z)
+        if mode == "sensitivity":
+            # abs first, then spatial sum (reference sensitivity.py:27-30)
+            return spatial_sum(jnp.abs(g))
+        taylor = spatial_sum(-g * z)  # sum first (reference taylor.py:39-42)
+        if mode == "taylor":
+            return jnp.abs(taylor)
+        return taylor  # taylor_signed
+
+    return fn
+
+
+class APoZAttributionMetric(AttributionMetric):
+    """1−APoZ: per-example count of positive activations per unit (Hu et al.;
+    reference apoz.py:15-39). Higher = more alive."""
+
+    def compute_rows(self, layer, eval_layer, **kw):
+        fn = grad_rows_fn(self.model, eval_layer, self.loss_fn, "apoz")
+        return self._collect(fn)
+
+
+class SensitivityAttributionMetric(AttributionMetric):
+    """Average absolute gradient of the loss w.r.t. each unit's activation
+    (Mittal et al.; reference sensitivity.py:13-34)."""
+
+    def compute_rows(self, layer, eval_layer, **kw):
+        fn = grad_rows_fn(self.model, eval_layer, self.loss_fn, "sensitivity")
+        return self._collect(fn)
+
+
+class TaylorAttributionMetric(AttributionMetric):
+    """First-order Taylor expansion |−g·a| of the loss change on unit removal
+    (Molchanov et al.; reference taylor.py:6-49). ``signed=True`` keeps the
+    sign (reference taylor.py:44-45)."""
+
+    def __init__(self, *args, signed: bool = False, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.signed = signed
+
+    def compute_rows(self, layer, eval_layer, **kw):
+        mode = "taylor_signed" if self.signed else "taylor"
+        fn = grad_rows_fn(self.model, eval_layer, self.loss_fn, mode)
+        return self._collect(fn)

@@ -1,0 +1,163 @@
+"""Attribution metric base — functional replacement for the reference's
+hook-driven ``_AttributionMetric`` (reference torchpruner/attributions/
+attributions.py).
+
+Where the reference inverts control into torch autograd and stashes
+accumulators on module attributes (``_tp_*``), every metric here reduces to a
+**row function**: one jit-compiled pure computation
+``(params, state, x, y) -> (batch, n_units)`` of per-example scores.  The base
+class iterates the dataset, stacks rows on host, and applies the reduction —
+and the same row functions are what the distributed scorer shards over the
+``data`` mesh axis (torchpruner_tpu/parallel/scoring.py).
+
+Scoring runs the model in eval mode (BatchNorm running statistics), which
+keeps examples independent — the property that makes per-example gradients
+exact.  Determinism needs no cuDNN toggles (reference attributions.py:108-116):
+JAX computations are deterministic and all randomness flows through explicit
+PRNG keys.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.graph import find_best_evaluation_layer
+from torchpruner_tpu.core.segment import SegmentedModel
+
+
+class AttributionMetric:
+    """Base attribution metric.
+
+    Uniform API (reference README.md:55-90)::
+
+        metric = Metric(model, params, data, loss_fn, state=state,
+                        reduction="mean")
+        scores = metric.run("conv3", find_best_evaluation_layer=True)
+
+    - ``data``: a re-iterable of ``(x, y)`` batches (list/tuple), or a
+      zero-arg callable returning an iterator.
+    - ``loss_fn(preds, y) -> (batch,)`` per-example losses
+      (torchpruner_tpu.utils.losses).
+    - ``reduction``: ``"mean" | "sum" | "none"`` or a callable on the
+      ``(N, n_units)`` row matrix (reference attributions.py:91-106).
+    """
+
+    #: whether evaluation-point shifting applies (False for weight-only
+    #: metrics, reference weight_norm.py:21 / random.py:12).
+    shiftable = True
+
+    def __init__(
+        self,
+        model: SegmentedModel,
+        params,
+        data,
+        loss_fn: Callable,
+        *,
+        state=None,
+        reduction="mean",
+        seed: int = 0,
+    ):
+        self.model = model
+        self.params = params
+        self.state = state if state is not None else {}
+        self.data = data
+        self.loss_fn = loss_fn
+        self.reduction = reduction
+        self.seed = seed
+
+    # ------------------------------------------------------------------ api
+
+    def run(
+        self, layer: str, *, find_best_evaluation_layer: bool = False, **kw
+    ) -> np.ndarray:
+        """Compute per-unit scores for prunable layer ``layer``."""
+        spec = self.model.layer(layer)
+        if not isinstance(spec, L.PRUNABLE_TYPES):
+            raise TypeError(
+                f"attributions require a Dense/Conv layer, got "
+                f"{type(spec).__name__} (reference attributions.py:27-32)"
+            )
+        eval_layer = self.find_evaluation_layer(
+            layer, find_best_evaluation_layer
+        )
+        rows = self.compute_rows(layer, eval_layer, **kw)
+        return self.aggregate_over_samples(rows)
+
+    def find_evaluation_layer(self, layer: str, find_best: bool = False) -> str:
+        if find_best and self.shiftable:
+            return find_best_evaluation_layer(self.model, layer)
+        return layer
+
+    def compute_rows(self, layer: str, eval_layer: str, **kw) -> np.ndarray:
+        raise NotImplementedError
+
+    def aggregate_over_samples(self, rows: np.ndarray) -> np.ndarray:
+        if self.reduction == "mean":
+            return np.mean(rows, 0)
+        if self.reduction == "sum":
+            return np.sum(rows, 0)
+        if self.reduction == "none":
+            return rows
+        return self.reduction(rows)
+
+    # ------------------------------------------------------------- plumbing
+
+    def batches(self):
+        return self.data() if callable(self.data) else iter(self.data)
+
+    def n_units(self, eval_layer: str) -> int:
+        return self.model.out_shape(eval_layer)[-1]
+
+    def _collect(self, row_fn) -> np.ndarray:
+        """Run ``row_fn`` over the dataset, stacking per-example rows."""
+        out = []
+        for x, y in self.batches():
+            out.append(np.asarray(row_fn(self.params, self.state, x, y)))
+        return np.concatenate(out, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Cached segment computations shared by the data-dependent metrics.  Caching
+# on the hashable (model, eval_layer, loss_fn) keeps XLA executables warm
+# across passes and invalidates exactly when pruning yields a new spec.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=512)
+def prefix_fn(model: SegmentedModel, eval_layer: str):
+    """jit: (params, state, x) -> activation at ``eval_layer``."""
+
+    @jax.jit
+    def fn(params, state, x):
+        z, _ = model.apply(params, x, state=state, train=False, to_layer=eval_layer)
+        return z
+
+    return fn
+
+
+@functools.lru_cache(maxsize=512)
+def suffix_loss_fn(model: SegmentedModel, eval_layer: str, loss_fn):
+    """(params, state, z, y) -> per-example loss (batch,), resuming after
+    ``eval_layer`` (the reference's ``run_forward_partial`` with
+    ``from_module``, attributions.py:70-89)."""
+
+    def fn(params, state, z, y):
+        preds, _ = model.apply(
+            params, z, state=state, train=False, from_layer=eval_layer
+        )
+        return loss_fn(preds, y)
+
+    return fn
+
+
+def spatial_sum(rows: jnp.ndarray) -> jnp.ndarray:
+    """(B, ..., n) -> (B, n): sum every non-batch, non-unit axis."""
+    if rows.ndim <= 2:
+        return rows
+    return rows.sum(axis=tuple(range(1, rows.ndim - 1)))

@@ -1,0 +1,122 @@
+"""Monte-Carlo Shapley value attribution — the hot loop of the framework.
+
+The reference walks each sampled permutation in Python, re-running the model
+suffix once per zeroed unit (``sv_samples × n_units`` forwards per batch,
+reference shapley_values.py:28-64) — the dominant cost of its 6.5-hour VGG16
+sweep (BASELINE.md).  Here the whole per-batch computation is ONE compiled XLA
+program:
+
+- the sequential marginal chain within a permutation (loss deltas chain
+  through cumulative masking) is a ``lax.scan`` over units;
+- permutations vectorize with ``vmap`` — the MXU sees suffix matmuls batched
+  over (permutations × examples);
+- the prefix activation is computed once per batch and reused (fast path), or
+  a cumulative unit-mask is applied mid-network on a full forward (slow path,
+  the functional analog of the reference's masking hook,
+  shapley_values.py:92-99).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+from torchpruner_tpu.attributions.base import (
+    AttributionMetric,
+    suffix_loss_fn,
+)
+
+
+@functools.lru_cache(maxsize=512)
+def shapley_rows_fn(model, eval_layer: str, loss_fn, use_partial: bool):
+    """jit: (params, state, x, y, perms) -> (batch, n_units) Shapley rows.
+
+    ``perms`` is an ``(sv_samples, n_units)`` int array of unit permutations,
+    fixed across batches (reference shapley_values.py:45-47).
+    """
+    n = model.out_shape(eval_layer)[-1]
+    suffix = suffix_loss_fn(model, eval_layer, loss_fn)
+
+    @jax.jit
+    def fn(params, state, x, y, perms):
+        if use_partial:
+            z, _ = model.apply(
+                params, x, state=state, train=False, to_layer=eval_layer
+            )
+            base = suffix(params, state, z, y)  # (B,) per-example loss
+
+            def masked_loss(mask):
+                return suffix(params, state, z * mask, y)
+
+        else:
+
+            def masked_loss(mask):
+                preds, _ = model.apply(
+                    params,
+                    x,
+                    state=state,
+                    train=False,
+                    unit_mask=(eval_layer, mask),
+                )
+                return loss_fn(preds, y)
+
+            base = masked_loss(jnp.ones((n,), x.dtype))
+
+        def per_perm(perm):
+            def step(carry, u):
+                mask, prev = carry
+                mask = mask.at[u].set(0.0)  # cumulative zeroing
+                loss = masked_loss(mask)
+                return (mask, loss), loss - prev
+
+            init = (jnp.ones((n,), base.dtype), base)
+            _, deltas = jax.lax.scan(step, init, perm)  # (n, B), perm order
+            return jnp.zeros_like(deltas).at[perm].set(deltas)  # unit order
+
+        svs = jax.vmap(per_perm)(perms)  # (S, n, B)
+        return jnp.mean(svs, axis=0).T  # (B, n): mean over permutations
+
+    return fn
+
+
+class ShapleyAttributionMetric(AttributionMetric):
+    """Sampled Shapley values of per-unit loss contribution
+    (reference shapley_values.py:7-99; cost ``sv_samples × n_units`` suffix
+    evaluations per batch, reference README.md:89 — here batched into one XLA
+    computation per batch).
+
+    ``use_partial=False`` forces the full-forward masking path (the
+    reference's slow path for models without ``forward_partial``); results
+    are identical, it only recomputes the prefix under the mask.
+    """
+
+    def __init__(self, *args, sv_samples: int = 5, use_partial: bool = True, **kw):
+        super().__init__(*args, **kw)
+        self.sv_samples = sv_samples
+        self.use_partial = use_partial
+        self._calls = 0
+
+    def compute_rows(self, layer, eval_layer, sv_samples=None, use_partial=None):
+        fn = self.make_row_fn(
+            eval_layer, sv_samples=sv_samples, use_partial=use_partial
+        )
+        return self._collect(fn)
+
+    def make_row_fn(self, eval_layer: str, sv_samples=None, use_partial=None):
+        """Draw fresh permutations (fixed across batches, reference
+        shapley_values.py:45-47), bind them, and return a plain
+        ``(params, state, x, y) -> rows`` function (also used by the
+        distributed scorer)."""
+        S = sv_samples if sv_samples is not None else self.sv_samples
+        partial = use_partial if use_partial is not None else self.use_partial
+        n = self.n_units(eval_layer)
+        self._calls += 1
+        key = jax.random.fold_in(jax.random.PRNGKey(self.seed), self._calls)
+        perms = jax.vmap(lambda k: jax.random.permutation(k, n))(
+            jax.random.split(key, S)
+        )
+        fn = shapley_rows_fn(self.model, eval_layer, self.loss_fn, partial)
+        return lambda params, state, x, y: fn(params, state, x, y, perms)

@@ -1,0 +1,49 @@
+"""Data-free metrics: Random and WeightNorm.
+
+Reference: torchpruner/attributions/methods/random.py and weight_norm.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.attributions.base import AttributionMetric
+
+
+class RandomAttributionMetric(AttributionMetric):
+    """Uniform random scores; the control baseline (reference random.py:5-13).
+
+    Randomness flows through an explicit PRNG key (deterministic given
+    ``seed``; a fresh subkey per call)."""
+
+    shiftable = False
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._calls = 0
+
+    def run(self, layer, *, find_best_evaluation_layer=False, **kw):
+        spec = self.model.layer(layer)
+        n = L.n_units(spec)
+        self._calls += 1
+        key = jax.random.fold_in(jax.random.PRNGKey(self.seed), self._calls)
+        return np.asarray(jax.random.uniform(key, (n,)))
+
+
+class WeightNormAttributionMetric(AttributionMetric):
+    """L1 norm of each unit's incoming weights (Li et al., ICLR 2017;
+    reference weight_norm.py:13-19: abs then sum all non-out axes)."""
+
+    shiftable = False
+
+    def run(self, layer, *, find_best_evaluation_layer=False, **kw):
+        spec = self.model.layer(layer)
+        w = self.params[layer]["w"]
+        if isinstance(spec, L.Dense):  # (in, out)
+            return np.asarray(jnp.abs(w).sum(axis=0))
+        if isinstance(spec, L.Conv):  # HWIO
+            return np.asarray(jnp.abs(w).sum(axis=(0, 1, 2)))
+        raise TypeError(f"no weights to score on {type(spec).__name__}")

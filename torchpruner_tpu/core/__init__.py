@@ -1,0 +1,1 @@
+"""Core abstractions: layer specs, segmented models, pruning plans, pruner."""

@@ -1,0 +1,173 @@
+"""Pruning-graph inference and the NaN-propagation oracle.
+
+The reference has three separate pruning-graph sources (hand-written
+``get_vgg_pruning_graph``, a notebook re-implementation, and a hardcoded model
+method — reference torchpruner/utils/graph.py:37-61, experiments/models/
+fmnist.py:68-73), and discovers cascade indices dynamically by injecting NaNs
+and running a forward pass (reference pruner/pruner.py:21-57).
+
+Here there is ONE graph API, derived statically from the model spec (we own
+the layer vocabulary, so cascades are computable), with the NaN trick kept as
+an *oracle* used by tests to validate the static analysis — it runs eagerly in
+jnp, outside jit, exactly because NaN-propagation is data-dependent control
+flow XLA should never see.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.plan import AttachedNorm, Consumer, PruneGroup
+from torchpruner_tpu.core.segment import SegmentedModel
+
+#: Activations that evaluation-point shifting may skip over — mirrors the
+#: reference's ACTIVATIONS set (reference torchpruner/utils/graph.py:6).
+SHIFTABLE_ACTIVATIONS = frozenset(
+    {"relu", "relu6", "leaky_relu", "sigmoid", "softplus", "tanh"}
+)
+
+
+def find_best_evaluation_layer(model: SegmentedModel, name: str) -> str:
+    """Walk forward from ``name`` while the next layer is a BatchNorm or a
+    shiftable activation; return the last such layer.  Scoring there measures
+    units where pruning will actually cut — after BN + nonlinearity
+    (reference torchpruner/utils/graph.py:9-34)."""
+    i = model.index(name)
+    best = name
+    for spec in model.layers[i + 1:]:
+        if isinstance(spec, L.BatchNorm) or (
+            isinstance(spec, L.Activation) and spec.fn in SHIFTABLE_ACTIVATIONS
+        ):
+            best = spec.name
+        else:
+            break
+    return best
+
+
+def pruning_graph(
+    model: SegmentedModel, include_output: bool = False
+) -> Tuple[PruneGroup, ...]:
+    """Derive the prune groups of a sequential model, in forward order.
+
+    Each Dense/Conv starts a group; following BatchNorm/Dropout layers attach
+    to it; the next Dense/Conv becomes its consumer, with the in-axis and
+    fan-out determined by the layers in between (Flatten introduces the
+    spatial fan-out).  The reference builds the same structure by scanning
+    ``model.modules()`` (reference utils/graph.py:37-61) and then *re-derives*
+    the index maps at prune time with NaNs; here the fan-out is static.
+
+    ``include_output=False`` drops the final group (the classifier head),
+    matching the reference convention of never pruning the output layer
+    (reference utils/graph.py:59-61).
+    """
+    shapes = model.shapes
+    groups = []
+    current: Optional[dict] = None  # mutable build of the open group
+
+    for i, spec in enumerate(model.layers):
+        if isinstance(spec, L.PRUNABLE_TYPES):
+            if current is not None:
+                fan_out = current["fan_out"]
+                axis = 0 if isinstance(spec, L.Dense) else 2
+                current["consumers"].append(
+                    Consumer(layer=spec.name, param="w", axis=axis, fan_out=fan_out)
+                )
+                groups.append(_close(current))
+            current = {
+                "target": spec.name,
+                "bn": [],
+                "dropout": [],
+                "consumers": [],
+                "fan_out": 1,
+            }
+        elif current is not None:
+            if isinstance(spec, L.BatchNorm):
+                current["bn"].append(
+                    AttachedNorm(spec.name, fan_out=current["fan_out"])
+                )
+            elif isinstance(spec, L.Dropout):
+                current["dropout"].append(spec.name)
+            elif isinstance(spec, L.Flatten):
+                in_shape = shapes[i][0]
+                spatial = 1
+                for d in in_shape[:-1]:
+                    spatial *= d
+                current["fan_out"] *= spatial
+            # Activation / Pool: transparent for unit identity.
+
+    if current is not None:
+        groups.append(_close(current))
+    if not include_output and groups and not groups[-1].consumers:
+        groups = groups[:-1]
+    return tuple(groups)
+
+
+def group_for(model: SegmentedModel, layer: str) -> PruneGroup:
+    """The prune group whose target is ``layer`` (output layer included)."""
+    for g in pruning_graph(model, include_output=True):
+        if g.target == layer:
+            return g
+    raise KeyError(f"{layer!r} is not a prunable layer of this model")
+
+
+def _close(build: dict) -> PruneGroup:
+    return PruneGroup(
+        target=build["target"],
+        attached_bn=tuple(build["bn"]),
+        attached_dropout=tuple(build["dropout"]),
+        consumers=tuple(build["consumers"]),
+    )
+
+
+# ---------------------------------------------------------------------------
+# NaN oracle (validator for the static graph; reference pruner.py:21-57)
+# ---------------------------------------------------------------------------
+
+
+def nan_cascade_oracle(
+    model: SegmentedModel,
+    params,
+    state,
+    target: str,
+    drop: Sequence[int],
+    batch: int = 2,
+    seed: int = 0,
+) -> Dict[str, Tuple[np.ndarray, int]]:
+    """Empirically discover cascade indices by NaN propagation.
+
+    Injects NaN at the dropped unit positions of ``target``'s output, runs the
+    model eagerly (eval mode, no jit), and reports for every *prunable or
+    normalizing* downstream layer the NaN-tainted input positions along its
+    unit axis, as ``{layer_name: (in_indices, original_len)}`` — the same
+    contract as the reference's ``_detect_nan_hook`` (reference
+    pruner.py:146-168).  Used in tests to validate :func:`pruning_graph`.
+    """
+    x = jax.random.normal(
+        jax.random.PRNGKey(seed), (batch,) + tuple(model.input_shape)
+    )
+    drop = jnp.asarray(np.asarray(drop, dtype=np.int64))
+    report: Dict[str, Tuple[np.ndarray, int]] = {}
+    ti = model.index(target)
+
+    detect_types = (L.Dense, L.Conv, L.BatchNorm, L.Dropout)
+    for i, spec in enumerate(model.layers):
+        if i > ti and isinstance(spec, detect_types):
+            flat = x
+            if flat.ndim > 2:
+                # sum out batch + spatial, keep the trailing unit axis
+                flat = flat.reshape(flat.shape[0], -1, flat.shape[-1])
+            summed = jnp.sum(flat, axis=tuple(range(flat.ndim - 1)))
+            nan_idx = np.asarray(jnp.nonzero(jnp.isnan(summed))[0])
+            if nan_idx.size:
+                report[spec.name] = (nan_idx, int(summed.shape[0]))
+        p = params.get(spec.name, {})
+        s = state.get(spec.name, {}) if state else {}
+        x, _ = L.apply_layer(spec, p, s, x, train=False)
+        if i == ti and drop.size:
+            x = x.at[..., drop].set(jnp.nan)
+    return report

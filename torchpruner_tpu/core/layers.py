@@ -1,0 +1,316 @@
+"""Layer specifications and their functional init/apply rules.
+
+Design notes (TPU-first):
+
+- All activation layouts are **channels-last** (``NHWC`` for images, ``(B, F)``
+  for vectors).  The prunable *unit* axis is therefore always the **last** axis
+  of an activation, so unit masking, Shapley scans and flatten fan-out maps are
+  uniform across Dense and Conv layers.  (The reference library works on torch's
+  ``NCHW`` and hardcodes "dim 1" everywhere, e.g. reference
+  torchpruner/pruner/pruner.py:129-168; channels-last is both the natural JAX
+  convention and what XLA tiles best onto the MXU.)
+- Layer specs are frozen, hashable dataclasses.  A model spec is static data:
+  it can key jit caches, and *changing* it (pruning!) naturally triggers
+  retracing at the new shapes.
+- Parameters and mutable state (BatchNorm running statistics) are plain
+  pytrees ``{layer_name: {param_name: array}}``; apply rules are pure
+  functions ``(spec, params, state, x) -> (y, new_state)``.
+
+Parameter layouts:
+
+- Dense: ``w`` is ``(in, out)``, ``b`` is ``(out,)``.  Out-prune = axis 1 of
+  ``w`` / axis 0 of ``b``; in-prune = axis 0 of ``w``.
+- Conv: ``w`` is ``HWIO``, ``b`` is ``(out,)``.  Out-prune = axis 3; in-prune
+  = axis 2.  (Reference prunes torch ``OIHW`` axis 0 / axis 1, reference
+  pruner.py:81-85.)
+- BatchNorm: ``scale``/``bias`` params and ``mean``/``var`` state, all
+  ``(features,)`` — in-pruned along axis 0 (reference pruner.py:86-90).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# ---------------------------------------------------------------------------
+# Layer specs
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Dense:
+    """Fully-connected layer. Prunable (out units = features)."""
+
+    name: str
+    features: int
+    use_bias: bool = True
+
+
+@dataclass(frozen=True)
+class Conv:
+    """2-D convolution, NHWC/HWIO. Prunable (out units = channels)."""
+
+    name: str
+    features: int
+    kernel_size: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    padding: str = "SAME"  # "SAME" | "VALID"
+    use_bias: bool = True
+
+
+@dataclass(frozen=True)
+class BatchNorm:
+    """Batch normalization over the last axis; functional running stats.
+
+    ``decay`` is the running-average retention factor:
+    ``new_running = decay * running + (1 - decay) * batch_stat``.
+    """
+
+    name: str
+    decay: float = 0.9
+    eps: float = 1e-5
+
+
+#: Activation function registry. Mirrors the reference's ACTIVATIONS set
+#: (reference torchpruner/utils/graph.py:6) for evaluation-point shifting.
+ACTIVATION_FNS: dict = {
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "leaky_relu": jax.nn.leaky_relu,  # slope 0.01, same default as torch
+    "sigmoid": jax.nn.sigmoid,
+    "softplus": jax.nn.softplus,
+    "tanh": jnp.tanh,
+    "gelu": jax.nn.gelu,
+    "silu": jax.nn.silu,
+    "identity": lambda x: x,
+}
+
+
+@dataclass(frozen=True)
+class Activation:
+    name: str
+    fn: str = "relu"
+
+    def __post_init__(self):
+        if self.fn not in ACTIVATION_FNS:
+            raise ValueError(f"unknown activation {self.fn!r}")
+
+
+@dataclass(frozen=True)
+class Pool:
+    """2-D max/avg pooling on NHWC."""
+
+    name: str
+    kind: str = "max"  # "max" | "avg"
+    window: Tuple[int, int] = (2, 2)
+    strides: Optional[Tuple[int, int]] = None  # default: == window
+
+
+@dataclass(frozen=True)
+class Flatten:
+    """Flatten all non-batch axes, row-major: (B,H,W,C) -> (B, H*W*C).
+
+    With channels-last, channel ``c`` of the input maps to flat indices
+    ``{p * C + c : p in range(H*W)}`` — the fan-out map used when a pruned
+    conv channel cascades into a Dense consumer (the case the reference
+    discovers with its NaN trick, reference tests/test_pruner.py:83-92).
+    """
+
+    name: str
+
+
+@dataclass(frozen=True)
+class Dropout:
+    """Dropout. ``rate`` is the drop probability; rescaled on pruning so the
+    expected number of active units is preserved (reference pruner.py:117-127).
+    """
+
+    name: str
+    rate: float = 0.5
+
+
+LayerSpec = Any  # union of the above dataclasses
+
+PRUNABLE_TYPES = (Dense, Conv)  # can be out-pruned (reference pruner.py:11)
+ATTACHABLE_TYPES = (BatchNorm, Dropout)  # in-pruned alongside a producer
+
+
+# ---------------------------------------------------------------------------
+# init rules: (spec, key, in_shape) -> (params, state, out_shape)
+# in_shape/out_shape exclude the batch dimension.
+# ---------------------------------------------------------------------------
+
+
+def _kaiming(key, shape, fan_in, dtype):
+    return jax.random.normal(key, shape, dtype) * jnp.sqrt(2.0 / fan_in)
+
+
+def out_shape(spec: LayerSpec, in_shape: Tuple[int, ...]) -> Tuple[int, ...]:
+    """The single source of truth for per-layer output shapes (batch dim
+    excluded) — used by init, by ``SegmentedModel.shapes``, and by the
+    pruning-graph fan-out computation."""
+    if isinstance(spec, Dense):
+        return (spec.features,)
+    if isinstance(spec, Conv):
+        h, w = in_shape[0], in_shape[1]
+        oh, ow = _conv_out_hw((h, w), spec)
+        return (oh, ow, spec.features)
+    if isinstance(spec, Pool):
+        strides = spec.strides or spec.window
+        oh = (in_shape[0] - spec.window[0]) // strides[0] + 1
+        ow = (in_shape[1] - spec.window[1]) // strides[1] + 1
+        return (oh, ow) + tuple(in_shape[2:])
+    if isinstance(spec, Flatten):
+        size = 1
+        for d in in_shape:
+            size *= d
+        return (size,)
+    return tuple(in_shape)
+
+
+def init_layer(spec: LayerSpec, key, in_shape: Tuple[int, ...], dtype=jnp.float32):
+    """Initialize one layer. Returns ``(params, state, out_shape)``; ``params``
+    / ``state`` are ``{}`` for parameter-free / stateless layers."""
+    if isinstance(spec, Dense):
+        if len(in_shape) != 1:
+            raise ValueError(
+                f"Dense {spec.name!r} expects flat input, got shape {in_shape}"
+            )
+        kw, _ = jax.random.split(key)
+        params = {"w": _kaiming(kw, (in_shape[0], spec.features), in_shape[0], dtype)}
+        if spec.use_bias:
+            params["b"] = jnp.zeros((spec.features,), dtype)
+        return params, {}, out_shape(spec, in_shape)
+
+    if isinstance(spec, Conv):
+        if len(in_shape) != 3:
+            raise ValueError(
+                f"Conv {spec.name!r} expects HWC input, got shape {in_shape}"
+            )
+        h, w, c = in_shape
+        kh, kw_ = spec.kernel_size
+        fan_in = kh * kw_ * c
+        k1, _ = jax.random.split(key)
+        params = {"w": _kaiming(k1, (kh, kw_, c, spec.features), fan_in, dtype)}
+        if spec.use_bias:
+            params["b"] = jnp.zeros((spec.features,), dtype)
+        return params, {}, out_shape(spec, in_shape)
+
+    if isinstance(spec, BatchNorm):
+        f = in_shape[-1]
+        params = {"scale": jnp.ones((f,), dtype), "bias": jnp.zeros((f,), dtype)}
+        state = {"mean": jnp.zeros((f,), dtype), "var": jnp.ones((f,), dtype)}
+        return params, state, in_shape
+
+    if isinstance(spec, (Pool, Flatten, Activation, Dropout)):
+        return {}, {}, out_shape(spec, in_shape)
+
+    raise TypeError(f"unknown layer spec {type(spec)}")
+
+
+def _conv_out_hw(hw, spec: Conv):
+    h, w = hw
+    sh, sw = spec.strides
+    if spec.padding == "SAME":
+        return -(-h // sh), -(-w // sw)
+    kh, kw = spec.kernel_size
+    return (h - kh) // sh + 1, (w - kw) // sw + 1
+
+
+# ---------------------------------------------------------------------------
+# apply rules: (spec, params, state, x, train, rng) -> (y, new_state)
+# ---------------------------------------------------------------------------
+
+
+def apply_layer(
+    spec: LayerSpec,
+    params,
+    state,
+    x,
+    *,
+    train: bool = False,
+    rng=None,
+):
+    """Apply one layer. Pure; returns ``(y, new_state)``."""
+    if isinstance(spec, Dense):
+        y = x @ params["w"]
+        if "b" in params:
+            y = y + params["b"]
+        return y, state
+
+    if isinstance(spec, Conv):
+        y = lax.conv_general_dilated(
+            x,
+            params["w"],
+            window_strides=spec.strides,
+            padding=spec.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if "b" in params:
+            y = y + params["b"]
+        return y, state
+
+    if isinstance(spec, BatchNorm):
+        if train:
+            axes = tuple(range(x.ndim - 1))
+            mean = jnp.mean(x, axes)
+            var = jnp.var(x, axes)
+            new_state = {
+                "mean": spec.decay * state["mean"] + (1 - spec.decay) * mean,
+                "var": spec.decay * state["var"] + (1 - spec.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = lax.rsqrt(var + spec.eps)
+        y = (x - mean) * inv * params["scale"] + params["bias"]
+        return y, new_state
+
+    if isinstance(spec, Activation):
+        return ACTIVATION_FNS[spec.fn](x), state
+
+    if isinstance(spec, Pool):
+        strides = spec.strides or spec.window
+        window = (1,) + tuple(spec.window) + (1,)
+        strides_ = (1,) + tuple(strides) + (1,)
+        if spec.kind == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides_, "VALID")
+        elif spec.kind == "avg":
+            y = lax.reduce_window(x, 0.0, lax.add, window, strides_, "VALID")
+            y = y / (spec.window[0] * spec.window[1])
+        else:
+            raise ValueError(f"unknown pool kind {spec.kind!r}")
+        return y, state
+
+    if isinstance(spec, Flatten):
+        return x.reshape(x.shape[0], -1), state
+
+    if isinstance(spec, Dropout):
+        if not train or spec.rate == 0.0:
+            return x, state
+        if rng is None:
+            raise ValueError(f"Dropout {spec.name!r} needs an rng in train mode")
+        keep = 1.0 - spec.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0), state
+
+    raise TypeError(f"unknown layer spec {type(spec)}")
+
+
+def n_units(spec: LayerSpec) -> int:
+    """Number of prunable output units of a prunable layer."""
+    if isinstance(spec, (Dense, Conv)):
+        return spec.features
+    raise TypeError(f"{type(spec).__name__} has no prunable units")
+
+
+def with_features(spec: LayerSpec, features: int) -> LayerSpec:
+    """Return a copy of a prunable spec with a new unit count."""
+    if isinstance(spec, (Dense, Conv)):
+        return dataclasses.replace(spec, features=features)
+    raise TypeError(f"{type(spec).__name__} has no feature count")

@@ -1,0 +1,239 @@
+"""Pruning plans — declarative descriptions of structural surgery.
+
+The reference *discovers* what to slice at run time with its NaN trick and
+mutates live tensors in place (reference torchpruner/pruner/pruner.py:21-115).
+Here the same knowledge is a static datatype:
+
+- a :class:`ParamSlice` names one array (by pytree path), the axis holding the
+  unit dimension, and a ``fan_out`` factor for flattened consumers;
+- a :class:`PruneGroup` bundles the slices implied by pruning one producer
+  layer: its own out-params, attached BatchNorm/Dropout, and consumer
+  in-params;
+- :func:`apply_plan` executes the slices functionally with ``jnp.take`` over
+  arbitrary pytrees (params, BN state, optax optimizer state).
+
+Plans are model-family-agnostic: sequential ``SegmentedModel`` graphs are
+*inferred* (core/graph.py), while non-sequential families (transformer FFN /
+attention-head pruning) declare their groups explicitly with pytree paths.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+Path = Tuple[Any, ...]  # keys into a nested-dict pytree
+
+
+@dataclass(frozen=True)
+class ParamSlice:
+    """Slice one array along ``axis``, keeping the rows for surviving units.
+
+    ``fan_out > 1`` means each producer unit ``u`` owns ``fan_out`` contiguous
+    *strided* positions ``{p * n_units + u}`` along the axis — the
+    channels-last flatten map (a conv channel fanning out into H*W inputs of a
+    Dense consumer; the case the reference resolves dynamically in
+    tests/test_pruner.py:83-92).
+
+    ``collection`` selects which pytree the path indexes: ``"params"`` or
+    ``"state"`` (BatchNorm running statistics).
+    """
+
+    path: Path
+    axis: int
+    fan_out: int = 1
+    collection: str = "params"
+    #: optional slices (e.g. a bias that may be absent with use_bias=False)
+    #: are skipped silently; any other unresolvable path is an error.
+    optional: bool = False
+
+
+@dataclass(frozen=True)
+class Consumer:
+    """A downstream layer whose *input* units cascade from the target."""
+
+    layer: str
+    param: str = "w"
+    axis: int = 0
+    fan_out: int = 1
+
+
+@dataclass(frozen=True)
+class AttachedNorm:
+    """A normalization layer sliced alongside the target.  ``fan_out > 1``
+    when the norm sits after a Flatten (its feature axis then holds
+    ``fan_out`` positions per producer unit)."""
+
+    layer: str
+    fan_out: int = 1
+
+
+@dataclass(frozen=True)
+class PruneGroup:
+    """Everything that must change when units of ``target`` are pruned."""
+
+    target: str
+    attached_bn: Tuple[AttachedNorm, ...] = ()
+    attached_dropout: Tuple[str, ...] = ()
+    consumers: Tuple[Consumer, ...] = ()
+
+
+@dataclass(frozen=True)
+class PrunePlan:
+    """A fully-resolved set of slices for one prune step.
+
+    ``n_units`` is the producer's current width; ``slices`` all refer to unit
+    indices in ``range(n_units)``.
+    """
+
+    n_units: int
+    slices: Tuple[ParamSlice, ...]
+
+
+def keep_indices(n_units: int, drop: Sequence[int]) -> np.ndarray:
+    """Complement of ``drop`` in ``range(n_units)`` (sorted). Mirrors the
+    boolean-mask construction in reference pruner.py:100-105."""
+    mask = np.ones(n_units, dtype=bool)
+    drop = np.unique(np.asarray(drop, dtype=np.int64))
+    if drop.size:
+        if drop.min() < 0 or drop.max() >= n_units:
+            raise IndexError(
+                f"drop indices out of range [0, {n_units}): {drop}"
+            )
+        mask[drop] = False
+    return np.arange(n_units)[mask]
+
+
+def expand_keep(keep: np.ndarray, n_units: int, fan_out: int) -> np.ndarray:
+    """Expand unit keep-indices through a fan-out map: kept positions are
+    ``{p * n_units + u : p in range(fan_out), u in keep}``, sorted ascending
+    (which preserves the original memory order of a channels-last flatten)."""
+    if fan_out == 1:
+        return keep
+    return (np.arange(fan_out)[:, None] * n_units + keep[None, :]).reshape(-1)
+
+
+def _get_path(tree, path: Path):
+    node = tree
+    for k in path:
+        node = node[k]
+    return node
+
+
+def _set_path(tree, path: Path, value):
+    """Functional set: returns a copy of ``tree`` with ``tree[path] = value``.
+    Works on nested dicts / lists / tuples."""
+    if not path:
+        return value
+    k, rest = path[0], path[1:]
+    if isinstance(tree, dict):
+        new = dict(tree)
+        new[k] = _set_path(tree[k], rest, value)
+        return new
+    if isinstance(tree, (list, tuple)):
+        seq = list(tree)
+        seq[k] = _set_path(seq[k], rest, value)
+        return type(tree)(seq) if not isinstance(tree, list) else seq
+    raise TypeError(f"cannot set path {path} in {type(tree)}")
+
+
+def apply_plan(
+    plan: PrunePlan,
+    drop: Sequence[int],
+    params,
+    state=None,
+    opt_state=None,
+):
+    """Execute a plan: slice every listed array, plus any matching arrays in
+    the optimizer state (momentum / Adam moments / anything params-shaped —
+    strictly more general than the reference's SGD-only optimizer pruning,
+    reference pruner/opt_pruner.py:4-19).
+
+    Returns ``(params', state', opt_state')`` (the latter two may be None if
+    not given).
+    """
+    keep = keep_indices(plan.n_units, drop)
+
+    # (path -> (axis, expanded keep, old_shape)) for optimizer-state matching.
+    param_slices: Dict[Tuple[str, ...], Tuple[int, np.ndarray, Tuple[int, ...]]] = {}
+
+    new_params, new_state = params, state
+    for s in plan.slices:
+        tree = new_params if s.collection == "params" else new_state
+        if tree is None:
+            if not s.optional:
+                raise KeyError(
+                    f"plan slice {s.path} targets collection "
+                    f"{s.collection!r}, but none was provided"
+                )
+            continue
+        try:
+            arr = _get_path(tree, s.path)
+        except (KeyError, IndexError, TypeError):
+            if not s.optional:
+                raise KeyError(
+                    f"plan slice path {s.path} does not resolve in "
+                    f"{s.collection}"
+                )
+            continue  # e.g. bias absent (use_bias=False)
+        idx = expand_keep(keep, plan.n_units, s.fan_out)
+        if arr.shape[s.axis] != plan.n_units * s.fan_out:
+            raise ValueError(
+                f"plan mismatch at {s.path}: axis {s.axis} has size "
+                f"{arr.shape[s.axis]}, expected {plan.n_units * s.fan_out}"
+            )
+        sliced = jnp.take(arr, idx, axis=s.axis)
+        if s.collection == "params":
+            param_slices[tuple(str(k) for k in s.path)] = (s.axis, idx, arr.shape)
+            new_params = _set_path(new_params, s.path, sliced)
+        else:
+            new_state = _set_path(new_state, s.path, sliced)
+
+    new_opt_state = opt_state
+    if opt_state is not None:
+        new_opt_state = _slice_opt_state(opt_state, param_slices)
+    return new_params, new_state, new_opt_state
+
+
+def _key_name(k) -> str:
+    """Human key for a tree_flatten_with_path key entry."""
+    if isinstance(k, jax.tree_util.DictKey):
+        return str(k.key)
+    if isinstance(k, jax.tree_util.SequenceKey):
+        return str(k.idx)
+    if isinstance(k, jax.tree_util.GetAttrKey):
+        return str(k.name)
+    if isinstance(k, jax.tree_util.FlattenedIndexKey):
+        return str(k.key)
+    return str(k)
+
+
+def _slice_opt_state(opt_state, param_slices):
+    """Slice every optimizer-state leaf whose pytree path *ends with* a pruned
+    parameter's path and whose shape matches the pre-slice parameter shape.
+
+    Optax states mirror the params tree (e.g. ``TraceState.trace['fc1']['w']``,
+    ``ScaleByAdamState.mu[...]``), so suffix-matching the path plus a shape
+    check identifies exactly the params-like leaves; scalars like Adam's
+    ``count`` fall through untouched.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(opt_state)
+    new_leaves = []
+    for path, leaf in leaves:
+        names = tuple(_key_name(k) for k in path)
+        replaced = leaf
+        if hasattr(leaf, "shape"):
+            for ppath, (axis, idx, old_shape) in param_slices.items():
+                if (
+                    len(names) >= len(ppath)
+                    and names[-len(ppath):] == ppath
+                    and tuple(leaf.shape) == tuple(old_shape)
+                ):
+                    replaced = jnp.take(leaf, idx, axis=axis)
+                    break
+        new_leaves.append(replaced)
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)

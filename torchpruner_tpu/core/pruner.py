@@ -1,0 +1,175 @@
+"""Functional pruner: ``prune`` maps (model, params, state, opt_state) to new,
+smaller pytrees plus an updated static model spec.
+
+The reference mutates live tensors in place and relies on object identity so
+training "just continues" (reference torchpruner/pruner/pruner.py:94-115,
+README "on-the-fly").  Under XLA the honest equivalent is re-instantiation:
+new static shapes, one retrace/recompile per prune step — accepted and
+measured as part of the workflow (SURVEY.md §7 "Recompilation economics").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core import graph as G
+from torchpruner_tpu.core.plan import (
+    Consumer,
+    ParamSlice,
+    PruneGroup,
+    PrunePlan,
+    apply_plan,
+)
+from torchpruner_tpu.core.segment import SegmentedModel
+
+
+@dataclass
+class PruneResult:
+    model: SegmentedModel
+    params: Any
+    state: Any = None
+    opt_state: Any = None
+
+    def __iter__(self):  # allow tuple-unpacking
+        return iter((self.model, self.params, self.state, self.opt_state))
+
+
+def plan_for_group(model: SegmentedModel, group: PruneGroup) -> PrunePlan:
+    """Resolve a PruneGroup against a sequential model into a concrete plan.
+
+    Slice table (cf. reference pruner.py:59-92):
+      - target Dense: ``w`` axis 1, ``b`` axis 0; target Conv: ``w`` axis 3,
+        ``b`` axis 0  (out-pruning)
+      - attached BatchNorm: ``scale``/``bias`` params axis 0 and
+        ``mean``/``var`` state axis 0  (in-pruning)
+      - consumers: Dense ``w`` axis 0 / Conv ``w`` axis 2, with flatten
+        fan-out  (in-pruning)
+    """
+    target = model.layer(group.target)
+    n = L.n_units(target)
+    out_axis = 1 if isinstance(target, L.Dense) else 3
+    slices = [
+        ParamSlice((group.target, "w"), axis=out_axis),
+        ParamSlice((group.target, "b"), axis=0, optional=True),
+    ]
+    for bn in group.attached_bn:
+        f = bn.fan_out
+        slices += [
+            ParamSlice((bn.layer, "scale"), axis=0, fan_out=f),
+            ParamSlice((bn.layer, "bias"), axis=0, fan_out=f),
+            ParamSlice((bn.layer, "mean"), axis=0, fan_out=f, collection="state"),
+            ParamSlice((bn.layer, "var"), axis=0, fan_out=f, collection="state"),
+        ]
+    for c in group.consumers:
+        slices.append(
+            ParamSlice((c.layer, c.param), axis=c.axis, fan_out=c.fan_out)
+        )
+    return PrunePlan(n_units=n, slices=tuple(slices))
+
+
+def prune(
+    model: SegmentedModel,
+    params,
+    layer: Union[str, PruneGroup],
+    drop: Sequence[int],
+    *,
+    state=None,
+    opt_state=None,
+) -> PruneResult:
+    """Prune units ``drop`` from prunable layer ``layer`` (or an explicit
+    group), cascading into attached BN/Dropout and consumer layers.
+
+    Equivalent of ``Pruner.prune_model`` (reference pruner.py:21-57) with the
+    cascade resolved statically instead of via NaN propagation, and optimizer
+    state sliced for *any* optax optimizer rather than SGD only.
+    """
+    group = layer if isinstance(layer, PruneGroup) else G.group_for(model, layer)
+    drop = np.unique(np.asarray(drop, dtype=np.int64).reshape(-1))
+    plan = plan_for_group(model, group)
+    new_params, new_state, new_opt = apply_plan(
+        plan, drop, params, state=state, opt_state=opt_state
+    )
+
+    # Rebuild the static spec: smaller target width, rescaled dropout rates.
+    target = model.layer(group.target)
+    new_model = model.replace_layer(
+        group.target, L.with_features(target, L.n_units(target) - len(drop))
+    )
+    for d_name in group.attached_dropout:
+        d = model.layer(d_name)
+        # Preserve expected active-unit count (reference pruner.py:117-127).
+        new_rate = d.rate * (1.0 - len(drop) / plan.n_units)
+        new_model = new_model.replace_layer(
+            d_name, dataclasses.replace(d, rate=new_rate)
+        )
+    return PruneResult(new_model, new_params, new_state, new_opt)
+
+
+def prune_by_scores(
+    model: SegmentedModel,
+    params,
+    layer: str,
+    scores: np.ndarray,
+    *,
+    policy: Union[str, Callable[[np.ndarray], np.ndarray]] = "negative",
+    fraction: float = 0.5,
+    state=None,
+    opt_state=None,
+) -> PruneResult:
+    """Score→indices policy + prune in one call.
+
+    The reference deliberately leaves this policy in user code
+    (``np.argwhere(attr < 0)``, SURVEY.md §1); this helper packages the two
+    common policies while :func:`prune` keeps the raw-indices API.
+
+    - ``policy="negative"``: drop all units with score < 0
+    - ``policy="fraction"``: drop the lowest-scoring ``fraction`` of units
+    - callable: ``policy(scores) -> drop indices``
+    """
+    scores = np.asarray(scores)
+    if callable(policy):
+        drop = np.asarray(policy(scores), dtype=np.int64)
+    elif policy == "negative":
+        drop = np.argwhere(scores < 0).flatten()
+    elif policy == "fraction":
+        k = int(len(scores) * fraction)
+        drop = np.argsort(scores)[:k]
+    else:
+        raise ValueError(f"unknown policy {policy!r}")
+    if len(drop) >= len(scores):
+        drop = drop[: len(scores) - 1]  # never remove a whole layer
+    return prune(model, params, layer, drop, state=state, opt_state=opt_state)
+
+
+class Pruner:
+    """Stateful convenience wrapper mirroring the reference's ``Pruner`` API
+    (reference pruner.py:14-57) over the functional core: holds the current
+    ``(model, params, state, opt_state)`` bundle and replaces them on each
+    ``prune_model`` call."""
+
+    def __init__(self, model: SegmentedModel, params, state=None, opt_state=None):
+        self.model = model
+        self.params = params
+        self.state = state
+        self.opt_state = opt_state
+
+    def prune_model(
+        self,
+        layer: Union[str, PruneGroup],
+        indices: Sequence[int],
+    ) -> PruneResult:
+        res = prune(
+            self.model,
+            self.params,
+            layer,
+            indices,
+            state=self.state,
+            opt_state=self.opt_state,
+        )
+        self.model, self.params, self.state, self.opt_state = res
+        return res

@@ -1,0 +1,198 @@
+"""SegmentedModel — the core abstraction of the framework.
+
+A model is an immutable, ordered pipeline of layer specs.  Any contiguous
+*segment* of the pipeline is itself a pure function, so the reference's
+``forward_partial(x, from_module, to_module)`` convention (reference
+torchpruner/attributions/attributions.py:70-89, experiments/models/cifar10.py:39-59)
+becomes first-class: ``model.apply(..., from_layer=a, to_layer=b)`` runs the
+segment *after* ``a`` up to and including ``b``, and :func:`segment_fn` hands
+back a cached, jit-compatible closure for any segment.
+
+Being a frozen dataclass of frozen dataclasses, a ``SegmentedModel`` is
+hashable: it keys jit/compile caches, and pruning produces a *new* spec whose
+segments recompile at the new static shapes — the XLA-honest equivalent of the
+reference's in-place tensor surgery.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchpruner_tpu.core import layers as L
+
+
+@dataclass(frozen=True)
+class SegmentedModel:
+    """An ordered pipeline of layer specs with named layers.
+
+    ``input_shape`` excludes the batch dimension and is channels-last
+    (e.g. ``(28, 28, 1)`` or ``(784,)``).
+    """
+
+    layers: Tuple[L.LayerSpec, ...]
+    input_shape: Tuple[int, ...]
+
+    def __post_init__(self):
+        names = [l.name for l in self.layers]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate layer names in {names}")
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(l.name for l in self.layers)
+
+    def layer(self, name: str) -> L.LayerSpec:
+        for l in self.layers:
+            if l.name == name:
+                return l
+        raise KeyError(name)
+
+    def index(self, name: str) -> int:
+        for i, l in enumerate(self.layers):
+            if l.name == name:
+                return i
+        raise KeyError(name)
+
+    @functools.cached_property
+    def shapes(self) -> Tuple[Tuple[Tuple[int, ...], Tuple[int, ...]], ...]:
+        """Per-layer ``(in_shape, out_shape)`` (batch dim excluded), inferred
+        statically from the specs — the metadata the reference obtains
+        dynamically with its NaN-trick forward (reference pruner.py:170-185)."""
+        out = []
+        shape = tuple(self.input_shape)
+        for spec in self.layers:
+            out_shape = L.out_shape(spec, shape)
+            out.append((shape, out_shape))
+            shape = out_shape
+        return tuple(out)
+
+    def out_shape(self, name: Optional[str] = None) -> Tuple[int, ...]:
+        """Output shape (batch excluded) of layer ``name`` (default: last)."""
+        if name is None:
+            return self.shapes[-1][1]
+        return self.shapes[self.index(name)][1]
+
+    # -- functional init / apply -------------------------------------------
+
+    def init(self, key, dtype=jnp.float32):
+        """Initialize ``(params, state)`` pytrees:
+        ``params[layer_name][param_name]`` / ``state[layer_name][stat_name]``.
+        Layers without params/state are omitted from the dicts."""
+        params: Dict[str, Any] = {}
+        state: Dict[str, Any] = {}
+        shape = tuple(self.input_shape)
+        for spec in self.layers:
+            key, sub = jax.random.split(key)
+            p, s, shape = L.init_layer(spec, sub, shape, dtype)
+            if p:
+                params[spec.name] = p
+            if s:
+                state[spec.name] = s
+        return params, state
+
+    def apply(
+        self,
+        params,
+        x,
+        *,
+        state=None,
+        train: bool = False,
+        rng=None,
+        from_layer: Optional[str] = None,
+        to_layer: Optional[str] = None,
+        unit_mask: Optional[Tuple[str, Any]] = None,
+        capture: Optional[str] = None,
+    ):
+        """Run the segment after ``from_layer`` through ``to_layer`` inclusive.
+
+        - ``from_layer=None`` starts at the input; otherwise ``x`` must be the
+          *output* of ``from_layer`` (reference forward_partial semantics).
+        - ``unit_mask=(name, vec)`` multiplies the output of layer ``name`` by
+          ``vec`` along the last (unit) axis — the functional replacement for
+          the reference's masking forward hook (reference
+          shapley_values.py:92-99).
+        - ``capture=name`` additionally returns the activation at ``name``.
+
+        Returns ``(y, new_state)``, or ``(y, new_state, captured)`` when
+        ``capture`` is given.
+        """
+        state = state if state is not None else {}
+        start = 0 if from_layer is None else self.index(from_layer) + 1
+        stop = len(self.layers) if to_layer is None else self.index(to_layer) + 1
+        if start >= stop and not (start == stop == len(self.layers)):
+            if from_layer is not None and to_layer is not None:
+                raise ValueError(
+                    f"empty segment: from {from_layer!r} to {to_layer!r}"
+                )
+        new_state = dict(state)
+        captured = None
+        for spec in self.layers[start:stop]:
+            p = params.get(spec.name, {})
+            s = state.get(spec.name, {})
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            else:
+                sub = None
+            x, s2 = L.apply_layer(spec, p, s, x, train=train, rng=sub)
+            if unit_mask is not None and spec.name == unit_mask[0]:
+                x = x * unit_mask[1]
+            if s2 is not s and s2:
+                new_state[spec.name] = s2
+            if capture is not None and spec.name == capture:
+                captured = x
+        if capture is not None:
+            return x, new_state, captured
+        return x, new_state
+
+    # -- pruning-adjacent helpers ------------------------------------------
+
+    def replace_layer(self, name: str, new_spec: L.LayerSpec) -> "SegmentedModel":
+        new_layers = tuple(
+            new_spec if l.name == name else l for l in self.layers
+        )
+        return SegmentedModel(new_layers, self.input_shape)
+
+    def widths(self) -> Dict[str, int]:
+        """Current unit count of every prunable layer — the architecture
+        metadata a checkpoint must carry (SURVEY.md §5.4)."""
+        return {
+            l.name: l.features
+            for l in self.layers
+            if isinstance(l, L.PRUNABLE_TYPES)
+        }
+
+
+def init_model(model: SegmentedModel, seed: int = 0, dtype=jnp.float32):
+    """Convenience: init from an integer seed."""
+    return model.init(jax.random.PRNGKey(seed), dtype)
+
+
+@functools.lru_cache(maxsize=512)
+def segment_fn(
+    model: SegmentedModel,
+    from_layer: Optional[str] = None,
+    to_layer: Optional[str] = None,
+    train: bool = False,
+):
+    """A cached pure closure for a model segment:
+    ``fn(params, state, x) -> (y, new_state)``.
+
+    Cached on the (hashable) model spec so repeated calls reuse one traced
+    function object — jit caches stay warm across attribution passes and only
+    invalidate when pruning produces a new spec.
+    """
+
+    def fn(params, state, x):
+        return model.apply(
+            params, x, state=state, train=train,
+            from_layer=from_layer, to_layer=to_layer,
+        )
+
+    return fn

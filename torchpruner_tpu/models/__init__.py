@@ -1,0 +1,9 @@
+"""Model zoo: segmented models mirroring the reference's experiments/models/
+plus the analytic test fixture."""
+
+from torchpruner_tpu.models.analytic import max_model
+from torchpruner_tpu.models.mlp import mnist_fc, cifar10_fc
+from torchpruner_tpu.models.convnet import fmnist_convnet
+from torchpruner_tpu.models.vgg import vgg16_bn
+
+__all__ = ["max_model", "mnist_fc", "cifar10_fc", "fmnist_convnet", "vgg16_bn"]

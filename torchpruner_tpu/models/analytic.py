@@ -1,0 +1,61 @@
+"""Analytic ``max_model`` fixture — hand-weighted 2→4→1 ReLU net computing
+``max(x1, x2)`` on four symmetric inputs, with exactly derivable ground-truth
+attributions (the crown-jewel fixture of the reference test suite, reference
+torchpruner/tests/test_attributions.py:19-45).
+
+Hidden units (columns of w1): A = relu(-x1/2 + x2/2), B = relu(x1 - x2),
+C = relu(x1 + x2), D = relu(x1 + x2).  Output = A + B/2 + C/2 + w_D·D, which
+equals max(x1, x2) when w_D = 0 (version 1).  Version 2 gives the redundant
+unit D a small negative outgoing weight (-0.1), making its
+sensitivity/Taylor/Shapley attributions nonzero and hand-checkable.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.segment import SegmentedModel
+
+
+def max_model(version: int = 1):
+    """Returns ``(model, params, x, y)``.
+
+    The four symmetric data points and expected ground truths (with MSE loss,
+    batch size 1, reduction "mean"):
+      weight-norm [1, 2, 2, 2]; APoZ [.5, .5, 1, 1]; sensitivity/taylor all 0
+      (version 1) / [.2, .1, .2, .04] and [.1, .1, .5, .1] (version 2);
+      Shapley ≈ [0.37, 0.37, 1.7, 0.0] (version 1, sv_samples→∞).
+    """
+    x = np.array([[0, 1], [1, 0], [1, 2], [2, 1]], dtype=np.float32)
+    y = np.max(x, axis=1, keepdims=True).astype(np.float32)
+
+    w1 = np.array(
+        [[-0.5, 1.0, 1.0, 1.0],
+         [0.5, -1.0, 1.0, 1.0]],
+        dtype=np.float32,
+    )  # (in=2, out=4) — columns are units A, B, C, D
+    w_d = 0.0 if version == 1 else -0.1
+    w2 = np.array([[1.0], [0.5], [0.5], [w_d]], dtype=np.float32)  # (4, 1)
+
+    model = SegmentedModel(
+        layers=(
+            L.Dense("fc1", 4, use_bias=False),
+            L.Activation("act1", "relu"),
+            L.Dense("fc2", 1, use_bias=False),
+        ),
+        input_shape=(2,),
+    )
+    params = {"fc1": {"w": jnp.asarray(w1)}, "fc2": {"w": jnp.asarray(w2)}}
+    return model, params, jnp.asarray(x), jnp.asarray(y)
+
+
+def max_model_batches(batch_size: int = 1):
+    """The fixture's dataset as a list of (x, y) batches (the reference uses
+    a batch-size-1 DataLoader, test_attributions.py:73-76)."""
+    _, _, x, y = max_model()
+    return [
+        (x[i : i + batch_size], y[i : i + batch_size])
+        for i in range(0, x.shape[0], batch_size)
+    ]

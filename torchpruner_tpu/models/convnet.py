@@ -1,0 +1,33 @@
+"""Fashion-MNIST convnet (reference experiments/models/fmnist.py:12-66):
+2 × [Conv-BN-ReLU-MaxPool] → Flatten → FC4096-BN-ReLU → FC10.
+
+``linearize=True`` swaps ReLUs for identity and MaxPool for AvgPool — the
+reference's ablation switch for studying the linearized network (reference
+fmnist.py:44-66).  Here it simply builds a different (still hashable) spec.
+"""
+
+from __future__ import annotations
+
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.segment import SegmentedModel
+
+
+def fmnist_convnet(linearize: bool = False) -> SegmentedModel:
+    act = "identity" if linearize else "relu"
+    pool = "avg" if linearize else "max"
+    layers = (
+        L.Conv("conv1", 32, kernel_size=(5, 5), padding="SAME"),
+        L.BatchNorm("bn1"),
+        L.Activation("act1", act),
+        L.Pool("pool1", pool, (2, 2)),
+        L.Conv("conv2", 64, kernel_size=(5, 5), padding="SAME"),
+        L.BatchNorm("bn2"),
+        L.Activation("act2", act),
+        L.Pool("pool2", pool, (2, 2)),
+        L.Flatten("flatten"),
+        L.Dense("fc1", 4096),
+        L.BatchNorm("bn3"),
+        L.Activation("act3", act),
+        L.Dense("out", 10),
+    )
+    return SegmentedModel(layers, (28, 28, 1))

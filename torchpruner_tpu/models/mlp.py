@@ -1,0 +1,39 @@
+"""Fully-connected model zoo entries.
+
+Mirrors the reference's experiments/models/mnist.py:12-48 (784→2024→2024→10
+LeakyReLU net) and the CIFAR-10 FC variant (experiments/models/cifar10.py:10-36)
+used by the "Pruning Untrained Networks" experiment.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.segment import SegmentedModel
+
+
+def fc_net(
+    input_size: int,
+    hidden: Sequence[int] = (2024, 2024),
+    n_classes: int = 10,
+    activation: str = "leaky_relu",
+) -> SegmentedModel:
+    layers = []
+    for i, h in enumerate(hidden):
+        layers.append(L.Dense(f"fc{i + 1}", h))
+        layers.append(L.Activation(f"act{i + 1}", activation))
+    layers.append(L.Dense("out", n_classes))
+    return SegmentedModel(tuple(layers), (input_size,))
+
+
+def mnist_fc() -> SegmentedModel:
+    """784-2024-2024-10 LeakyReLU (reference experiments/models/mnist.py:14-23).
+    Input is the flattened 28×28 image."""
+    return fc_net(784)
+
+
+def cifar10_fc() -> SegmentedModel:
+    """Same architecture for flattened 32×32×3 CIFAR-10 input (reference
+    experiments/models/cifar10.py:10-36)."""
+    return fc_net(32 * 32 * 3)

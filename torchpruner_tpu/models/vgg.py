@@ -1,0 +1,61 @@
+"""VGG16-bn for CIFAR-10 — the flagship / north-star model.
+
+Matches the reference's ``prunable_vgg16`` (reference experiments/models/
+cifar10.py:62-76): torchvision ``vgg16_bn`` feature extractor (13 convs with
+BatchNorm, 5 max-pools) with a CIFAR-sized 512-wide classifier.  On 32×32
+inputs the feature map is 1×1×512 at the flatten, so the classifier is
+512→512→512→10 with dropout.  15 prunable layers precede the output head
+(the "15 prunable modules" of the layerwise-robustness experiment,
+SURVEY.md §2.8).
+
+Built as a flat ``SegmentedModel``, the pruning graph — which the reference
+hand-writes in ``get_vgg_pruning_graph`` (reference torchpruner/utils/
+graph.py:37-61) — is *derived* by ``torchpruner_tpu.core.graph.pruning_graph``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.segment import SegmentedModel
+
+# Standard VGG16 configuration: channel widths with 'M' = max-pool.
+VGG16_CFG: Tuple[Union[int, str], ...] = (
+    64, 64, "M",
+    128, 128, "M",
+    256, 256, 256, "M",
+    512, 512, 512, "M",
+    512, 512, 512, "M",
+)
+
+
+def vgg16_bn(
+    n_classes: int = 10,
+    input_shape: Tuple[int, int, int] = (32, 32, 3),
+    classifier_width: int = 512,
+    dropout: float = 0.5,
+) -> SegmentedModel:
+    layers = []
+    conv_i = 0
+    pool_i = 0
+    for v in VGG16_CFG:
+        if v == "M":
+            pool_i += 1
+            layers.append(L.Pool(f"pool{pool_i}", "max", (2, 2)))
+        else:
+            conv_i += 1
+            layers.append(L.Conv(f"conv{conv_i}", int(v), kernel_size=(3, 3)))
+            layers.append(L.BatchNorm(f"bn{conv_i}"))
+            layers.append(L.Activation(f"relu{conv_i}", "relu"))
+    layers.append(L.Flatten("flatten"))
+    layers += [
+        L.Dense("fc1", classifier_width),
+        L.Activation("relu_fc1", "relu"),
+        L.Dropout("drop1", dropout),
+        L.Dense("fc2", classifier_width),
+        L.Activation("relu_fc2", "relu"),
+        L.Dropout("drop2", dropout),
+        L.Dense("out", n_classes),
+    ]
+    return SegmentedModel(tuple(layers), input_shape)

@@ -1,0 +1,6 @@
+"""Utility subpackage: losses, reductions, logging, FLOPs accounting."""
+
+from torchpruner_tpu.utils.losses import mse_loss, cross_entropy_loss, nll_loss
+from torchpruner_tpu.utils.reductions import mean_plus_2std
+
+__all__ = ["mse_loss", "cross_entropy_loss", "nll_loss", "mean_plus_2std"]

@@ -1,0 +1,36 @@
+"""Per-example loss functions.
+
+The attribution contract is per-example-first (SURVEY.md §2.1): every loss
+here maps ``(preds, targets) -> (batch,)`` — the equivalent of calling a torch
+criterion with ``reduction="none"`` (reference attributions.py:40-56).  Mean
+over the batch gives the training loss.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def mse_loss(preds, targets):
+    """Mean-squared error, averaged over non-batch dims -> (batch,)."""
+    d = (preds - targets) ** 2
+    return d.reshape(d.shape[0], -1).mean(axis=1)
+
+
+def cross_entropy_loss(logits, labels):
+    """Softmax cross-entropy with integer labels -> (batch,)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+
+
+def nll_loss(log_probs, labels):
+    """Negative log-likelihood on log-probabilities (reference
+    experiments/models/fmnist.py:80-81 pairs NLL with an in-model
+    log_softmax) -> (batch,)."""
+    return -jnp.take_along_axis(log_probs, labels[:, None], axis=-1)[:, 0]
+
+
+def accuracy(logits, labels):
+    """Fraction of argmax-correct predictions (scalar)."""
+    return jnp.mean(jnp.argmax(logits, axis=-1) == labels)
