@@ -1,0 +1,85 @@
+"""Benchmark: the reference's headline prune workload on TPU.
+
+Reproduces the "Pruning Untrained Networks" MNIST experiment end to end
+(BASELINE.md: 28 s wall-clock on a CUDA GPU): untrained 784-2024-2024-10 FC
+net, Shapley attribution (sv_samples=5) on 1000 validation examples for both
+hidden layers (outermost first), pruning all negative-attribution units —
+including all JIT compilation and the shape-changing recompile between the
+two prune steps.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": seconds, "unit": "s", "vs_baseline": 28/seconds}
+(vs_baseline > 1 means faster than the reference.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_SECONDS = 28.0  # reference wall-clock (BASELINE.md, MNIST FC prune)
+
+
+def main() -> dict:
+    if "--cpu" in sys.argv:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import numpy as np
+
+    from torchpruner_tpu.attributions import ShapleyAttributionMetric
+    from torchpruner_tpu.core.graph import pruning_graph
+    from torchpruner_tpu.core.pruner import prune_by_scores
+    from torchpruner_tpu.core.segment import init_model
+    from torchpruner_tpu.data import load_dataset
+    from torchpruner_tpu.models import mnist_fc
+    from torchpruner_tpu.utils.flops import param_count
+    from torchpruner_tpu.utils.losses import cross_entropy_loss
+
+    smoke = "--smoke" in sys.argv  # tiny config to validate the path on CPU
+    if smoke:
+        from torchpruner_tpu.models.mlp import fc_net
+
+        model = fc_net(784, hidden=(64, 64))
+        n_examples, bs = 64, 32
+    else:
+        model = mnist_fc()
+        n_examples, bs = 1000, 500
+    params, state = init_model(model, seed=0)
+    val = load_dataset("mnist_flat", "val", n=n_examples, seed=0)
+    batches = val.batches(bs)
+    # stage data on device once (input pipeline, not the measured prune loop)
+    batches = [(jax.numpy.asarray(x), jax.numpy.asarray(y)) for x, y in batches]
+    jax.block_until_ready(batches)
+
+    params_before = param_count(params)
+    t0 = time.perf_counter()
+    targets = [g.target for g in pruning_graph(model)][::-1]  # fc2 then fc1
+    for target in targets:
+        metric = ShapleyAttributionMetric(
+            model, params, batches, cross_entropy_loss, state=state,
+            sv_samples=5, seed=0,
+        )
+        scores = metric.run(target)
+        res = prune_by_scores(model, params, target, scores,
+                              policy="negative", state=state)
+        model, params, state = res.model, res.params, res.state
+    jax.block_until_ready(params)
+    elapsed = time.perf_counter() - t0
+
+    return {
+        "metric": "mnist_fc_shapley_prune_wall_clock",
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_SECONDS / elapsed, 3),
+        "platform": jax.devices()[0].platform,
+        "params_before": params_before,
+        "params_after": param_count(params),
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(main()))

@@ -1,0 +1,65 @@
+"""Shape-aware checkpoint/restore, including post-prune widths
+(SURVEY.md §5.4: layer widths are the extra metadata pruning forces)."""
+
+import jax
+import numpy as np
+import optax
+
+from torchpruner_tpu.checkpoint import (
+    restore_checkpoint,
+    save_checkpoint,
+    spec_from_dict,
+    spec_to_dict,
+)
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.pruner import prune
+from torchpruner_tpu.core.segment import SegmentedModel, init_model
+from torchpruner_tpu.models import fmnist_convnet, vgg16_bn
+from torchpruner_tpu.utils.losses import cross_entropy_loss
+
+
+def test_spec_roundtrip():
+    for model in [fmnist_convnet(), vgg16_bn(), fmnist_convnet(linearize=True)]:
+        d = spec_to_dict(model)
+        m2 = spec_from_dict(d)
+        assert m2 == model
+
+
+def test_checkpoint_roundtrip_after_prune(tmp_path):
+    model = fmnist_convnet()
+    params, state = init_model(model, seed=0)
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 28, 28, 1))
+    y = np.zeros((4,), dtype=np.int32)
+    g = jax.grad(
+        lambda p: float(0)
+        + cross_entropy_loss(model.apply(p, x, state=state)[0], y).mean()
+    )(params)
+    _, opt_state = tx.update(g, opt_state, params)
+
+    res = prune(model, params, "conv1", [0, 1, 2, 3], state=state,
+                opt_state=opt_state)
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, res.model, res.params, res.state, res.opt_state,
+                    step=7, prune_history=[{"layer": "conv1", "dropped": 4}])
+
+    m2, p2, s2, o2, meta = restore_checkpoint(path, tx=tx)
+    assert m2 == res.model
+    assert meta["widths"]["conv1"] == 28
+    assert meta["step"] == 7
+    np.testing.assert_array_equal(
+        np.asarray(p2["conv1"]["w"]), np.asarray(res.params["conv1"]["w"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(s2["bn1"]["mean"]), np.asarray(res.state["bn1"]["mean"])
+    )
+    # restored optimizer state continues training at the pruned shapes
+    out, _ = m2.apply(p2, x, state=s2)
+    assert out.shape == (4, 10)
+    g2 = jax.grad(
+        lambda p: cross_entropy_loss(m2.apply(p, x, state=s2)[0], y).mean()
+    )(p2)
+    up, _ = tx.update(g2, o2, p2)
+    p3 = optax.apply_updates(p2, up)
+    assert jax.tree_util.tree_structure(p3) == jax.tree_util.tree_structure(p2)

@@ -1,0 +1,134 @@
+"""End-to-end experiment drivers on tiny models/data (the minimum
+end-to-end slice of SURVEY.md §7, as a test)."""
+
+import numpy as np
+import optax
+
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.segment import SegmentedModel, init_model
+from torchpruner_tpu.data import synthetic_dataset
+from torchpruner_tpu.experiments import (
+    ablation_curve,
+    build_metric,
+    layerwise_robustness,
+    run_prune_retrain,
+)
+from torchpruner_tpu.experiments.robustness import auc_summary, loss_increase_auc
+from torchpruner_tpu.utils.config import ExperimentConfig
+from torchpruner_tpu.utils.losses import cross_entropy_loss
+
+
+def tiny_model():
+    return SegmentedModel(
+        (L.Dense("fc1", 16), L.Activation("r1", "relu"),
+         L.Dense("fc2", 16), L.Activation("r2", "relu"),
+         L.Dense("out", 4)),
+        (8,),
+    )
+
+
+def tiny_sets():
+    train = synthetic_dataset((8,), 4, 256, seed=1)
+    val = synthetic_dataset((8,), 4, 64, seed=2)
+    test = synthetic_dataset((8,), 4, 64, seed=3)
+    return train, val, test
+
+
+def test_prune_retrain_shapley_end_to_end(tmp_path):
+    """The full spine: dataset → Shapley scores → negative-index prune →
+    recompiled fine-tune step → evaluation (reference 'Pruning Untrained
+    Networks' recipe)."""
+    cfg = ExperimentConfig(
+        name="tiny", method="shapley",
+        method_kwargs={"sv_samples": 3},
+        policy="fraction", fraction=0.25,
+        finetune_epochs=1, batch_size=32, eval_batch_size=32,
+        lr=0.05, log_path=str(tmp_path / "log.csv"),
+    )
+    history = run_prune_retrain(
+        cfg, model=tiny_model(), datasets=tiny_sets(), verbose=False
+    )
+    assert [h.layer for h in history] == ["fc2", "fc1"]  # outermost first
+    assert all(h.n_dropped == 4 for h in history)
+    assert history[-1].widths == {"fc1": 12, "fc2": 12, "out": 4}
+    assert np.isfinite(history[-1].post_loss)
+    assert (tmp_path / "log.csv").exists()
+
+
+def test_prune_retrain_negative_policy(tmp_path):
+    cfg = ExperimentConfig(
+        name="neg", method="taylor", reduction="mean",
+        policy="negative", finetune_epochs=0,
+        eval_batch_size=32, log_path=str(tmp_path / "l.csv"),
+    )
+    history = run_prune_retrain(
+        cfg, model=tiny_model(), datasets=tiny_sets(), verbose=False
+    )
+    assert len(history) == 2
+
+
+def test_ablation_curve_monotonic_degradation():
+    """Removing ALL units must end at a fully-ablated network; the curve's
+    last point equals masking everything; base point equals no masking."""
+    model = tiny_model()
+    params, state = init_model(model, seed=0)
+    _, _, test = tiny_sets()
+    data = test.batches(32)
+    n = 16
+    ranking = np.arange(n)
+    curve = ablation_curve(model, params, state, "fc1", ranking, data,
+                           cross_entropy_loss)
+    assert curve["loss"].shape == (n,)
+    # removing nothing (base) should differ from removing everything
+    assert curve["loss"][-1] != curve["base_loss"]
+    auc = loss_increase_auc(curve)
+    assert np.isfinite(auc)
+
+
+def test_layerwise_robustness_sweep_ranks_methods():
+    """A trained model's Shapley/Taylor rankings should beat an adversarial
+    (worst-first) ranking; smoke-checks the full sweep structure."""
+    import optax
+    from torchpruner_tpu.train import Trainer, train_epoch
+
+    model = tiny_model()
+    train, val, test = tiny_sets()
+    trainer = Trainer.create(model, optax.adam(1e-2), cross_entropy_loss)
+    for e in range(3):
+        train_epoch(trainer, train.batches(32, shuffle=True, seed=e),
+                    verbose=False)
+    model, params, state = trainer.model, trainer.params, trainer.state
+    val_b = val.batches(32)
+    test_b = test.batches(32)
+
+    methods = {
+        "taylor": lambda: build_metric("taylor", model, params, val_b,
+                                       cross_entropy_loss, state=state),
+        "sv": lambda: build_metric("shapley", model, params, val_b,
+                                   cross_entropy_loss, state=state,
+                                   sv_samples=3),
+        "random": lambda: build_metric("random", model, params, val_b,
+                                       cross_entropy_loss, state=state),
+    }
+    results = layerwise_robustness(
+        model, params, state, test_b, methods, cross_entropy_loss,
+        runs_stochastic=2, verbose=False,
+    )
+    assert set(results.keys()) == {"fc1", "fc2"}
+    assert len(results["fc1"]["sv"]) == 2   # stochastic repeats
+    assert len(results["fc1"]["taylor"]) == 1
+    summary = auc_summary(results)
+    assert set(summary) == {"taylor", "sv", "random"}
+    # informed rankings should not be worse than random on average
+    assert summary["sv"] <= summary["random"] + 0.5
+
+
+def test_mean_plus_2std_reduction_via_registry():
+    model = tiny_model()
+    params, state = init_model(model, 0)
+    _, val, _ = tiny_sets()
+    m = build_metric("shapley", model, params, val.batches(32),
+                     cross_entropy_loss, state=state, reduction="mean+2std",
+                     sv_samples=2)
+    scores = m.run("fc1")
+    assert scores.shape == (16,)

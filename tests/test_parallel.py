@@ -1,0 +1,164 @@
+"""Distribution layer tests on the 8-device virtual CPU mesh: distributed
+scoring must equal single-device scoring; DP×FSDP training must run, match
+single-device training, and survive prune→reshard→recompile."""
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+import optax
+import pytest
+
+from torchpruner_tpu.attributions import (
+    ShapleyAttributionMetric,
+    TaylorAttributionMetric,
+    WeightNormAttributionMetric,
+)
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.pruner import prune
+from torchpruner_tpu.core.segment import SegmentedModel, init_model
+from torchpruner_tpu.data import synthetic_dataset
+from torchpruner_tpu.parallel import (
+    DistributedScorer,
+    ShardedTrainer,
+    make_mesh,
+    mesh_axes,
+    shard_params,
+)
+from torchpruner_tpu.parallel.sharding import fsdp_spec
+from torchpruner_tpu.train import Trainer, train_epoch
+from torchpruner_tpu.utils.losses import cross_entropy_loss
+from torchpruner_tpu.utils.reductions import mean_plus_2std
+
+
+def model_8():
+    return SegmentedModel(
+        (L.Dense("fc1", 64), L.Activation("r1", "relu"),
+         L.Dense("fc2", 32), L.Activation("r2", "relu"),
+         L.Dense("out", 4)),
+        (16,),
+    )
+
+
+def batches_8(n=128, bs=32, seed=0):
+    return synthetic_dataset((16,), 4, n, seed=seed).batches(bs)
+
+
+def test_make_mesh_shapes():
+    assert jax.device_count() == 8
+    m = make_mesh()
+    assert mesh_axes(m) == {"data": 8}
+    m2 = make_mesh({"data": 2, "model": 4})
+    assert mesh_axes(m2) == {"data": 2, "model": 4}
+    m3 = make_mesh({"data": -1, "model": 2})
+    assert mesh_axes(m3) == {"data": 4, "model": 2}
+    with pytest.raises(ValueError):
+        make_mesh({"data": 3})
+
+
+def test_fsdp_spec_rules():
+    mesh = make_mesh({"data": 2, "model": 4})
+    assert fsdp_spec((128, 64), mesh, min_size=0) == jax.sharding.PartitionSpec("model", None)
+    assert fsdp_spec((63, 61), mesh, min_size=0) == jax.sharding.PartitionSpec()  # indivisible
+    assert fsdp_spec((8, 8), mesh, min_size=2**14) == jax.sharding.PartitionSpec()  # too small
+
+
+@pytest.mark.parametrize("reduction", ["mean", "sum", "none", "mean+2std"])
+def test_distributed_taylor_matches_single_device(reduction):
+    model = model_8()
+    params, state = init_model(model, seed=0)
+    data = batches_8()
+    red = mean_plus_2std if reduction == "mean+2std" else reduction
+    single = TaylorAttributionMetric(model, params, data,
+                                     cross_entropy_loss, reduction=red)
+    expected = single.run("fc1", find_best_evaluation_layer=True)
+    mesh = make_mesh({"data": 8})
+    dist = DistributedScorer(
+        TaylorAttributionMetric(model, params, data, cross_entropy_loss,
+                                reduction=red),
+        mesh,
+    )
+    got = dist.run("fc1", find_best_evaluation_layer=True)
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=1e-6)
+
+
+def test_distributed_shapley_matches_single_device():
+    model = model_8()
+    params, state = init_model(model, seed=0)
+    data = batches_8()
+    kw = dict(sv_samples=3, seed=11)
+    expected = ShapleyAttributionMetric(
+        model, params, data, cross_entropy_loss, **kw
+    ).run("fc1")
+    mesh = make_mesh({"data": 4, "model": 2})
+    got = DistributedScorer(
+        ShapleyAttributionMetric(model, params, data, cross_entropy_loss,
+                                 **kw),
+        mesh,
+    ).run("fc1")
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=1e-6)
+
+
+def test_distributed_weight_only_falls_back():
+    model = model_8()
+    params, _ = init_model(model, seed=0)
+    mesh = make_mesh()
+    m = WeightNormAttributionMetric(model, params, batches_8(),
+                                    cross_entropy_loss)
+    got = DistributedScorer(m, mesh).run("fc1")
+    np.testing.assert_allclose(got, m.run("fc1"))
+
+
+def test_indivisible_batch_rejected():
+    model = model_8()
+    params, _ = init_model(model, seed=0)
+    mesh = make_mesh({"data": 8})
+    data = synthetic_dataset((16,), 4, 30, seed=0).batches(30)  # 30 % 8 != 0
+    m = TaylorAttributionMetric(model, params, data, cross_entropy_loss)
+    with pytest.raises(ValueError, match="not divisible"):
+        DistributedScorer(m, mesh).run("fc1")
+
+
+def test_sharded_trainer_matches_single_device():
+    """DP×FSDP SPMD training must track the single-device trajectory."""
+    mesh = make_mesh({"data": 2, "model": 4})
+    tx = optax.sgd(0.05)
+    t_single = Trainer.create(model_8(), tx, cross_entropy_loss, seed=0)
+    t_shard = ShardedTrainer.create(model_8(), tx, cross_entropy_loss, mesh,
+                                    seed=0, min_shard_size=0)
+    data = batches_8(n=64, bs=32)
+    for x, y in data:
+        l1 = t_single.step(x, y)
+        l2 = t_shard.step(x, y)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-4)
+    w1 = np.asarray(t_single.params["fc1"]["w"])
+    w2 = np.asarray(t_shard.params["fc1"]["w"])
+    np.testing.assert_allclose(w1, w2, rtol=1e-3, atol=1e-5)
+
+
+def test_sharded_trainer_prune_reshard_recompile():
+    mesh = make_mesh({"data": 2, "model": 4})
+    t = ShardedTrainer.create(model_8(), optax.adam(1e-3),
+                              cross_entropy_loss, mesh, seed=0,
+                              min_shard_size=0)
+    data = batches_8(n=64, bs=32)
+    for x, y in data:
+        t.step(x, y)
+    res = prune(t.model, t.params, "fc1", list(range(0, 64, 2)),
+                state=t.state, opt_state=t.opt_state)
+    t2 = t.rebuild(res.model, res.params, res.state, res.opt_state)
+    assert t2.model.layer("fc1").features == 32
+    for x, y in data:
+        l = t2.step(x, y)
+    assert np.isfinite(float(l))
+    loss, acc = t2.evaluate(data)
+    assert np.isfinite(loss)
+
+
+def test_shard_params_layouts():
+    mesh = make_mesh({"data": 2, "model": 4})
+    model = model_8()
+    params, _ = init_model(model, seed=0)
+    placed, shardings = shard_params(params, mesh, min_size=0)
+    # fc1 w (16,64): 64 divisible by 4 -> sharded on model axis
+    s = placed["fc1"]["w"].sharding
+    assert s.spec == jax.sharding.PartitionSpec(None, "model")

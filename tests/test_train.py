@@ -1,0 +1,120 @@
+"""Training loop, logger, FLOPs, config, data pipeline."""
+
+import csv
+import os
+
+import numpy as np
+import optax
+import pytest
+
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.pruner import prune
+from torchpruner_tpu.core.segment import SegmentedModel
+from torchpruner_tpu.data import load_dataset, synthetic_dataset
+from torchpruner_tpu.train import CSVLogger, Trainer, evaluate, train_epoch
+from torchpruner_tpu.utils.config import ExperimentConfig
+from torchpruner_tpu.utils.flops import model_cost, param_count
+from torchpruner_tpu.utils.losses import cross_entropy_loss
+
+
+def tiny_model():
+    return SegmentedModel(
+        (L.Dense("fc1", 32), L.Activation("r1", "relu"), L.Dense("out", 4)),
+        (8,),
+    )
+
+
+def tiny_data(n=256, seed=0):
+    return synthetic_dataset((8,), 4, n, seed=seed)
+
+
+def test_training_reduces_loss():
+    ds = tiny_data()
+    trainer = Trainer.create(tiny_model(), optax.adam(1e-2),
+                             cross_entropy_loss, seed=0)
+    batches = ds.batches(32)
+    l0, a0 = trainer.evaluate(batches)
+    for epoch in range(3):
+        train_epoch(trainer, ds.batches(32, shuffle=True, seed=epoch),
+                    verbose=False)
+    l1, a1 = trainer.evaluate(batches)
+    assert l1 < l0
+    assert a1 > a0
+
+
+def test_train_prune_train():
+    # the reference's behavioral optimizer test, end to end through Trainer
+    # (reference tests/test_pruner.py:180-228)
+    ds = tiny_data()
+    trainer = Trainer.create(tiny_model(), optax.sgd(1e-2, momentum=0.9),
+                             cross_entropy_loss, seed=0)
+    train_epoch(trainer, ds.batches(32), verbose=False)
+    res = prune(trainer.model, trainer.params, "fc1", [0, 1, 2, 3],
+                state=trainer.state, opt_state=trainer.opt_state)
+    trainer = trainer.rebuild(res.model, res.params, res.state, res.opt_state)
+    l = train_epoch(trainer, ds.batches(32), verbose=False)
+    assert np.isfinite(l)
+    assert trainer.model.layer("fc1").features == 28
+
+
+def test_param_count_and_flops():
+    m = tiny_model()
+    trainer = Trainer.create(m, optax.sgd(1e-2), cross_entropy_loss)
+    n, flops = model_cost(m, trainer.params, trainer.state)
+    assert n == 8 * 32 + 32 + 32 * 4 + 4
+    if flops is not None:  # cost analysis is best-effort per backend
+        assert flops > 0
+
+
+def test_csv_logger_schema(tmp_path):
+    path = str(tmp_path / "log.csv")
+    logger = CSVLogger(path, experiment="t")
+    logger.log_prune_step(
+        layer="fc1", method="shapley", test_loss=1.0, test_acc=0.5,
+        test_loss_pp=1.1, test_acc_pp=0.45, n_params=123, flops=456.0,
+        widths={"fc1": 28, "out": 4}, prune_time=0.5, prune_ratio=0.1,
+    )
+    with open(path) as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) == 1
+    assert rows[0]["widths"] == "28-4"
+    assert rows[0]["test_loss_pp"] == "1.100000"
+    assert os.path.exists(path + ".jsonl")
+
+
+def test_config_roundtrip(tmp_path):
+    cfg = ExperimentConfig(name="x", method="taylor", mesh={"data": 4})
+    p = str(tmp_path / "cfg.json")
+    cfg.to_json(p)
+    cfg2 = ExperimentConfig.from_json(p)
+    assert cfg2 == cfg
+    # unknown keys rejected
+    import json
+    with open(p) as f:
+        raw = json.load(f)
+    raw["bogus"] = 1
+    with open(p, "w") as f:
+        json.dump(raw, f)
+    with pytest.raises(ValueError):
+        ExperimentConfig.from_json(p)
+
+
+def test_load_dataset_shapes_and_split_consistency():
+    tr = load_dataset("mnist_flat", "train", n=64)
+    te = load_dataset("mnist_flat", "test", n=64)
+    assert tr.x.shape == (64, 784) and tr.y.dtype == np.int32
+    # same class centers across splits: a model trained on train should do
+    # better than chance on test — proxy: class-conditional means correlate
+    for c in range(3):
+        a = tr.x[tr.y == c].mean(0)
+        b = te.x[te.y == c].mean(0)
+        corr = np.corrcoef(a, b)[0, 1]
+        assert corr > 0.3, f"class {c} centers inconsistent across splits"
+
+
+def test_dataset_batching():
+    ds = tiny_data(100)
+    bs = ds.batches(32)
+    assert [len(b[0]) for b in bs] == [32, 32, 32, 4]
+    bs2 = ds.batches(32, drop_remainder=True)
+    assert [len(b[0]) for b in bs2] == [32, 32, 32]

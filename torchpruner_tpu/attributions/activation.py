@@ -62,18 +62,16 @@ class APoZAttributionMetric(AttributionMetric):
     """1−APoZ: per-example count of positive activations per unit (Hu et al.;
     reference apoz.py:15-39). Higher = more alive."""
 
-    def compute_rows(self, layer, eval_layer, **kw):
-        fn = grad_rows_fn(self.model, eval_layer, self.loss_fn, "apoz")
-        return self._collect(fn)
+    def make_row_fn(self, eval_layer, **kw):
+        return grad_rows_fn(self.model, eval_layer, self.loss_fn, "apoz")
 
 
 class SensitivityAttributionMetric(AttributionMetric):
     """Average absolute gradient of the loss w.r.t. each unit's activation
     (Mittal et al.; reference sensitivity.py:13-34)."""
 
-    def compute_rows(self, layer, eval_layer, **kw):
-        fn = grad_rows_fn(self.model, eval_layer, self.loss_fn, "sensitivity")
-        return self._collect(fn)
+    def make_row_fn(self, eval_layer, **kw):
+        return grad_rows_fn(self.model, eval_layer, self.loss_fn, "sensitivity")
 
 
 class TaylorAttributionMetric(AttributionMetric):
@@ -85,7 +83,6 @@ class TaylorAttributionMetric(AttributionMetric):
         super().__init__(*args, **kwargs)
         self.signed = signed
 
-    def compute_rows(self, layer, eval_layer, **kw):
+    def make_row_fn(self, eval_layer, **kw):
         mode = "taylor_signed" if self.signed else "taylor"
-        fn = grad_rows_fn(self.model, eval_layer, self.loss_fn, mode)
-        return self._collect(fn)
+        return grad_rows_fn(self.model, eval_layer, self.loss_fn, mode)

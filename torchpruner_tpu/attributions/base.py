@@ -95,7 +95,17 @@ class AttributionMetric:
         return layer
 
     def compute_rows(self, layer: str, eval_layer: str, **kw) -> np.ndarray:
-        raise NotImplementedError
+        return self._collect(self.make_row_fn(eval_layer, **kw))
+
+    def make_row_fn(self, eval_layer: str, **kw):
+        """Return the jit row function ``(params, state, x, y) ->
+        (batch, n_units)`` — the unit every data-dependent metric reduces
+        to, and what the distributed scorer shards over the data axis
+        (torchpruner_tpu/parallel/scoring.py)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement make_row_fn "
+            "(weight-only metrics override run() instead)"
+        )
 
     def aggregate_over_samples(self, rows: np.ndarray) -> np.ndarray:
         if self.reduction == "mean":

@@ -99,12 +99,6 @@ class ShapleyAttributionMetric(AttributionMetric):
         self.use_partial = use_partial
         self._calls = 0
 
-    def compute_rows(self, layer, eval_layer, sv_samples=None, use_partial=None):
-        fn = self.make_row_fn(
-            eval_layer, sv_samples=sv_samples, use_partial=use_partial
-        )
-        return self._collect(fn)
-
     def make_row_fn(self, eval_layer: str, sv_samples=None, use_partial=None):
         """Draw fresh permutations (fixed across batches, reference
         shapley_values.py:45-47), bind them, and return a plain

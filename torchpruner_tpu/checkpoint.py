@@ -1,0 +1,126 @@
+"""Shape-aware checkpointing (orbax).
+
+The reference never saves anything (SURVEY.md §5.4) — and pruning makes
+checkpointing non-trivial precisely because *shapes change*: a checkpoint
+must carry the current architecture widths to be restorable.  A checkpoint
+here bundles ``{model spec, params, BN state, optimizer state, prune
+history, step}``; restore rebuilds the (pruned) spec first, so arrays load
+into the right static shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.segment import SegmentedModel
+
+_LAYER_TYPES = {
+    cls.__name__: cls
+    for cls in (L.Dense, L.Conv, L.BatchNorm, L.Activation, L.Pool,
+                L.Flatten, L.Dropout)
+}
+
+
+def spec_to_dict(model: SegmentedModel) -> dict:
+    """JSON-serializable model spec (layer kinds + fields + input shape)."""
+    return {
+        "input_shape": list(model.input_shape),
+        "layers": [
+            {"type": type(l).__name__, "fields": dataclasses.asdict(l)}
+            for l in model.layers
+        ],
+    }
+
+
+def spec_from_dict(d: dict) -> SegmentedModel:
+    layers = []
+    for entry in d["layers"]:
+        cls = _LAYER_TYPES[entry["type"]]
+        fields = {
+            k: tuple(v) if isinstance(v, list) else v
+            for k, v in entry["fields"].items()
+        }
+        layers.append(cls(**fields))
+    return SegmentedModel(tuple(layers), tuple(d["input_shape"]))
+
+
+def save_checkpoint(
+    path: str,
+    model: SegmentedModel,
+    params,
+    state=None,
+    opt_state=None,
+    *,
+    step: int = 0,
+    prune_history: Optional[list] = None,
+    extra: Optional[Dict[str, Any]] = None,
+):
+    """Write a checkpoint directory: ``spec.json`` + orbax array tree."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
+    meta = {
+        "spec": spec_to_dict(model),
+        "widths": model.widths(),
+        "step": step,
+        "prune_history": prune_history or [],
+        "extra": extra or {},
+    }
+    with open(os.path.join(path, "spec.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+
+    tree = {"params": params}
+    if state:
+        tree["state"] = state
+    if opt_state is not None:
+        tree["opt_state"] = opt_state
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(os.path.join(path, "arrays"), tree, force=True)
+
+
+def restore_checkpoint(path: str, tx=None):
+    """Restore ``(model, params, state, opt_state, meta)``.
+
+    ``opt_state`` needs ``tx`` to rebuild the optax pytree *structure* at the
+    pruned shapes (orbax restores raw arrays; structure comes from
+    ``tx.init`` on the restored params).
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with open(os.path.join(path, "spec.json")) as f:
+        meta = json.load(f)
+    model = spec_from_dict(meta["spec"])
+    ckptr = ocp.PyTreeCheckpointer()
+    restored = ckptr.restore(os.path.join(path, "arrays"))
+    params = restored["params"]
+    state = restored.get("state", {})
+    opt_state = None
+    if tx is not None and "opt_state" in restored:
+        template = jax.eval_shape(tx.init, params)
+        flat_template, treedef = jax.tree_util.tree_flatten(template)
+        flat_restored = jax.tree_util.tree_leaves(restored["opt_state"])
+        if len(flat_template) != len(flat_restored):
+            raise ValueError(
+                "optimizer-state layout mismatch: checkpoint has "
+                f"{len(flat_restored)} leaves, tx.init gives "
+                f"{len(flat_template)}"
+            )
+        for t, r in zip(flat_template, flat_restored):
+            if tuple(t.shape) != tuple(np.shape(r)):
+                raise ValueError(
+                    f"optimizer-state shape mismatch: {np.shape(r)} vs "
+                    f"expected {t.shape}"
+                )
+        opt_state = jax.tree_util.tree_unflatten(treedef, flat_restored)
+    elif "opt_state" in restored:
+        opt_state = restored["opt_state"]  # raw nested containers
+    return model, params, state, opt_state, meta

@@ -1,0 +1,16 @@
+"""Input pipeline.
+
+The reference uses torch DataLoaders over torchvision datasets (reference
+experiments/models/mnist.py:51-82, cifar10.py:102-161).  Here datasets are
+in-memory numpy arrays batched by a lightweight, deterministic iterator that
+knows how to shard per host/device for data-parallel scoring and training.
+
+This environment has no network egress, so ``load_dataset`` serves
+deterministic synthetic data with the real datasets' shapes unless arrays
+are found on disk (``TORCHPRUNER_TPU_DATA_DIR`` pointing at ``{name}_{split}
+_x.npy`` / ``_y.npy`` files) — the loader interface is identical either way.
+"""
+
+from torchpruner_tpu.data.datasets import Dataset, load_dataset, synthetic_dataset
+
+__all__ = ["Dataset", "load_dataset", "synthetic_dataset"]

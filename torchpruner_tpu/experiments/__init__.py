@@ -1,0 +1,23 @@
+"""Experiment drivers — library versions of the reference's three notebooks
+(SURVEY.md §2.8): the prune→fine-tune loop ("Pruning Untrained Networks")
+and the layerwise-robustness ablation sweep (CIFAR-10 VGG16 notebook)."""
+
+from torchpruner_tpu.experiments.prune_retrain import (
+    build_metric,
+    run_prune_retrain,
+    METRIC_REGISTRY,
+)
+from torchpruner_tpu.experiments.robustness import (
+    ablation_curve,
+    layerwise_robustness,
+    loss_increase_auc,
+)
+
+__all__ = [
+    "build_metric",
+    "run_prune_retrain",
+    "METRIC_REGISTRY",
+    "ablation_curve",
+    "layerwise_robustness",
+    "loss_increase_auc",
+]

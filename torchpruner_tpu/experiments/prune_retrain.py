@@ -1,0 +1,172 @@
+"""The prune→fine-tune driver — the reference's core recipe as a library
+function (reference "Pruning Untrained Networks.ipynb" cell 6 /
+SURVEY.md §3.4): for each prunable layer, outermost first: score → turn
+scores into indices (policy) → prune → evaluate (→ optionally fine-tune).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+import optax
+
+from torchpruner_tpu.attributions import (
+    APoZAttributionMetric,
+    RandomAttributionMetric,
+    SensitivityAttributionMetric,
+    ShapleyAttributionMetric,
+    TaylorAttributionMetric,
+    WeightNormAttributionMetric,
+)
+from torchpruner_tpu.core.graph import pruning_graph
+from torchpruner_tpu.core.pruner import prune_by_scores
+from torchpruner_tpu.data import load_dataset
+from torchpruner_tpu.models import cifar10_fc, fmnist_convnet, mnist_fc, vgg16_bn
+from torchpruner_tpu.train.logger import CSVLogger
+from torchpruner_tpu.train.loop import Trainer, train_epoch
+from torchpruner_tpu.utils.config import ExperimentConfig
+from torchpruner_tpu.utils.flops import model_cost
+from torchpruner_tpu.utils.losses import cross_entropy_loss
+from torchpruner_tpu.utils.reductions import mean_plus_2std
+
+METRIC_REGISTRY = {
+    "random": RandomAttributionMetric,
+    "weight_norm": WeightNormAttributionMetric,
+    "apoz": APoZAttributionMetric,
+    "sensitivity": SensitivityAttributionMetric,
+    "taylor": TaylorAttributionMetric,
+    "shapley": ShapleyAttributionMetric,
+}
+
+MODEL_REGISTRY = {
+    "mnist_fc": (mnist_fc, "mnist_flat"),
+    "cifar10_fc": (cifar10_fc, "cifar10_flat"),
+    "fmnist_convnet": (fmnist_convnet, "fashion_mnist"),
+    "vgg16_bn": (vgg16_bn, "cifar10"),
+}
+
+
+def build_metric(name: str, model, params, data, loss_fn, *, state=None,
+                 reduction="mean", seed=0, **kwargs):
+    """Metric factory; ``reduction`` accepts the named 'mean+2std'
+    (the VGG notebook's custom reduction, BASELINE.md)."""
+    if reduction == "mean+2std":
+        reduction = mean_plus_2std
+    cls = METRIC_REGISTRY[name]
+    return cls(model, params, data, loss_fn, state=state,
+               reduction=reduction, seed=seed, **kwargs)
+
+
+def make_optimizer(cfg: ExperimentConfig):
+    tx = optax.sgd(cfg.lr, momentum=cfg.momentum or None)
+    if cfg.weight_decay:
+        tx = optax.chain(optax.add_decayed_weights(cfg.weight_decay), tx)
+    return tx
+
+
+@dataclass
+class PruneStepRecord:
+    layer: str
+    pre_loss: float
+    pre_acc: float
+    post_loss: float
+    post_acc: float
+    n_params: int
+    n_dropped: int
+    prune_time: float
+    widths: Dict[str, int]
+
+
+def run_prune_retrain(
+    cfg: ExperimentConfig,
+    *,
+    model=None,
+    datasets=None,
+    verbose: bool = True,
+) -> List[PruneStepRecord]:
+    """Run the full prune(-retrain) experiment described by ``cfg``.
+
+    ``model`` / ``datasets=(train, val, test)`` may be injected (tests,
+    custom zoos); defaults come from the registries.
+    """
+    if model is None:
+        model_fn, default_ds = MODEL_REGISTRY[cfg.model]
+        model = model_fn()
+    else:
+        default_ds = cfg.dataset
+    if datasets is None:
+        ds_name = cfg.dataset if cfg.dataset != "synthetic" else default_ds
+        train = load_dataset(ds_name, "train", seed=cfg.seed)
+        val = load_dataset(ds_name, "val", n=cfg.score_examples, seed=cfg.seed)
+        test = load_dataset(ds_name, "test", seed=cfg.seed)
+    else:
+        train, val, test = datasets
+
+    tx = make_optimizer(cfg)
+    trainer = Trainer.create(model, tx, cross_entropy_loss, seed=cfg.seed)
+    logger = CSVLogger(cfg.log_path, experiment=cfg.name)
+    history: List[PruneStepRecord] = []
+
+    groups = list(pruning_graph(trainer.model))
+    if cfg.prune_order == "reverse":
+        groups = groups[::-1]  # outermost layer first (reference recipe)
+    targets = [g.target for g in groups]
+
+    val_batches = val.batches(cfg.eval_batch_size)
+    test_batches = test.batches(cfg.eval_batch_size)
+
+    for target in targets:
+        metric = build_metric(
+            cfg.method, trainer.model, trainer.params, val_batches,
+            cross_entropy_loss, state=trainer.state,
+            reduction=cfg.reduction, seed=cfg.seed, **cfg.method_kwargs,
+        )
+        t0 = time.perf_counter()
+        scores = metric.run(
+            target, find_best_evaluation_layer=cfg.find_best_evaluation_layer
+        )
+        pre_loss, pre_acc = trainer.evaluate(test_batches)
+        res = prune_by_scores(
+            trainer.model, trainer.params, target, scores,
+            policy=cfg.policy, fraction=cfg.fraction,
+            state=trainer.state, opt_state=trainer.opt_state,
+        )
+        prune_time = time.perf_counter() - t0
+        n_dropped = trainer.model.layer(target).features - res.model.layer(
+            target
+        ).features
+        trainer = trainer.rebuild(res.model, res.params, res.state, res.opt_state)
+
+        for epoch in range(cfg.finetune_epochs):
+            train_epoch(
+                trainer, train.batches(cfg.batch_size, shuffle=True,
+                                       seed=cfg.seed + epoch),
+                epoch=epoch, verbose=False,
+            )
+
+        post_loss, post_acc = trainer.evaluate(test_batches)
+        n_params, flops = model_cost(trainer.model, trainer.params, trainer.state)
+        rec = PruneStepRecord(
+            layer=target, pre_loss=pre_loss, pre_acc=pre_acc,
+            post_loss=post_loss, post_acc=post_acc, n_params=n_params,
+            n_dropped=n_dropped, prune_time=prune_time,
+            widths=trainer.model.widths(),
+        )
+        history.append(rec)
+        logger.log_prune_step(
+            layer=target, method=cfg.method,
+            test_loss=pre_loss, test_acc=pre_acc,
+            test_loss_pp=post_loss, test_acc_pp=post_acc,
+            n_params=n_params, flops=flops, widths=rec.widths,
+            prune_time=prune_time,
+        )
+        if verbose:
+            print(
+                f"[{cfg.name}] pruned {n_dropped} units from {target}: "
+                f"acc {pre_acc:.4f}→{post_acc:.4f}, params {n_params}",
+                flush=True,
+            )
+    return history

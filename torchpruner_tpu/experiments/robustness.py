@@ -1,0 +1,185 @@
+"""Layerwise-robustness ablation sweep — the reference's headline experiment
+("CIFAR-10 - VGG16 - Layerwise robustness.ipynb", SURVEY.md §3.5): for each
+prunable layer × attribution method, zero units one at a time in
+ascending-score order and log test loss/acc per removal count.
+
+The reference runs ``n_units`` separate suffix forwards per layer per method
+in Python — 6.5 h wall-clock on a CUDA GPU (BASELINE.md).  Here the whole
+cumulative-ablation walk over a layer is ONE ``lax.scan`` inside one jit
+per batch: the scan carries the cumulative unit mask, and each step's suffix
+evaluation is a batched MXU matmul.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+from torchpruner_tpu.core.graph import find_best_evaluation_layer, pruning_graph
+from torchpruner_tpu.core.segment import SegmentedModel
+
+
+@functools.lru_cache(maxsize=512)
+def _ablation_fn(model: SegmentedModel, eval_layer: str, loss_fn):
+    """jit: (params, state, x, y, ranking) -> (loss_sums, correct_counts),
+    both (n_units,): test metrics after each cumulative unit removal."""
+
+    @jax.jit
+    def fn(params, state, x, y, ranking):
+        z, _ = model.apply(params, x, state=state, train=False,
+                           to_layer=eval_layer)
+        n = z.shape[-1]
+
+        def step(mask, u):
+            mask = mask.at[u].set(0.0)
+            logits, _ = model.apply(params, z * mask, state=state,
+                                    train=False, from_layer=eval_layer)
+            losses = loss_fn(logits, y)
+            correct = jnp.sum(jnp.argmax(logits, axis=-1) == y)
+            return mask, (jnp.sum(losses), correct)
+
+        _, (loss_sums, corrects) = jax.lax.scan(
+            step, jnp.ones((n,), z.dtype), ranking
+        )
+        base_logits, _ = model.apply(params, z, state=state, train=False,
+                                     from_layer=eval_layer)
+        base = (jnp.sum(loss_fn(base_logits, y)),
+                jnp.sum(jnp.argmax(base_logits, axis=-1) == y))
+        return loss_sums, corrects, base[0], base[1]
+
+    return fn
+
+
+def ablation_curve(
+    model: SegmentedModel,
+    params,
+    state,
+    layer: str,
+    ranking: np.ndarray,
+    data,
+    loss_fn,
+    *,
+    eval_layer: Optional[str] = None,
+) -> Dict[str, np.ndarray]:
+    """Simulated pruning of ``layer``'s units in ``ranking`` order.
+
+    Returns ``{"loss": (n,), "acc": (n,), "base_loss": float,
+    "base_acc": float}`` — test loss/accuracy after each cumulative removal
+    (the reference's cell-8 inner loop, one scan per batch here).
+    """
+    eval_layer = eval_layer or layer
+    fn = _ablation_fn(model, eval_layer, loss_fn)
+    ranking = jnp.asarray(np.asarray(ranking, dtype=np.int32))
+    tot_l = tot_c = None
+    base_l = base_c = 0.0
+    n_examples = 0
+    for x, y in (data() if callable(data) else data):
+        l, c, bl, bc = fn(params, state, x, y, ranking)
+        tot_l = l if tot_l is None else tot_l + l
+        tot_c = c if tot_c is None else tot_c + c
+        base_l += float(bl)
+        base_c += float(bc)
+        n_examples += x.shape[0]
+    return {
+        "loss": np.asarray(tot_l) / n_examples,
+        "acc": np.asarray(tot_c) / n_examples,
+        "base_loss": base_l / n_examples,
+        "base_acc": base_c / n_examples,
+    }
+
+
+def loss_increase_auc(curve: Dict[str, np.ndarray]) -> float:
+    """Average test-loss increase per unit removed — the reference's summary
+    statistic (VGG notebook cell 11; lower = better ranking)."""
+    return float(np.mean(curve["loss"] - curve["base_loss"]))
+
+
+def layerwise_robustness(
+    model: SegmentedModel,
+    params,
+    state,
+    test_data,
+    methods: Dict[str, Callable[[], "AttributionMetric"]],
+    loss_fn,
+    *,
+    layers: Optional[Sequence[str]] = None,
+    runs_stochastic: int = 3,
+    stochastic: Sequence[str] = ("random", "shapley", "sv"),
+    find_best_evaluation_layer_: bool = True,
+    verbose: bool = True,
+) -> Dict[str, Dict[str, List[Dict]]]:
+    """The full sweep: every prunable layer × every method (×
+    ``runs_stochastic`` repeats for stochastic methods).
+
+    ``methods`` maps display names to zero-arg metric factories (so each run
+    can draw fresh randomness).  Returns
+    ``results[layer][method] = [ {scores, loss, acc, auc, seconds}, ... ]``.
+    """
+    if layers is None:
+        layers = [g.target for g in pruning_graph(model)]
+    results: Dict[str, Dict[str, List[Dict]]] = {}
+    for layer in layers:
+        results[layer] = {}
+        for name, factory in methods.items():
+            n_runs = (
+                runs_stochastic
+                if any(s in name.lower() for s in stochastic)
+                else 1
+            )
+            runs = []
+            for _ in range(n_runs):
+                t0 = time.perf_counter()
+                metric = factory()
+                scores = metric.run(
+                    layer,
+                    find_best_evaluation_layer=find_best_evaluation_layer_,
+                )
+                # The ablation mask point is always the post-BN/activation
+                # layer, for every method — matching the reference sweep,
+                # which masks at find_best_module_for_attributions(module)
+                # regardless of how scores were computed (VGG notebook
+                # cell 8).  Zeroing there is what unit removal actually does.
+                eval_layer = (
+                    find_best_evaluation_layer(model, layer)
+                    if find_best_evaluation_layer_
+                    else layer
+                )
+                ranking = np.argsort(scores)
+                curve = ablation_curve(
+                    model, params, state, layer, ranking, test_data, loss_fn,
+                    eval_layer=eval_layer,
+                )
+                runs.append({
+                    "scores": scores,
+                    "loss": curve["loss"],
+                    "acc": curve["acc"],
+                    "base_loss": curve["base_loss"],
+                    "base_acc": curve["base_acc"],
+                    "auc": loss_increase_auc(curve),
+                    "seconds": time.perf_counter() - t0,
+                })
+            results[layer][name] = runs
+            if verbose:
+                aucs = [r["auc"] for r in runs]
+                print(
+                    f"[robustness] {layer} / {name}: auc "
+                    f"{np.mean(aucs):.4f} ± {np.std(aucs):.4f} "
+                    f"({runs[0]['seconds']:.1f}s/run)",
+                    flush=True,
+                )
+    return results
+
+
+def auc_summary(results) -> Dict[str, float]:
+    """Mean AUC per method across layers and runs (the reference's cell-11
+    table, BASELINE.md row 'Layerwise robustness AUC')."""
+    per_method: Dict[str, List[float]] = {}
+    for layer in results.values():
+        for method, runs in layer.items():
+            per_method.setdefault(method, []).extend(r["auc"] for r in runs)
+    return {m: float(np.mean(v)) for m, v in per_method.items()}

@@ -35,7 +35,17 @@ def vgg16_bn(
     input_shape: Tuple[int, int, int] = (32, 32, 3),
     classifier_width: int = 512,
     dropout: float = 0.5,
+    width_multiplier: float = 1.0,
 ) -> SegmentedModel:
+    """``width_multiplier`` scales every conv width (same 16-layer structure
+    at a fraction of the size — used for multi-chip dryruns on tiny shapes).
+    Must satisfy ``64 * width_multiplier >= 1`` so every layer keeps at
+    least one channel; widths round down."""
+    if width_multiplier <= 0 or 64 * width_multiplier < 1:
+        raise ValueError(
+            f"width_multiplier {width_multiplier} would produce empty conv "
+            "layers (need 64 * width_multiplier >= 1)"
+        )
     layers = []
     conv_i = 0
     pool_i = 0
@@ -45,7 +55,8 @@ def vgg16_bn(
             layers.append(L.Pool(f"pool{pool_i}", "max", (2, 2)))
         else:
             conv_i += 1
-            layers.append(L.Conv(f"conv{conv_i}", int(v), kernel_size=(3, 3)))
+            width = int(int(v) * width_multiplier)
+            layers.append(L.Conv(f"conv{conv_i}", width, kernel_size=(3, 3)))
             layers.append(L.BatchNorm(f"bn{conv_i}"))
             layers.append(L.Activation(f"relu{conv_i}", "relu"))
     layers.append(L.Flatten("flatten"))

@@ -1,0 +1,35 @@
+"""Distribution layer — device meshes, sharding rules, data-parallel
+attribution scoring and DP/FSDP training.
+
+The reference has NO distributed components at all (single process, one
+device — SURVEY.md §2.11); this subsystem is the TPU-native capability that
+replaces the torch-DDP/NCCL layer the north-star workload would otherwise
+need (BASELINE.json).  There is no hand-written communication backend: the
+mesh + named shardings make XLA insert the collectives (all-reduce of
+gradients for DP, all-gather/reduce-scatter for FSDP parameters, psum of
+score moments for distributed attribution), riding ICI within a pod and DCN
+across pods.
+"""
+
+from torchpruner_tpu.parallel.mesh import make_mesh, mesh_axes
+from torchpruner_tpu.parallel.sharding import (
+    batch_sharding,
+    fsdp_sharding,
+    replicate,
+    shard_batch,
+    shard_params,
+)
+from torchpruner_tpu.parallel.scoring import DistributedScorer
+from torchpruner_tpu.parallel.train import ShardedTrainer
+
+__all__ = [
+    "make_mesh",
+    "mesh_axes",
+    "batch_sharding",
+    "fsdp_sharding",
+    "replicate",
+    "shard_batch",
+    "shard_params",
+    "DistributedScorer",
+    "ShardedTrainer",
+]

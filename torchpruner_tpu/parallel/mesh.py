@@ -1,0 +1,58 @@
+"""Device-mesh construction.
+
+One mesh, named axes, everything else is sharding annotations — the
+"pick a mesh, annotate shardings, let XLA insert collectives" recipe.
+Default axes: ``data`` (DP / sharded scoring) × ``model`` (FSDP/TP).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+DEFAULT_AXES = ("data", "model")
+
+
+def make_mesh(
+    axes: Optional[Dict[str, int]] = None, devices: Optional[Sequence] = None
+) -> Mesh:
+    """Build a mesh from ``{axis_name: size}``.
+
+    - ``axes=None``: all devices on a 1-D ``data`` axis (pure DP).
+    - sizes may use ``-1`` once, meaning "whatever is left".
+    - the product must equal the device count.
+
+    On real TPU slices ``mesh_utils.create_device_mesh`` lays the axes out so
+    the innermost axis maps to physically-adjacent chips (ICI neighbors);
+    put the highest-bandwidth-demand axis (``model``) last.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if axes is None:
+        axes = {"data": n}
+    names = tuple(axes.keys())
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis size may be -1")
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError(
+            f"mesh {dict(zip(names, sizes))} needs {int(np.prod(sizes))} "
+            f"devices, have {n}"
+        )
+    mesh_devices = mesh_utils.create_device_mesh(
+        tuple(sizes), devices=devices
+    )
+    return Mesh(mesh_devices, names)
+
+
+def mesh_axes(mesh: Mesh) -> Dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))

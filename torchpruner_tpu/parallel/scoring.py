@@ -1,0 +1,89 @@
+"""Data-parallel attribution scoring.
+
+The reference scores on one device, one batch at a time (SURVEY.md §2.11);
+here the per-example score rows — the uniform currency of every metric
+(``make_row_fn``) — are computed SPMD with the batch sharded over the
+``data`` mesh axis.  Reductions happen as distributed moments (Σx, Σx², N
+psum-reduced by XLA when the sharded rows are summed), so ``mean``, ``sum``
+and ``mean+2std`` never gather the ``(examples, n_units)`` matrix; ``none``
+or arbitrary callables gather rows to host (both forms exposed, SURVEY.md
+§7 "Distributed scoring semantics").
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+from torchpruner_tpu.attributions.base import AttributionMetric
+from torchpruner_tpu.parallel.sharding import shard_batch
+from torchpruner_tpu.utils.reductions import from_moments, mean_plus_2std
+
+MOMENT_REDUCTIONS = ("mean", "sum", "mean+2std")
+
+
+class DistributedScorer:
+    """Wrap any attribution metric to score with batches sharded over the
+    mesh's ``data`` axis.
+
+    ``scorer = DistributedScorer(metric, mesh); scores = scorer.run(layer)``
+    gives the same result as ``metric.run(layer)`` (same rows, same
+    reduction), computed SPMD.
+    """
+
+    def __init__(self, metric: AttributionMetric, mesh, axis: str = "data"):
+        self.metric = metric
+        self.mesh = mesh
+        self.axis = axis
+
+    def run(self, layer: str, *, find_best_evaluation_layer: bool = False,
+            **kw) -> np.ndarray:
+        metric = self.metric
+        try:
+            metric.make_row_fn  # weight-only metrics have no rows to shard
+        except AttributeError:  # pragma: no cover
+            pass
+        if type(metric).make_row_fn is AttributionMetric.make_row_fn:
+            return metric.run(
+                layer, find_best_evaluation_layer=find_best_evaluation_layer,
+                **kw,
+            )
+        eval_layer = metric.find_evaluation_layer(
+            layer, find_best_evaluation_layer
+        )
+        row_fn = metric.make_row_fn(eval_layer, **kw)
+        reduction = metric.reduction
+        momentish = (
+            reduction in ("mean", "sum", "mean+2std")
+            or reduction is mean_plus_2std
+        )
+
+        if momentish:
+            red = (
+                "mean+2std"
+                if reduction is mean_plus_2std or reduction == "mean+2std"
+                else reduction
+            )
+            s1 = s2 = None
+            n = 0
+            for batch in metric.batches():
+                x, y = shard_batch(batch, self.mesh, self.axis)
+                rows = row_fn(metric.params, metric.state, x, y)
+                b1 = jnp.sum(rows, axis=0)   # cross-device psum via XLA
+                b2 = jnp.sum(rows * rows, axis=0)
+                s1 = b1 if s1 is None else s1 + b1
+                s2 = b2 if s2 is None else s2 + b2
+                n += int(np.shape(batch[0])[0])
+            return np.asarray(
+                from_moments(red, np.asarray(s1), np.asarray(s2), n)
+            )
+
+        # row-gathering path: 'none' or arbitrary callables
+        out = []
+        for batch in metric.batches():
+            x, y = shard_batch(batch, self.mesh, self.axis)
+            out.append(np.asarray(row_fn(metric.params, metric.state, x, y)))
+        return metric.aggregate_over_samples(np.concatenate(out, axis=0))

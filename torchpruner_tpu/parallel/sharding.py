@@ -1,0 +1,80 @@
+"""Sharding rules: how params and batches map onto the mesh.
+
+FSDP here = shard each (large-enough) parameter's largest divisible axis
+over the ``model`` mesh axis; XLA all-gathers parameters into the matmuls
+and reduce-scatters gradients — no hand-written collectives.  After a prune
+step changes parameter shapes, call :func:`shard_params` again: arrays whose
+pruned axis no longer divides the mesh fall back to replication (resharding
+smaller arrays over the same mesh, SURVEY.md §5.8c).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Shard axis 0 (batch) over the data axis; replicate the rest."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicate(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def fsdp_spec(shape, mesh: Mesh, axis: str = "model", min_size: int = 2**14):
+    """PartitionSpec for one array: shard the largest dim divisible by the
+    mesh axis; replicate small or indivisible arrays."""
+    if axis not in mesh.axis_names:
+        return P()
+    size = mesh.shape[axis]
+    if size == 1 or int(np.prod(shape)) < min_size:
+        return P()
+    dims = sorted(range(len(shape)), key=lambda d: -shape[d])
+    for d in dims:
+        if shape[d] % size == 0:
+            spec = [None] * len(shape)
+            spec[d] = axis
+            return P(*spec)
+    return P()
+
+
+def fsdp_sharding(tree, mesh: Mesh, axis: str = "model",
+                  min_size: int = 2**14):
+    """Sharding pytree (same structure as ``tree``) under the FSDP rule."""
+    return jax.tree_util.tree_map(
+        lambda leaf: NamedSharding(
+            mesh, fsdp_spec(np.shape(leaf), mesh, axis, min_size)
+        ),
+        tree,
+    )
+
+
+def shard_params(tree, mesh: Mesh, axis: str = "model",
+                 min_size: int = 2**14):
+    """Place a params-like pytree on the mesh under the FSDP rule.
+    Returns ``(sharded_tree, sharding_tree)``."""
+    shardings = fsdp_sharding(tree, mesh, axis, min_size)
+    placed = jax.device_put(tree, shardings)
+    return placed, shardings
+
+
+def shard_batch(batch, mesh: Mesh, axis: str = "data"):
+    """Place ``(x, y)`` with batch dim sharded over the data axis.  The
+    leading dim must divide the axis size (callers pad or drop the
+    remainder — ``Dataset.iter_batches(drop_remainder=True)``)."""
+    sh = batch_sharding(mesh, axis)
+
+    def put(a):
+        if a.shape[0] % mesh.shape[axis]:
+            raise ValueError(
+                f"batch dim {a.shape[0]} not divisible by mesh axis "
+                f"{axis}={mesh.shape[axis]}"
+            )
+        return jax.device_put(a, sh)
+
+    return jax.tree_util.tree_map(put, batch)

@@ -1,0 +1,174 @@
+"""Sharded training: DP over the ``data`` axis × FSDP over ``model``.
+
+The train step is the same pure function as the single-device one
+(torchpruner_tpu/train/loop.py); distribution is entirely in the placement:
+params/opt-state live sharded under the FSDP rule, batches arrive sharded on
+``data``, and jit compiles one SPMD program in which XLA has inserted the
+gradient all-reduce (DP) and parameter all-gather / gradient reduce-scatter
+(FSDP).  ``out_shardings`` pins results to the input layout so buffers are
+donated cleanly step to step.
+
+After a prune step, ``rebuild`` re-shards the smaller arrays over the same
+mesh and recompiles at the new shapes — the distributed version of the
+recompilation economics in SURVEY.md §7.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchpruner_tpu.core.segment import SegmentedModel
+from torchpruner_tpu.parallel.sharding import (
+    batch_sharding,
+    fsdp_sharding,
+    replicate,
+    shard_batch,
+)
+
+
+def make_sharded_train_step(
+    model: SegmentedModel,
+    tx,
+    loss_fn,
+    mesh: Mesh,
+    param_shardings,
+    state_shardings,
+    opt_shardings,
+    data_axis: str = "data",
+):
+    """Compile the SPMD train step with explicit in/out shardings."""
+    bs = batch_sharding(mesh, data_axis)
+    rep = replicate(mesh)
+
+    def step(params, state, opt_state, x, y, rng):
+        def loss(p):
+            out, new_state = model.apply(p, x, state=state, train=True,
+                                         rng=rng)
+            return jnp.mean(loss_fn(out, y)), new_state
+
+        (l, new_state), grads = jax.value_and_grad(loss, has_aux=True)(params)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_state, new_opt, l
+
+    return jax.jit(
+        step,
+        in_shardings=(param_shardings, state_shardings, opt_shardings,
+                      bs, bs, rep),
+        out_shardings=(param_shardings, state_shardings, opt_shardings, rep),
+        donate_argnums=(0, 2),
+    )
+
+
+@dataclass
+class ShardedTrainer:
+    """DP×FSDP trainer over a mesh; same surface as ``train.loop.Trainer``."""
+
+    model: SegmentedModel
+    params: Any
+    state: Any
+    tx: Any
+    opt_state: Any
+    loss_fn: Callable
+    rng: Any
+    mesh: Mesh
+    data_axis: str = "data"
+    model_axis: str = "model"
+    min_shard_size: int = 2**14
+    _step_fn: Any = field(default=None, repr=False)
+    step_count: int = 0
+
+    @classmethod
+    def create(
+        cls,
+        model: SegmentedModel,
+        tx,
+        loss_fn,
+        mesh: Mesh,
+        seed: int = 0,
+        data_axis: str = "data",
+        model_axis: str = "model",
+        min_shard_size: int = 2**14,
+    ) -> "ShardedTrainer":
+        key = jax.random.PRNGKey(seed)
+        params, state = model.init(key)
+        opt_state = tx.init(params)
+        t = cls(
+            model=model, params=params, state=state, tx=tx,
+            opt_state=opt_state, loss_fn=loss_fn, rng=key, mesh=mesh,
+            data_axis=data_axis, model_axis=model_axis,
+            min_shard_size=min_shard_size,
+        )
+        t._place()
+        return t
+
+    # -- placement ---------------------------------------------------------
+
+    def _shardings(self):
+        ps = fsdp_sharding(self.params, self.mesh, self.model_axis,
+                           self.min_shard_size)
+        ss = jax.tree_util.tree_map(lambda _: replicate(self.mesh), self.state)
+        # optimizer-state leaves shaped like a param shard with it; the rest
+        # (step counts etc.) replicate
+        flat_p = {
+            tuple(np.shape(l)): s
+            for l, s in zip(
+                jax.tree_util.tree_leaves(self.params),
+                jax.tree_util.tree_leaves(ps),
+            )
+        }
+
+        def opt_rule(leaf):
+            return flat_p.get(tuple(np.shape(leaf)), replicate(self.mesh))
+
+        os_ = jax.tree_util.tree_map(opt_rule, self.opt_state)
+        return ps, ss, os_
+
+    def _place(self):
+        ps, ss, os_ = self._shardings()
+        self.params = jax.device_put(self.params, ps)
+        self.state = jax.device_put(self.state, ss)
+        self.opt_state = jax.device_put(self.opt_state, os_)
+        self._step_fn = make_sharded_train_step(
+            self.model, self.tx, self.loss_fn, self.mesh, ps, ss, os_,
+            self.data_axis,
+        )
+
+    # -- training ----------------------------------------------------------
+
+    def step(self, x, y) -> float:
+        x, y = shard_batch((jnp.asarray(x), jnp.asarray(y)), self.mesh,
+                           self.data_axis)
+        self.rng, sub = jax.random.split(self.rng)
+        self.params, self.state, self.opt_state, l = self._step_fn(
+            self.params, self.state, self.opt_state, x, y, sub
+        )
+        self.step_count += 1
+        return l
+
+    def rebuild(self, model, params, state, opt_state) -> "ShardedTrainer":
+        """Adopt pruned (smaller) pytrees: re-shard over the same mesh,
+        recompile the step."""
+        t = ShardedTrainer(
+            model=model, params=params,
+            state=state if state is not None else {},
+            tx=self.tx, opt_state=opt_state, loss_fn=self.loss_fn,
+            rng=self.rng, mesh=self.mesh, data_axis=self.data_axis,
+            model_axis=self.model_axis, min_shard_size=self.min_shard_size,
+            step_count=self.step_count,
+        )
+        t._place()
+        return t
+
+    def evaluate(self, data):
+        from torchpruner_tpu.train.loop import evaluate
+
+        return evaluate(self.model, self.params, self.state, data,
+                        self.loss_fn)

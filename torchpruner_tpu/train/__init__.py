@@ -1,0 +1,21 @@
+"""Training subsystem: jitted train/eval steps, the prune→fine-tune driver,
+and experiment logging (the TPU-native equivalent of the reference's
+experiments/utils/, reference experiments/utils/train.py + utils.py)."""
+
+from torchpruner_tpu.train.loop import (
+    Trainer,
+    evaluate,
+    make_eval_step,
+    make_train_step,
+    train_epoch,
+)
+from torchpruner_tpu.train.logger import CSVLogger
+
+__all__ = [
+    "Trainer",
+    "evaluate",
+    "make_eval_step",
+    "make_train_step",
+    "train_epoch",
+    "CSVLogger",
+]

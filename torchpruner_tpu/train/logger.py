@@ -1,0 +1,94 @@
+"""Structured experiment logging.
+
+Keeps the reference's CSV schema — one row per prune step with pre/post-prune
+metrics, parameter count, FLOPs, layer widths and prune time (reference
+experiments/utils/utils.py:39-74) — plus JSONL mirroring and proper
+``logging`` instead of bare prints (SURVEY.md §5.5).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+log = logging.getLogger("torchpruner_tpu")
+
+CSV_FIELDS = [
+    "timestamp",
+    "experiment",
+    "step",
+    "layer",
+    "method",
+    "test_loss",
+    "test_acc",
+    "test_loss_pp",   # post-prune ("pp" naming from reference utils.py:58-62)
+    "test_acc_pp",
+    "n_params",
+    "flops",
+    "widths",
+    "prune_time",
+    "prune_ratio",
+]
+
+
+@dataclass
+class CSVLogger:
+    """Append one row per prune step to ``path`` (+ ``path.jsonl``)."""
+
+    path: str
+    experiment: str = "experiment"
+    _step: int = 0
+
+    def __post_init__(self):
+        new = not os.path.exists(self.path)
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        if new:
+            with open(self.path, "w", newline="") as f:
+                csv.DictWriter(f, CSV_FIELDS).writeheader()
+
+    def log_prune_step(
+        self,
+        *,
+        layer: str,
+        method: str,
+        test_loss: float,
+        test_acc: float,
+        test_loss_pp: float,
+        test_acc_pp: float,
+        n_params: int,
+        flops: Optional[float] = None,
+        widths: Optional[dict] = None,
+        prune_time: float = 0.0,
+        prune_ratio: Optional[float] = None,
+    ):
+        row = {
+            "timestamp": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "experiment": self.experiment,
+            "step": self._step,
+            "layer": layer,
+            "method": method,
+            "test_loss": f"{test_loss:.6f}",
+            "test_acc": f"{test_acc:.6f}",
+            "test_loss_pp": f"{test_loss_pp:.6f}",
+            "test_acc_pp": f"{test_acc_pp:.6f}",
+            "n_params": n_params,
+            "flops": flops if flops is not None else "",
+            "widths": "-".join(str(v) for v in (widths or {}).values()),
+            "prune_time": f"{prune_time:.3f}",
+            "prune_ratio": prune_ratio if prune_ratio is not None else "",
+        }
+        with open(self.path, "a", newline="") as f:
+            csv.DictWriter(f, CSV_FIELDS).writerow(row)
+        with open(self.path + ".jsonl", "a") as f:
+            f.write(json.dumps(row) + "\n")
+        log.info(
+            "prune step %d [%s/%s]: loss %.4f→%.4f acc %.4f→%.4f params %d",
+            self._step, layer, method, test_loss, test_loss_pp,
+            test_acc, test_acc_pp, n_params,
+        )
+        self._step += 1

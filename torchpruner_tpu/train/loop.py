@@ -1,0 +1,150 @@
+"""Jitted training and evaluation loops.
+
+The reference's epoch loops (reference experiments/utils/train.py:11-72) run
+batch-at-a-time Python with host-side printing; here the per-batch step is a
+single donated jit computation (params/opt-state buffers reused in place —
+the XLA equivalent of in-place updates), and the epoch loop only feeds data.
+
+After a prune step changes shapes, build a new ``Trainer`` (or call
+``Trainer.rebuild``) — retrace happens automatically because the model spec
+changed.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+import optax
+
+from torchpruner_tpu.core.segment import SegmentedModel
+from torchpruner_tpu.utils.losses import accuracy
+
+
+def make_train_step(model: SegmentedModel, tx, loss_fn, donate: bool = True):
+    """(params, state, opt_state, x, y, rng) -> (params, state, opt_state,
+    loss).  Donation reuses the input buffers for the outputs."""
+
+    def step(params, state, opt_state, x, y, rng):
+        def loss(p):
+            out, new_state = model.apply(
+                p, x, state=state, train=True, rng=rng
+            )
+            return jnp.mean(loss_fn(out, y)), new_state
+
+        (l, new_state), grads = jax.value_and_grad(loss, has_aux=True)(params)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_state, new_opt, l
+
+    donate_argnums = (0, 2) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)
+
+
+def make_eval_step(model: SegmentedModel, loss_fn):
+    """(params, state, x, y) -> (sum per-example loss, #correct, n)."""
+
+    def step(params, state, x, y):
+        out, _ = model.apply(params, x, state=state, train=False)
+        losses = loss_fn(out, y)
+        correct = jnp.sum(jnp.argmax(out, axis=-1) == y)
+        return jnp.sum(losses), correct, losses.shape[0]
+
+    return jax.jit(step)
+
+
+def evaluate(model, params, state, data, loss_fn):
+    """Average loss and accuracy over ``data`` (reference train.py:51-72)."""
+    step = make_eval_step(model, loss_fn)
+    tot_l, tot_c, tot_n = 0.0, 0, 0
+    for x, y in (data() if callable(data) else data):
+        l, c, n = step(params, state, x, y)
+        tot_l += float(l)
+        tot_c += int(c)
+        tot_n += int(n)
+    if tot_n == 0:
+        raise ValueError("evaluate() got an empty dataset")
+    return tot_l / tot_n, tot_c / tot_n
+
+
+def train_epoch(trainer, data, epoch: int = 0, log_every: int = 20,
+                verbose: bool = True):
+    """One epoch over ``data``; returns (avg loss, avg acc is not computed
+    here — use evaluate).  Mirrors reference train.py:11-48's cadence."""
+    t0 = time.perf_counter()
+    losses = []
+    for i, (x, y) in enumerate(data() if callable(data) else data):
+        l = trainer.step(x, y)
+        losses.append(float(l))
+        if verbose and i % log_every == 0:
+            dt = time.perf_counter() - t0
+            print(
+                f"epoch {epoch} batch {i}: loss {losses[-1]:.4f} "
+                f"({dt:.1f}s)", flush=True
+            )
+    return float(np.mean(losses)) if losses else float("nan")
+
+
+@dataclass
+class Trainer:
+    """Holds the mutable training bundle and its compiled step.
+
+    Rebuild after pruning: ``trainer = trainer.rebuild(res.model,
+    res.params, res.state, res.opt_state)`` — new spec ⇒ new compiled step
+    at the smaller shapes (SURVEY.md §7 "recompilation economics").
+    """
+
+    model: SegmentedModel
+    params: Any
+    state: Any
+    tx: Any
+    opt_state: Any
+    loss_fn: Callable
+    rng: Any
+    _step_fn: Any = field(default=None, repr=False)
+    step_count: int = 0
+
+    @classmethod
+    def create(cls, model, tx, loss_fn, seed: int = 0, params=None, state=None):
+        key = jax.random.PRNGKey(seed)
+        if params is None:
+            params, state = model.init(key)
+        return cls(
+            model=model,
+            params=params,
+            state=state if state is not None else {},
+            tx=tx,
+            opt_state=tx.init(params),
+            loss_fn=loss_fn,
+            rng=key,
+        )
+
+    def step(self, x, y) -> float:
+        if self._step_fn is None:
+            self._step_fn = make_train_step(self.model, self.tx, self.loss_fn)
+        self.rng, sub = jax.random.split(self.rng)
+        self.params, self.state, self.opt_state, l = self._step_fn(
+            self.params, self.state, self.opt_state, x, y, sub
+        )
+        self.step_count += 1
+        return l
+
+    def rebuild(self, model, params, state, opt_state) -> "Trainer":
+        return Trainer(
+            model=model,
+            params=params,
+            state=state if state is not None else {},
+            tx=self.tx,
+            opt_state=opt_state,
+            loss_fn=self.loss_fn,
+            rng=self.rng,
+            step_count=self.step_count,
+        )
+
+    def evaluate(self, data):
+        return evaluate(self.model, self.params, self.state, data, self.loss_fn)

@@ -1,0 +1,60 @@
+"""Experiment configuration — the single config system the reference lacks
+(hyperparameters are hardcoded per model file and a phantom ``args`` object,
+SURVEY.md §5.6).  One dataclass, JSON round-trippable, covering the five
+named configs in BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+
+@dataclass
+class ExperimentConfig:
+    name: str = "experiment"
+    model: str = "mnist_fc"          # model-zoo entry point name
+    dataset: str = "synthetic"       # data module entry
+    n_classes: int = 10
+
+    # attribution
+    method: str = "shapley"          # random|weight_norm|apoz|sensitivity|taylor|shapley
+    method_kwargs: Dict[str, Any] = field(default_factory=dict)
+    reduction: str = "mean"          # mean|sum|none|mean+2std
+    find_best_evaluation_layer: bool = True
+
+    # pruning schedule
+    policy: str = "negative"         # negative|fraction
+    fraction: float = 0.5
+    prune_order: str = "reverse"     # outermost layer first (reference recipe)
+    score_examples: int = 1000       # val examples used for scoring
+
+    # fine-tune loop
+    finetune_epochs: int = 0
+    batch_size: int = 64
+    eval_batch_size: int = 250
+    lr: float = 0.01
+    momentum: float = 0.0
+    weight_decay: float = 0.0
+
+    # distribution
+    mesh: Dict[str, int] = field(default_factory=dict)  # e.g. {"data": 4, "model": 2}
+
+    seed: int = 0
+    log_path: str = "logs/experiment.csv"
+
+    def to_json(self, path: str):
+        with open(path, "w") as f:
+            json.dump(dataclasses.asdict(self), f, indent=2)
+
+    @classmethod
+    def from_json(cls, path: str) -> "ExperimentConfig":
+        with open(path) as f:
+            raw = json.load(f)
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(raw) - known
+        if unknown:
+            raise ValueError(f"unknown config keys: {sorted(unknown)}")
+        return cls(**raw)

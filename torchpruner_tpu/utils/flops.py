@@ -1,0 +1,46 @@
+"""Parameter and FLOPs accounting via XLA cost analysis.
+
+Replaces the reference's ``thop.profile`` on a 2-sample random input
+(reference experiments/utils/utils.py:30-36) with the compiler's own cost
+model: ``jit(...).lower(...).compile().cost_analysis()`` — exact for the
+compiled graph, no tracing heuristics.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+from torchpruner_tpu.core.segment import SegmentedModel
+
+
+def param_count(params) -> int:
+    return int(sum(np.prod(l.shape) for l in jax.tree_util.tree_leaves(params)))
+
+
+def model_cost(
+    model: SegmentedModel, params, state=None, batch_size: int = 2
+) -> Tuple[int, Optional[float]]:
+    """Returns ``(n_params, forward_flops)`` for a ``batch_size`` forward
+    (the reference uses batch 2 because of BatchNorm, utils.py:33-34; here
+    eval-mode BN has no batch constraint but we keep the convention)."""
+    state = state if state is not None else {}
+    x = jnp.zeros((batch_size,) + tuple(model.input_shape))
+
+    def fwd(p, s, x):
+        return model.apply(p, x, state=s, train=False)[0]
+
+    flops = None
+    try:
+        compiled = jax.jit(fwd).lower(params, state, x).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca:
+            flops = float(ca.get("flops", 0.0)) or None
+    except Exception:  # cost analysis is best-effort on some backends
+        flops = None
+    return param_count(params), flops
