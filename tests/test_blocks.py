@@ -1,0 +1,463 @@
+"""Composite-block tests: Residual / attention / GLU specs, nested-path
+instrumentation, recursive pruning-graph inference, and structural pruning
+correctness via prune-vs-mask equivalence (the composite-model analog of the
+reference's NaN-cascade tests, reference tests/test_pruner.py:72-121)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.graph import (
+    find_best_evaluation_layer,
+    group_for,
+    pruning_graph,
+)
+from torchpruner_tpu.core.pruner import prune
+from torchpruner_tpu.core.segment import SegmentedModel, init_model
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+
+def resnet_blocklet():
+    """Stem conv -> projection-shortcut residual -> identity residual ->
+    head.  Covers: stem cascade into body+shortcut, inner conv groups,
+    body-final conv exclusion."""
+    return SegmentedModel(
+        layers=(
+            L.Conv("stem", 8, (3, 3), use_bias=False),
+            L.BatchNorm("stem_bn"),
+            L.Activation("stem_relu", "relu"),
+            L.Residual(
+                "block1",
+                body=(
+                    L.Conv("conv1", 8, (3, 3), use_bias=False),
+                    L.BatchNorm("bn1"),
+                    L.Activation("relu1", "relu"),
+                    L.Conv("conv2", 16, (3, 3), use_bias=False),
+                    L.BatchNorm("bn2"),
+                ),
+                shortcut=(
+                    L.Conv("sc", 16, (1, 1), use_bias=False),
+                    L.BatchNorm("sc_bn"),
+                ),
+            ),
+            L.Residual(
+                "block2",
+                body=(
+                    L.Conv("conv1", 12, (3, 3), use_bias=False),
+                    L.BatchNorm("bn1"),
+                    L.Activation("relu1", "relu"),
+                    L.Conv("conv2", 16, (3, 3), use_bias=False),
+                    L.BatchNorm("bn2"),
+                ),
+            ),
+            L.GlobalPool("pool", "avg"),
+            L.Dense("head", 10),
+        ),
+        input_shape=(8, 8, 3),
+    )
+
+
+def tiny_transformer(causal=False, gated=False, heads=4, kv_heads=None):
+    """Embedding -> pre-LN attention block -> pre-LN FFN block -> head."""
+    d, dh = 16, 4
+    ffn_body = (
+        (
+            L.RMSNorm("norm"),
+            L.GatedDense("wi", 32, fn="silu"),
+            L.Dense("wo", d, use_bias=False),
+        )
+        if gated
+        else (
+            L.LayerNorm("norm"),
+            L.Dense("wi", 32),
+            L.Activation("act", "gelu"),
+            L.Dense("wo", d),
+        )
+    )
+    norm = L.RMSNorm if gated else L.LayerNorm
+    return SegmentedModel(
+        layers=(
+            L.Embedding("emb", 11, d),
+            L.PosEmbed("pos", 12),
+            L.Residual(
+                "attn_block",
+                body=(
+                    norm("norm"),
+                    L.MultiHeadAttention(
+                        "attn", heads, dh, num_kv_heads=kv_heads,
+                        causal=causal, rope=gated, use_bias=not gated,
+                        impl="xla",
+                    ),
+                ),
+            ),
+            L.Residual("ffn_block", body=ffn_body),
+            norm("final_norm"),
+            L.GlobalPool("pool", "seq_mean"),
+            L.Dense("head", 7),
+        ),
+        input_shape=(12,),
+        input_dtype="int32",
+    )
+
+
+def tokens(model, batch=4, seed=0):
+    return model.example_input(batch, seed)
+
+
+# ---------------------------------------------------------------------------
+# spec / apply basics
+# ---------------------------------------------------------------------------
+
+
+def test_residual_forward_shapes():
+    model = resnet_blocklet()
+    params, state = init_model(model, seed=0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 8, 3))
+    y, _ = model.apply(params, x, state=state)
+    assert y.shape == (2, 10)
+    assert model.out_shape("block1") == (8, 8, 16)
+    assert model.out_shape("block1/conv1") == (8, 8, 8)
+    assert model.in_shape("block1/sc") == (8, 8, 3 * 0 + 8)  # block input: 8ch
+
+
+def test_transformer_forward_shapes():
+    for gated in (False, True):
+        model = tiny_transformer(gated=gated, causal=gated,
+                                 kv_heads=2 if gated else None)
+        params, state = init_model(model, seed=0)
+        y, _ = model.apply(params, tokens(model), state=state)
+        assert y.shape == (4, 7)
+
+
+def test_identity_residual_shape_mismatch_raises():
+    with pytest.raises(ValueError):
+        model = SegmentedModel(
+            layers=(
+                L.Dense("fc", 8),
+                L.Residual("r", body=(L.Dense("inner", 9),)),
+            ),
+            input_shape=(8,),
+        )
+        init_model(model)
+
+
+def test_nested_layer_resolution():
+    model = resnet_blocklet()
+    assert model.layer("block1/conv2").features == 16
+    assert model.layer("block1/sc").kernel_size == (1, 1)
+    with pytest.raises(KeyError):
+        model.layer("block1/nope")
+    assert model.site_shape("block1/conv1") == (8, 8, 8)
+
+
+def test_mha_site_shape_is_head_context():
+    model = tiny_transformer()
+    # (S, Dh, H): head axis last
+    assert model.site_shape("attn_block/attn") == (12, 4, 4)
+
+
+def test_widths_recurse():
+    w = resnet_blocklet().widths()
+    assert w["stem"] == 8 and w["block1/conv1"] == 8 and w["head"] == 10
+    w = tiny_transformer().widths()
+    assert w["attn_block/attn"] == 4 and w["ffn_block/wi"] == 32
+
+
+# ---------------------------------------------------------------------------
+# taps at nested sites
+# ---------------------------------------------------------------------------
+
+
+def test_nested_capture_and_mask():
+    model = resnet_blocklet()
+    params, state = init_model(model, seed=0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 8, 3))
+    y, _, z = model.apply(params, x, state=state, capture="block1/conv1")
+    assert z.shape == (2, 8, 8, 8)
+    mask = jnp.zeros((8,)).at[:4].set(1.0)
+    y2, _, z2 = model.apply(
+        params, x, state=state, unit_mask=("block1/conv1", mask),
+        capture="block1/conv1",
+    )
+    assert np.allclose(np.asarray(z2[..., 4:]), 0.0)
+    assert not np.allclose(np.asarray(y), np.asarray(y2))
+
+
+def test_head_mask_zeroes_head_contribution():
+    model = tiny_transformer()
+    params, state = init_model(model, seed=0)
+    x = tokens(model)
+    z = model.apply(params, x, state=state, capture="attn_block/attn")[2]
+    assert z.shape == (4, 12, 4, 4)  # (B, S, Dh, H)
+    # masking ALL heads == zero attention output == residual passthrough
+    y_masked, _ = model.apply(
+        params, x, state=state,
+        unit_mask=("attn_block/attn", jnp.zeros((4,))),
+    )
+    # manually compute: remove the attention block entirely except bo
+    bo = params["attn_block"]["attn"].get("bo")
+    stripped = SegmentedModel(
+        layers=tuple(
+            l for l in model.layers if l.name != "attn_block"
+        ),
+        input_shape=model.input_shape,
+        input_dtype=model.input_dtype,
+    )
+    sp = {k: v for k, v in params.items() if k != "attn_block"}
+    h, _ = stripped.apply(sp, x, state=state)
+    # not exactly equal (bo still added); equal when bo is zero at init
+    assert np.allclose(np.asarray(y_masked), np.asarray(h), atol=1e-5)
+
+
+def test_perturb_matches_mask_gradient():
+    """grad wrt an additive perturbation at a site == activation gradient."""
+    model = tiny_transformer(gated=True, kv_heads=2)
+    params, state = init_model(model, seed=0)
+    x = tokens(model)
+    site = "ffn_block/wi"
+    zshape = model.site_shape(site)
+
+    def loss_via_perturb(delta):
+        y, _ = model.apply(params, x, state=state, perturb=(site, delta))
+        return jnp.sum(y**2)
+
+    g = jax.grad(loss_via_perturb)(jnp.zeros((4,) + zshape))
+    assert g.shape == (4,) + zshape
+    assert float(jnp.abs(g).sum()) > 0
+
+
+# ---------------------------------------------------------------------------
+# pruning-graph inference
+# ---------------------------------------------------------------------------
+
+
+def test_resnet_pruning_graph():
+    model = resnet_blocklet()
+    graph = pruning_graph(model)
+    targets = {g.target: g for g in graph}
+    # stem cascades into both block1 chains (projection shortcut present)
+    assert "stem" in targets
+    stem = targets["stem"]
+    assert {c.layer for c in stem.consumers} == {"block1/conv1", "block1/sc"}
+    assert {b.layer for b in stem.attached_bn} == {"stem_bn"}
+    # inner conv1 groups prunable; body-final conv2 and shortcut sc excluded
+    assert "block1/conv1" in targets and "block2/conv1" in targets
+    assert "block1/conv2" not in targets
+    assert "block1/sc" not in targets
+    assert "block2/conv2" not in targets
+    # block2 has an identity skip: nothing cascades into it from outside
+    inner = targets["block1/conv1"]
+    assert {c.layer for c in inner.consumers} == {"block1/conv2"}
+    assert {b.layer for b in inner.attached_bn} == {"block1/bn1"}
+    # head (model output) excluded by default
+    assert "head" not in targets
+    assert "head" in {g.target for g in pruning_graph(model, include_output=True)}
+
+
+def test_transformer_pruning_graph():
+    for gated in (False, True):
+        model = tiny_transformer(gated=gated)
+        targets = {g.target: g for g in pruning_graph(model)}
+        # head group: self-contained
+        assert targets["attn_block/attn"].consumers == ()
+        # FFN hidden: consumer is wo inside the block
+        ffn = targets["ffn_block/wi"]
+        assert {c.layer for c in ffn.consumers} == {"ffn_block/wo"}
+        # wo (body-final) and the residual stream are not prunable
+        assert "ffn_block/wo" not in targets
+        assert "emb" not in targets
+
+
+def test_find_best_evaluation_layer_nested():
+    model = resnet_blocklet()
+    assert find_best_evaluation_layer(model, "block1/conv1") == "block1/relu1"
+    assert find_best_evaluation_layer(model, "stem") == "stem_relu"
+    t = tiny_transformer()
+    assert find_best_evaluation_layer(t, "attn_block/attn") == "attn_block/attn"
+    assert find_best_evaluation_layer(t, "ffn_block/wi") == "ffn_block/wi"
+
+
+# ---------------------------------------------------------------------------
+# structural pruning correctness: prune ≡ mask
+# ---------------------------------------------------------------------------
+
+
+def assert_prune_equals_mask(model, target, drop, mask_site, x, atol=1e-5):
+    """Pruning units ``drop`` of ``target`` must produce the same model output
+    as zero-masking those units at ``mask_site`` (the site just before the
+    consumer — after attached norms).  Eval mode."""
+    params, state = init_model(model, seed=0)
+    n = L.n_units(model.layer(target))
+    mask = jnp.ones((n,)).at[jnp.asarray(drop)].set(0.0)
+    y_masked, _ = model.apply(
+        params, x, state=state, unit_mask=(mask_site, mask)
+    )
+    res = prune(model, params, target, drop, state=state)
+    y_pruned, _ = res.model.apply(res.params, x, state=res.state)
+    np.testing.assert_allclose(
+        np.asarray(y_masked), np.asarray(y_pruned), atol=atol
+    )
+    return res
+
+
+def test_prune_resnet_inner_conv():
+    model = resnet_blocklet()
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 8, 8, 3))
+    res = assert_prune_equals_mask(
+        model, "block1/conv1", [1, 5, 6], "block1/relu1", x
+    )
+    assert res.model.layer("block1/conv1").features == 5
+    assert res.params["block1"]["conv1"]["w"].shape == (3, 3, 8, 5)
+    assert res.params["block1"]["conv2"]["w"].shape == (3, 3, 5, 16)
+    assert res.params["block1"]["bn1"]["scale"].shape == (5,)
+    assert res.state["block1"]["bn1"]["mean"].shape == (5,)
+
+
+def test_prune_resnet_stem_cascades_into_block():
+    model = resnet_blocklet()
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 8, 8, 3))
+    res = assert_prune_equals_mask(model, "stem", [0, 7], "stem_relu", x)
+    assert res.params["stem"]["w"].shape == (3, 3, 3, 6)
+    assert res.params["block1"]["conv1"]["w"].shape == (3, 3, 6, 8)
+    assert res.params["block1"]["sc"]["w"].shape == (1, 1, 6, 16)
+    assert res.params["stem_bn"]["scale"].shape == (6,)
+
+
+def test_prune_ffn_hidden_dense():
+    model = tiny_transformer(gated=False)
+    x = tokens(model)
+    # mask site: hidden activations after gelu (== after what pruning cuts)
+    res = assert_prune_equals_mask(
+        model, "ffn_block/wi", [0, 3, 31], "ffn_block/act", x
+    )
+    assert res.params["ffn_block"]["wi"]["w"].shape == (16, 29)
+    assert res.params["ffn_block"]["wo"]["w"].shape == (29, 16)
+
+
+def test_prune_ffn_hidden_gated():
+    model = tiny_transformer(gated=True, kv_heads=2)
+    x = tokens(model)
+    res = assert_prune_equals_mask(
+        model, "ffn_block/wi", [2, 17], "ffn_block/wi", x
+    )
+    assert res.params["ffn_block"]["wi"]["wg"].shape == (16, 30)
+    assert res.params["ffn_block"]["wi"]["wu"].shape == (16, 30)
+    assert res.params["ffn_block"]["wo"]["w"].shape == (30, 16)
+
+
+def test_prune_attention_heads_mha():
+    model = tiny_transformer(gated=False)
+    x = tokens(model)
+    res = assert_prune_equals_mask(
+        model, "attn_block/attn", [1, 2], "attn_block/attn", x
+    )
+    attn = res.model.layer("attn_block/attn")
+    assert attn.num_heads == 2 and attn.kv_heads == 2
+    p = res.params["attn_block"]["attn"]
+    assert p["wq"].shape == (16, 2, 4)
+    assert p["wk"].shape == (16, 2, 4)
+    assert p["wo"].shape == (2, 4, 16)
+    assert p["bq"].shape == (2, 4)
+
+
+def test_prune_attention_heads_gqa():
+    """GQA: query heads prunable, shared KV heads untouched."""
+    model = tiny_transformer(gated=True, causal=True, kv_heads=2)
+    x = tokens(model)
+    res = assert_prune_equals_mask(
+        model, "attn_block/attn", [3], "attn_block/attn", x
+    )
+    attn = res.model.layer("attn_block/attn")
+    assert attn.num_heads == 3 and attn.kv_heads == 2
+    p = res.params["attn_block"]["attn"]
+    assert p["wq"].shape == (16, 3, 4)
+    assert p["wk"].shape == (16, 2, 4)  # shared KV: not sliced
+    assert p["wo"].shape == (3, 4, 16)
+
+
+def test_prune_gqa_head_forward_still_runs():
+    """After pruning a GQA query head, H is no longer divisible by KV —
+    the grouped repeat must still map groups correctly."""
+    model = tiny_transformer(gated=True, causal=True, kv_heads=2)
+    params, state = init_model(model, seed=0)
+    x = tokens(model)
+    res = prune(model, params, "attn_block/attn", [0], state=state)
+    y, _ = res.model.apply(res.params, x, state=res.state)
+    assert y.shape == (4, 7)
+    assert np.all(np.isfinite(np.asarray(y)))
+
+
+def test_prune_with_optimizer_state():
+    model = tiny_transformer(gated=True, kv_heads=2)
+    params, state = init_model(model, seed=0)
+    tx = optax.adamw(1e-3)
+    opt_state = tx.init(params)
+    res = prune(
+        model, params, "ffn_block/wi", [0, 1], state=state,
+        opt_state=opt_state,
+    )
+    # Adam mu/nu sliced alongside params
+    flat = jax.tree_util.tree_leaves_with_path(res.opt_state)
+    mus = [
+        leaf
+        for path, leaf in flat
+        if any("wg" == getattr(k, "key", None) for k in path)
+        and hasattr(leaf, "shape")
+    ]
+    assert mus and all(m.shape == (16, 30) for m in mus)
+    # pruned training step still runs
+    def loss(p):
+        y, _ = res.model.apply(p, tokens(model), state=res.state)
+        return jnp.mean(y**2)
+
+    g = jax.grad(loss)(res.params)
+    updates, _ = tx.update(g, res.opt_state, res.params)
+    optax.apply_updates(res.params, updates)
+
+
+def test_spec_roundtrip_composite(tmp_path):
+    """Composite / transformer specs survive spec_to_dict/spec_from_dict,
+    including a pruned GQA layer's irregular kv_group and input_dtype."""
+    from torchpruner_tpu.checkpoint import spec_from_dict, spec_to_dict
+
+    model = tiny_transformer(gated=True, causal=True, kv_heads=2)
+    params, state = init_model(model, seed=0)
+    res = prune(model, params, "attn_block/attn", [0], state=state)
+    restored = spec_from_dict(spec_to_dict(res.model))
+    assert restored == res.model
+    restored2 = spec_from_dict(spec_to_dict(resnet_blocklet()))
+    assert restored2 == resnet_blocklet()
+
+
+def test_with_features_rejects_irregular_kv_group():
+    spec = L.MultiHeadAttention("a", 4, 8, num_kv_heads=2)
+    irregular = L.pruned_spec(spec, [0, 2, 3])
+    assert irregular.kv_group == (0, 1, 1)
+    with pytest.raises(ValueError):
+        L.with_features(irregular, 2)
+
+
+def test_same_avg_pool_excludes_padding():
+    model = SegmentedModel(
+        layers=(L.Pool("p", "avg", (2, 2), padding="SAME"),),
+        input_shape=(3, 3, 1),
+    )
+    x = jnp.ones((1, 3, 3, 1))
+    y, _ = model.apply({}, x)
+    # all-ones input must stay all-ones when padding is excluded
+    np.testing.assert_allclose(np.asarray(y), 1.0)
+
+
+def test_group_for_nested():
+    model = resnet_blocklet()
+    g = group_for(model, "block1/conv1")
+    assert g.target == "block1/conv1"
+    with pytest.raises(KeyError):
+        group_for(model, "block1/bn1")

@@ -23,32 +23,57 @@ from torchpruner_tpu.core.segment import SegmentedModel
 
 _LAYER_TYPES = {
     cls.__name__: cls
-    for cls in (L.Dense, L.Conv, L.BatchNorm, L.Activation, L.Pool,
-                L.Flatten, L.Dropout)
+    for cls in (L.Dense, L.Conv, L.BatchNorm, L.LayerNorm, L.RMSNorm,
+                L.Activation, L.Pool, L.GlobalPool, L.Flatten, L.Reshape,
+                L.Dropout, L.Embedding, L.PosEmbed, L.MultiHeadAttention,
+                L.GatedDense, L.Residual)
 }
+
+
+def _layer_to_dict(l: L.LayerSpec) -> dict:
+    if isinstance(l, L.Residual):
+        return {
+            "type": "Residual",
+            "fields": {
+                "name": l.name,
+                "body": [_layer_to_dict(c) for c in l.body],
+                "shortcut": [_layer_to_dict(c) for c in l.shortcut],
+            },
+        }
+    return {"type": type(l).__name__, "fields": dataclasses.asdict(l)}
+
+
+def _layer_from_dict(entry: dict) -> L.LayerSpec:
+    cls = _LAYER_TYPES[entry["type"]]
+    if cls is L.Residual:
+        f = entry["fields"]
+        return L.Residual(
+            f["name"],
+            body=tuple(_layer_from_dict(c) for c in f["body"]),
+            shortcut=tuple(_layer_from_dict(c) for c in f["shortcut"]),
+        )
+    fields = {
+        k: tuple(v) if isinstance(v, list) else v
+        for k, v in entry["fields"].items()
+    }
+    return cls(**fields)
 
 
 def spec_to_dict(model: SegmentedModel) -> dict:
     """JSON-serializable model spec (layer kinds + fields + input shape)."""
     return {
         "input_shape": list(model.input_shape),
-        "layers": [
-            {"type": type(l).__name__, "fields": dataclasses.asdict(l)}
-            for l in model.layers
-        ],
+        "input_dtype": model.input_dtype,
+        "layers": [_layer_to_dict(l) for l in model.layers],
     }
 
 
 def spec_from_dict(d: dict) -> SegmentedModel:
-    layers = []
-    for entry in d["layers"]:
-        cls = _LAYER_TYPES[entry["type"]]
-        fields = {
-            k: tuple(v) if isinstance(v, list) else v
-            for k, v in entry["fields"].items()
-        }
-        layers.append(cls(**fields))
-    return SegmentedModel(tuple(layers), tuple(d["input_shape"]))
+    return SegmentedModel(
+        tuple(_layer_from_dict(entry) for entry in d["layers"]),
+        tuple(d["input_shape"]),
+        d.get("input_dtype", "float32"),
+    )
 
 
 def save_checkpoint(

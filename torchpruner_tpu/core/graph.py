@@ -11,11 +11,27 @@ the layer vocabulary, so cascades are computable), with the NaN trick kept as
 an *oracle* used by tests to validate the static analysis — it runs eagerly in
 jnp, outside jit, exactly because NaN-propagation is data-dependent control
 flow XLA should never see.
+
+Composite blocks extend the same rules recursively:
+
+- a :class:`~torchpruner_tpu.core.layers.Residual` body/shortcut is walked
+  like a sequential model; a producer whose consumer lies within the same
+  chain is prunable, while a producer whose output reaches the residual *sum*
+  has its width pinned by the skip connection and is excluded — the block-level
+  analog of never pruning the model's output layer (reference
+  utils/graph.py:59-61);
+- attention heads (:class:`MultiHeadAttention`) and GLU channels
+  (:class:`GatedDense`) form groups whose surgery stays inside the layer/block
+  (head pruning never changes the block's output width), so they are always
+  prunable;
+- a producer immediately *preceding* a projection-shortcut Residual (e.g. a
+  ResNet stem conv) cascades into the first prunable layer of both the body
+  and the shortcut chains.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -31,79 +47,64 @@ SHIFTABLE_ACTIVATIONS = frozenset(
     {"relu", "relu6", "leaky_relu", "sigmoid", "softplus", "tanh"}
 )
 
+#: width-changing prunable producers (attention heads are handled separately:
+#: head pruning leaves the layer's output width unchanged).
+_CHANNEL_PRODUCERS = (L.Dense, L.Conv, L.GatedDense)
+
 
 def find_best_evaluation_layer(model: SegmentedModel, name: str) -> str:
-    """Walk forward from ``name`` while the next layer is a BatchNorm or a
-    shiftable activation; return the last such layer.  Scoring there measures
-    units where pruning will actually cut — after BN + nonlinearity
-    (reference torchpruner/utils/graph.py:9-34)."""
-    i = model.index(name)
-    best = name
-    for spec in model.layers[i + 1:]:
-        if isinstance(spec, L.BatchNorm) or (
-            isinstance(spec, L.Activation) and spec.fn in SHIFTABLE_ACTIVATIONS
+    """Walk forward from ``name`` while the next layer is a Batch/Layer/RMS
+    norm or a shiftable activation; return the last such layer.  Scoring there
+    measures units where pruning will actually cut — after norm + nonlinearity
+    (reference torchpruner/utils/graph.py:9-34).  Works inside Residual bodies
+    for nested paths; attention/GLU targets are their own evaluation site."""
+    path = L.parse_path(name)
+    spec = model.layer(name)
+    if isinstance(spec, (L.MultiHeadAttention, L.GatedDense)):
+        return name
+    if len(path) == 1:
+        siblings = model.layers
+    else:
+        parent = model.layer("/".join(path[:-1]))
+        siblings = parent.body if any(
+            l.name == path[-1] for l in parent.body
+        ) else parent.shortcut
+    idx = next(i for i, l in enumerate(siblings) if l.name == path[-1])
+    best = path[-1]
+    for nxt in siblings[idx + 1:]:
+        if isinstance(nxt, (L.BatchNorm, L.LayerNorm, L.RMSNorm)) or (
+            isinstance(nxt, L.Activation) and nxt.fn in SHIFTABLE_ACTIVATIONS
         ):
-            best = spec.name
+            best = nxt.name
         else:
             break
-    return best
+    return "/".join(path[:-1] + (best,))
 
 
 def pruning_graph(
     model: SegmentedModel, include_output: bool = False
 ) -> Tuple[PruneGroup, ...]:
-    """Derive the prune groups of a sequential model, in forward order.
+    """Derive the prune groups of a model, in forward order, recursing into
+    composite blocks.
 
-    Each Dense/Conv starts a group; following BatchNorm/Dropout layers attach
-    to it; the next Dense/Conv becomes its consumer, with the in-axis and
-    fan-out determined by the layers in between (Flatten introduces the
-    spatial fan-out).  The reference builds the same structure by scanning
-    ``model.modules()`` (reference utils/graph.py:37-61) and then *re-derives*
-    the index maps at prune time with NaNs; here the fan-out is static.
+    Each Dense/Conv/GatedDense starts a width-changing group; following
+    norm/Dropout layers attach to it; the next prunable layer becomes its
+    consumer, with the in-axis and fan-out determined by the layers in between
+    (Flatten introduces the spatial fan-out).  MultiHeadAttention layers form
+    self-contained head groups.  The reference builds the sequential version
+    of this by scanning ``model.modules()`` (reference utils/graph.py:37-61)
+    and then *re-derives* the index maps at prune time with NaNs; here the
+    fan-out is static.
 
-    ``include_output=False`` drops the final group (the classifier head),
-    matching the reference convention of never pruning the output layer
-    (reference utils/graph.py:59-61).
+    ``include_output=False`` drops the final top-level group (the classifier
+    head), matching the reference convention of never pruning the output layer
+    (reference utils/graph.py:59-61).  Groups whose producer feeds a residual
+    sum are always excluded (width pinned by the skip connection).
     """
-    shapes = model.shapes
-    groups = []
-    current: Optional[dict] = None  # mutable build of the open group
-
-    for i, spec in enumerate(model.layers):
-        if isinstance(spec, L.PRUNABLE_TYPES):
-            if current is not None:
-                fan_out = current["fan_out"]
-                axis = 0 if isinstance(spec, L.Dense) else 2
-                current["consumers"].append(
-                    Consumer(layer=spec.name, param="w", axis=axis, fan_out=fan_out)
-                )
-                groups.append(_close(current))
-            current = {
-                "target": spec.name,
-                "bn": [],
-                "dropout": [],
-                "consumers": [],
-                "fan_out": 1,
-            }
-        elif current is not None:
-            if isinstance(spec, L.BatchNorm):
-                current["bn"].append(
-                    AttachedNorm(spec.name, fan_out=current["fan_out"])
-                )
-            elif isinstance(spec, L.Dropout):
-                current["dropout"].append(spec.name)
-            elif isinstance(spec, L.Flatten):
-                in_shape = shapes[i][0]
-                spatial = 1
-                for d in in_shape[:-1]:
-                    spatial *= d
-                current["fan_out"] *= spatial
-            # Activation / Pool: transparent for unit identity.
-
-    if current is not None:
-        groups.append(_close(current))
-    if not include_output and groups and not groups[-1].consumers:
-        groups = groups[:-1]
+    groups: List[PruneGroup] = []
+    open_group = _walk(model.layers, (), tuple(model.input_shape), groups)
+    if include_output and open_group is not None:
+        groups.append(_close(open_group))
     return tuple(groups)
 
 
@@ -113,6 +114,143 @@ def group_for(model: SegmentedModel, layer: str) -> PruneGroup:
         if g.target == layer:
             return g
     raise KeyError(f"{layer!r} is not a prunable layer of this model")
+
+
+def _join(prefix: Tuple[str, ...], name: str) -> str:
+    return "/".join(prefix + (name,))
+
+
+def _consumer_entries(spec: L.LayerSpec, path: str, fan_out: int):
+    """Consumer slices when ``spec``'s *input* width shrinks."""
+    if isinstance(spec, L.Dense):
+        return [Consumer(path, "w", axis=0, fan_out=fan_out)]
+    if isinstance(spec, L.Conv):
+        return [Consumer(path, "w", axis=2, fan_out=fan_out)]
+    if isinstance(spec, L.GatedDense):
+        return [
+            Consumer(path, "wg", axis=0, fan_out=fan_out),
+            Consumer(path, "wu", axis=0, fan_out=fan_out),
+        ]
+    if isinstance(spec, L.MultiHeadAttention):
+        return [
+            Consumer(path, "wq", axis=0, fan_out=fan_out),
+            Consumer(path, "wk", axis=0, fan_out=fan_out),
+            Consumer(path, "wv", axis=0, fan_out=fan_out),
+        ]
+    raise TypeError(f"{type(spec).__name__} cannot consume")
+
+
+def _walk(
+    layers: Tuple[L.LayerSpec, ...],
+    prefix: Tuple[str, ...],
+    in_shape: Tuple[int, ...],
+    groups: List[PruneGroup],
+) -> Optional[dict]:
+    """Walk one sequential scope; append closed groups to ``groups``; return
+    the group still open at scope end (its producer's output is the scope
+    output), or None."""
+    shapes = L.seq_shapes(layers, in_shape)
+    current: Optional[dict] = None
+
+    for i, spec in enumerate(layers):
+        path = _join(prefix, spec.name)
+
+        if isinstance(spec, L.MultiHeadAttention):
+            if current is not None:
+                current["consumers"] += _consumer_entries(
+                    spec, path, current["fan_out"]
+                )
+                groups.append(_close(current))
+                current = None
+            # self-contained head group: output width unchanged by pruning
+            groups.append(PruneGroup(target=path))
+
+        elif isinstance(spec, _CHANNEL_PRODUCERS):
+            if current is not None:
+                current["consumers"] += _consumer_entries(
+                    spec, path, current["fan_out"]
+                )
+                groups.append(_close(current))
+            current = {
+                "target": path,
+                "bn": [],
+                "dropout": [],
+                "consumers": [],
+                "fan_out": 1,
+            }
+
+        elif isinstance(spec, L.Residual):
+            if current is not None:
+                if _consume_into_residual(
+                    spec, prefix + (spec.name,), current
+                ):
+                    groups.append(_close(current))
+                # else: output feeds an identity skip — width pinned, drop
+                current = None
+            block_in = shapes[i][0]
+            body_open = _walk(
+                spec.body, prefix + (spec.name,), block_in, groups
+            )
+            # body-final producer feeds the residual sum: width pinned, drop
+            if spec.shortcut:
+                _walk(spec.shortcut, prefix + (spec.name,), block_in, groups)
+
+        elif current is not None:
+            if isinstance(spec, (L.BatchNorm, L.LayerNorm, L.RMSNorm)):
+                current["bn"].append(
+                    AttachedNorm(path, fan_out=current["fan_out"])
+                )
+            elif isinstance(spec, L.Dropout):
+                current["dropout"].append(path)
+            elif isinstance(spec, L.Flatten):
+                spatial = 1
+                for d in shapes[i][0][:-1]:
+                    spatial *= d
+                current["fan_out"] *= spatial
+            elif isinstance(spec, L.Reshape):
+                if shapes[i][1][-1] != shapes[i][0][-1]:
+                    # unit identity lost (channels folded) — conservative drop
+                    current = None
+            elif isinstance(spec, (L.Embedding, L.PosEmbed)):
+                current = None  # unit identity lost
+            # Activation / Pool / GlobalPool: transparent for unit identity.
+
+    return current
+
+
+def _consume_into_residual(
+    res: L.Residual, res_prefix: Tuple[str, ...], group: dict
+) -> bool:
+    """Try to cascade an open producer group into a Residual block it feeds.
+
+    Possible only with a projection shortcut (an identity skip pins the
+    producer's width); both the body and the shortcut chains must begin with
+    (norms/transparent layers followed by) a prunable consumer.  Mutates
+    ``group`` with the discovered attachments/consumers on success."""
+    if not res.shortcut:
+        return False
+    bn, consumers = [], []
+    for chain in (res.body, res.shortcut):
+        found = False
+        for spec in chain:
+            path = _join(res_prefix, spec.name)
+            if isinstance(spec, (L.BatchNorm, L.LayerNorm, L.RMSNorm)):
+                bn.append(AttachedNorm(path, fan_out=group["fan_out"]))
+            elif isinstance(spec, (L.Activation, L.Pool, L.GlobalPool)):
+                pass  # transparent
+            elif isinstance(
+                spec, _CHANNEL_PRODUCERS + (L.MultiHeadAttention,)
+            ):
+                consumers += _consumer_entries(spec, path, group["fan_out"])
+                found = True
+                break
+            else:
+                return False  # nested block / reshape before a consumer
+        if not found:
+            return False
+    group["bn"] += bn
+    group["consumers"] += consumers
+    return True
 
 
 def _close(build: dict) -> PruneGroup:
@@ -138,7 +276,9 @@ def nan_cascade_oracle(
     batch: int = 2,
     seed: int = 0,
 ) -> Dict[str, Tuple[np.ndarray, int]]:
-    """Empirically discover cascade indices by NaN propagation.
+    """Empirically discover cascade indices by NaN propagation (flat
+    top-level models; composite models are validated by prune-vs-mask
+    equivalence instead — see tests/test_blocks.py).
 
     Injects NaN at the dropped unit positions of ``target``'s output, runs the
     model eagerly (eval mode, no jit), and reports for every *prunable or

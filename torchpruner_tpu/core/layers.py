@@ -3,18 +3,26 @@
 Design notes (TPU-first):
 
 - All activation layouts are **channels-last** (``NHWC`` for images, ``(B, F)``
-  for vectors).  The prunable *unit* axis is therefore always the **last** axis
-  of an activation, so unit masking, Shapley scans and flatten fan-out maps are
-  uniform across Dense and Conv layers.  (The reference library works on torch's
-  ``NCHW`` and hardcodes "dim 1" everywhere, e.g. reference
+  for vectors, ``(B, S, D)`` for sequences).  The prunable *unit* axis is
+  therefore always the **last** axis of a layer's unit-site activation, so unit
+  masking, Shapley scans and flatten fan-out maps are uniform across Dense,
+  Conv, GatedDense and attention-head sites.  (The reference library works on
+  torch's ``NCHW`` and hardcodes "dim 1" everywhere, e.g. reference
   torchpruner/pruner/pruner.py:129-168; channels-last is both the natural JAX
   convention and what XLA tiles best onto the MXU.)
 - Layer specs are frozen, hashable dataclasses.  A model spec is static data:
   it can key jit caches, and *changing* it (pruning!) naturally triggers
-  retracing at the new shapes.
+  retracing at the new shapes.  Composite specs (:class:`Residual`) nest other
+  specs; nested layers are addressed by ``"block/child"`` path strings.
 - Parameters and mutable state (BatchNorm running statistics) are plain
-  pytrees ``{layer_name: {param_name: array}}``; apply rules are pure
-  functions ``(spec, params, state, x) -> (y, new_state)``.
+  pytrees ``{layer_name: {param_name: array}}``, nested one level per
+  composite block; apply rules are pure functions
+  ``(spec, params, state, x) -> (y, new_state)``.
+- :class:`Taps` carries the attribution instrumentation — unit masking
+  (functional replacement for the reference's masking forward hook, reference
+  shapley_values.py:92-99), additive perturbation (for activation-gradient
+  metrics via ``jax.vjp``) and activation capture — addressed by site path,
+  working at any nesting depth.
 
 Parameter layouts:
 
@@ -25,13 +33,27 @@ Parameter layouts:
   pruner.py:81-85.)
 - BatchNorm: ``scale``/``bias`` params and ``mean``/``var`` state, all
   ``(features,)`` — in-pruned along axis 0 (reference pruner.py:86-90).
+- LayerNorm/RMSNorm: ``scale`` (and LayerNorm ``bias``) ``(features,)`` —
+  in-pruned along axis 0 when their producer is pruned.
+- MultiHeadAttention: ``wq`` ``(d, H, Dh)``, ``wk``/``wv`` ``(d, KV, Dh)``,
+  ``wo`` ``(H, Dh, d_out)``; biases ``bq`` ``(H, Dh)``, ``bk``/``bv``
+  ``(KV, Dh)``, ``bo`` ``(d_out,)``.  The prunable unit is the **query head**:
+  head-prune = axis 1 of ``wq`` / axis 0 of ``wo`` (+ ``wk``/``wv`` axis 1
+  when ``KV == H``); the block's *output width* is unchanged, so head pruning
+  never cascades outside the attention layer.  In-prune (producer width
+  change) = axis 0 of ``wq``/``wk``/``wv``.
+- GatedDense (SwiGLU-style): ``wg``/``wu`` ``(in, features)``, ``bg``/``bu``
+  ``(features,)``.  Out-prune = axis 1 of both mats; in-prune = axis 0.
+- Embedding: ``emb`` ``(vocab, features)``; PosEmbed: ``emb``
+  ``(max_len, features)``.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from dataclasses import dataclass
-from typing import Any, Callable, Optional, Tuple
+import math
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -76,6 +98,23 @@ class BatchNorm:
     eps: float = 1e-5
 
 
+@dataclass(frozen=True)
+class LayerNorm:
+    """Layer normalization over the last axis (transformer blocks)."""
+
+    name: str
+    eps: float = 1e-5
+    use_bias: bool = True
+
+
+@dataclass(frozen=True)
+class RMSNorm:
+    """RMS normalization over the last axis (Llama-family blocks)."""
+
+    name: str
+    eps: float = 1e-6
+
+
 #: Activation function registry. Mirrors the reference's ACTIVATIONS set
 #: (reference torchpruner/utils/graph.py:6) for evaluation-point shifting.
 ACTIVATION_FNS: dict = {
@@ -109,6 +148,20 @@ class Pool:
     kind: str = "max"  # "max" | "avg"
     window: Tuple[int, int] = (2, 2)
     strides: Optional[Tuple[int, int]] = None  # default: == window
+    padding: str = "VALID"  # "VALID" | "SAME"
+
+
+@dataclass(frozen=True)
+class GlobalPool:
+    """Global pooling / token selection:
+
+    - ``"avg"``: NHWC -> (B, C) spatial mean (ResNet final pool)
+    - ``"seq_mean"``: (B, S, D) -> (B, D) mean over the sequence
+    - ``"cls"``: (B, S, D) -> (B, D) first-token select (BERT/ViT CLS)
+    """
+
+    name: str
+    kind: str = "avg"
 
 
 @dataclass(frozen=True)
@@ -125,6 +178,15 @@ class Flatten:
 
 
 @dataclass(frozen=True)
+class Reshape:
+    """Reshape non-batch dims to ``shape`` (one ``-1`` allowed).  E.g. the
+    ViT patch-grid -> token-sequence step: ``(B,h,w,C) -> (B, h*w, C)``."""
+
+    name: str
+    shape: Tuple[int, ...]
+
+
+@dataclass(frozen=True)
 class Dropout:
     """Dropout. ``rate`` is the drop probability; rescaled on pruning so the
     expected number of active units is preserved (reference pruner.py:117-127).
@@ -134,10 +196,211 @@ class Dropout:
     rate: float = 0.5
 
 
+@dataclass(frozen=True)
+class Embedding:
+    """Token embedding lookup: int tokens ``(..., S)`` -> ``(..., S, d)``."""
+
+    name: str
+    vocab_size: int
+    features: int
+
+
+@dataclass(frozen=True)
+class PosEmbed:
+    """Learned positional embedding added to a ``(B, S, d)`` sequence."""
+
+    name: str
+    max_len: int
+
+
+@dataclass(frozen=True)
+class MultiHeadAttention:
+    """Multi-head (optionally grouped-query) self-attention on ``(B, S, d)``.
+
+    Prunable: the unit is the **query head** (``n_units = num_heads``); its
+    unit site is the pre-output-projection head context, exposed to taps in
+    ``(B, S, Dh, H)`` layout (head axis last) so masking/capture/attribution
+    are uniform with channel sites.  ``num_kv_heads < num_heads`` gives GQA
+    (Llama-3 style); KV projections are then shared across query-head groups
+    and are only sliced by head pruning when ``num_kv_heads == num_heads``.
+
+    ``impl`` selects the attention core: ``"auto"`` (Pallas flash kernel on
+    TPU, reference einsum elsewhere), ``"xla"``, or ``"flash"``.
+    """
+
+    name: str
+    num_heads: int
+    head_dim: int
+    num_kv_heads: Optional[int] = None  # None -> num_heads
+    out_features: Optional[int] = None  # None -> input width
+    causal: bool = False
+    rope: bool = False
+    rope_theta: float = 10000.0
+    use_bias: bool = False
+    impl: str = "auto"  # "auto" | "xla" | "flash"
+    #: per-query-head KV-head assignment.  None = uniform grouping
+    #: (head h -> KV head h // (H / KV)).  Pruning query heads of a GQA
+    #: layer makes the grouping irregular; the surviving heads' original
+    #: assignments are recorded here (set by ``pruned_spec``).
+    kv_group: Optional[Tuple[int, ...]] = None
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads if self.num_kv_heads is not None else self.num_heads
+
+    def head_kv_index(self) -> Tuple[int, ...]:
+        """KV head consumed by each query head."""
+        if self.kv_group is not None:
+            return self.kv_group
+        rep = self.num_heads // self.kv_heads
+        return tuple(h // rep for h in range(self.num_heads))
+
+
+@dataclass(frozen=True)
+class GatedDense:
+    """Gated linear unit ``act(x @ wg) * (x @ wu)`` (SwiGLU with
+    ``fn="silu"``).  Prunable (out units = features); the Llama FFN hidden
+    layer, pruned with its ``wo`` consumer inside the block."""
+
+    name: str
+    features: int
+    fn: str = "silu"
+    use_bias: bool = False
+
+    def __post_init__(self):
+        if self.fn not in ACTIVATION_FNS:
+            raise ValueError(f"unknown activation {self.fn!r}")
+
+
+@dataclass(frozen=True)
+class Residual:
+    """Residual block: ``y = body(x) + shortcut(x)`` (identity shortcut when
+    ``shortcut`` is empty).  ``body``/``shortcut`` are nested sequential
+    pipelines whose layers are addressed ``"resname/childname"``; pruning
+    recurses into them (core/graph.py) with the block's *output* width pinned
+    (the residual stream), exactly like the model's own output layer."""
+
+    name: str
+    body: Tuple[Any, ...]
+    shortcut: Tuple[Any, ...] = ()
+
+    def __post_init__(self):
+        names = [l.name for l in self.body] + [l.name for l in self.shortcut]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate child names in Residual {self.name!r}")
+
+    def child(self, name: str):
+        for l in self.body + self.shortcut:
+            if l.name == name:
+                return l
+        raise KeyError(f"{self.name}/{name}")
+
+
 LayerSpec = Any  # union of the above dataclasses
 
-PRUNABLE_TYPES = (Dense, Conv)  # can be out-pruned (reference pruner.py:11)
-ATTACHABLE_TYPES = (BatchNorm, Dropout)  # in-pruned alongside a producer
+#: can be out-pruned. Dense/Conv match the reference (reference pruner.py:11);
+#: GatedDense and MultiHeadAttention (query heads) are the transformer-era
+#: additions the BASELINE.json configs require.
+PRUNABLE_TYPES = (Dense, Conv, GatedDense, MultiHeadAttention)
+#: in-pruned alongside a producer (reference pruner.py:11 lists Dropout and
+#: BatchNorm; LayerNorm/RMSNorm are their transformer equivalents).
+ATTACHABLE_TYPES = (BatchNorm, Dropout, LayerNorm, RMSNorm)
+#: composite specs containing nested pipelines.
+COMPOSITE_TYPES = (Residual,)
+
+
+# ---------------------------------------------------------------------------
+# Shapes
+# ---------------------------------------------------------------------------
+
+
+def _kaiming(key, shape, fan_in, dtype):
+    return jax.random.normal(key, shape, dtype) * jnp.sqrt(2.0 / fan_in)
+
+
+def _reshape_target(shape: Tuple[int, ...], in_shape: Tuple[int, ...]):
+    size = 1
+    for d in in_shape:
+        size *= d
+    if shape.count(-1) > 1:
+        raise ValueError(f"Reshape allows one -1, got {shape}")
+    if -1 in shape:
+        known = 1
+        for d in shape:
+            if d != -1:
+                known *= d
+        if size % known:
+            raise ValueError(f"cannot reshape {in_shape} to {shape}")
+        return tuple(size // known if d == -1 else d for d in shape)
+    return tuple(shape)
+
+
+def out_shape(spec: LayerSpec, in_shape: Tuple[int, ...]) -> Tuple[int, ...]:
+    """The single source of truth for per-layer output shapes (batch dim
+    excluded) — used by init, by ``SegmentedModel.shapes``, and by the
+    pruning-graph fan-out computation."""
+    if isinstance(spec, Dense):
+        return tuple(in_shape[:-1]) + (spec.features,)
+    if isinstance(spec, Conv):
+        h, w = in_shape[0], in_shape[1]
+        oh, ow = _conv_out_hw((h, w), spec)
+        return (oh, ow, spec.features)
+    if isinstance(spec, Pool):
+        strides = spec.strides or spec.window
+        if spec.padding == "SAME":
+            oh = -(-in_shape[0] // strides[0])
+            ow = -(-in_shape[1] // strides[1])
+        else:
+            oh = (in_shape[0] - spec.window[0]) // strides[0] + 1
+            ow = (in_shape[1] - spec.window[1]) // strides[1] + 1
+        return (oh, ow) + tuple(in_shape[2:])
+    if isinstance(spec, GlobalPool):
+        return (in_shape[-1],)
+    if isinstance(spec, Flatten):
+        size = 1
+        for d in in_shape:
+            size *= d
+        return (size,)
+    if isinstance(spec, Reshape):
+        return _reshape_target(spec.shape, in_shape)
+    if isinstance(spec, Embedding):
+        return tuple(in_shape) + (spec.features,)
+    if isinstance(spec, MultiHeadAttention):
+        d_out = spec.out_features if spec.out_features is not None else in_shape[-1]
+        return tuple(in_shape[:-1]) + (d_out,)
+    if isinstance(spec, GatedDense):
+        return tuple(in_shape[:-1]) + (spec.features,)
+    if isinstance(spec, Residual):
+        return seq_out_shape(spec.body, in_shape)
+    return tuple(in_shape)
+
+
+def seq_out_shape(layers, in_shape: Tuple[int, ...]) -> Tuple[int, ...]:
+    shape = tuple(in_shape)
+    for spec in layers:
+        shape = out_shape(spec, shape)
+    return shape
+
+
+def seq_shapes(layers, in_shape: Tuple[int, ...]):
+    """Per-layer ``(in_shape, out_shape)`` for a sequential pipeline."""
+    out = []
+    shape = tuple(in_shape)
+    for spec in layers:
+        o = out_shape(spec, shape)
+        out.append((shape, o))
+        shape = o
+    return tuple(out)
+
+
+def unit_site_shape(spec: LayerSpec, in_shape: Tuple[int, ...]) -> Tuple[int, ...]:
+    """Per-example shape of the activation at a layer's *unit site* — the
+    tensor taps act on, with the unit axis last.  For most layers this is the
+    output; for attention it is the head context in ``(S, Dh, H)`` layout."""
+    if isinstance(spec, MultiHeadAttention):
+        S = in_shape[0]
+        return (S, spec.head_dim, spec.num_heads)
+    return out_shape(spec, in_shape)
 
 
 # ---------------------------------------------------------------------------
@@ -146,43 +409,17 @@ ATTACHABLE_TYPES = (BatchNorm, Dropout)  # in-pruned alongside a producer
 # ---------------------------------------------------------------------------
 
 
-def _kaiming(key, shape, fan_in, dtype):
-    return jax.random.normal(key, shape, dtype) * jnp.sqrt(2.0 / fan_in)
-
-
-def out_shape(spec: LayerSpec, in_shape: Tuple[int, ...]) -> Tuple[int, ...]:
-    """The single source of truth for per-layer output shapes (batch dim
-    excluded) — used by init, by ``SegmentedModel.shapes``, and by the
-    pruning-graph fan-out computation."""
-    if isinstance(spec, Dense):
-        return (spec.features,)
-    if isinstance(spec, Conv):
-        h, w = in_shape[0], in_shape[1]
-        oh, ow = _conv_out_hw((h, w), spec)
-        return (oh, ow, spec.features)
-    if isinstance(spec, Pool):
-        strides = spec.strides or spec.window
-        oh = (in_shape[0] - spec.window[0]) // strides[0] + 1
-        ow = (in_shape[1] - spec.window[1]) // strides[1] + 1
-        return (oh, ow) + tuple(in_shape[2:])
-    if isinstance(spec, Flatten):
-        size = 1
-        for d in in_shape:
-            size *= d
-        return (size,)
-    return tuple(in_shape)
-
-
 def init_layer(spec: LayerSpec, key, in_shape: Tuple[int, ...], dtype=jnp.float32):
     """Initialize one layer. Returns ``(params, state, out_shape)``; ``params``
     / ``state`` are ``{}`` for parameter-free / stateless layers."""
     if isinstance(spec, Dense):
-        if len(in_shape) != 1:
+        if len(in_shape) < 1:
             raise ValueError(
-                f"Dense {spec.name!r} expects flat input, got shape {in_shape}"
+                f"Dense {spec.name!r} expects >=1D input, got shape {in_shape}"
             )
         kw, _ = jax.random.split(key)
-        params = {"w": _kaiming(kw, (in_shape[0], spec.features), in_shape[0], dtype)}
+        fan_in = in_shape[-1]
+        params = {"w": _kaiming(kw, (fan_in, spec.features), fan_in, dtype)}
         if spec.use_bias:
             params["b"] = jnp.zeros((spec.features,), dtype)
         return params, {}, out_shape(spec, in_shape)
@@ -207,7 +444,104 @@ def init_layer(spec: LayerSpec, key, in_shape: Tuple[int, ...], dtype=jnp.float3
         state = {"mean": jnp.zeros((f,), dtype), "var": jnp.ones((f,), dtype)}
         return params, state, in_shape
 
-    if isinstance(spec, (Pool, Flatten, Activation, Dropout)):
+    if isinstance(spec, LayerNorm):
+        f = in_shape[-1]
+        params = {"scale": jnp.ones((f,), dtype)}
+        if spec.use_bias:
+            params["bias"] = jnp.zeros((f,), dtype)
+        return params, {}, tuple(in_shape)
+
+    if isinstance(spec, RMSNorm):
+        f = in_shape[-1]
+        return {"scale": jnp.ones((f,), dtype)}, {}, tuple(in_shape)
+
+    if isinstance(spec, Embedding):
+        params = {
+            "emb": jax.random.normal(
+                key, (spec.vocab_size, spec.features), dtype
+            ) * 0.02
+        }
+        return params, {}, out_shape(spec, in_shape)
+
+    if isinstance(spec, PosEmbed):
+        f = in_shape[-1]
+        if in_shape[0] > spec.max_len:
+            raise ValueError(
+                f"PosEmbed {spec.name!r}: sequence {in_shape[0]} exceeds "
+                f"max_len {spec.max_len}"
+            )
+        params = {"emb": jax.random.normal(key, (spec.max_len, f), dtype) * 0.02}
+        return params, {}, tuple(in_shape)
+
+    if isinstance(spec, MultiHeadAttention):
+        d = in_shape[-1]
+        H, KV, Dh = spec.num_heads, spec.kv_heads, spec.head_dim
+        if H % KV:
+            raise ValueError(
+                f"MHA {spec.name!r}: num_heads {H} not divisible by "
+                f"num_kv_heads {KV}"
+            )
+        d_out = spec.out_features if spec.out_features is not None else d
+        kq, kk, kv, ko = jax.random.split(key, 4)
+        s_in = 1.0 / math.sqrt(d)
+        s_out = 1.0 / math.sqrt(H * Dh)
+        params = {
+            "wq": jax.random.normal(kq, (d, H, Dh), dtype) * s_in,
+            "wk": jax.random.normal(kk, (d, KV, Dh), dtype) * s_in,
+            "wv": jax.random.normal(kv, (d, KV, Dh), dtype) * s_in,
+            "wo": jax.random.normal(ko, (H, Dh, d_out), dtype) * s_out,
+        }
+        if spec.use_bias:
+            params["bq"] = jnp.zeros((H, Dh), dtype)
+            params["bk"] = jnp.zeros((KV, Dh), dtype)
+            params["bv"] = jnp.zeros((KV, Dh), dtype)
+            params["bo"] = jnp.zeros((d_out,), dtype)
+        return params, {}, out_shape(spec, in_shape)
+
+    if isinstance(spec, GatedDense):
+        fan_in = in_shape[-1]
+        kg, ku = jax.random.split(key)
+        params = {
+            "wg": _kaiming(kg, (fan_in, spec.features), fan_in, dtype),
+            "wu": _kaiming(ku, (fan_in, spec.features), fan_in, dtype),
+        }
+        if spec.use_bias:
+            params["bg"] = jnp.zeros((spec.features,), dtype)
+            params["bu"] = jnp.zeros((spec.features,), dtype)
+        return params, {}, out_shape(spec, in_shape)
+
+    if isinstance(spec, Residual):
+        params: Dict[str, Any] = {}
+        state: Dict[str, Any] = {}
+        shape = tuple(in_shape)
+        for child in spec.body:
+            key, sub = jax.random.split(key)
+            p, s, shape = init_layer(child, sub, shape, dtype)
+            if p:
+                params[child.name] = p
+            if s:
+                state[child.name] = s
+        sc_shape = tuple(in_shape)
+        for child in spec.shortcut:
+            key, sub = jax.random.split(key)
+            p, s, sc_shape = init_layer(child, sub, sc_shape, dtype)
+            if p:
+                params[child.name] = p
+            if s:
+                state[child.name] = s
+        if spec.shortcut and sc_shape != shape:
+            raise ValueError(
+                f"Residual {spec.name!r}: body out {shape} != shortcut out "
+                f"{sc_shape}"
+            )
+        if not spec.shortcut and shape != tuple(in_shape):
+            raise ValueError(
+                f"Residual {spec.name!r}: identity shortcut needs body out "
+                f"{shape} == in {tuple(in_shape)}"
+            )
+        return params, state, shape
+
+    if isinstance(spec, (Pool, GlobalPool, Flatten, Reshape, Activation, Dropout)):
         return {}, {}, out_shape(spec, in_shape)
 
     raise TypeError(f"unknown layer spec {type(spec)}")
@@ -223,8 +557,129 @@ def _conv_out_hw(hw, spec: Conv):
 
 
 # ---------------------------------------------------------------------------
-# apply rules: (spec, params, state, x, train, rng) -> (y, new_state)
+# Taps — attribution instrumentation addressed by site path
 # ---------------------------------------------------------------------------
+
+
+def parse_path(name) -> Tuple[str, ...]:
+    """``"block/child"`` -> ``("block", "child")``; tuples pass through."""
+    if isinstance(name, tuple):
+        return name
+    return tuple(name.split("/"))
+
+
+class Taps:
+    """Per-trace instrumentation: unit masking, additive perturbation, and
+    activation capture at named sites (paths).  Created fresh per ``apply``
+    call, so the ``captured`` side-slot is trace-local and jit-safe."""
+
+    __slots__ = ("unit_mask", "perturb", "capture", "captured")
+
+    def __init__(self, unit_mask=None, perturb=None, capture=None):
+        self.unit_mask = (
+            None if unit_mask is None else (parse_path(unit_mask[0]), unit_mask[1])
+        )
+        self.perturb = (
+            None if perturb is None else (parse_path(perturb[0]), perturb[1])
+        )
+        self.capture = None if capture is None else parse_path(capture)
+        self.captured = None
+
+    def empty(self) -> bool:
+        return (
+            self.unit_mask is None
+            and self.perturb is None
+            and self.capture is None
+        )
+
+    def at_site(self, path: Tuple[str, ...], y):
+        """Apply mask/perturb and record capture if ``path`` is a tap site.
+        ``y`` must have the unit axis last."""
+        if self.unit_mask is not None and self.unit_mask[0] == path:
+            y = y * self.unit_mask[1]
+        if self.perturb is not None and self.perturb[0] == path:
+            y = y + self.perturb[1]
+        if self.capture == path:
+            self.captured = y
+        return y
+
+
+# ---------------------------------------------------------------------------
+# apply rules: (spec, params, state, x, train, rng, taps, path) -> (y, state')
+# ---------------------------------------------------------------------------
+
+
+def apply_seq(
+    layers,
+    params,
+    state,
+    x,
+    *,
+    train: bool = False,
+    rng=None,
+    taps: Optional[Taps] = None,
+    prefix: Tuple[str, ...] = (),
+):
+    """Run a sequential pipeline of layers.  The shared runner behind
+    ``SegmentedModel.apply`` and ``Residual`` bodies: threads state and rng,
+    and applies output-site taps after every non-attention layer (attention
+    handles its own head site internally)."""
+    state = state if state is not None else {}
+    new_state = dict(state)
+    for spec in layers:
+        p = params.get(spec.name, {}) if params else {}
+        s = state.get(spec.name, {})
+        if rng is not None:
+            rng, sub = jax.random.split(rng)
+        else:
+            sub = None
+        path = prefix + (spec.name,)
+        x, s2 = apply_layer(
+            spec, p, s, x, train=train, rng=sub, taps=taps, path=path
+        )
+        if (
+            taps is not None
+            and not taps.empty()
+            and not isinstance(spec, MultiHeadAttention)
+        ):
+            x = taps.at_site(path, x)
+        if s2 is not s and s2:
+            new_state[spec.name] = s2
+    return x, new_state
+
+
+def _rope(x, theta: float):
+    """Rotary position embedding on ``(B, S, H, Dh)`` (Su et al., 2021)."""
+    S, Dh = x.shape[1], x.shape[-1]
+    half = Dh // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = jnp.arange(S, dtype=jnp.float32)[:, None] * freqs[None, :]  # (S, half)
+    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def attention_core(q, k, v, *, causal: bool, impl: str = "auto"):
+    """Scaled-dot-product attention core on ``(B, S, H, Dh)`` tensors
+    (K/V already expanded to H heads).  ``impl="auto"`` uses the Pallas
+    flash kernel on TPU (torchpruner_tpu/ops/flash_attention.py) and the
+    XLA einsum path elsewhere."""
+    if impl == "auto":
+        impl = "flash" if jax.default_backend() == "tpu" else "xla"
+    if impl == "flash":
+        from torchpruner_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bshk,bthk->bhst", q, k) * scale
+    if causal:
+        S = q.shape[1]
+        neg = jnp.finfo(logits.dtype).min
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        logits = jnp.where(mask[None, None], logits, neg)
+    w = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhst,bthk->bshk", w, v)
 
 
 def apply_layer(
@@ -235,6 +690,8 @@ def apply_layer(
     *,
     train: bool = False,
     rng=None,
+    taps: Optional[Taps] = None,
+    path: Tuple[str, ...] = (),
 ):
     """Apply one layer. Pure; returns ``(y, new_state)``."""
     if isinstance(spec, Dense):
@@ -271,6 +728,18 @@ def apply_layer(
         y = (x - mean) * inv * params["scale"] + params["bias"]
         return y, new_state
 
+    if isinstance(spec, LayerNorm):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        y = (x - mean) * lax.rsqrt(var + spec.eps) * params["scale"]
+        if "bias" in params:
+            y = y + params["bias"]
+        return y, state
+
+    if isinstance(spec, RMSNorm):
+        ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+        return x * lax.rsqrt(ms + spec.eps) * params["scale"], state
+
     if isinstance(spec, Activation):
         return ACTIVATION_FNS[spec.fn](x), state
 
@@ -279,16 +748,40 @@ def apply_layer(
         window = (1,) + tuple(spec.window) + (1,)
         strides_ = (1,) + tuple(strides) + (1,)
         if spec.kind == "max":
-            y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides_, "VALID")
+            y = lax.reduce_window(
+                x, -jnp.inf, lax.max, window, strides_, spec.padding
+            )
         elif spec.kind == "avg":
-            y = lax.reduce_window(x, 0.0, lax.add, window, strides_, "VALID")
-            y = y / (spec.window[0] * spec.window[1])
+            y = lax.reduce_window(
+                x, 0.0, lax.add, window, strides_, spec.padding
+            )
+            if spec.padding == "SAME":
+                # divide by the number of *valid* elements per window
+                counts = lax.reduce_window(
+                    jnp.ones_like(x), 0.0, lax.add, window, strides_, "SAME"
+                )
+                y = y / counts
+            else:
+                y = y / (spec.window[0] * spec.window[1])
         else:
             raise ValueError(f"unknown pool kind {spec.kind!r}")
         return y, state
 
+    if isinstance(spec, GlobalPool):
+        if spec.kind == "avg":
+            return jnp.mean(x, axis=tuple(range(1, x.ndim - 1))), state
+        if spec.kind == "seq_mean":
+            return jnp.mean(x, axis=1), state
+        if spec.kind == "cls":
+            return x[:, 0], state
+        raise ValueError(f"unknown global pool kind {spec.kind!r}")
+
     if isinstance(spec, Flatten):
         return x.reshape(x.shape[0], -1), state
+
+    if isinstance(spec, Reshape):
+        target = _reshape_target(spec.shape, x.shape[1:])
+        return x.reshape((x.shape[0],) + target), state
 
     if isinstance(spec, Dropout):
         if not train or spec.rate == 0.0:
@@ -299,18 +792,121 @@ def apply_layer(
         mask = jax.random.bernoulli(rng, keep, x.shape)
         return jnp.where(mask, x / keep, 0.0), state
 
+    if isinstance(spec, Embedding):
+        return jnp.take(params["emb"], x, axis=0), state
+
+    if isinstance(spec, PosEmbed):
+        S = x.shape[-2]
+        return x + params["emb"][:S], state
+
+    if isinstance(spec, MultiHeadAttention):
+        H, KV = spec.num_heads, spec.kv_heads
+        q = jnp.einsum("bsd,dhk->bshk", x, params["wq"])
+        k = jnp.einsum("bsd,dhk->bshk", x, params["wk"])
+        v = jnp.einsum("bsd,dhk->bshk", x, params["wv"])
+        if "bq" in params:
+            q = q + params["bq"]
+            k = k + params["bk"]
+            v = v + params["bv"]
+        if spec.rope:
+            q = _rope(q, spec.rope_theta)
+            k = _rope(k, spec.rope_theta)
+        if KV != H or spec.kv_group is not None:
+            idx = jnp.asarray(spec.head_kv_index())
+            k = jnp.take(k, idx, axis=2)
+            v = jnp.take(v, idx, axis=2)
+        ctx = attention_core(q, k, v, causal=spec.causal, impl=spec.impl)
+        if taps is not None and not taps.empty():
+            # head unit site: (B, S, Dh, H) — head axis last, uniform with
+            # channel sites for masking/capture/attribution.
+            zh = jnp.moveaxis(ctx, 2, 3)
+            zh = taps.at_site(path, zh)
+            ctx = jnp.moveaxis(zh, 3, 2)
+        y = jnp.einsum("bshk,hkd->bsd", ctx, params["wo"])
+        if "bo" in params:
+            y = y + params["bo"]
+        return y, state
+
+    if isinstance(spec, GatedDense):
+        g = x @ params["wg"]
+        u = x @ params["wu"]
+        if "bg" in params:
+            g = g + params["bg"]
+            u = u + params["bu"]
+        return ACTIVATION_FNS[spec.fn](g) * u, state
+
+    if isinstance(spec, Residual):
+        r_body = r_sc = None
+        if rng is not None:
+            r_body, r_sc = jax.random.split(rng)
+        y, body_state = apply_seq(
+            spec.body, params, state, x,
+            train=train, rng=r_body, taps=taps, prefix=path,
+        )
+        if spec.shortcut:
+            sc, sc_state = apply_seq(
+                spec.shortcut, params, state, x,
+                train=train, rng=r_sc, taps=taps, prefix=path,
+            )
+            new_state = dict(body_state)
+            for name, s in sc_state.items():
+                if name not in (c.name for c in spec.body):
+                    new_state[name] = s
+        else:
+            sc = x
+            new_state = body_state
+        return y + sc, new_state
+
     raise TypeError(f"unknown layer spec {type(spec)}")
+
+
+# ---------------------------------------------------------------------------
+# Prunable-unit helpers
+# ---------------------------------------------------------------------------
 
 
 def n_units(spec: LayerSpec) -> int:
     """Number of prunable output units of a prunable layer."""
-    if isinstance(spec, (Dense, Conv)):
+    if isinstance(spec, (Dense, Conv, GatedDense)):
         return spec.features
+    if isinstance(spec, MultiHeadAttention):
+        return spec.num_heads
     raise TypeError(f"{type(spec).__name__} has no prunable units")
 
 
 def with_features(spec: LayerSpec, features: int) -> LayerSpec:
     """Return a copy of a prunable spec with a new unit count."""
-    if isinstance(spec, (Dense, Conv)):
+    if isinstance(spec, (Dense, Conv, GatedDense)):
         return dataclasses.replace(spec, features=features)
+    if isinstance(spec, MultiHeadAttention):
+        if spec.kv_group is not None:
+            raise ValueError(
+                f"MHA {spec.name!r} has an irregular kv_group; resize it "
+                "with pruned_spec(spec, keep) so the grouping stays valid"
+            )
+        kv = features if spec.kv_heads == spec.num_heads else spec.num_kv_heads
+        return dataclasses.replace(spec, num_heads=features, num_kv_heads=kv)
     raise TypeError(f"{type(spec).__name__} has no feature count")
+
+
+def pruned_spec(spec: LayerSpec, keep) -> LayerSpec:
+    """The spec after keeping exactly the units ``keep`` (sorted indices).
+    Unlike :func:`with_features` this sees *which* units survive — needed for
+    GQA attention, where pruning query heads makes the head->KV-group mapping
+    irregular and it must be recorded on the spec."""
+    keep = list(keep)
+    if isinstance(spec, MultiHeadAttention):
+        if spec.kv_heads == spec.num_heads and spec.kv_group is None:
+            # non-GQA: KV heads sliced alongside query heads, mapping stays
+            # the identity
+            return dataclasses.replace(
+                spec, num_heads=len(keep), num_kv_heads=len(keep)
+                if spec.num_kv_heads is not None else None,
+            )
+        group = spec.head_kv_index()
+        return dataclasses.replace(
+            spec,
+            num_heads=len(keep),
+            kv_group=tuple(group[h] for h in keep),
+        )
+    return with_features(spec, len(keep))

@@ -40,34 +40,92 @@ class PruneResult:
 
 
 def plan_for_group(model: SegmentedModel, group: PruneGroup) -> PrunePlan:
-    """Resolve a PruneGroup against a sequential model into a concrete plan.
+    """Resolve a PruneGroup against a model into a concrete plan.
 
-    Slice table (cf. reference pruner.py:59-92):
+    Slice table (cf. reference pruner.py:59-92, extended to the
+    transformer-era layer vocabulary):
       - target Dense: ``w`` axis 1, ``b`` axis 0; target Conv: ``w`` axis 3,
-        ``b`` axis 0  (out-pruning)
+        ``b`` axis 0; target GatedDense: ``wg``/``wu`` axis 1, ``bg``/``bu``
+        axis 0  (out-pruning)
+      - target MultiHeadAttention (query-head pruning): ``wq`` head axis 1,
+        ``wo`` head axis 0, ``bq`` axis 0; plus ``wk``/``wv``/``bk``/``bv``
+        when KV heads match query heads (non-GQA)
       - attached BatchNorm: ``scale``/``bias`` params axis 0 and
-        ``mean``/``var`` state axis 0  (in-pruning)
-      - consumers: Dense ``w`` axis 0 / Conv ``w`` axis 2, with flatten
-        fan-out  (in-pruning)
+        ``mean``/``var`` state axis 0; LayerNorm ``scale``/``bias`` axis 0;
+        RMSNorm ``scale`` axis 0  (in-pruning)
+      - consumers: Dense ``w``/GatedDense ``wg``+``wu`` axis 0, Conv ``w``
+        axis 2, attention ``wq``/``wk``/``wv`` axis 0, with flatten fan-out
+        (in-pruning)
     """
     target = model.layer(group.target)
+    tpath = L.parse_path(group.target)
     n = L.n_units(target)
-    out_axis = 1 if isinstance(target, L.Dense) else 3
-    slices = [
-        ParamSlice((group.target, "w"), axis=out_axis),
-        ParamSlice((group.target, "b"), axis=0, optional=True),
-    ]
+    slices = []
+    if isinstance(target, L.Dense):
+        slices += [
+            ParamSlice(tpath + ("w",), axis=1),
+            ParamSlice(tpath + ("b",), axis=0, optional=True),
+        ]
+    elif isinstance(target, L.Conv):
+        slices += [
+            ParamSlice(tpath + ("w",), axis=3),
+            ParamSlice(tpath + ("b",), axis=0, optional=True),
+        ]
+    elif isinstance(target, L.GatedDense):
+        slices += [
+            ParamSlice(tpath + ("wg",), axis=1),
+            ParamSlice(tpath + ("wu",), axis=1),
+            ParamSlice(tpath + ("bg",), axis=0, optional=True),
+            ParamSlice(tpath + ("bu",), axis=0, optional=True),
+        ]
+    elif isinstance(target, L.MultiHeadAttention):
+        slices += [
+            ParamSlice(tpath + ("wq",), axis=1),
+            ParamSlice(tpath + ("wo",), axis=0),
+            ParamSlice(tpath + ("bq",), axis=0, optional=True),
+        ]
+        if target.kv_heads == target.num_heads and target.kv_group is None:
+            slices += [
+                ParamSlice(tpath + ("wk",), axis=1),
+                ParamSlice(tpath + ("wv",), axis=1),
+                ParamSlice(tpath + ("bk",), axis=0, optional=True),
+                ParamSlice(tpath + ("bv",), axis=0, optional=True),
+            ]
+    else:
+        raise TypeError(
+            f"cannot out-prune {type(target).__name__} {group.target!r}"
+        )
     for bn in group.attached_bn:
         f = bn.fan_out
-        slices += [
-            ParamSlice((bn.layer, "scale"), axis=0, fan_out=f),
-            ParamSlice((bn.layer, "bias"), axis=0, fan_out=f),
-            ParamSlice((bn.layer, "mean"), axis=0, fan_out=f, collection="state"),
-            ParamSlice((bn.layer, "var"), axis=0, fan_out=f, collection="state"),
-        ]
+        npath = L.parse_path(bn.layer)
+        spec = model.layer(bn.layer)
+        if isinstance(spec, L.BatchNorm):
+            slices += [
+                ParamSlice(npath + ("scale",), axis=0, fan_out=f),
+                ParamSlice(npath + ("bias",), axis=0, fan_out=f),
+                ParamSlice(
+                    npath + ("mean",), axis=0, fan_out=f, collection="state"
+                ),
+                ParamSlice(
+                    npath + ("var",), axis=0, fan_out=f, collection="state"
+                ),
+            ]
+        elif isinstance(spec, L.LayerNorm):
+            slices += [
+                ParamSlice(npath + ("scale",), axis=0, fan_out=f),
+                ParamSlice(npath + ("bias",), axis=0, fan_out=f, optional=True),
+            ]
+        elif isinstance(spec, L.RMSNorm):
+            slices.append(ParamSlice(npath + ("scale",), axis=0, fan_out=f))
+        else:
+            raise TypeError(
+                f"unknown attached norm {type(spec).__name__} {bn.layer!r}"
+            )
     for c in group.consumers:
         slices.append(
-            ParamSlice((c.layer, c.param), axis=c.axis, fan_out=c.fan_out)
+            ParamSlice(
+                L.parse_path(c.layer) + (c.param,), axis=c.axis, fan_out=c.fan_out
+            )
         )
     return PrunePlan(n_units=n, slices=tuple(slices))
 
@@ -97,9 +155,8 @@ def prune(
 
     # Rebuild the static spec: smaller target width, rescaled dropout rates.
     target = model.layer(group.target)
-    new_model = model.replace_layer(
-        group.target, L.with_features(target, L.n_units(target) - len(drop))
-    )
+    keep = [u for u in range(L.n_units(target)) if u not in set(drop.tolist())]
+    new_model = model.replace_layer(group.target, L.pruned_spec(target, keep))
     for d_name in group.attached_dropout:
         d = model.layer(d_name)
         # Preserve expected active-unit count (reference pruner.py:117-127).

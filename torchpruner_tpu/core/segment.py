@@ -8,6 +8,14 @@ becomes first-class: ``model.apply(..., from_layer=a, to_layer=b)`` runs the
 segment *after* ``a`` up to and including ``b``, and :func:`segment_fn` hands
 back a cached, jit-compatible closure for any segment.
 
+Composite layers (:class:`~torchpruner_tpu.core.layers.Residual`) nest
+sub-pipelines; their children are addressed by ``"block/child"`` path strings
+everywhere a layer name is accepted for instrumentation (masking, capture,
+perturbation, pruning targets).  Segment *boundaries* (``from_layer`` /
+``to_layer``) stay at the top level — a block is the unit of sequential
+composition, which is what keeps prefix/suffix reuse well-defined under
+residual connections.
+
 Being a frozen dataclass of frozen dataclasses, a ``SegmentedModel`` is
 hashable: it keys jit/compile caches, and pruning produces a *new* spec whose
 segments recompile at the new static shapes — the XLA-honest equivalent of the
@@ -17,7 +25,7 @@ reference's in-place tensor surgery.
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -31,11 +39,14 @@ class SegmentedModel:
     """An ordered pipeline of layer specs with named layers.
 
     ``input_shape`` excludes the batch dimension and is channels-last
-    (e.g. ``(28, 28, 1)`` or ``(784,)``).
+    (e.g. ``(28, 28, 1)``, ``(784,)``, or ``(seq_len,)`` for token models).
+    ``input_dtype`` names the element type example inputs should use
+    (``"float32"`` activations or ``"int32"`` token ids).
     """
 
     layers: Tuple[L.LayerSpec, ...]
     input_shape: Tuple[int, ...]
+    input_dtype: str = "float32"
 
     def __post_init__(self):
         names = [l.name for l in self.layers]
@@ -49,42 +60,102 @@ class SegmentedModel:
         return tuple(l.name for l in self.layers)
 
     def layer(self, name: str) -> L.LayerSpec:
-        for l in self.layers:
-            if l.name == name:
-                return l
-        raise KeyError(name)
+        """Resolve a (possibly nested, ``"block/child"``) layer path."""
+        path = L.parse_path(name)
+        spec = None
+        layers = self.layers
+        for part in path:
+            spec = None
+            for l in layers:
+                if l.name == part:
+                    spec = l
+                    break
+            if spec is None:
+                raise KeyError(name)
+            layers = (
+                spec.body + spec.shortcut
+                if isinstance(spec, L.Residual)
+                else ()
+            )
+        return spec
 
     def index(self, name: str) -> int:
+        """Top-level index of a layer (segment boundaries are top-level)."""
         for i, l in enumerate(self.layers):
             if l.name == name:
                 return i
         raise KeyError(name)
+
+    def top_level_of(self, name: str) -> str:
+        """The top-level layer containing (or equal to) ``name``."""
+        top = L.parse_path(name)[0]
+        self.index(top)  # raises KeyError if absent
+        return top
 
     @functools.cached_property
     def shapes(self) -> Tuple[Tuple[Tuple[int, ...], Tuple[int, ...]], ...]:
         """Per-layer ``(in_shape, out_shape)`` (batch dim excluded), inferred
         statically from the specs — the metadata the reference obtains
         dynamically with its NaN-trick forward (reference pruner.py:170-185)."""
-        out = []
-        shape = tuple(self.input_shape)
-        for spec in self.layers:
-            out_shape = L.out_shape(spec, shape)
-            out.append((shape, out_shape))
-            shape = out_shape
-        return tuple(out)
+        return L.seq_shapes(self.layers, self.input_shape)
 
     def out_shape(self, name: Optional[str] = None) -> Tuple[int, ...]:
-        """Output shape (batch excluded) of layer ``name`` (default: last)."""
+        """Output shape (batch excluded) of layer ``name`` (default: last).
+        Accepts nested paths."""
         if name is None:
             return self.shapes[-1][1]
-        return self.shapes[self.index(name)][1]
+        _, out = self._resolve_shapes(L.parse_path(name))
+        return out
+
+    def in_shape(self, name: str) -> Tuple[int, ...]:
+        """Input shape (batch excluded) of (possibly nested) layer ``name``."""
+        inp, _ = self._resolve_shapes(L.parse_path(name))
+        return inp
+
+    def site_shape(self, name: str) -> Tuple[int, ...]:
+        """Per-example shape of the activation at ``name``'s *unit site* —
+        what taps (mask/perturb/capture) act on, unit axis last.  Equals the
+        output shape except for attention, whose site is the pre-projection
+        head context ``(S, Dh, H)``."""
+        path = L.parse_path(name)
+        inp, _ = self._resolve_shapes(path)
+        return L.unit_site_shape(self.layer(name), inp)
+
+    def _resolve_shapes(self, path: Tuple[str, ...]):
+        """(in_shape, out_shape) of the layer at ``path``."""
+        layers = self.layers
+        in_shape = tuple(self.input_shape)
+        for depth, part in enumerate(path):
+            found = None
+            for spec, (i_shape, o_shape) in zip(
+                layers, L.seq_shapes(layers, in_shape)
+            ):
+                if spec.name == part:
+                    found = (spec, i_shape, o_shape)
+                    break
+            if found is None:
+                raise KeyError("/".join(path))
+            spec, i_shape, o_shape = found
+            if depth == len(path) - 1:
+                return i_shape, o_shape
+            if not isinstance(spec, L.Residual):
+                raise KeyError("/".join(path))
+            # descend: body and shortcut both start from the block input
+            nxt = path[depth + 1]
+            if any(l.name == nxt for l in spec.body):
+                layers = spec.body
+            else:
+                layers = spec.shortcut
+            in_shape = i_shape
+        raise KeyError("/".join(path))
 
     # -- functional init / apply -------------------------------------------
 
     def init(self, key, dtype=jnp.float32):
         """Initialize ``(params, state)`` pytrees:
-        ``params[layer_name][param_name]`` / ``state[layer_name][stat_name]``.
-        Layers without params/state are omitted from the dicts."""
+        ``params[layer_name][param_name]`` / ``state[layer_name][stat_name]``
+        (nested one level per composite block).  Layers without params/state
+        are omitted from the dicts."""
         params: Dict[str, Any] = {}
         state: Dict[str, Any] = {}
         shape = tuple(self.input_shape)
@@ -97,6 +168,20 @@ class SegmentedModel:
                 state[spec.name] = s
         return params, state
 
+    def example_input(self, batch: int = 2, seed: int = 0):
+        """A random batch with the model's input shape/dtype (the reference's
+        ``_run_forward`` random input, reference pruner.py:170-185)."""
+        key = jax.random.PRNGKey(seed)
+        shape = (batch,) + tuple(self.input_shape)
+        if self.input_dtype.startswith("int"):
+            vocab = 2
+            for spec in self.layers:
+                if isinstance(spec, L.Embedding):
+                    vocab = spec.vocab_size
+                    break
+            return jax.random.randint(key, shape, 0, vocab, jnp.int32)
+        return jax.random.normal(key, shape, jnp.float32)
+
     def apply(
         self,
         params,
@@ -108,17 +193,22 @@ class SegmentedModel:
         from_layer: Optional[str] = None,
         to_layer: Optional[str] = None,
         unit_mask: Optional[Tuple[str, Any]] = None,
+        perturb: Optional[Tuple[str, Any]] = None,
         capture: Optional[str] = None,
     ):
         """Run the segment after ``from_layer`` through ``to_layer`` inclusive.
 
         - ``from_layer=None`` starts at the input; otherwise ``x`` must be the
           *output* of ``from_layer`` (reference forward_partial semantics).
-        - ``unit_mask=(name, vec)`` multiplies the output of layer ``name`` by
+          Segment boundaries are top-level layer names.
+        - ``unit_mask=(site, vec)`` multiplies the activation at ``site`` by
           ``vec`` along the last (unit) axis — the functional replacement for
           the reference's masking forward hook (reference
-          shapley_values.py:92-99).
-        - ``capture=name`` additionally returns the activation at ``name``.
+          shapley_values.py:92-99).  ``site`` may be a nested path; for
+          attention layers the site is the per-head context (head axis last).
+        - ``perturb=(site, delta)`` adds ``delta`` at the site — differentiate
+          w.r.t. ``delta`` at zero for activation-gradient attributions.
+        - ``capture=site`` additionally returns the activation at the site.
 
         Returns ``(y, new_state)``, or ``(y, new_state, captured)`` when
         ``capture`` is given.
@@ -131,42 +221,72 @@ class SegmentedModel:
                 raise ValueError(
                     f"empty segment: from {from_layer!r} to {to_layer!r}"
                 )
-        new_state = dict(state)
-        captured = None
-        for spec in self.layers[start:stop]:
-            p = params.get(spec.name, {})
-            s = state.get(spec.name, {})
-            if rng is not None:
-                rng, sub = jax.random.split(rng)
-            else:
-                sub = None
-            x, s2 = L.apply_layer(spec, p, s, x, train=train, rng=sub)
-            if unit_mask is not None and spec.name == unit_mask[0]:
-                x = x * unit_mask[1]
-            if s2 is not s and s2:
-                new_state[spec.name] = s2
-            if capture is not None and spec.name == capture:
-                captured = x
+        taps = None
+        if unit_mask is not None or perturb is not None or capture is not None:
+            taps = L.Taps(unit_mask=unit_mask, perturb=perturb, capture=capture)
+        y, new_state = L.apply_seq(
+            self.layers[start:stop], params, state, x,
+            train=train, rng=rng, taps=taps,
+        )
+        # merge: untouched layers keep their previous state entries
+        merged = dict(state)
+        merged.update(new_state)
         if capture is not None:
-            return x, new_state, captured
-        return x, new_state
+            return y, merged, taps.captured
+        return y, merged
 
     # -- pruning-adjacent helpers ------------------------------------------
 
     def replace_layer(self, name: str, new_spec: L.LayerSpec) -> "SegmentedModel":
-        new_layers = tuple(
-            new_spec if l.name == name else l for l in self.layers
-        )
-        return SegmentedModel(new_layers, self.input_shape)
+        """Replace the (possibly nested) layer at path ``name``."""
+        path = L.parse_path(name)
+        new_layers = _replace_in(self.layers, path, new_spec)
+        return SegmentedModel(new_layers, self.input_shape, self.input_dtype)
 
     def widths(self) -> Dict[str, int]:
-        """Current unit count of every prunable layer — the architecture
-        metadata a checkpoint must carry (SURVEY.md §5.4)."""
-        return {
-            l.name: l.features
-            for l in self.layers
-            if isinstance(l, L.PRUNABLE_TYPES)
-        }
+        """Current unit count of every prunable layer (nested paths included)
+        — the architecture metadata a checkpoint must carry (SURVEY.md §5.4)."""
+        out: Dict[str, int] = {}
+
+        def walk(layers, prefix):
+            for l in layers:
+                path = prefix + (l.name,)
+                if isinstance(l, L.Residual):
+                    walk(l.body, path)
+                    walk(l.shortcut, path)
+                elif isinstance(l, L.PRUNABLE_TYPES):
+                    out["/".join(path)] = L.n_units(l)
+
+        walk(self.layers, ())
+        return out
+
+
+def _replace_in(layers: Tuple[L.LayerSpec, ...], path, new_spec):
+    out = []
+    head, rest = path[0], path[1:]
+    found = False
+    for l in layers:
+        if l.name == head:
+            found = True
+            if not rest:
+                out.append(new_spec)
+            else:
+                if not isinstance(l, L.Residual):
+                    raise KeyError("/".join(path))
+                import dataclasses as _dc
+
+                if any(c.name == rest[0] for c in l.body):
+                    l = _dc.replace(l, body=_replace_in(l.body, rest, new_spec))
+                else:
+                    l = _dc.replace(
+                        l, shortcut=_replace_in(l.shortcut, rest, new_spec)
+                    )
+                out.append(l)
+        else:
+            out.append(l)
+    if not found:
+        raise KeyError("/".join(path))
+    return tuple(out)
 
 
 def init_model(model: SegmentedModel, seed: int = 0, dtype=jnp.float32):

@@ -1,0 +1,1 @@
+"""TPU kernels and fused ops (Pallas where warranted, XLA otherwise)."""

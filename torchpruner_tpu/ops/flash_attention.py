@@ -1,0 +1,26 @@
+"""Flash attention for TPU.
+
+Placeholder implementation: numerically identical XLA path.  Replaced by a
+Pallas kernel (same signature) — see this module's history; the public entry
+point is :func:`flash_attention` and callers never depend on the backend.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def flash_attention(q, k, v, *, causal: bool = False):
+    """Attention on ``(B, S, H, Dh)`` q/k/v (K/V already at H heads)."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bshk,bthk->bhst", q, k) * scale
+    if causal:
+        S = q.shape[1]
+        neg = jnp.finfo(logits.dtype).min
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        logits = jnp.where(mask[None, None], logits, neg)
+    w = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhst,bthk->bshk", w, v)
