@@ -1,0 +1,390 @@
+"""Model-zoo tests for the BASELINE.json capability families (ResNet, ViT,
+BERT, Llama): forward shapes, static pruning-graph structure, and structural
+pruning correctness (prune-vs-mask equivalence — the composite-model analog
+of the reference's NaN-cascade tests, reference tests/test_pruner.py:72-121).
+
+Full-size specs (resnet50 / vit_b16 / bert_base / llama3_8b) are checked
+*statically* — graph structure and parameter counts from the specs alone —
+so no big array is ever materialized on the test CPU.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.graph import group_for, pruning_graph
+from torchpruner_tpu.core.pruner import prune
+from torchpruner_tpu.core.segment import SegmentedModel, init_model
+from torchpruner_tpu.models import (
+    bert_base,
+    bert_tiny,
+    llama3_8b,
+    llama_tiny,
+    resnet20_cifar,
+    resnet50,
+    vit_b16,
+    vit_tiny,
+)
+from torchpruner_tpu.utils.losses import lm_cross_entropy_loss
+
+
+def spec_param_count(model: SegmentedModel) -> int:
+    """Parameter count from the static spec (no arrays materialized)."""
+
+    def count(layers, in_shape):
+        total = 0
+        shape = tuple(in_shape)
+        for spec in layers:
+            if isinstance(spec, L.Residual):
+                total += count(spec.body, shape)
+                total += count(spec.shortcut, shape)
+            else:
+                total += _layer_params(spec, shape)
+            shape = L.out_shape(spec, shape)
+        return total
+
+    return count(model.layers, model.input_shape)
+
+
+def _layer_params(spec, in_shape):
+    d = in_shape[-1] if in_shape else 0
+    if isinstance(spec, L.Dense):
+        return d * spec.features + (spec.features if spec.use_bias else 0)
+    if isinstance(spec, L.Conv):
+        kh, kw = spec.kernel_size
+        return kh * kw * d * spec.features + (
+            spec.features if spec.use_bias else 0
+        )
+    if isinstance(spec, L.BatchNorm):
+        return 2 * d
+    if isinstance(spec, L.LayerNorm):
+        return d * (2 if spec.use_bias else 1)
+    if isinstance(spec, L.RMSNorm):
+        return d
+    if isinstance(spec, L.Embedding):
+        return spec.vocab_size * spec.features
+    if isinstance(spec, L.PosEmbed):
+        return spec.max_len * d
+    if isinstance(spec, L.ClsToken):
+        return d
+    if isinstance(spec, L.MultiHeadAttention):
+        H, KV, Dh = spec.num_heads, spec.kv_heads, spec.head_dim
+        d_out = spec.out_features if spec.out_features is not None else d
+        n = d * H * Dh + 2 * d * KV * Dh + H * Dh * d_out
+        if spec.use_bias:
+            n += H * Dh + 2 * KV * Dh + d_out
+        return n
+    if isinstance(spec, L.GatedDense):
+        return 2 * d * spec.features + (
+            2 * spec.features if spec.use_bias else 0
+        )
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# ResNet
+# ---------------------------------------------------------------------------
+
+
+def test_resnet20_forward_and_graph():
+    model = resnet20_cifar()
+    params, state = init_model(model, seed=0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+    y, _ = model.apply(params, x, state=state)
+    assert y.shape == (2, 10)
+    graph = pruning_graph(model)
+    targets = [g.target for g in graph]
+    # stem feeds stage1_block1 through an *identity* skip (16 -> 16, stride
+    # 1) so it is width-pinned; interior conv1s are prunable, conv2s (feeding
+    # the residual sum) are not.
+    assert "stem" not in targets
+    assert "stage1_block1/conv1" in targets
+    assert all(not t.endswith("/conv2") for t in targets)
+    # 9 blocks, one prunable conv each
+    assert len(targets) == 9
+
+
+def test_resnet20_prune_block_conv_then_forward():
+    model = resnet20_cifar()
+    params, state = init_model(model, seed=0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+    target = "stage2_block1/conv1"
+    g = group_for(model, target)
+    assert any(c.layer == "stage2_block1/conv2" for c in g.consumers)
+    res = prune(model, params, target, [0, 3, 7], state=state)
+    assert res.model.layer(target).features == 32 - 3
+    y, _ = res.model.apply(res.params, x, state=res.state)
+    assert y.shape == (2, 10)
+
+
+def test_resnet20_prune_vs_mask_equivalence():
+    """Zeroing units of an interior block conv == pruning them (eval mode):
+    the consumer slice removes exactly the masked contributions."""
+    model = resnet20_cifar()
+    params, state = init_model(model, seed=1)
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 32, 32, 3))
+    target = "stage1_block2/conv1"
+    drop = [1, 5, 11]
+    keep_mask = jnp.ones((16,)).at[jnp.asarray(drop)].set(0.0)
+    y_masked, _ = model.apply(
+        params, x, state=state, unit_mask=(target, keep_mask)
+    )
+    res = prune(model, params, target, drop, state=state)
+    y_pruned, _ = res.model.apply(res.params, x, state=res.state)
+    np.testing.assert_allclose(
+        np.asarray(y_masked), np.asarray(y_pruned), atol=1e-4
+    )
+
+
+def test_resnet50_static_structure():
+    model = resnet50()
+    # 16 bottleneck blocks x 2 prunable convs each, + prunable stem (the
+    # first block has a projection shortcut, so the stem cascades into it)
+    graph = pruning_graph(model)
+    targets = [g.target for g in graph]
+    assert "stem" in targets
+    assert len(targets) == 1 + 2 * 16
+    stem = group_for(model, "stem")
+    consumer_layers = {c.layer for c in stem.consumers}
+    assert consumer_layers == {
+        "stage1_block1/conv1", "stage1_block1/proj"
+    }
+    n = spec_param_count(model)
+    assert abs(n - 25.56e6) / 25.56e6 < 0.01  # torchvision: 25,557,032
+
+
+# ---------------------------------------------------------------------------
+# ViT
+# ---------------------------------------------------------------------------
+
+
+def test_vit_tiny_forward_and_prune_groups():
+    model = vit_tiny()
+    params, state = init_model(model, seed=0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 16, 3))
+    y, _ = model.apply(params, x, state=state)
+    assert y.shape == (2, 10)
+    targets = [g.target for g in pruning_graph(model)]
+    # per block: one head group + one MLP hidden group
+    assert "block1_attn/attn" in targets
+    assert "block1_mlp/fc1" in targets
+    assert len(targets) == 2 * 2
+
+
+def test_vit_tiny_prune_heads_and_mlp():
+    model = vit_tiny()
+    params, state = init_model(model, seed=0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 16, 3))
+    y0, _ = model.apply(params, x, state=state)
+    res = prune(model, params, "block1_attn/attn", [2], state=state)
+    res2 = prune(
+        res.model, res.params, "block2_mlp/fc1", [0, 9, 33], state=res.state
+    )
+    assert res2.model.layer("block1_attn/attn").num_heads == 3
+    assert res2.model.layer("block2_mlp/fc1").features == 61
+    y, _ = res2.model.apply(res2.params, x, state=res2.state)
+    assert y.shape == (2, 10)
+    assert np.all(np.isfinite(np.asarray(y)))
+
+
+def test_vit_tiny_head_prune_vs_mask_equivalence():
+    model = vit_tiny()
+    params, state = init_model(model, seed=3)
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 16, 16, 3))
+    site = "block2_attn/attn"
+    mask = jnp.ones((4,)).at[1].set(0.0)
+    y_masked, _ = model.apply(params, x, state=state, unit_mask=(site, mask))
+    res = prune(model, params, site, [1], state=state)
+    y_pruned, _ = res.model.apply(res.params, x, state=res.state)
+    np.testing.assert_allclose(
+        np.asarray(y_masked), np.asarray(y_pruned), atol=1e-5
+    )
+
+
+def test_vit_b16_static_structure():
+    model = vit_b16()
+    targets = [g.target for g in pruning_graph(model)]
+    assert len(targets) == 2 * 12
+    n = spec_param_count(model)
+    assert abs(n - 86.6e6) / 86.6e6 < 0.01  # ViT-B/16: ~86.6M
+
+
+# ---------------------------------------------------------------------------
+# BERT
+# ---------------------------------------------------------------------------
+
+
+def test_bert_tiny_forward_and_linear_pruning():
+    model = bert_tiny()
+    params, state = init_model(model, seed=0)
+    x = model.example_input(3)
+    y, _ = model.apply(params, x, state=state)
+    assert y.shape == (3, 2)
+    # the BASELINE "Linear-layer pruning" target: fc1 with fc2 consumer
+    g = group_for(model, "block1_mlp/fc1")
+    assert any(c.layer == "block1_mlp/fc2" for c in g.consumers)
+    res = prune(model, params, "block1_mlp/fc1", list(range(16)), state=state)
+    assert res.model.layer("block1_mlp/fc1").features == 48
+    y2, _ = res.model.apply(res.params, x, state=res.state)
+    assert y2.shape == (3, 2)
+
+
+def test_bert_tiny_fc1_prune_vs_mask_equivalence():
+    model = bert_tiny()
+    params, state = init_model(model, seed=1)
+    x = model.example_input(2, seed=5)
+    drop = [0, 7, 40]
+    mask = jnp.ones((64,)).at[jnp.asarray(drop)].set(0.0)
+    y_masked, _ = model.apply(
+        params, x, state=state, unit_mask=("block2_mlp/fc1", mask)
+    )
+    res = prune(model, params, "block2_mlp/fc1", drop, state=state)
+    y_pruned, _ = res.model.apply(res.params, x, state=res.state)
+    np.testing.assert_allclose(
+        np.asarray(y_masked), np.asarray(y_pruned), atol=1e-5
+    )
+
+
+def test_bert_base_static_structure():
+    model = bert_base()
+    targets = [g.target for g in pruning_graph(model)]
+    # per block: head group + MLP hidden group; plus the prunable pooler
+    # (the classification head itself is excluded as the output layer)
+    assert len(targets) == 2 * 12 + 1 and "pooler" in targets
+    n = spec_param_count(model)
+    # BERT-base encoder + pooler (no token-type embs, no MLM head): ~109M
+    assert abs(n - 109e6) / 109e6 < 0.02
+
+
+# ---------------------------------------------------------------------------
+# Llama
+# ---------------------------------------------------------------------------
+
+
+def test_llama_tiny_forward_loss_and_causality():
+    model = llama_tiny()
+    params, state = init_model(model, seed=0)
+    x = model.example_input(2)
+    y, _ = model.apply(params, x, state=state)
+    assert y.shape == (2, 16, 256)
+    loss = lm_cross_entropy_loss(y, x)
+    assert loss.shape == (2,) and np.all(np.isfinite(np.asarray(loss)))
+    # causality: changing the last token must not affect earlier logits
+    x2 = np.asarray(x).copy()
+    x2[:, -1] = (x2[:, -1] + 1) % 256
+    y2, _ = model.apply(params, jnp.asarray(x2), state=state)
+    np.testing.assert_allclose(
+        np.asarray(y[:, :-1]), np.asarray(y2[:, :-1]), atol=1e-5
+    )
+    assert not np.allclose(np.asarray(y[:, -1]), np.asarray(y2[:, -1]))
+
+
+def test_llama_tiny_ffn_channel_pruning():
+    model = llama_tiny()
+    params, state = init_model(model, seed=0)
+    x = model.example_input(2)
+    g = group_for(model, "block1_ffn/gate")
+    assert any(c.layer == "block1_ffn/down" for c in g.consumers)
+    drop = [0, 13, 50, 63]
+    mask = jnp.ones((64,)).at[jnp.asarray(drop)].set(0.0)
+    y_masked, _ = model.apply(
+        params, x, state=state, unit_mask=("block1_ffn/gate", mask)
+    )
+    res = prune(model, params, "block1_ffn/gate", drop, state=state)
+    assert res.model.layer("block1_ffn/gate").features == 60
+    assert res.params["block1_ffn"]["down"]["w"].shape[0] == 60
+    y_pruned, _ = res.model.apply(res.params, x, state=res.state)
+    np.testing.assert_allclose(
+        np.asarray(y_masked), np.asarray(y_pruned), atol=1e-5
+    )
+
+
+def test_llama_tiny_gqa_head_pruning():
+    model = llama_tiny()  # 4 query heads, 2 KV heads
+    params, state = init_model(model, seed=0)
+    x = model.example_input(2)
+    res = prune(model, params, "block2_attn/attn", [1], state=state)
+    spec = res.model.layer("block2_attn/attn")
+    assert spec.num_heads == 3
+    # surviving heads keep their original KV assignments
+    assert spec.head_kv_index() == (0, 1, 1)
+    y, _ = res.model.apply(res.params, x, state=res.state)
+    assert y.shape == (2, 16, 256)
+    assert np.all(np.isfinite(np.asarray(y)))
+
+
+def test_attributions_on_nested_sites():
+    """Data-dependent metrics score nested (in-Residual) and attention-head
+    sites via the tap path; weight-norm resolves nested params."""
+    from torchpruner_tpu import (
+        ShapleyAttributionMetric,
+        TaylorAttributionMetric,
+        WeightNormAttributionMetric,
+    )
+    from torchpruner_tpu.utils.losses import cross_entropy_loss
+
+    model = vit_tiny()
+    params, state = init_model(model, seed=0)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 16, 16, 3))
+    y = jnp.arange(4) % 10
+    data = [(x, y)]
+    t = TaylorAttributionMetric(
+        model, params, data, cross_entropy_loss, state=state
+    )
+    assert t.run("block1_mlp/fc1").shape == (64,)
+    assert t.run("block1_attn/attn").shape == (4,)
+    sv = ShapleyAttributionMetric(
+        model, params, data, cross_entropy_loss, state=state, sv_samples=2
+    )
+    assert sv.run("block2_attn/attn").shape == (4,)
+    wn = WeightNormAttributionMetric(
+        model, params, data, cross_entropy_loss, state=state
+    )
+    assert wn.run("block1_mlp/fc1").shape == (64,)
+    assert wn.run("block1_attn/attn").shape == (4,)
+
+
+def test_nested_taylor_matches_topLevel_equivalent():
+    """The tap-based gradient path must agree with the segment-based path:
+    score the same Dense both ways by building the same net flat vs wrapped
+    in a size-1 'residual' (body-only, zero shortcut is not expressible, so
+    compare tap path on a top-level layer instead: force taps via the
+    attention-free nested check is impossible — use a flat model and compare
+    grad_rows_fn tap mode against segment mode directly)."""
+    from torchpruner_tpu.attributions.activation import grad_rows_fn
+    from torchpruner_tpu.models import mnist_fc
+    from torchpruner_tpu.utils.losses import cross_entropy_loss
+    from torchpruner_tpu.models.mlp import fc_net
+
+    model = fc_net(20, hidden=(8, 8), n_classes=4)
+    params, state = init_model(model, seed=0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (6, 20))
+    y = jnp.arange(6) % 4
+    seg = grad_rows_fn(model, "fc1", cross_entropy_loss, "taylor")
+    # build the tap-mode function by hand (what nested sites use)
+    import torchpruner_tpu.attributions.activation as act
+
+    orig = act.needs_taps
+    act.needs_taps = lambda m, l: True
+    try:
+        grad_rows_fn.cache_clear()
+        tap = grad_rows_fn(model, "fc1", cross_entropy_loss, "taylor")
+    finally:
+        act.needs_taps = orig
+        grad_rows_fn.cache_clear()
+    np.testing.assert_allclose(
+        np.asarray(seg(params, state, x, y)),
+        np.asarray(tap(params, state, x, y)),
+        atol=1e-5,
+    )
+
+
+def test_llama3_8b_static_structure():
+    model = llama3_8b()
+    targets = [g.target for g in pruning_graph(model)]
+    # per block: head group + FFN group; lm_head excluded as output layer
+    assert len(targets) == 2 * 32
+    n = spec_param_count(model)
+    assert abs(n - 8.03e6 * 1000) / 8.03e9 < 0.01  # Llama-3-8B: 8.03B

@@ -17,10 +17,20 @@ import jax.numpy as jnp
 
 from torchpruner_tpu.attributions.base import (
     AttributionMetric,
-    prefix_fn,
+    needs_taps,
     suffix_loss_fn,
     spatial_sum,
 )
+
+
+def _finish(mode, z, g):
+    if mode == "sensitivity":
+        # abs first, then spatial sum (reference sensitivity.py:27-30)
+        return spatial_sum(jnp.abs(g))
+    taylor = spatial_sum(-g * z)  # sum first (reference taylor.py:39-42)
+    if mode == "taylor":
+        return jnp.abs(taylor)
+    return taylor  # taylor_signed
 
 
 @functools.lru_cache(maxsize=512)
@@ -32,7 +42,36 @@ def grad_rows_fn(model, eval_layer, loss_fn, mode: str):
     ``loss.backward()`` on a mean criterion (reference attributions.py:58-68) —
     per-example grads therefore carry the 1/batch factor, and examples are
     exactly separable because scoring runs in eval mode.
+
+    Top-level non-attention sites split the model at the site and
+    differentiate the suffix only.  Nested sites (inside ``Residual``
+    bodies) and attention head-context sites instead instrument one full
+    forward: activation via ``capture``, gradient as the derivative w.r.t.
+    an additive ``perturb`` at zero — same values, computed where
+    segmentation cannot cut.
     """
+    if needs_taps(model, eval_layer):
+
+        @jax.jit
+        def fn(params, state, x, y):
+            _, _, z = model.apply(
+                params, x, state=state, train=False, capture=eval_layer
+            )
+            if mode == "apoz":
+                return spatial_sum((z > 0).astype(jnp.float32))
+
+            def mean_loss(delta):
+                preds, _ = model.apply(
+                    params, x, state=state, train=False,
+                    perturb=(eval_layer, delta),
+                )
+                return jnp.mean(loss_fn(preds, y))
+
+            g = jax.grad(mean_loss)(jnp.zeros(z.shape, z.dtype))
+            return _finish(mode, z, g)
+
+        return fn
+
     suffix = suffix_loss_fn(model, eval_layer, loss_fn)
 
     @jax.jit
@@ -47,13 +86,7 @@ def grad_rows_fn(model, eval_layer, loss_fn, mode: str):
             return jnp.mean(suffix(params, state, z_, y))
 
         g = jax.grad(mean_loss)(z)
-        if mode == "sensitivity":
-            # abs first, then spatial sum (reference sensitivity.py:27-30)
-            return spatial_sum(jnp.abs(g))
-        taylor = spatial_sum(-g * z)  # sum first (reference taylor.py:39-42)
-        if mode == "taylor":
-            return jnp.abs(taylor)
-        return taylor  # taylor_signed
+        return _finish(mode, z, g)
 
     return fn
 

@@ -122,7 +122,9 @@ class AttributionMetric:
         return self.data() if callable(self.data) else iter(self.data)
 
     def n_units(self, eval_layer: str) -> int:
-        return self.model.out_shape(eval_layer)[-1]
+        # site shape has the unit axis last (== out width everywhere except
+        # attention, whose unit is the query head)
+        return self.model.site_shape(eval_layer)[-1]
 
     def _collect(self, row_fn) -> np.ndarray:
         """Run ``row_fn`` over the dataset, stacking per-example rows."""
@@ -137,6 +139,24 @@ class AttributionMetric:
 # on the hashable (model, eval_layer, loss_fn) keeps XLA executables warm
 # across passes and invalidates exactly when pruning yields a new spec.
 # ---------------------------------------------------------------------------
+
+
+def needs_taps(model: SegmentedModel, eval_layer: str) -> bool:
+    """True when the evaluation site cannot be a segment boundary and metrics
+    must instrument a full forward instead: nested sites (inside a
+    ``Residual`` body — segment boundaries are top-level) and attention
+    layers (whose unit site is the pre-projection head context, not the layer
+    output)."""
+    if len(L.parse_path(eval_layer)) > 1:
+        return True
+    return isinstance(model.layer(eval_layer), L.MultiHeadAttention)
+
+
+def param_at(params, layer: str):
+    """Resolve a (possibly nested, ``"block/child"``) layer's param dict."""
+    from torchpruner_tpu.core.plan import _get_path
+
+    return _get_path(params, L.parse_path(layer))
 
 
 @functools.lru_cache(maxsize=512)

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from torchpruner_tpu.attributions.base import (
     AttributionMetric,
+    needs_taps,
     suffix_loss_fn,
 )
 
@@ -37,8 +38,8 @@ def shapley_rows_fn(model, eval_layer: str, loss_fn, use_partial: bool):
     ``perms`` is an ``(sv_samples, n_units)`` int array of unit permutations,
     fixed across batches (reference shapley_values.py:45-47).
     """
-    n = model.out_shape(eval_layer)[-1]
-    suffix = suffix_loss_fn(model, eval_layer, loss_fn)
+    n = model.site_shape(eval_layer)[-1]
+    suffix = suffix_loss_fn(model, eval_layer, loss_fn) if use_partial else None
 
     @jax.jit
     def fn(params, state, x, y, perms):
@@ -106,6 +107,10 @@ class ShapleyAttributionMetric(AttributionMetric):
         distributed scorer)."""
         S = sv_samples if sv_samples is not None else self.sv_samples
         partial = use_partial if use_partial is not None else self.use_partial
+        if needs_taps(self.model, eval_layer):
+            # nested / attention-head sites cannot be segment boundaries —
+            # the masking path applies the cumulative unit mask mid-forward
+            partial = False
         n = self.n_units(eval_layer)
         self._calls += 1
         key = jax.random.fold_in(jax.random.PRNGKey(self.seed), self._calls)

@@ -10,7 +10,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from torchpruner_tpu.core import layers as L
-from torchpruner_tpu.attributions.base import AttributionMetric
+from torchpruner_tpu.attributions.base import AttributionMetric, param_at
 
 
 class RandomAttributionMetric(AttributionMetric):
@@ -41,9 +41,20 @@ class WeightNormAttributionMetric(AttributionMetric):
 
     def run(self, layer, *, find_best_evaluation_layer=False, **kw):
         spec = self.model.layer(layer)
-        w = self.params[layer]["w"]
+        p = param_at(self.params, layer)
         if isinstance(spec, L.Dense):  # (in, out)
-            return np.asarray(jnp.abs(w).sum(axis=0))
+            return np.asarray(jnp.abs(p["w"]).sum(axis=0))
         if isinstance(spec, L.Conv):  # HWIO
-            return np.asarray(jnp.abs(w).sum(axis=(0, 1, 2)))
+            return np.asarray(jnp.abs(p["w"]).sum(axis=(0, 1, 2)))
+        if isinstance(spec, L.GatedDense):  # gate + up, per hidden channel
+            return np.asarray(
+                jnp.abs(p["wg"]).sum(axis=0) + jnp.abs(p["wu"]).sum(axis=0)
+            )
+        if isinstance(spec, L.MultiHeadAttention):
+            # per query head: incoming |wq| + outgoing |wo| (KV projections
+            # are shared across groups under GQA and excluded)
+            return np.asarray(
+                jnp.abs(p["wq"]).sum(axis=(0, 2))
+                + jnp.abs(p["wo"]).sum(axis=(1, 2))
+            )
         raise TypeError(f"no weights to score on {type(spec).__name__}")

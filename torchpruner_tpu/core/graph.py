@@ -211,8 +211,9 @@ def _walk(
                 if shapes[i][1][-1] != shapes[i][0][-1]:
                     # unit identity lost (channels folded) — conservative drop
                     current = None
-            elif isinstance(spec, (L.Embedding, L.PosEmbed)):
-                current = None  # unit identity lost
+            elif isinstance(spec, (L.Embedding, L.PosEmbed, L.ClsToken)):
+                current = None  # unit identity lost (added params share the
+                # producer's channel width but are not sliced with it)
             # Activation / Pool / GlobalPool: transparent for unit identity.
 
     return current

@@ -214,6 +214,14 @@ class PosEmbed:
 
 
 @dataclass(frozen=True)
+class ClsToken:
+    """Prepend a learned classification token: ``(B, S, d) -> (B, S+1, d)``
+    (ViT/BERT-style; pair with ``GlobalPool("...", "cls")`` at the head)."""
+
+    name: str
+
+
+@dataclass(frozen=True)
 class MultiHeadAttention:
     """Multi-head (optionally grouped-query) self-attention on ``(B, S, d)``.
 
@@ -365,6 +373,8 @@ def out_shape(spec: LayerSpec, in_shape: Tuple[int, ...]) -> Tuple[int, ...]:
         return _reshape_target(spec.shape, in_shape)
     if isinstance(spec, Embedding):
         return tuple(in_shape) + (spec.features,)
+    if isinstance(spec, ClsToken):
+        return (in_shape[0] + 1,) + tuple(in_shape[1:])
     if isinstance(spec, MultiHeadAttention):
         d_out = spec.out_features if spec.out_features is not None else in_shape[-1]
         return tuple(in_shape[:-1]) + (d_out,)
@@ -461,6 +471,11 @@ def init_layer(spec: LayerSpec, key, in_shape: Tuple[int, ...], dtype=jnp.float3
                 key, (spec.vocab_size, spec.features), dtype
             ) * 0.02
         }
+        return params, {}, out_shape(spec, in_shape)
+
+    if isinstance(spec, ClsToken):
+        f = in_shape[-1]
+        params = {"tok": jax.random.normal(key, (f,), dtype) * 0.02}
         return params, {}, out_shape(spec, in_shape)
 
     if isinstance(spec, PosEmbed):
@@ -794,6 +809,12 @@ def apply_layer(
 
     if isinstance(spec, Embedding):
         return jnp.take(params["emb"], x, axis=0), state
+
+    if isinstance(spec, ClsToken):
+        tok = jnp.broadcast_to(
+            params["tok"], (x.shape[0], 1, x.shape[-1])
+        ).astype(x.dtype)
+        return jnp.concatenate([tok, x], axis=1), state
 
     if isinstance(spec, PosEmbed):
         S = x.shape[-2]

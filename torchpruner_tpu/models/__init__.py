@@ -1,9 +1,23 @@
-"""Model zoo: segmented models mirroring the reference's experiments/models/
-plus the analytic test fixture."""
+"""Model zoo.
+
+Reference parity (experiments/models/): the analytic ``max_model`` fixture,
+MNIST/CIFAR FC nets, the FMNIST convnet, and CIFAR VGG16-bn.  Beyond parity,
+the BASELINE.json capability targets: ResNet (filter pruning), ViT (head +
+MLP pruning), BERT (Linear pruning), and Llama (FFN channel pruning)."""
 
 from torchpruner_tpu.models.analytic import max_model
 from torchpruner_tpu.models.mlp import mnist_fc, cifar10_fc
 from torchpruner_tpu.models.convnet import fmnist_convnet
 from torchpruner_tpu.models.vgg import vgg16_bn
+from torchpruner_tpu.models.resnet import resnet18, resnet20_cifar, resnet50
+from torchpruner_tpu.models.vit import vit, vit_b16, vit_tiny
+from torchpruner_tpu.models.bert import bert, bert_base, bert_tiny
+from torchpruner_tpu.models.llama import llama, llama3_8b, llama_tiny
 
-__all__ = ["max_model", "mnist_fc", "cifar10_fc", "fmnist_convnet", "vgg16_bn"]
+__all__ = [
+    "max_model", "mnist_fc", "cifar10_fc", "fmnist_convnet", "vgg16_bn",
+    "resnet18", "resnet20_cifar", "resnet50",
+    "vit", "vit_b16", "vit_tiny",
+    "bert", "bert_base", "bert_tiny",
+    "llama", "llama3_8b", "llama_tiny",
+]

@@ -1,0 +1,82 @@
+"""Llama-family decoder — the "Llama-3-8B FFN channel pruning + fine-tune
+(pjit FSDP)" config of BASELINE.json.
+
+Pre-norm decoder (Touvron et al., 2023; Llama-3 uses GQA): token embedding,
+``depth`` blocks of ``Residual[RMSNorm, causal GQA attention with RoPE]`` +
+``Residual[RMSNorm, SwiGLU, down-proj]``, final RMSNorm, LM head.
+
+The FFN channel-pruning target is each block's
+:class:`~torchpruner_tpu.core.layers.GatedDense` (``wg``/``wu`` hidden
+channels) pruned with its ``wo`` down-projection consumer inside the body —
+the group the static graph derives for GLU chains.  Attention-head groups
+are also exposed (GQA-aware: surviving query heads keep their original KV
+assignments via ``kv_group``).
+"""
+
+from __future__ import annotations
+
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.segment import SegmentedModel
+
+
+def llama(
+    *,
+    vocab_size: int = 128256,
+    dim: int = 4096,
+    depth: int = 32,
+    num_heads: int = 32,
+    num_kv_heads: int = 8,
+    head_dim: int = 128,
+    ffn_dim: int = 14336,
+    rope_theta: float = 500000.0,
+    seq_len: int = 2048,
+) -> SegmentedModel:
+    layers: list = [L.Embedding("tok_emb", vocab_size, dim)]
+    for i in range(1, depth + 1):
+        attn_body = (
+            L.RMSNorm("norm"),
+            L.MultiHeadAttention(
+                "attn", num_heads=num_heads, head_dim=head_dim,
+                num_kv_heads=num_kv_heads, out_features=dim,
+                causal=True, rope=True, rope_theta=rope_theta,
+            ),
+        )
+        ffn_body = (
+            L.RMSNorm("norm"),
+            L.GatedDense("gate", ffn_dim, fn="silu"),
+            L.Dense("down", dim, use_bias=False),
+        )
+        layers += [
+            L.Residual(f"block{i}_attn", attn_body),
+            L.Residual(f"block{i}_ffn", ffn_body),
+        ]
+    layers += [
+        L.RMSNorm("final_norm"),
+        L.Dense("lm_head", vocab_size, use_bias=False),
+    ]
+    return SegmentedModel(tuple(layers), (seq_len,), input_dtype="int32")
+
+
+def llama3_8b(seq_len: int = 2048) -> SegmentedModel:
+    """Llama-3-8B: 32 blocks, dim 4096, 32 query / 8 KV heads, FFN 14336,
+    vocab 128256 — the BASELINE.json FSDP fine-tune target.  ~8.0B params."""
+    return llama(seq_len=seq_len)
+
+
+def llama_tiny(
+    *,
+    vocab_size: int = 256,
+    dim: int = 32,
+    depth: int = 2,
+    num_heads: int = 4,
+    num_kv_heads: int = 2,
+    ffn_dim: int = 64,
+    seq_len: int = 16,
+) -> SegmentedModel:
+    """Miniature Llama with the full block structure (GQA + RoPE + SwiGLU)
+    — tests / CPU smoke / multi-chip dryruns."""
+    return llama(
+        vocab_size=vocab_size, dim=dim, depth=depth, num_heads=num_heads,
+        num_kv_heads=num_kv_heads, head_dim=dim // num_heads,
+        ffn_dim=ffn_dim, rope_theta=10000.0, seq_len=seq_len,
+    )

@@ -1,0 +1,150 @@
+"""ResNet family — the "ResNet-50 / ImageNet structured filter pruning"
+config of BASELINE.json.
+
+The reference has no residual models (its zoo is FC nets + VGG16,
+reference experiments/models/ — SURVEY.md §2.6); ResNet is the first
+BASELINE.json capability target beyond reference parity.  Blocks are
+:class:`~torchpruner_tpu.core.layers.Residual` specs, so the pruning graph
+falls out of the same static analysis as everything else
+(torchpruner_tpu/core/graph.py): convs feeding the residual sum are
+width-pinned, interior convs prune with their in-block consumers, and a
+stem conv feeding a projection-shortcut block cascades into both chains.
+
+Taylor-criterion filter pruning on these models is the TPU-native analog of
+the reference's conv-channel pruning (reference pruner.py:81-85) — same
+surgery, derived statically instead of via the NaN trick.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.segment import SegmentedModel
+
+
+def _basic_block(name: str, width: int, in_width: int, stride: int) -> L.Residual:
+    """3x3 -> 3x3 residual block (ResNet-18/20/34)."""
+    body = (
+        L.Conv("conv1", width, (3, 3), (stride, stride), use_bias=False),
+        L.BatchNorm("bn1"),
+        L.Activation("relu1", "relu"),
+        L.Conv("conv2", width, (3, 3), use_bias=False),
+        L.BatchNorm("bn2"),
+    )
+    shortcut: Tuple[L.LayerSpec, ...] = ()
+    if stride != 1 or in_width != width:
+        shortcut = (
+            L.Conv("proj", width, (1, 1), (stride, stride), use_bias=False),
+            L.BatchNorm("proj_bn"),
+        )
+    return L.Residual(name, body, shortcut)
+
+
+def _bottleneck(name: str, width: int, in_width: int, stride: int) -> L.Residual:
+    """1x1 -> 3x3 -> 1x1(4x) bottleneck block (ResNet-50/101/152)."""
+    out_width = 4 * width
+    body = (
+        L.Conv("conv1", width, (1, 1), use_bias=False),
+        L.BatchNorm("bn1"),
+        L.Activation("relu1", "relu"),
+        L.Conv("conv2", width, (3, 3), (stride, stride), use_bias=False),
+        L.BatchNorm("bn2"),
+        L.Activation("relu2", "relu"),
+        L.Conv("conv3", out_width, (1, 1), use_bias=False),
+        L.BatchNorm("bn3"),
+    )
+    shortcut: Tuple[L.LayerSpec, ...] = ()
+    if stride != 1 or in_width != out_width:
+        shortcut = (
+            L.Conv("proj", out_width, (1, 1), (stride, stride), use_bias=False),
+            L.BatchNorm("proj_bn"),
+        )
+    return L.Residual(name, body, shortcut)
+
+
+def _resnet(
+    stage_blocks: Sequence[int],
+    bottleneck: bool,
+    n_classes: int,
+    input_shape: Tuple[int, int, int],
+    stem_width: int = 64,
+    deep_stem_pool: bool = True,
+    width_multiplier: float = 1.0,
+) -> SegmentedModel:
+    def w(x: int) -> int:
+        return max(1, int(x * width_multiplier))
+
+    make = _bottleneck if bottleneck else _basic_block
+    expansion = 4 if bottleneck else 1
+    layers: list = []
+    if deep_stem_pool:
+        layers += [
+            L.Conv("stem", w(stem_width), (7, 7), (2, 2), use_bias=False),
+            L.BatchNorm("stem_bn"),
+            L.Activation("stem_relu", "relu"),
+            L.Pool("stem_pool", "max", (3, 3), (2, 2), "SAME"),
+        ]
+    else:  # CIFAR stem: single 3x3, no pool
+        layers += [
+            L.Conv("stem", w(stem_width), (3, 3), use_bias=False),
+            L.BatchNorm("stem_bn"),
+            L.Activation("stem_relu", "relu"),
+        ]
+    in_width = w(stem_width)
+    for si, n_blocks in enumerate(stage_blocks):
+        width = w(stem_width * (2 ** si))
+        for bi in range(n_blocks):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            layers.append(
+                make(f"stage{si + 1}_block{bi + 1}", width, in_width, stride)
+            )
+            layers.append(
+                L.Activation(f"stage{si + 1}_block{bi + 1}_relu", "relu")
+            )
+            in_width = width * expansion
+    layers += [
+        L.GlobalPool("avgpool", "avg"),
+        L.Dense("out", n_classes),
+    ]
+    return SegmentedModel(tuple(layers), input_shape)
+
+
+def resnet50(
+    n_classes: int = 1000,
+    input_shape: Tuple[int, int, int] = (224, 224, 3),
+    width_multiplier: float = 1.0,
+) -> SegmentedModel:
+    """ResNet-50: [3,4,6,3] bottleneck stages, the ImageNet filter-pruning
+    target (Taylor criterion, BASELINE.json config 2)."""
+    return _resnet(
+        (3, 4, 6, 3), True, n_classes, input_shape,
+        width_multiplier=width_multiplier,
+    )
+
+
+def resnet18(
+    n_classes: int = 1000,
+    input_shape: Tuple[int, int, int] = (224, 224, 3),
+    width_multiplier: float = 1.0,
+) -> SegmentedModel:
+    """ResNet-18: [2,2,2,2] basic-block stages."""
+    return _resnet(
+        (2, 2, 2, 2), False, n_classes, input_shape,
+        width_multiplier=width_multiplier,
+    )
+
+
+def resnet20_cifar(
+    n_classes: int = 10,
+    input_shape: Tuple[int, int, int] = (32, 32, 3),
+    width_multiplier: float = 1.0,
+) -> SegmentedModel:
+    """CIFAR ResNet-20 (He et al. §4.2): 3x3 stem (width 16), three stages of
+    three basic blocks at widths 16/32/64 — the small residual model used by
+    tests and CPU smoke runs."""
+    return _resnet(
+        (3, 3, 3), False, n_classes, input_shape,
+        stem_width=16, deep_stem_pool=False,
+        width_multiplier=width_multiplier,
+    )

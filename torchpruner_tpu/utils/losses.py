@@ -31,6 +31,20 @@ def nll_loss(log_probs, labels):
     return -jnp.take_along_axis(log_probs, labels[:, None], axis=-1)[:, 0]
 
 
+def lm_cross_entropy_loss(logits, tokens):
+    """Next-token cross-entropy for causal LMs -> (batch,).
+
+    ``logits``: (B, S, V); ``tokens``: (B, S) int.  Position ``t`` predicts
+    token ``t+1``; the last position has no target and is dropped.  The
+    per-example value is the mean over the S-1 predicted positions, keeping
+    the per-example-first attribution contract (SURVEY.md §2.1).
+    """
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    tgt = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return nll.mean(axis=-1)
+
+
 def accuracy(logits, labels):
     """Fraction of argmax-correct predictions (scalar)."""
     return jnp.mean(jnp.argmax(logits, axis=-1) == labels)
