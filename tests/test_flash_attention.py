@@ -1,0 +1,80 @@
+"""Flash-attention kernel tests: Pallas (interpret mode on CPU) vs the XLA
+einsum reference, forward and gradients, causal and bidirectional, plus the
+fallback path for non-blocking sequence lengths."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from torchpruner_tpu.ops.flash_attention import (
+    _pick_blocks,
+    _xla_attention,
+    flash_attention,
+)
+
+
+def qkv(B=2, S=64, H=3, Dh=8, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (B, S, H, Dh)
+    return tuple(jax.random.normal(k, shape, dtype) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_xla(causal):
+    q, k, v = qkv()
+    out = flash_attention(q, k, v, causal=causal)
+    ref = _xla_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gradients_match_xla(causal):
+    q, k, v = qkv(S=32)
+    g = jax.random.normal(jax.random.PRNGKey(9), q.shape)
+
+    def loss(fn):
+        def f(q_, k_, v_):
+            return jnp.sum(fn(q_, k_, v_) * g)
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    got = loss(lambda a, b, c: flash_attention(a, b, c, causal=causal))
+    want = loss(lambda a, b, c: _xla_attention(a, b, c, causal=causal))
+    for ga, gw in zip(got, want):
+        np.testing.assert_allclose(np.asarray(ga), np.asarray(gw), atol=1e-4)
+
+
+def test_blocking_selection():
+    assert _pick_blocks(256) == (128, 128)
+    assert _pick_blocks(64) == (64, 64)
+    assert _pick_blocks(96) == (96, 96)  # < 128: single block
+    assert _pick_blocks(200) == (8, 8)  # 200 = 8 * 25: halve down to 8
+
+
+def test_odd_length_still_matches():
+    q, k, v = qkv(S=17)  # prime-ish length: single (17, 17) block
+    out = flash_attention(q, k, v, causal=True)
+    ref = _xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_causal_first_row_attends_self_only():
+    q, k, v = qkv(S=16)
+    out = flash_attention(q, k, v, causal=True)
+    # position 0 can only attend to itself: output == v[:, 0]
+    np.testing.assert_allclose(
+        np.asarray(out[:, 0]), np.asarray(v[:, 0]), atol=1e-5
+    )
+
+
+def test_bf16_runs_and_is_close():
+    q, k, v = qkv(dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=False)
+    ref = _xla_attention(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+        causal=False,
+    )
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32), np.asarray(ref), atol=3e-2
+    )

@@ -1,26 +1,163 @@
-"""Flash attention for TPU.
+"""Flash attention for TPU — Pallas forward kernel with online softmax.
 
-Placeholder implementation: numerically identical XLA path.  Replaced by a
-Pallas kernel (same signature) — see this module's history; the public entry
-point is :func:`flash_attention` and callers never depend on the backend.
+The hot op of the transformer families (ViT/BERT/Llama head pruning,
+BASELINE.json configs 3-5).  The forward never materializes the ``(S, S)``
+score matrix: the grid runs over ``(batch, heads, query blocks)`` and each
+program streams KV blocks from VMEM with the numerically-stable running
+``(max, sum, acc)`` update (Dao et al., 2022).  Matmuls are
+``preferred_element_type=float32`` so bf16 inputs still accumulate in f32 on
+the MXU.
+
+The backward is a ``custom_vjp`` that recomputes attention with the XLA
+einsum path and differentiates that — O(S^2) memory in the backward only.
+Inputs whose shapes don't block cleanly (sequence not divisible by the block
+size) fall back to the XLA path entirely; on CPU the kernel runs in
+interpreter mode so tests exercise the same code path as TPU.
 """
 
 from __future__ import annotations
 
+import functools
 import math
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _xla_attention(q, k, v, *, causal: bool):
+    """Reference einsum path on (B, S, H, Dh); also the backward's recompute."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum(
+        "bshk,bthk->bhst", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        S = q.shape[1]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    w = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhst,bthk->bshk", w, v)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_k):
+    """One (batch, head, query-block) program: stream KV blocks with the
+    online-softmax running state carried through ``fori_loop``."""
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)  # (block_q, Dh)
+    dh = q.shape[-1]
+    S = k_ref.shape[2]
+    n_kv = S // block_k
+    if causal:
+        # skip KV blocks entirely above the diagonal
+        n_run = lax.div((qi + 1) * block_q + block_k - 1, block_k)
+        n_run = jnp.minimum(n_run, n_kv)
+    else:
+        n_run = n_kv
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (block_q, block_k)
+        if causal:
+            qpos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + p.sum(axis=1, keepdims=True)
+        acc_new = acc * alpha + lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, dh), jnp.float32)
+    m, l, acc = lax.fori_loop(0, n_run, body, (m0, l0, acc0))
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    """(B, H, S, Dh) layout in, same out."""
+    B, H, S, Dh = q.shape
+    scale = 1.0 / math.sqrt(Dh)
+    grid = (B, H, S // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, Dh), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, S, Dh), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, S, Dh), lambda b, h, i: (b, h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, Dh), lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, Dh), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _pick_blocks(S: int):
+    """Largest clean blocking <= default; None if S doesn't block."""
+    bq = min(DEFAULT_BLOCK_Q, S)
+    while bq > 1 and S % bq:
+        bq //= 2
+    bk = min(DEFAULT_BLOCK_K, S)
+    while bk > 1 and S % bk:
+        bk //= 2
+    if S % bq or S % bk:
+        return None
+    return bq, bk
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_attention(q, k, v, causal):
+    blocks = _pick_blocks(q.shape[1])
+    if blocks is None:
+        return _xla_attention(q, k, v, causal=causal)
+    bq, bk = blocks
+    interpret = jax.default_backend() != "tpu"
+    # (B, S, H, Dh) -> (B, H, S, Dh) for clean per-(batch, head) blocking
+    qt, kt, vt = (jnp.moveaxis(t, 2, 1) for t in (q, k, v))
+    out = _flash_fwd(qt, kt, vt, causal, bq, bk, interpret)
+    return jnp.moveaxis(out, 1, 2)
+
+
+def _flash_vjp_fwd(q, k, v, causal):
+    return _flash_attention(q, k, v, causal), (q, k, v)
+
+
+def _flash_vjp_bwd(causal, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _xla_attention(q_, k_, v_, causal=causal), q, k, v
+    )
+    return vjp(g)
+
+
+_flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = False):
     """Attention on ``(B, S, H, Dh)`` q/k/v (K/V already at H heads)."""
-    scale = 1.0 / math.sqrt(q.shape[-1])
-    logits = jnp.einsum("bshk,bthk->bhst", q, k) * scale
-    if causal:
-        S = q.shape[1]
-        neg = jnp.finfo(logits.dtype).min
-        mask = jnp.tril(jnp.ones((S, S), bool))
-        logits = jnp.where(mask[None, None], logits, neg)
-    w = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bhst,bthk->bshk", w, v)
+    return _flash_attention(q, k, v, causal)
