@@ -18,9 +18,12 @@ from torchpruner_tpu.parallel.sharding import (
     replicate,
     shard_batch,
     shard_params,
+    tp_sharding,
+    tp_specs,
 )
 from torchpruner_tpu.parallel.scoring import DistributedScorer
 from torchpruner_tpu.parallel.train import ShardedTrainer
+from torchpruner_tpu.parallel.ring import ring_attention, ring_attention_local
 
 __all__ = [
     "make_mesh",
@@ -30,6 +33,10 @@ __all__ = [
     "replicate",
     "shard_batch",
     "shard_params",
+    "tp_sharding",
+    "tp_specs",
     "DistributedScorer",
     "ShardedTrainer",
+    "ring_attention",
+    "ring_attention_local",
 ]

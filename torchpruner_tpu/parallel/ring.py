@@ -1,0 +1,151 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+Long-context capability (absent from the reference, which has no attention at
+all — SURVEY.md §5.7): the sequence axis is sharded over a ``seq`` mesh axis;
+each device keeps its local query block and the KV shards rotate around the
+ring with ``lax.ppermute`` (one hop per step, riding ICI), while a running
+online-softmax state ``(max, sumexp, acc)`` merges each arriving chunk (Liu
+et al., 2023).  Peak memory per device is O(S_local^2) scores + two KV
+shards, independent of the global sequence length; compute overlaps with the
+next chunk's transfer inside one compiled XLA program.
+
+``ring_attention`` is the user-facing wrapper (global arrays in, shard_map
+inside); ``ring_attention_local`` is the per-shard computation for callers
+already running under ``shard_map``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+_NEG_INF = -1e30
+
+
+def _chunk_stats(q, k, v, q_off, k_off, causal):
+    """Attention of local queries against one KV chunk, returning the
+    online-softmax statistics instead of normalized output.
+
+    ``q``: (B, Sq, H, Dh); ``k``/``v``: (B, Sk, H, Dh); offsets are the
+    chunks' global sequence positions (for causal masking across the ring).
+    Returns ``m``: (B, H, Sq), ``l``: (B, H, Sq), ``acc``: (B, H, Sq, Dh).
+    """
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum(
+        "bshk,bthk->bhst", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        Sq, Sk = q.shape[1], k.shape[1]
+        qpos = q_off + lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
+        kpos = k_off + lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
+        keep = qpos >= kpos
+        s = jnp.where(keep[None, None], s, _NEG_INF)
+        m = jnp.max(s, axis=-1)
+        # re-apply the mask multiplicatively so a fully-masked row yields
+        # l = 0 (not Sk) — its m is _NEG_INF and it merges away to nothing
+        p = jnp.exp(s - m[..., None]) * keep[None, None]
+    else:
+        m = jnp.max(s, axis=-1)
+        p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum(
+        "bhst,bthk->bhsk", p, v.astype(p.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return m, l, acc
+
+
+def ring_attention_local(q, k, v, *, axis: str, causal: bool = False):
+    """Per-shard ring attention; must run under ``shard_map`` with the
+    sequence dim of q/k/v sharded over mesh axis ``axis``.
+
+    ``q``/``k``/``v``: (B, S_local, H, Dh) local shards (KV already expanded
+    to H heads).  Returns the local output shard (B, S_local, H, Dh).
+    """
+    if k.shape[1] != q.shape[1]:
+        raise ValueError(
+            f"ring attention is self-attention: K/V shard length "
+            f"{k.shape[1]} must equal Q's {q.shape[1]}"
+        )
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    B, S_loc, H, Dh = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def merge(t, m, l, acc, k_cur, v_cur):
+        src = (idx - t) % n  # whose KV chunk this device holds at step t
+        cm, cl, cacc = _chunk_stats(
+            q, k_cur, v_cur, idx * S_loc, src * S_loc, causal
+        )
+        m_new = jnp.maximum(m, cm)
+        a_old = jnp.exp(m - m_new)
+        a_new = jnp.exp(cm - m_new)
+        l = l * a_old + cl * a_new
+        acc = acc * a_old[..., None] + cacc * a_new[..., None]
+        return m_new, l, acc
+
+    def step(t, carry):
+        m, l, acc, k_cur, v_cur = carry
+        m, l, acc = merge(t, m, l, acc, k_cur, v_cur)
+        k_nxt = lax.ppermute(k_cur, axis, perm)
+        v_nxt = lax.ppermute(v_cur, axis, perm)
+        return m, l, acc, k_nxt, v_nxt
+
+    # initial state must be marked varying over the ring axis (the loop
+    # carry mixes it with axis-varying values under shard_map)
+    m0, l0, acc0 = lax.pcast(
+        (
+            jnp.full((B, H, S_loc), _NEG_INF, jnp.float32),
+            jnp.zeros((B, H, S_loc), jnp.float32),
+            jnp.zeros((B, H, S_loc, Dh), jnp.float32),
+        ),
+        (axis,),
+        to="varying",
+    )
+    # n-1 hops; the last chunk merges without a (discarded) final rotate
+    m, l, acc, k_last, v_last = lax.fori_loop(
+        0, n - 1, step, (m0, l0, acc0, k, v)
+    )
+    m, l, acc = merge(n - 1, m, l, acc, k_last, v_last)
+    out = acc / l[..., None]
+    return jnp.moveaxis(out, 1, 2).astype(q.dtype)  # (B, S_loc, H, Dh)
+
+
+def ring_attention(
+    q, k, v, mesh: Mesh, *, axis: str = "seq", causal: bool = False
+):
+    """Context-parallel attention on globally-shaped ``(B, S, H, Dh)``
+    arrays: shards the sequence dim over mesh axis ``axis`` and runs the
+    ring under ``shard_map`` (collectives ride ICI, inserted explicitly as
+    ``ppermute`` hops)."""
+    n = mesh.shape[axis]
+    if q.shape[1] % n:
+        raise ValueError(
+            f"sequence {q.shape[1]} not divisible by mesh axis "
+            f"{axis}={n}"
+        )
+    if k.shape[1] != q.shape[1] or v.shape[1] != q.shape[1]:
+        raise ValueError(
+            f"ring attention is self-attention: K/V length "
+            f"{k.shape[1]}/{v.shape[1]} must equal Q's {q.shape[1]}"
+        )
+    spec = P(None, axis, None, None)
+
+    fn = shard_map(
+        functools.partial(ring_attention_local, axis=axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    sharding = NamedSharding(mesh, spec)
+    return fn(
+        jax.device_put(q, sharding),
+        jax.device_put(k, sharding),
+        jax.device_put(v, sharding),
+    )

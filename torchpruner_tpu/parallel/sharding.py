@@ -6,15 +6,27 @@ and reduce-scatters gradients — no hand-written collectives.  After a prune
 step changes parameter shapes, call :func:`shard_params` again: arrays whose
 pruned axis no longer divides the mesh fall back to replication (resharding
 smaller arrays over the same mesh, SURVEY.md §5.8c).
+
+Tensor parallelism (:func:`tp_sharding`) is *derived from the pruning
+graph*: a prune group's target is exactly a Megatron column-parallel layer
+(its unit axis shards over ``model``) and its consumers are the matching
+row-parallel layers (their input axis shards, XLA psums the partial
+products) — the same producer/consumer structure that makes a group
+prunable makes it tensor-parallelizable.  Attention-head groups shard the
+head axis (GQA KV projections shard only when the KV-head count divides the
+axis).  Anything the graph doesn't claim falls back to the FSDP rule, so
+``partition="tp"`` is a TP+FSDP hybrid on one mesh axis.
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchpruner_tpu.core import layers as L
 
 
 def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
@@ -61,6 +73,111 @@ def shard_params(tree, mesh: Mesh, axis: str = "model",
     shardings = fsdp_sharding(tree, mesh, axis, min_size)
     placed = jax.device_put(tree, shardings)
     return placed, shardings
+
+
+def _tp_target_specs(spec, size: int) -> Dict[str, P]:
+    """Column-parallel specs for a prune-group target (unit axis sharded)."""
+    if isinstance(spec, L.Dense) and spec.features % size == 0:
+        return {"w": P(None, "model"), "b": P("model")}
+    if isinstance(spec, L.Conv) and spec.features % size == 0:
+        return {"w": P(None, None, None, "model"), "b": P("model")}
+    if isinstance(spec, L.GatedDense) and spec.features % size == 0:
+        return {
+            "wg": P(None, "model"), "wu": P(None, "model"),
+            "bg": P("model"), "bu": P("model"),
+        }
+    if isinstance(spec, L.MultiHeadAttention) and spec.num_heads % size == 0:
+        out = {
+            "wq": P(None, "model", None), "bq": P("model", None),
+            "wo": P("model", None, None), "bo": P(),
+        }
+        if spec.kv_heads % size == 0:
+            out.update({
+                "wk": P(None, "model", None), "bk": P("model", None),
+                "wv": P(None, "model", None), "bv": P("model", None),
+            })
+        return out
+    return {}
+
+
+def _tp_consumer_specs(spec, in_width: int, size: int) -> Dict[str, P]:
+    """Row-parallel specs for a group consumer (input axis sharded; XLA
+    inserts the partial-sum reduction).  Biases stay replicated (added once
+    after the reduce)."""
+    if in_width % size:
+        return {}
+    if isinstance(spec, L.Dense):
+        return {"w": P("model", None)}
+    if isinstance(spec, L.Conv):
+        return {"w": P(None, None, "model", None)}
+    if isinstance(spec, L.GatedDense):
+        return {"wg": P("model", None), "wu": P("model", None)}
+    if isinstance(spec, L.MultiHeadAttention):
+        return {
+            "wq": P("model", None, None),
+            "wk": P("model", None, None),
+            "wv": P("model", None, None),
+        }
+    return {}
+
+
+def tp_specs(model, mesh: Mesh, axis: str = "model") -> Dict[Tuple[str, str], P]:
+    """``{(layer_path, param_name): PartitionSpec}`` tensor-parallel
+    assignments derived from the pruning graph (column-parallel targets,
+    row-parallel consumers; first claim wins where a layer appears in
+    multiple groups, e.g. conv chains)."""
+    from torchpruner_tpu.core.graph import pruning_graph
+
+    size = mesh.shape[axis]
+    if size == 1:
+        return {}
+    out: Dict[Tuple[str, str], P] = {}
+
+    def rename(p: P) -> P:
+        return P(*(axis if x == "model" else x for x in p))
+
+    def claim(layer: str, specs: Dict[str, P]):
+        for pname, pspec in specs.items():
+            out.setdefault((layer, pname), rename(pspec))
+
+    for g in pruning_graph(model, include_output=True):
+        tgt = model.layer(g.target)
+        specs = _tp_target_specs(tgt, size)
+        if not specs:
+            continue
+        claim(g.target, specs)
+        for c in g.consumers:
+            cspec = model.layer(c.layer)
+            in_w = L.n_units(tgt) * c.fan_out
+            claim(c.layer, _tp_consumer_specs(cspec, in_w, size))
+    return out
+
+
+def tp_sharding(model, params, mesh: Mesh, axis: str = "model",
+                min_size: int = 2**14):
+    """Sharding pytree for ``params``: pruning-graph-derived TP specs where
+    they apply, the FSDP rule everywhere else (embeddings, norms, the
+    residual-pinned projections)."""
+    assigned = tp_specs(model, mesh, axis)
+
+    def spec_for(path, leaf):
+        keys = tuple(getattr(k, "key", k) for k in path)
+        layer, pname = "/".join(keys[:-1]), keys[-1]
+        p = assigned.get((layer, pname))
+        shape = np.shape(leaf)
+        if p is not None:
+            # a pruned layer may have stopped dividing the axis — fall back
+            ok = all(
+                s is None or shape[d] % mesh.shape[s] == 0
+                for d, s in enumerate(p)
+            )
+            if ok:
+                return NamedSharding(mesh, p)
+        return NamedSharding(
+            mesh, fsdp_spec(shape, mesh, axis, min_size)
+        )
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
 
 
 def shard_batch(batch, mesh: Mesh, axis: str = "data"):

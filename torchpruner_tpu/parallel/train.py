@@ -30,6 +30,7 @@ from torchpruner_tpu.parallel.sharding import (
     fsdp_sharding,
     replicate,
     shard_batch,
+    tp_sharding,
 )
 
 
@@ -82,6 +83,9 @@ class ShardedTrainer:
     data_axis: str = "data"
     model_axis: str = "model"
     min_shard_size: int = 2**14
+    #: "fsdp" = shard each large param's largest axis; "tp" = pruning-graph
+    #: tensor parallelism (column/row-parallel pairs) with FSDP fallback
+    partition: str = "fsdp"
     _step_fn: Any = field(default=None, repr=False)
     step_count: int = 0
 
@@ -96,6 +100,7 @@ class ShardedTrainer:
         data_axis: str = "data",
         model_axis: str = "model",
         min_shard_size: int = 2**14,
+        partition: str = "fsdp",
     ) -> "ShardedTrainer":
         key = jax.random.PRNGKey(seed)
         params, state = model.init(key)
@@ -104,7 +109,7 @@ class ShardedTrainer:
             model=model, params=params, state=state, tx=tx,
             opt_state=opt_state, loss_fn=loss_fn, rng=key, mesh=mesh,
             data_axis=data_axis, model_axis=model_axis,
-            min_shard_size=min_shard_size,
+            min_shard_size=min_shard_size, partition=partition,
         )
         t._place()
         return t
@@ -112,23 +117,26 @@ class ShardedTrainer:
     # -- placement ---------------------------------------------------------
 
     def _shardings(self):
-        ps = fsdp_sharding(self.params, self.mesh, self.model_axis,
-                           self.min_shard_size)
-        ss = jax.tree_util.tree_map(lambda _: replicate(self.mesh), self.state)
-        # optimizer-state leaves shaped like a param shard with it; the rest
-        # (step counts etc.) replicate
-        flat_p = {
-            tuple(np.shape(l)): s
-            for l, s in zip(
-                jax.tree_util.tree_leaves(self.params),
-                jax.tree_util.tree_leaves(ps),
+        if self.partition not in ("fsdp", "tp"):
+            raise ValueError(
+                f"unknown partition {self.partition!r} (use 'fsdp' or 'tp')"
             )
-        }
-
-        def opt_rule(leaf):
-            return flat_p.get(tuple(np.shape(leaf)), replicate(self.mesh))
-
-        os_ = jax.tree_util.tree_map(opt_rule, self.opt_state)
+        if self.partition == "tp":
+            ps = tp_sharding(self.model, self.params, self.mesh,
+                             self.model_axis, self.min_shard_size)
+        else:
+            ps = fsdp_sharding(self.params, self.mesh, self.model_axis,
+                               self.min_shard_size)
+        ss = jax.tree_util.tree_map(lambda _: replicate(self.mesh), self.state)
+        # param-shaped optimizer-state leaves (momentum, Adam m/v) shard with
+        # their param; non-param leaves (step counts) replicate
+        os_ = optax.tree_map_params(
+            self.tx,
+            lambda _leaf, spec: spec,
+            self.opt_state,
+            ps,
+            transform_non_params=lambda _leaf: replicate(self.mesh),
+        )
         return ps, ss, os_
 
     def _place(self):
@@ -162,7 +170,7 @@ class ShardedTrainer:
             tx=self.tx, opt_state=opt_state, loss_fn=self.loss_fn,
             rng=self.rng, mesh=self.mesh, data_axis=self.data_axis,
             model_axis=self.model_axis, min_shard_size=self.min_shard_size,
-            step_count=self.step_count,
+            partition=self.partition, step_count=self.step_count,
         )
         t._place()
         return t
