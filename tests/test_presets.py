@@ -1,0 +1,82 @@
+"""Preset / CLI / token-dataset tests: the five BASELINE.json configs
+resolve, round-trip through JSON, and the transformer prune-retrain path
+runs end to end on miniature variants."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from torchpruner_tpu.data import load_dataset
+from torchpruner_tpu.data.datasets import synthetic_token_dataset
+from torchpruner_tpu.experiments.presets import PRESETS, get_preset
+from torchpruner_tpu.experiments.prune_retrain import (
+    LOSS_REGISTRY,
+    MODEL_REGISTRY,
+    run_prune_retrain,
+)
+from torchpruner_tpu.utils.config import ExperimentConfig
+
+
+def test_all_presets_resolve_and_roundtrip(tmp_path):
+    assert len(PRESETS) == 5  # the five BASELINE.json configs
+    for name in PRESETS:
+        for smoke in (False, True):
+            cfg = get_preset(name, smoke=smoke)
+            assert cfg.model in MODEL_REGISTRY, cfg.model
+            assert cfg.loss in LOSS_REGISTRY
+            p = tmp_path / f"{name}_{smoke}.json"
+            cfg.to_json(str(p))
+            back = ExperimentConfig.from_json(str(p))
+            assert back == cfg
+
+
+def test_unknown_preset_raises():
+    with pytest.raises(KeyError):
+        get_preset("nope")
+
+
+def test_token_classification_dataset_is_learnable_structure():
+    ds = synthetic_token_dataset(16, 64, 2, 200, seed=0)
+    assert ds.x.shape == (200, 16) and ds.x.dtype == np.int32
+    assert set(np.unique(ds.y)) <= {0, 1}
+    # the two classes must differ in token statistics (signal exists)
+    h0 = np.bincount(ds.x[ds.y == 0].ravel(), minlength=64)
+    h1 = np.bincount(ds.x[ds.y == 1].ravel(), minlength=64)
+    assert np.abs(h0 / h0.sum() - h1 / h1.sum()).max() > 0.01
+
+
+def test_lm_dataset_targets_are_inputs():
+    ds = load_dataset("lm_tiny", "val", n=32)
+    assert ds.x.shape == (32, 16)
+    np.testing.assert_array_equal(ds.x, ds.y)
+
+
+def test_prune_retrain_on_llama_tiny_ffn():
+    """Config-5 recipe end to end at miniature scale: Taylor on LM loss,
+    FFN channels only, fraction policy."""
+    cfg = get_preset("llama3_ffn_taylor", smoke=True)
+    cfg.score_examples = 16
+    cfg.eval_batch_size = 16
+    cfg.log_path = os.devnull
+    history = run_prune_retrain(cfg, verbose=False)
+    assert len(history) == 2  # one FFN group per block, heads untouched
+    assert all(r.layer.endswith("_ffn/gate") for r in history)
+    assert all(r.n_dropped == 16 for r in history)  # 25% of 64
+
+
+def test_cli_list_and_dump(tmp_path, capsys):
+    from torchpruner_tpu.__main__ import main
+
+    assert main(["--list"]) == 0
+    out = capsys.readouterr().out
+    for name in PRESETS:
+        assert name in out
+    dump = tmp_path / "cfg.json"
+    assert main([
+        "--preset", "bert_glue_sensitivity", "--smoke",
+        "--dump-config", str(dump),
+    ]) == 0
+    cfg = json.loads(dump.read_text())
+    assert cfg["model"] == "bert_tiny"

@@ -1,0 +1,92 @@
+"""Command-line driver: ``python -m torchpruner_tpu``.
+
+The CLI the reference never had (its drivers are notebooks and a phantom
+``args`` object — SURVEY.md §2.8, §5.6).  Runs a named preset or a JSON
+config through the prune-retrain loop or the layerwise-robustness sweep.
+
+Examples::
+
+    python -m torchpruner_tpu --preset llama3_ffn_taylor --smoke
+    python -m torchpruner_tpu --config my_experiment.json
+    python -m torchpruner_tpu --list
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="torchpruner_tpu",
+        description="TPU-native structured pruning experiments",
+    )
+    p.add_argument("--preset", help="named preset (see --list)")
+    p.add_argument("--config", help="path to an ExperimentConfig JSON")
+    p.add_argument(
+        "--smoke", action="store_true",
+        help="miniature model/data variants (CPU-friendly smoke run)",
+    )
+    p.add_argument(
+        "--cpu", action="store_true", help="force the CPU backend"
+    )
+    p.add_argument(
+        "--list", action="store_true", help="list presets and exit"
+    )
+    p.add_argument(
+        "--dump-config", metavar="PATH",
+        help="write the resolved config JSON to PATH and exit",
+    )
+    args = p.parse_args(argv)
+
+    if args.list:
+        from torchpruner_tpu.experiments.presets import PRESETS
+
+        for name, fn in PRESETS.items():
+            print(f"{name:26s} {fn.__doc__.splitlines()[0]}")
+        return 0
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from torchpruner_tpu.utils.config import ExperimentConfig
+
+    if args.config:
+        cfg = ExperimentConfig.from_json(args.config)
+    elif args.preset:
+        from torchpruner_tpu.experiments.presets import get_preset
+
+        cfg = get_preset(args.preset, smoke=args.smoke)
+    else:
+        p.error("one of --preset / --config / --list is required")
+
+    if args.dump_config:
+        cfg.to_json(args.dump_config)
+        print(f"wrote {args.dump_config}")
+        return 0
+
+    if cfg.experiment == "robustness":
+        from torchpruner_tpu.experiments.robustness import run_robustness_config
+
+        summary = run_robustness_config(cfg)
+        print(json.dumps(summary))
+    else:
+        from torchpruner_tpu.experiments.prune_retrain import run_prune_retrain
+
+        history = run_prune_retrain(cfg)
+        last = history[-1] if history else None
+        print(json.dumps({
+            "experiment": cfg.name,
+            "steps": len(history),
+            "final_acc": last.post_acc if last else None,
+            "final_params": last.n_params if last else None,
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,13 +9,26 @@ from typing import Iterator, Optional, Tuple
 import jax
 import numpy as np
 
-#: (input_shape channels-last, n_classes) of the reference's datasets.
+#: (input_shape channels-last, n_classes) of the reference's datasets, plus
+#: the BASELINE.json image targets.
 DATASET_SHAPES = {
     "mnist": ((28, 28, 1), 10),
     "fashion_mnist": ((28, 28, 1), 10),
     "cifar10": ((32, 32, 3), 10),
     "mnist_flat": ((784,), 10),
     "cifar10_flat": ((3072,), 10),
+    "imagenet": ((224, 224, 3), 1000),
+    "imagenet64": ((64, 64, 3), 1000),
+    "tiny_images16": ((16, 16, 3), 10),
+}
+
+#: (seq_len, vocab_size, n_classes) — token datasets; ``n_classes=None``
+#: marks language-modeling data (targets = inputs, next-token loss).
+TOKEN_DATASET_SHAPES = {
+    "glue_sst2": (128, 30522, 2),
+    "glue_tiny": (16, 128, 2),
+    "lm_corpus": (2048, 128256, None),
+    "lm_tiny": (16, 256, None),
 }
 
 
@@ -81,23 +94,88 @@ def synthetic_dataset(
     return Dataset(x.astype(np.float32), y.astype(np.int32), name)
 
 
-def load_dataset(
-    name: str, split: str = "train", n: Optional[int] = None, seed: int = 0
-) -> Dataset:
-    """Load ``name`` (see DATASET_SHAPES) from disk if available, else
-    synthesize with the right shapes.  ``n`` limits the example count."""
-    if name == "synthetic":
-        name = "mnist_flat"
-    if name not in DATASET_SHAPES:
-        raise KeyError(f"unknown dataset {name!r}; known: {list(DATASET_SHAPES)}")
-    shape, n_classes = DATASET_SHAPES[name]
+def _load_from_disk(name: str, split: str, dtype) -> Optional[Dataset]:
+    """``$TORCHPRUNER_TPU_DATA_DIR/{name}_{split}_{x,y}.npy`` if present
+    (real data drops in for any dataset name, image or token)."""
     data_dir = os.environ.get("TORCHPRUNER_TPU_DATA_DIR", "")
     fx = os.path.join(data_dir, f"{name}_{split}_x.npy")
     fy = os.path.join(data_dir, f"{name}_{split}_y.npy")
     if data_dir and os.path.exists(fx) and os.path.exists(fy):
         x, y = np.load(fx), np.load(fy)
-        ds = Dataset(x.astype(np.float32), y.astype(np.int32), name)
-    else:
+        return Dataset(x.astype(dtype), y.astype(np.int32), name)
+    return None
+
+
+def synthetic_token_dataset(
+    seq_len: int,
+    vocab_size: int,
+    n_classes: Optional[int],
+    n: int,
+    seed: int = 0,
+    name: str = "tokens",
+    center_seed: int = 1234,
+) -> Dataset:
+    """Deterministic synthetic token data.
+
+    Classification (``n_classes`` set): each class has a preferred token
+    subset (drawn from ``center_seed``); examples mix class tokens with
+    uniform noise, so attention models can actually learn the labels.
+    Language modeling (``n_classes=None``): first-order Markov sequences
+    with a fixed random transition structure; targets are the inputs
+    (next-token objective).
+    """
+    rng = np.random.default_rng(seed)
+    cg = np.random.default_rng(center_seed)
+    if n_classes is not None:
+        pref = cg.integers(0, vocab_size, size=(n_classes, max(4, seq_len // 4)))
+        y = rng.integers(0, n_classes, size=(n,))
+        x = rng.integers(0, vocab_size, size=(n, seq_len))
+        sig = rng.random((n, seq_len)) < 0.5  # half the positions carry signal
+        choice = rng.integers(0, pref.shape[1], size=(n, seq_len))
+        x = np.where(sig, pref[y[:, None], choice], x)
+        return Dataset(x.astype(np.int32), y.astype(np.int32), name)
+    # LM: sparse Markov chain — each token has a few likely successors
+    succ = cg.integers(0, vocab_size, size=(vocab_size, 4))
+    x = np.empty((n, seq_len), dtype=np.int64)
+    x[:, 0] = rng.integers(0, vocab_size, size=(n,))
+    for t in range(1, seq_len):
+        pick = succ[x[:, t - 1], rng.integers(0, 4, size=(n,))]
+        noise = rng.integers(0, vocab_size, size=(n,))
+        x[:, t] = np.where(rng.random(n) < 0.8, pick, noise)
+    x = x.astype(np.int32)
+    return Dataset(x, x, name)
+
+
+def load_dataset(
+    name: str, split: str = "train", n: Optional[int] = None, seed: int = 0
+) -> Dataset:
+    """Load ``name`` (see DATASET_SHAPES / TOKEN_DATASET_SHAPES) from disk
+    if available, else synthesize with the right shapes.  ``n`` limits the
+    example count."""
+    if name == "synthetic":
+        name = "mnist_flat"
+    if name in TOKEN_DATASET_SHAPES:
+        ds = _load_from_disk(name, split, dtype=np.int32)
+        if ds is None:
+            seq_len, vocab, n_classes = TOKEN_DATASET_SHAPES[name]
+            defaults = {"train": 10000, "val": 1000, "test": 2000}
+            count = n or defaults.get(split, 1000)
+            split_seed = {"train": 1, "val": 2, "test": 3}.get(split, 9)
+            ds = synthetic_token_dataset(
+                seq_len, vocab, n_classes, count, seed=seed * 10 + split_seed,
+                name=f"{name}:{split}:synthetic",
+            )
+        if n is not None and len(ds) > n:
+            ds = ds.subset(n, seed=seed)
+        return ds
+    if name not in DATASET_SHAPES:
+        raise KeyError(
+            f"unknown dataset {name!r}; known: "
+            f"{list(DATASET_SHAPES) + list(TOKEN_DATASET_SHAPES)}"
+        )
+    shape, n_classes = DATASET_SHAPES[name]
+    ds = _load_from_disk(name, split, dtype=np.float32)
+    if ds is None:
         defaults = {"train": 50000, "val": 1000, "test": 10000}
         count = n or defaults.get(split, 1000)
         # different splits draw from the same class centers (same seed for

@@ -1,0 +1,122 @@
+"""Named experiment presets — the five configs of BASELINE.json.
+
+Each preset returns an :class:`~torchpruner_tpu.utils.config.ExperimentConfig`
+ready for :func:`~torchpruner_tpu.experiments.prune_retrain.run_prune_retrain`
+(or the robustness sweep for the VGG16 recipe).  ``smoke=True`` swaps in the
+miniature model/dataset variants with the identical block structure, so every
+preset's full code path runs on one CPU in seconds — the scaled configs are
+the same recipe at size.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from torchpruner_tpu.utils.config import ExperimentConfig
+
+
+def vgg16_layerwise(smoke: bool = False) -> ExperimentConfig:
+    """Config 1 — the reference's own recipe: CIFAR-10 VGG16 layerwise
+    pruning (VGG notebook; SURVEY.md §2.8)."""
+    return ExperimentConfig(
+        name="vgg16_layerwise",
+        model="vgg16_bn_tiny" if smoke else "vgg16_bn",
+        dataset="cifar10",
+        experiment="robustness",
+        method="shapley" if smoke else "all",
+        method_kwargs={"sv_samples": 5},
+        score_examples=64 if smoke else 1000,
+        eval_batch_size=64 if smoke else 250,
+    )
+
+
+def resnet50_taylor(smoke: bool = False) -> ExperimentConfig:
+    """Config 2: ResNet-50 / ImageNet structured filter pruning, Taylor
+    criterion."""
+    return ExperimentConfig(
+        name="resnet50_taylor",
+        model="resnet20_cifar" if smoke else "resnet50",
+        dataset="cifar10" if smoke else "imagenet",
+        n_classes=10 if smoke else 1000,
+        method="taylor",
+        policy="fraction",
+        fraction=0.25,
+        finetune_epochs=0 if smoke else 1,
+        score_examples=64 if smoke else 1000,
+        eval_batch_size=64 if smoke else 250,
+        lr=0.01,
+        momentum=0.9,
+    )
+
+
+def bert_glue_sensitivity(smoke: bool = False) -> ExperimentConfig:
+    """Config 3: BERT-base Linear-layer pruning on GLUE, Sensitivity
+    criterion — targets the per-block FFN hidden Linears."""
+    return ExperimentConfig(
+        name="bert_glue_sensitivity",
+        model="bert_tiny" if smoke else "bert_base",
+        dataset="glue_tiny" if smoke else "glue_sst2",
+        n_classes=2,
+        method="sensitivity",
+        policy="fraction",
+        fraction=0.3,
+        target_filter=("_mlp/",),
+        score_examples=64 if smoke else 1000,
+        batch_size=16 if smoke else 32,
+        eval_batch_size=64 if smoke else 128,
+        lr=3e-3,
+    )
+
+
+def vit_head_mlp_shapley(smoke: bool = False) -> ExperimentConfig:
+    """Config 4: ViT-B/16 attention-head + MLP pruning, Shapley
+    (sv_samples=5)."""
+    return ExperimentConfig(
+        name="vit_head_mlp_shapley",
+        model="vit_tiny" if smoke else "vit_b16",
+        dataset="tiny_images16" if smoke else "imagenet",
+        n_classes=10 if smoke else 1000,
+        method="shapley",
+        method_kwargs={"sv_samples": 5},
+        policy="negative",
+        target_filter=("_attn/", "_mlp/"),
+        score_examples=64 if smoke else 1000,
+        eval_batch_size=64 if smoke else 128,
+    )
+
+
+def llama3_ffn_taylor(smoke: bool = False) -> ExperimentConfig:
+    """Config 5: Llama-3-8B FFN channel pruning + fine-tune (pjit FSDP).
+    Attribution on LM loss; FFN GatedDense channels only; the full-size run
+    shards over a ``{"data": 8, "model": 8}`` mesh (v5p-64-shaped)."""
+    return ExperimentConfig(
+        name="llama3_ffn_taylor",
+        model="llama_tiny" if smoke else "llama3_8b",
+        dataset="lm_tiny" if smoke else "lm_corpus",
+        loss="lm_cross_entropy",
+        method="taylor",
+        policy="fraction",
+        fraction=0.25,
+        target_filter=("_ffn/",),
+        finetune_epochs=0 if smoke else 1,
+        score_examples=32 if smoke else 512,
+        batch_size=8 if smoke else 16,
+        eval_batch_size=16 if smoke else 32,
+        lr=1e-4,
+        mesh={} if smoke else {"data": 8, "model": 8},
+    )
+
+
+PRESETS: Dict[str, Callable[..., ExperimentConfig]] = {
+    "vgg16_layerwise": vgg16_layerwise,
+    "resnet50_taylor": resnet50_taylor,
+    "bert_glue_sensitivity": bert_glue_sensitivity,
+    "vit_head_mlp_shapley": vit_head_mlp_shapley,
+    "llama3_ffn_taylor": llama3_ffn_taylor,
+}
+
+
+def get_preset(name: str, smoke: bool = False) -> ExperimentConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown preset {name!r}; known: {list(PRESETS)}")
+    return PRESETS[name](smoke=smoke)

@@ -21,15 +21,34 @@ from torchpruner_tpu.attributions import (
     TaylorAttributionMetric,
     WeightNormAttributionMetric,
 )
+from torchpruner_tpu.core import layers as L
 from torchpruner_tpu.core.graph import pruning_graph
 from torchpruner_tpu.core.pruner import prune_by_scores
 from torchpruner_tpu.data import load_dataset
-from torchpruner_tpu.models import cifar10_fc, fmnist_convnet, mnist_fc, vgg16_bn
+from torchpruner_tpu.models import (
+    bert_base,
+    bert_tiny,
+    cifar10_fc,
+    fmnist_convnet,
+    llama3_8b,
+    llama_tiny,
+    mnist_fc,
+    resnet20_cifar,
+    resnet50,
+    vgg16_bn,
+    vit_b16,
+    vit_tiny,
+)
 from torchpruner_tpu.train.logger import CSVLogger
 from torchpruner_tpu.train.loop import Trainer, train_epoch
 from torchpruner_tpu.utils.config import ExperimentConfig
 from torchpruner_tpu.utils.flops import model_cost
-from torchpruner_tpu.utils.losses import cross_entropy_loss
+from torchpruner_tpu.utils.losses import (
+    cross_entropy_loss,
+    lm_cross_entropy_loss,
+    mse_loss,
+    nll_loss,
+)
 from torchpruner_tpu.utils.reductions import mean_plus_2std
 
 METRIC_REGISTRY = {
@@ -41,11 +60,32 @@ METRIC_REGISTRY = {
     "shapley": ShapleyAttributionMetric,
 }
 
+#: model name -> (constructor, default dataset).  Reference-parity models
+#: plus the BASELINE.json capability families and their tiny smoke variants.
 MODEL_REGISTRY = {
     "mnist_fc": (mnist_fc, "mnist_flat"),
     "cifar10_fc": (cifar10_fc, "cifar10_flat"),
     "fmnist_convnet": (fmnist_convnet, "fashion_mnist"),
     "vgg16_bn": (vgg16_bn, "cifar10"),
+    "vgg16_bn_tiny": (
+        lambda: vgg16_bn(width_multiplier=0.125, classifier_width=64),
+        "cifar10",
+    ),
+    "resnet50": (resnet50, "imagenet"),
+    "resnet20_cifar": (resnet20_cifar, "cifar10"),
+    "vit_b16": (vit_b16, "imagenet"),
+    "vit_tiny": (vit_tiny, "tiny_images16"),
+    "bert_base": (bert_base, "glue_sst2"),
+    "bert_tiny": (bert_tiny, "glue_tiny"),
+    "llama3_8b": (llama3_8b, "lm_corpus"),
+    "llama_tiny": (llama_tiny, "lm_tiny"),
+}
+
+LOSS_REGISTRY = {
+    "cross_entropy": cross_entropy_loss,
+    "lm_cross_entropy": lm_cross_entropy_loss,
+    "nll": nll_loss,
+    "mse": mse_loss,
 }
 
 
@@ -58,6 +98,35 @@ def build_metric(name: str, model, params, data, loss_fn, *, state=None,
     cls = METRIC_REGISTRY[name]
     return cls(model, params, data, loss_fn, state=state,
                reduction=reduction, seed=seed, **kwargs)
+
+
+def resolve_model_and_data(cfg: ExperimentConfig, model=None, datasets=None):
+    """Shared experiment setup: registry lookups with injection overrides.
+    Returns ``(model, (train, val, test))``."""
+    if model is None:
+        model_fn, default_ds = MODEL_REGISTRY[cfg.model]
+        model = model_fn()
+        ds_name = cfg.dataset if cfg.dataset != "synthetic" else default_ds
+    else:
+        if datasets is None and cfg.dataset == "synthetic":
+            raise ValueError(
+                "injecting a model requires an explicit cfg.dataset (or "
+                "injected datasets) — 'synthetic' has no shape to infer"
+            )
+        ds_name = cfg.dataset
+    if datasets is None:
+        train = load_dataset(ds_name, "train", seed=cfg.seed)
+        val = load_dataset(ds_name, "val", n=cfg.score_examples, seed=cfg.seed)
+        test = load_dataset(ds_name, "test", seed=cfg.seed)
+        datasets = (train, val, test)
+    return model, datasets
+
+
+def filter_targets(targets, cfg: ExperimentConfig):
+    """Apply ``cfg.target_filter`` (substring match; empty = keep all)."""
+    if not cfg.target_filter:
+        return list(targets)
+    return [t for t in targets if any(s in t for s in cfg.target_filter)]
 
 
 def make_optimizer(cfg: ExperimentConfig):
@@ -92,28 +161,18 @@ def run_prune_retrain(
     ``model`` / ``datasets=(train, val, test)`` may be injected (tests,
     custom zoos); defaults come from the registries.
     """
-    if model is None:
-        model_fn, default_ds = MODEL_REGISTRY[cfg.model]
-        model = model_fn()
-    else:
-        default_ds = cfg.dataset
-    if datasets is None:
-        ds_name = cfg.dataset if cfg.dataset != "synthetic" else default_ds
-        train = load_dataset(ds_name, "train", seed=cfg.seed)
-        val = load_dataset(ds_name, "val", n=cfg.score_examples, seed=cfg.seed)
-        test = load_dataset(ds_name, "test", seed=cfg.seed)
-    else:
-        train, val, test = datasets
+    model, (train, val, test) = resolve_model_and_data(cfg, model, datasets)
 
     tx = make_optimizer(cfg)
-    trainer = Trainer.create(model, tx, cross_entropy_loss, seed=cfg.seed)
+    loss_fn = LOSS_REGISTRY[cfg.loss]
+    trainer = Trainer.create(model, tx, loss_fn, seed=cfg.seed)
     logger = CSVLogger(cfg.log_path, experiment=cfg.name)
     history: List[PruneStepRecord] = []
 
     groups = list(pruning_graph(trainer.model))
     if cfg.prune_order == "reverse":
         groups = groups[::-1]  # outermost layer first (reference recipe)
-    targets = [g.target for g in groups]
+    targets = filter_targets([g.target for g in groups], cfg)
 
     val_batches = val.batches(cfg.eval_batch_size)
     test_batches = test.batches(cfg.eval_batch_size)
@@ -121,7 +180,7 @@ def run_prune_retrain(
     for target in targets:
         metric = build_metric(
             cfg.method, trainer.model, trainer.params, val_batches,
-            cross_entropy_loss, state=trainer.state,
+            loss_fn, state=trainer.state,
             reduction=cfg.reduction, seed=cfg.seed, **cfg.method_kwargs,
         )
         t0 = time.perf_counter()
@@ -135,9 +194,9 @@ def run_prune_retrain(
             state=trainer.state, opt_state=trainer.opt_state,
         )
         prune_time = time.perf_counter() - t0
-        n_dropped = trainer.model.layer(target).features - res.model.layer(
-            target
-        ).features
+        n_dropped = L.n_units(trainer.model.layer(target)) - L.n_units(
+            res.model.layer(target)
+        )
         trainer = trainer.rebuild(res.model, res.params, res.state, res.opt_state)
 
         for epoch in range(cfg.finetune_epochs):

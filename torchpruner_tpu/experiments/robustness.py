@@ -29,6 +29,8 @@ def _ablation_fn(model: SegmentedModel, eval_layer: str, loss_fn):
     """jit: (params, state, x, y, ranking) -> (loss_sums, correct_counts),
     both (n_units,): test metrics after each cumulative unit removal."""
 
+    from torchpruner_tpu.utils.losses import prediction_counts
+
     @jax.jit
     def fn(params, state, x, y, ranking):
         z, _ = model.apply(params, x, state=state, train=False,
@@ -40,7 +42,7 @@ def _ablation_fn(model: SegmentedModel, eval_layer: str, loss_fn):
             logits, _ = model.apply(params, z * mask, state=state,
                                     train=False, from_layer=eval_layer)
             losses = loss_fn(logits, y)
-            correct = jnp.sum(jnp.argmax(logits, axis=-1) == y)
+            correct, _ = prediction_counts(logits, y)
             return mask, (jnp.sum(losses), correct)
 
         _, (loss_sums, corrects) = jax.lax.scan(
@@ -48,9 +50,9 @@ def _ablation_fn(model: SegmentedModel, eval_layer: str, loss_fn):
         )
         base_logits, _ = model.apply(params, z, state=state, train=False,
                                      from_layer=eval_layer)
-        base = (jnp.sum(loss_fn(base_logits, y)),
-                jnp.sum(jnp.argmax(base_logits, axis=-1) == y))
-        return loss_sums, corrects, base[0], base[1]
+        base_correct, n_pred = prediction_counts(base_logits, y)
+        base = (jnp.sum(loss_fn(base_logits, y)), base_correct)
+        return loss_sums, corrects, base[0], base[1], n_pred
 
     return fn
 
@@ -78,18 +80,20 @@ def ablation_curve(
     tot_l = tot_c = None
     base_l = base_c = 0.0
     n_examples = 0
+    n_preds = 0
     for x, y in (data() if callable(data) else data):
-        l, c, bl, bc = fn(params, state, x, y, ranking)
+        l, c, bl, bc, n_pred = fn(params, state, x, y, ranking)
         tot_l = l if tot_l is None else tot_l + l
         tot_c = c if tot_c is None else tot_c + c
         base_l += float(bl)
         base_c += float(bc)
         n_examples += x.shape[0]
+        n_preds += int(n_pred)
     return {
         "loss": np.asarray(tot_l) / n_examples,
-        "acc": np.asarray(tot_c) / n_examples,
+        "acc": np.asarray(tot_c) / n_preds,
         "base_loss": base_l / n_examples,
-        "base_acc": base_c / n_examples,
+        "base_acc": base_c / n_preds,
     }
 
 
@@ -183,3 +187,65 @@ def auc_summary(results) -> Dict[str, float]:
         for method, runs in layer.items():
             per_method.setdefault(method, []).extend(r["auc"] for r in runs)
     return {m: float(np.mean(v)) for m, v in per_method.items()}
+
+
+def run_robustness_config(cfg, *, model=None, datasets=None,
+                          verbose: bool = True) -> Dict[str, float]:
+    """Config-driven sweep entry (the CLI's robustness path).
+
+    ``cfg.method == "all"`` runs the reference's full method panel
+    (6 metrics + signed Taylor + SV mean+2std — VGG notebook cell 8);
+    otherwise just the configured method.  Returns the AUC summary.
+    """
+    from torchpruner_tpu.core.segment import init_model
+    from torchpruner_tpu.experiments.prune_retrain import (
+        LOSS_REGISTRY,
+        build_metric,
+        filter_targets,
+        resolve_model_and_data,
+    )
+
+    model, (_, _, test) = resolve_model_and_data(cfg, model, datasets)
+    if len(test) > cfg.score_examples:
+        test = test.subset(cfg.score_examples, seed=cfg.seed)
+    params, state = init_model(model, seed=cfg.seed)
+    loss_fn = LOSS_REGISTRY[cfg.loss]
+    test_batches = test.batches(cfg.eval_batch_size)
+
+    def factory(method, reduction="mean", **kw):
+        def make():
+            return build_metric(
+                method, model, params, test_batches, loss_fn, state=state,
+                reduction=reduction, seed=cfg.seed, **kw,
+            )
+        return make
+
+    if cfg.method == "all":
+        methods = {
+            "random": factory("random"),
+            "weight_norm": factory("weight_norm"),
+            "apoz": factory("apoz"),
+            "sensitivity": factory("sensitivity"),
+            "taylor": factory("taylor"),
+            "taylor_signed": factory("taylor", signed=True),
+            "sv": factory("shapley", **cfg.method_kwargs),
+            "sv_mean+2std": factory(
+                "shapley", reduction="mean+2std", **cfg.method_kwargs
+            ),
+        }
+    else:
+        methods = {
+            cfg.method: factory(
+                cfg.method, reduction=cfg.reduction, **cfg.method_kwargs
+            )
+        }
+    layers = filter_targets(
+        [g.target for g in pruning_graph(model)], cfg
+    )
+    results = layerwise_robustness(
+        model, params, state, test_batches, methods, loss_fn,
+        layers=layers,
+        find_best_evaluation_layer_=cfg.find_best_evaluation_layer,
+        verbose=verbose,
+    )
+    return auc_summary(results)

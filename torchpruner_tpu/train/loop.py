@@ -47,29 +47,34 @@ def make_train_step(model: SegmentedModel, tx, loss_fn, donate: bool = True):
 
 
 def make_eval_step(model: SegmentedModel, loss_fn):
-    """(params, state, x, y) -> (sum per-example loss, #correct, n)."""
+    """(params, state, x, y) ->
+    (sum per-example loss, #correct, n examples, n predictions)."""
+    from torchpruner_tpu.utils.losses import prediction_counts
 
     def step(params, state, x, y):
         out, _ = model.apply(params, x, state=state, train=False)
         losses = loss_fn(out, y)
-        correct = jnp.sum(jnp.argmax(out, axis=-1) == y)
-        return jnp.sum(losses), correct, losses.shape[0]
+        correct, n_pred = prediction_counts(out, y)
+        return jnp.sum(losses), correct, losses.shape[0], n_pred
 
     return jax.jit(step)
 
 
 def evaluate(model, params, state, data, loss_fn):
-    """Average loss and accuracy over ``data`` (reference train.py:51-72)."""
+    """Average loss and accuracy over ``data`` (reference train.py:51-72).
+    Loss averages per example; accuracy per prediction (== per example for
+    classification, per next-token target for LMs)."""
     step = make_eval_step(model, loss_fn)
-    tot_l, tot_c, tot_n = 0.0, 0, 0
+    tot_l, tot_c, tot_n, tot_p = 0.0, 0, 0, 0
     for x, y in (data() if callable(data) else data):
-        l, c, n = step(params, state, x, y)
+        l, c, n, n_pred = step(params, state, x, y)
         tot_l += float(l)
         tot_c += int(c)
         tot_n += int(n)
+        tot_p += int(n_pred)
     if tot_n == 0:
         raise ValueError("evaluate() got an empty dataset")
-    return tot_l / tot_n, tot_c / tot_n
+    return tot_l / tot_n, tot_c / tot_p
 
 
 def train_epoch(trainer, data, epoch: int = 0, log_every: int = 20,

@@ -18,6 +18,11 @@ class ExperimentConfig:
     model: str = "mnist_fc"          # model-zoo entry point name
     dataset: str = "synthetic"       # data module entry
     n_classes: int = 10
+    loss: str = "cross_entropy"      # cross_entropy|lm_cross_entropy|nll|mse
+    experiment: str = "prune_retrain"  # prune_retrain|robustness
+    #: restrict pruning to targets containing any of these substrings
+    #: (e.g. ["_ffn/", "_mlp/"] for FFN-channel-only pruning); empty = all
+    target_filter: Tuple[str, ...] = ()
 
     # attribution
     method: str = "shapley"          # random|weight_norm|apoz|sensitivity|taylor|shapley
@@ -45,6 +50,13 @@ class ExperimentConfig:
     seed: int = 0
     log_path: str = "logs/experiment.csv"
 
+    def __post_init__(self):
+        if self.experiment not in ("prune_retrain", "robustness"):
+            raise ValueError(
+                f"unknown experiment {self.experiment!r} "
+                "(use 'prune_retrain' or 'robustness')"
+            )
+
     def to_json(self, path: str):
         with open(path, "w") as f:
             json.dump(dataclasses.asdict(self), f, indent=2)
@@ -57,4 +69,6 @@ class ExperimentConfig:
         unknown = set(raw) - known
         if unknown:
             raise ValueError(f"unknown config keys: {sorted(unknown)}")
+        if "target_filter" in raw:  # JSON has no tuples
+            raw["target_filter"] = tuple(raw["target_filter"])
         return cls(**raw)

@@ -48,3 +48,20 @@ def lm_cross_entropy_loss(logits, tokens):
 def accuracy(logits, labels):
     """Fraction of argmax-correct predictions (scalar)."""
     return jnp.mean(jnp.argmax(logits, axis=-1) == labels)
+
+
+def prediction_counts(out, y):
+    """``(n_correct, n_predictions)`` for accuracy accounting, shared by all
+    eval paths.
+
+    Classification (``out`` (B, C), ``y`` (B,)): argmax over classes, B
+    predictions.  Language modeling (``out`` (B, S, V), ``y`` (B, S) int):
+    next-token aligned — position t predicts token t+1, B*(S-1)
+    predictions — matching :func:`lm_cross_entropy_loss`.
+    ``n_predictions`` is a static Python int.
+    """
+    if out.ndim == y.ndim + 1 and y.ndim >= 2:
+        pred = jnp.argmax(out[:, :-1], axis=-1)
+        tgt = y[:, 1:]
+        return jnp.sum(pred == tgt), pred.size
+    return jnp.sum(jnp.argmax(out, axis=-1) == y), y.shape[0]
