@@ -1,0 +1,76 @@
+// Native data-pipeline kernels for torchpruner_tpu.
+//
+// The reference gets host-side batching from torch's C++ DataLoader
+// machinery (num_workers, pinned memory); this library is the TPU build's
+// native equivalent for the host path: deterministic index shuffling and
+// multithreaded batch gather into contiguous buffers that jax.device_put
+// can DMA without an extra copy.  Python calls in through ctypes (the GIL
+// is released for the duration of each call, so a Python-side prefetch
+// thread genuinely overlaps gather with device compute).
+//
+// Determinism contract: tp_shuffle_indices is splitmix64-seeded
+// Fisher-Yates — the pure-Python fallback in data/native.py implements the
+// identical sequence, so pipelines are reproducible whether or not the
+// native library is present.
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// splitmix64 (Steele et al.) — tiny, high-quality, trivially portable.
+static inline uint64_t splitmix64(uint64_t* s) {
+  uint64_t z = (*s += 0x9E3779B97F4A7C15ULL);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+// Fill idx[0..n) with a seeded Fisher-Yates permutation of 0..n-1.
+void tp_shuffle_indices(int64_t* idx, int64_t n, uint64_t seed) {
+  for (int64_t i = 0; i < n; ++i) idx[i] = i;
+  uint64_t s = seed;
+  for (int64_t i = n - 1; i > 0; --i) {
+    // unbiased bounded draw (rejection sampling)
+    uint64_t bound = static_cast<uint64_t>(i) + 1;
+    uint64_t threshold = (~bound + 1) % bound;  // 2^64 mod bound
+    uint64_t r;
+    do {
+      r = splitmix64(&s);
+    } while (r < threshold);
+    uint64_t j = r % bound;
+    int64_t t = idx[i];
+    idx[i] = idx[j];
+    idx[j] = t;
+  }
+}
+
+// Gather rows: out[b] = src[idx[b]] for b in [0, batch).  row_bytes is the
+// byte size of one example; parallelized over a small thread pool for the
+// large rows image batches produce.
+void tp_gather_rows(const uint8_t* src, const int64_t* idx, int64_t batch,
+                    int64_t row_bytes, uint8_t* out, int32_t n_threads) {
+  if (n_threads <= 1 || batch < 4 * n_threads) {
+    for (int64_t b = 0; b < batch; ++b)
+      std::memcpy(out + b * row_bytes, src + idx[b] * row_bytes, row_bytes);
+    return;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(n_threads);
+  int64_t chunk = (batch + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < batch ? lo + chunk : batch;
+    if (lo >= hi) break;
+    pool.emplace_back([=] {
+      for (int64_t b = lo; b < hi; ++b)
+        std::memcpy(out + b * row_bytes, src + idx[b] * row_bytes,
+                    row_bytes);
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+}  // extern "C"

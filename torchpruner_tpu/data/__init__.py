@@ -11,6 +11,24 @@ are found on disk (``TORCHPRUNER_TPU_DATA_DIR`` pointing at ``{name}_{split}
 _x.npy`` / ``_y.npy`` files) — the loader interface is identical either way.
 """
 
-from torchpruner_tpu.data.datasets import Dataset, load_dataset, synthetic_dataset
+from torchpruner_tpu.data.datasets import (
+    Dataset,
+    load_dataset,
+    synthetic_dataset,
+    synthetic_token_dataset,
+)
+from torchpruner_tpu.data.native import (
+    native_available,
+    prefetch_batches,
+    shuffled_indices,
+)
 
-__all__ = ["Dataset", "load_dataset", "synthetic_dataset"]
+__all__ = [
+    "Dataset",
+    "load_dataset",
+    "synthetic_dataset",
+    "synthetic_token_dataset",
+    "native_available",
+    "prefetch_batches",
+    "shuffled_indices",
+]

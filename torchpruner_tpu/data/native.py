@@ -1,0 +1,156 @@
+"""ctypes bindings for the native data-pipeline library (cpp/data_pipeline.cc),
+with a bit-identical pure-Python fallback.
+
+The native path exists for the host side of big-input pipelines (ImageNet-
+sized batches): C++ releases the GIL during shuffle/gather, so the
+:func:`prefetch_batches` background thread overlaps host batch assembly with
+device compute — the role torch's multi-worker DataLoader plays for the
+reference.  Both paths produce identical batches (splitmix64 Fisher-Yates),
+so determinism does not depend on whether the library built.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import queue
+import subprocess
+import threading
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+_CPP_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "cpp")
+_LIB_PATH = os.path.join(_CPP_DIR, "build", "libtp_data.so")
+_lib = None
+_lib_tried = False
+
+
+def _load_library(build: bool = True):
+    """Load (building on first use) the native library; None if unavailable."""
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    _lib_tried = True
+    try:
+        if not os.path.exists(_LIB_PATH) and build:
+            subprocess.run(
+                ["make", "-C", os.path.abspath(_CPP_DIR)],
+                check=True, capture_output=True, timeout=120,
+            )
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.tp_shuffle_indices.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_uint64,
+        ]
+        lib.tp_gather_rows.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int32,
+        ]
+        _lib = lib
+    except (OSError, subprocess.SubprocessError):
+        _lib = None
+    return _lib
+
+
+def native_available() -> bool:
+    return _load_library() is not None
+
+
+# -- splitmix64 Fisher-Yates: the shared determinism contract ---------------
+
+_M = (1 << 64) - 1
+
+
+def _splitmix64(s: int) -> Tuple[int, int]:
+    s = (s + 0x9E3779B97F4A7C15) & _M
+    z = s
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _M
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _M
+    return s, z ^ (z >> 31)
+
+
+def _py_shuffle(n: int, seed: int) -> np.ndarray:
+    idx = np.arange(n, dtype=np.int64)
+    s = seed & _M
+    for i in range(n - 1, 0, -1):
+        bound = i + 1
+        threshold = ((1 << 64) - bound) % bound  # 2^64 mod bound
+        while True:
+            s, r = _splitmix64(s)
+            if r >= threshold:
+                break
+        j = r % bound
+        idx[i], idx[j] = idx[j], idx[i]
+    return idx
+
+
+def shuffled_indices(n: int, seed: int) -> np.ndarray:
+    """Seeded permutation of ``0..n-1`` — native when available, identical
+    Python sequence otherwise."""
+    lib = _load_library()
+    if lib is None:
+        return _py_shuffle(n, seed)
+    idx = np.empty(n, dtype=np.int64)
+    lib.tp_shuffle_indices(
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int64(n), ctypes.c_uint64(seed & _M),
+    )
+    return idx
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray,
+                n_threads: int = 4) -> np.ndarray:
+    """``src[idx]`` into a fresh contiguous buffer; multithreaded memcpy in
+    C++ (GIL released) when available."""
+    lib = _load_library()
+    src = np.ascontiguousarray(src)
+    if lib is None:
+        return src[idx]
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    out = np.empty((len(idx),) + src.shape[1:], dtype=src.dtype)
+    row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+    lib.tp_gather_rows(
+        ctypes.c_void_p(src.ctypes.data),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int64(len(idx)), ctypes.c_int64(row_bytes),
+        ctypes.c_void_p(out.ctypes.data), ctypes.c_int32(n_threads),
+    )
+    return out
+
+
+def prefetch_batches(
+    dataset,
+    batch_size: int,
+    *,
+    shuffle: bool = False,
+    seed: int = 0,
+    drop_remainder: bool = False,
+    prefetch: int = 2,
+    n_threads: int = 4,
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Batches of ``dataset`` assembled in a background thread, ``prefetch``
+    deep — host gather overlaps device compute.  Same batch contents as
+    ``Dataset.iter_batches`` with native shuffling."""
+    n = len(dataset)
+    idx = shuffled_indices(n, seed) if shuffle else np.arange(n, dtype=np.int64)
+    stop = n - (n % batch_size) if drop_remainder else n
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, prefetch))
+    _SENTINEL = object()
+
+    def worker():
+        try:
+            for i in range(0, stop, batch_size):
+                j = idx[i : i + batch_size]
+                q.put((gather_rows(dataset.x, j, n_threads),
+                       gather_rows(dataset.y, j, n_threads)))
+        finally:
+            q.put(_SENTINEL)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _SENTINEL:
+            break
+        yield item
+    t.join()
