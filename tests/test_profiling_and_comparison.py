@@ -1,0 +1,49 @@
+"""Profiling utilities + the max-model methods-comparison experiment
+(reference notebook 1 parity: every metric reproduces its analytic value
+through the public API)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from torchpruner_tpu.experiments.max_comparison import (
+    GROUND_TRUTH,
+    run_max_comparison,
+)
+from torchpruner_tpu.utils.profiling import StepTimer, time_fn
+
+
+def test_max_comparison_matches_analytic_values():
+    r = run_max_comparison(sv_samples=300, verbose=False)
+    for k in ("weight_norm", "apoz", "sensitivity", "taylor"):
+        np.testing.assert_allclose(r[k], GROUND_TRUTH[k], atol=1e-5)
+    np.testing.assert_allclose(r["shapley"], GROUND_TRUTH["shapley"], atol=0.2)
+
+
+def test_max_comparison_version2_nonzero_gradients():
+    r = run_max_comparison(version=2, sv_samples=50, verbose=False)
+    # unit D's negative outgoing weight makes gradient metrics nonzero
+    # (reference test_attributions.py:139-162)
+    assert np.all(r["sensitivity"] > 0)
+    assert np.all(r["taylor"] > 0)
+
+
+def test_time_fn_reports_steady_state():
+    import jax
+
+    f = jax.jit(lambda x: x * 2 + 1)
+    stats = time_fn(f, jnp.ones((64, 64)), iters=3, warmup=1)
+    assert 0 < stats["min_s"] <= stats["mean_s"]
+    assert stats["compile_s"] > 0
+
+
+def test_step_timer_phases():
+    t = StepTimer()
+    with t.phase("score"):
+        pass
+    with t.phase("score"):
+        pass
+    with t.phase("prune"):
+        pass
+    s = t.summary()
+    assert s["score"]["calls"] == 2 and s["prune"]["calls"] == 1
+    assert s["score"]["total_s"] >= 0

@@ -1,0 +1,83 @@
+"""Attribution-methods comparison on the analytic max model — the
+reference's first notebook ("Attributions comparison (Max model).ipynb",
+SURVEY.md §2.8): compute every metric side by side on the 2→4→1 net whose
+ground-truth unit relevances are derivable by hand, and report them next to
+the analytic values.
+
+The reference notebook re-implements each method in raw torch with a
+20k-permutation Shapley loop; here the same table falls out of the library's
+own metrics (which is the point: the library reproduces the paper's Fig. 1
+numbers through its public API).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from torchpruner_tpu.attributions import (
+    APoZAttributionMetric,
+    SensitivityAttributionMetric,
+    ShapleyAttributionMetric,
+    TaylorAttributionMetric,
+    WeightNormAttributionMetric,
+)
+from torchpruner_tpu.models.analytic import max_model, max_model_batches
+from torchpruner_tpu.utils.losses import mse_loss
+
+#: analytic ground truths (reference tests/test_attributions.py:93-137 and
+#: models/analytic.py docstring), version-1 weights
+GROUND_TRUTH = {
+    "weight_norm": [1.0, 2.0, 2.0, 2.0],
+    "apoz": [0.5, 0.5, 1.0, 1.0],
+    "sensitivity": [0.0, 0.0, 0.0, 0.0],
+    "taylor": [0.0, 0.0, 0.0, 0.0],
+    "shapley": [0.37, 0.37, 1.7, 0.0],
+}
+
+
+def run_max_comparison(
+    version: int = 1, sv_samples: int = 1000, seed: int = 0,
+    verbose: bool = True,
+) -> Dict[str, np.ndarray]:
+    """Score units A-D of the max model with every metric.
+
+    Returns ``{method: (4,) scores}``; with ``version=1`` the values match
+    :data:`GROUND_TRUTH` (Shapley statistically, at ``sv_samples=1000`` to
+    ~1 decimal — the reference's own test tolerance,
+    test_attributions.py:128-137).
+    """
+    model, params, _, _ = max_model(version)
+    data = max_model_batches()
+    common = dict(state=None, reduction="mean", seed=seed)
+    metrics = {
+        "weight_norm": WeightNormAttributionMetric(
+            model, params, data, mse_loss, **common),
+        "apoz": APoZAttributionMetric(model, params, data, mse_loss, **common),
+        "sensitivity": SensitivityAttributionMetric(
+            model, params, data, mse_loss, **common),
+        "taylor": TaylorAttributionMetric(
+            model, params, data, mse_loss, **common),
+        "shapley": ShapleyAttributionMetric(
+            model, params, data, mse_loss, sv_samples=sv_samples, **common),
+    }
+    results = {}
+    for name, metric in metrics.items():
+        results[name] = np.asarray(
+            metric.run("fc1", find_best_evaluation_layer=True)
+        )
+    if verbose:
+        units = ["A", "B", "C", "D"]
+        print(f"{'method':14s} " + " ".join(f"{u:>7s}" for u in units)
+              + ("   (analytic)" if version == 1 else ""))
+        for name, vals in results.items():
+            row = f"{name:14s} " + " ".join(f"{v:7.3f}" for v in vals)
+            if version == 1 and name in GROUND_TRUTH:
+                row += "   " + str(GROUND_TRUTH[name])
+            print(row)
+    return results
+
+
+if __name__ == "__main__":
+    run_max_comparison()

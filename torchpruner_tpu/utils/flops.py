@@ -28,7 +28,7 @@ def model_cost(
     (the reference uses batch 2 because of BatchNorm, utils.py:33-34; here
     eval-mode BN has no batch constraint but we keep the convention)."""
     state = state if state is not None else {}
-    x = jnp.zeros((batch_size,) + tuple(model.input_shape))
+    x = model.example_input(batch_size)
 
     def fwd(p, s, x):
         return model.apply(p, x, state=s, train=False)[0]

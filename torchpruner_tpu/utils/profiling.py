@@ -1,0 +1,96 @@
+"""Tracing and timing utilities.
+
+Replaces the reference's ``timeit.default_timer`` spot checks and ``%%time``
+cells (reference experiments/utils/train.py:16, SURVEY.md §5.1) with the
+TPU-native equivalents: ``jax.profiler`` traces viewable in
+XProf/TensorBoard, and steady-state wall-clock timing that respects async
+dispatch (``block_until_ready`` fencing — naive timing measures only the
+Python dispatch of a TPU computation, not its execution).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "/tmp/torchpruner_tpu_trace"):
+    """Capture a profiler trace of the enclosed block::
+
+        with profiling.trace("logs/trace"):
+            trainer.step(x, y)
+
+    View with TensorBoard's profile plugin / XProf.
+    """
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def time_fn(
+    fn: Callable,
+    *args,
+    iters: int = 10,
+    warmup: int = 2,
+    **kwargs,
+) -> Dict[str, float]:
+    """Steady-state wall-clock of ``fn(*args, **kwargs)``.
+
+    Warms up (compile + cache), then times ``iters`` calls with a
+    ``block_until_ready`` fence on each result.  Returns
+    ``{"mean_s", "min_s", "p50_s", "compile_s"}``.
+    """
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(max(1, warmup)):
+        out = fn(*args, **kwargs)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+
+    times: List[float] = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return {
+        "mean_s": sum(times) / len(times),
+        "min_s": times[0],
+        "p50_s": times[len(times) // 2],
+        "compile_s": compile_s,
+    }
+
+
+@dataclass
+class StepTimer:
+    """Accumulates per-phase wall-clock inside experiment loops (score /
+    prune / recompile / finetune) — the breakdown the north-star metric
+    needs (SURVEY.md §7 'recompilation economics')."""
+
+    totals: Dict[str, float] = field(default_factory=dict)
+    counts: Dict[str, int] = field(default_factory=dict)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        return {
+            k: {"total_s": v, "calls": self.counts[k],
+                "mean_s": v / self.counts[k]}
+            for k, v in self.totals.items()
+        }
