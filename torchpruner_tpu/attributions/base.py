@@ -149,7 +149,7 @@ def needs_taps(model: SegmentedModel, eval_layer: str) -> bool:
     output)."""
     if len(L.parse_path(eval_layer)) > 1:
         return True
-    return isinstance(model.layer(eval_layer), L.MultiHeadAttention)
+    return isinstance(model.layer(eval_layer), (L.MultiHeadAttention, L.MoE))
 
 
 def param_at(params, layer: str):

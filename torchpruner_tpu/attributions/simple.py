@@ -57,4 +57,12 @@ class WeightNormAttributionMetric(AttributionMetric):
                 jnp.abs(p["wq"]).sum(axis=(0, 2))
                 + jnp.abs(p["wo"]).sum(axis=(1, 2))
             )
+        if isinstance(spec, L.MoE):
+            # per expert: all of its weight planes + its router column
+            return np.asarray(
+                jnp.abs(p["wg"]).sum(axis=(1, 2))
+                + jnp.abs(p["wu"]).sum(axis=(1, 2))
+                + jnp.abs(p["wo"]).sum(axis=(1, 2))
+                + jnp.abs(p["router"]).sum(axis=0)
+            )
         raise TypeError(f"no weights to score on {type(spec).__name__}")

@@ -25,8 +25,8 @@ _LAYER_TYPES = {
     cls.__name__: cls
     for cls in (L.Dense, L.Conv, L.BatchNorm, L.LayerNorm, L.RMSNorm,
                 L.Activation, L.Pool, L.GlobalPool, L.Flatten, L.Reshape,
-                L.Dropout, L.Embedding, L.PosEmbed, L.MultiHeadAttention,
-                L.GatedDense, L.Residual)
+                L.Dropout, L.Embedding, L.PosEmbed, L.ClsToken,
+                L.MultiHeadAttention, L.GatedDense, L.MoE, L.Residual)
 }
 
 

@@ -60,7 +60,7 @@ def find_best_evaluation_layer(model: SegmentedModel, name: str) -> str:
     for nested paths; attention/GLU targets are their own evaluation site."""
     path = L.parse_path(name)
     spec = model.layer(name)
-    if isinstance(spec, (L.MultiHeadAttention, L.GatedDense)):
+    if isinstance(spec, (L.MultiHeadAttention, L.GatedDense, L.MoE)):
         return name
     if len(path) == 1:
         siblings = model.layers
@@ -121,7 +121,12 @@ def _join(prefix: Tuple[str, ...], name: str) -> str:
 
 
 def _consumer_entries(spec: L.LayerSpec, path: str, fan_out: int):
-    """Consumer slices when ``spec``'s *input* width shrinks."""
+    """Consumer slices when ``spec``'s *input* width shrinks, or ``None``
+    when the consumer cannot safely absorb an input-width change — its
+    output width *follows* its input width (attention with
+    ``out_features=None``; MoE, whose output dim is ``wo``'s last axis) —
+    in which case the producer is width-pinned, exactly like a producer
+    feeding a residual sum."""
     if isinstance(spec, L.Dense):
         return [Consumer(path, "w", axis=0, fan_out=fan_out)]
     if isinstance(spec, L.Conv):
@@ -132,11 +137,15 @@ def _consumer_entries(spec: L.LayerSpec, path: str, fan_out: int):
             Consumer(path, "wu", axis=0, fan_out=fan_out),
         ]
     if isinstance(spec, L.MultiHeadAttention):
+        if spec.out_features is None:
+            return None  # output width tied to input width — pin
         return [
             Consumer(path, "wq", axis=0, fan_out=fan_out),
             Consumer(path, "wk", axis=0, fan_out=fan_out),
             Consumer(path, "wv", axis=0, fan_out=fan_out),
         ]
+    if isinstance(spec, L.MoE):
+        return None  # output width tied to input width — pin
     raise TypeError(f"{type(spec).__name__} cannot consume")
 
 
@@ -155,14 +164,16 @@ def _walk(
     for i, spec in enumerate(layers):
         path = _join(prefix, spec.name)
 
-        if isinstance(spec, L.MultiHeadAttention):
+        if isinstance(spec, (L.MultiHeadAttention, L.MoE)):
             if current is not None:
-                current["consumers"] += _consumer_entries(
-                    spec, path, current["fan_out"]
-                )
-                groups.append(_close(current))
-                current = None
-            # self-contained head group: output width unchanged by pruning
+                entries = _consumer_entries(spec, path, current["fan_out"])
+                if entries is None:
+                    current = None  # width pinned by the consumer's output
+                else:
+                    current["consumers"] += entries
+                    groups.append(_close(current))
+                    current = None
+            # self-contained head/expert group: output width unchanged
             groups.append(PruneGroup(target=path))
 
         elif isinstance(spec, _CHANNEL_PRODUCERS):
@@ -240,9 +251,12 @@ def _consume_into_residual(
             elif isinstance(spec, (L.Activation, L.Pool, L.GlobalPool)):
                 pass  # transparent
             elif isinstance(
-                spec, _CHANNEL_PRODUCERS + (L.MultiHeadAttention,)
+                spec, _CHANNEL_PRODUCERS + (L.MultiHeadAttention, L.MoE)
             ):
-                consumers += _consumer_entries(spec, path, group["fan_out"])
+                entries = _consumer_entries(spec, path, group["fan_out"])
+                if entries is None:
+                    return False  # consumer's output width follows input
+                consumers += entries
                 found = True
                 break
             else:

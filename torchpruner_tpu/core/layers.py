@@ -281,6 +281,39 @@ class GatedDense:
 
 
 @dataclass(frozen=True)
+class MoE:
+    """Mixture-of-experts SwiGLU FFN on ``(B, S, d)`` (Mixtral-style).
+
+    Router picks ``top_k`` of ``n_experts``; gates are the softmax over the
+    selected logits.  Compute is the *dense* formulation — every expert's
+    contribution weighted by its (mostly zero) gate — which is exactly what
+    makes it jittable, differentiable, and **expert-parallel by sharding**:
+    partition the expert axis of ``wg``/``wu``/``wo`` over a mesh axis and
+    each device computes only its experts' partial sums, XLA inserting the
+    reduction (capacity-based all-to-all dispatch is the later optimization
+    for large expert counts).
+
+    Prunable: the unit is the **expert** (``n_units = n_experts``); the unit
+    site is the gate tensor ``(B, S, E)``, so attribution metrics score
+    expert utility and pruning removes whole experts (router column +
+    expert weights)."""
+
+    name: str
+    n_experts: int
+    ffn_dim: int
+    top_k: int = 2
+    fn: str = "silu"
+
+    def __post_init__(self):
+        if self.fn not in ACTIVATION_FNS:
+            raise ValueError(f"unknown activation {self.fn!r}")
+        if not (1 <= self.top_k <= self.n_experts):
+            raise ValueError(
+                f"top_k {self.top_k} out of range [1, {self.n_experts}]"
+            )
+
+
+@dataclass(frozen=True)
 class Residual:
     """Residual block: ``y = body(x) + shortcut(x)`` (identity shortcut when
     ``shortcut`` is empty).  ``body``/``shortcut`` are nested sequential
@@ -307,9 +340,9 @@ class Residual:
 LayerSpec = Any  # union of the above dataclasses
 
 #: can be out-pruned. Dense/Conv match the reference (reference pruner.py:11);
-#: GatedDense and MultiHeadAttention (query heads) are the transformer-era
-#: additions the BASELINE.json configs require.
-PRUNABLE_TYPES = (Dense, Conv, GatedDense, MultiHeadAttention)
+#: GatedDense, MultiHeadAttention (query heads) and MoE (experts) are the
+#: transformer-era additions the BASELINE.json configs require.
+PRUNABLE_TYPES = (Dense, Conv, GatedDense, MultiHeadAttention, MoE)
 #: in-pruned alongside a producer (reference pruner.py:11 lists Dropout and
 #: BatchNorm; LayerNorm/RMSNorm are their transformer equivalents).
 ATTACHABLE_TYPES = (BatchNorm, Dropout, LayerNorm, RMSNorm)
@@ -380,6 +413,8 @@ def out_shape(spec: LayerSpec, in_shape: Tuple[int, ...]) -> Tuple[int, ...]:
         return tuple(in_shape[:-1]) + (d_out,)
     if isinstance(spec, GatedDense):
         return tuple(in_shape[:-1]) + (spec.features,)
+    if isinstance(spec, MoE):
+        return tuple(in_shape)
     if isinstance(spec, Residual):
         return seq_out_shape(spec.body, in_shape)
     return tuple(in_shape)
@@ -410,6 +445,8 @@ def unit_site_shape(spec: LayerSpec, in_shape: Tuple[int, ...]) -> Tuple[int, ..
     if isinstance(spec, MultiHeadAttention):
         S = in_shape[0]
         return (S, spec.head_dim, spec.num_heads)
+    if isinstance(spec, MoE):
+        return (in_shape[0], spec.n_experts)  # the gate tensor (S, E)
     return out_shape(spec, in_shape)
 
 
@@ -524,6 +561,18 @@ def init_layer(spec: LayerSpec, key, in_shape: Tuple[int, ...], dtype=jnp.float3
             params["bg"] = jnp.zeros((spec.features,), dtype)
             params["bu"] = jnp.zeros((spec.features,), dtype)
         return params, {}, out_shape(spec, in_shape)
+
+    if isinstance(spec, MoE):
+        d = in_shape[-1]
+        E, F = spec.n_experts, spec.ffn_dim
+        kr, kg, ku, ko = jax.random.split(key, 4)
+        params = {
+            "router": jax.random.normal(kr, (d, E), dtype) / jnp.sqrt(d),
+            "wg": _kaiming(kg, (E, d, F), d, dtype),
+            "wu": _kaiming(ku, (E, d, F), d, dtype),
+            "wo": jax.random.normal(ko, (E, F, d), dtype) / jnp.sqrt(F),
+        }
+        return params, {}, tuple(in_shape)
 
     if isinstance(spec, Residual):
         params: Dict[str, Any] = {}
@@ -655,9 +704,10 @@ def apply_seq(
         if (
             taps is not None
             and not taps.empty()
-            and not isinstance(spec, MultiHeadAttention)
+            and not isinstance(spec, (MultiHeadAttention, MoE))
         ):
-            x = taps.at_site(path, x)
+            x = taps.at_site(path, x)  # attention/MoE tap their own
+            # internal unit sites (head context / gates)
         if s2 is not s and s2:
             new_state[spec.name] = s2
     return x, new_state
@@ -856,6 +906,25 @@ def apply_layer(
             u = u + params["bu"]
         return ACTIVATION_FNS[spec.fn](g) * u, state
 
+    if isinstance(spec, MoE):
+        E = spec.n_experts
+        logits = x @ params["router"]  # (B, S, E)
+        if spec.top_k < E:
+            # keep the top-k logits per token; softmax over those only
+            kth = jnp.sort(logits, axis=-1)[..., E - spec.top_k]
+            neg = jnp.finfo(logits.dtype).min
+            logits = jnp.where(logits >= kth[..., None], logits, neg)
+        gates = jax.nn.softmax(logits, axis=-1)  # (B, S, E)
+        if taps is not None and not taps.empty():
+            gates = taps.at_site(path, gates)  # expert unit site
+        g = jnp.einsum("bsd,edf->bsef", x, params["wg"])
+        u = jnp.einsum("bsd,edf->bsef", x, params["wu"])
+        h = ACTIVATION_FNS[spec.fn](g) * u  # (B, S, E, F)
+        y = jnp.einsum(
+            "bsef,efd->bsd", h * gates[..., None], params["wo"]
+        )
+        return y, state
+
     if isinstance(spec, Residual):
         r_body = r_sc = None
         if rng is not None:
@@ -892,6 +961,8 @@ def n_units(spec: LayerSpec) -> int:
         return spec.features
     if isinstance(spec, MultiHeadAttention):
         return spec.num_heads
+    if isinstance(spec, MoE):
+        return spec.n_experts
     raise TypeError(f"{type(spec).__name__} has no prunable units")
 
 
@@ -899,6 +970,10 @@ def with_features(spec: LayerSpec, features: int) -> LayerSpec:
     """Return a copy of a prunable spec with a new unit count."""
     if isinstance(spec, (Dense, Conv, GatedDense)):
         return dataclasses.replace(spec, features=features)
+    if isinstance(spec, MoE):
+        return dataclasses.replace(
+            spec, n_experts=features, top_k=min(spec.top_k, features)
+        )
     if isinstance(spec, MultiHeadAttention):
         if spec.kv_group is not None:
             raise ValueError(

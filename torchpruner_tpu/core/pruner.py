@@ -91,6 +91,14 @@ def plan_for_group(model: SegmentedModel, group: PruneGroup) -> PrunePlan:
                 ParamSlice(tpath + ("bk",), axis=0, optional=True),
                 ParamSlice(tpath + ("bv",), axis=0, optional=True),
             ]
+    elif isinstance(target, L.MoE):
+        # expert pruning: router column + the expert's weight planes
+        slices += [
+            ParamSlice(tpath + ("router",), axis=1),
+            ParamSlice(tpath + ("wg",), axis=0),
+            ParamSlice(tpath + ("wu",), axis=0),
+            ParamSlice(tpath + ("wo",), axis=0),
+        ]
     else:
         raise TypeError(
             f"cannot out-prune {type(target).__name__} {group.target!r}"

@@ -12,12 +12,18 @@ from torchpruner_tpu.models.vgg import vgg16_bn
 from torchpruner_tpu.models.resnet import resnet18, resnet20_cifar, resnet50
 from torchpruner_tpu.models.vit import vit, vit_b16, vit_tiny
 from torchpruner_tpu.models.bert import bert, bert_base, bert_tiny
-from torchpruner_tpu.models.llama import llama, llama3_8b, llama_tiny
+from torchpruner_tpu.models.llama import (
+    llama,
+    llama3_8b,
+    llama_moe,
+    llama_moe_tiny,
+    llama_tiny,
+)
 
 __all__ = [
     "max_model", "mnist_fc", "cifar10_fc", "fmnist_convnet", "vgg16_bn",
     "resnet18", "resnet20_cifar", "resnet50",
     "vit", "vit_b16", "vit_tiny",
     "bert", "bert_base", "bert_tiny",
-    "llama", "llama3_8b", "llama_tiny",
+    "llama", "llama3_8b", "llama_moe", "llama_moe_tiny", "llama_tiny",
 ]

@@ -57,6 +57,68 @@ def llama(
     return SegmentedModel(tuple(layers), (seq_len,), input_dtype="int32")
 
 
+def llama_moe(
+    *,
+    vocab_size: int = 32000,
+    dim: int = 4096,
+    depth: int = 32,
+    num_heads: int = 32,
+    num_kv_heads: int = 8,
+    head_dim: int = 128,
+    ffn_dim: int = 14336,
+    n_experts: int = 8,
+    top_k: int = 2,
+    rope_theta: float = 1e6,
+    seq_len: int = 2048,
+) -> SegmentedModel:
+    """Mixtral-style sparse-MoE decoder: the dense FFN replaced by a
+    top-k-routed expert mixture.  The expert axis is the prunable unit
+    (attribution-driven *expert pruning*) and the expert-parallel sharding
+    axis (``partition="tp"``)."""
+    layers: list = [L.Embedding("tok_emb", vocab_size, dim)]
+    for i in range(1, depth + 1):
+        layers += [
+            L.Residual(f"block{i}_attn", (
+                L.RMSNorm("norm"),
+                L.MultiHeadAttention(
+                    "attn", num_heads=num_heads, head_dim=head_dim,
+                    num_kv_heads=num_kv_heads, out_features=dim,
+                    causal=True, rope=True, rope_theta=rope_theta,
+                ),
+            )),
+            L.Residual(f"block{i}_moe", (
+                L.RMSNorm("norm"),
+                L.MoE("experts", n_experts, ffn_dim, top_k=top_k),
+            )),
+        ]
+    layers += [
+        L.RMSNorm("final_norm"),
+        L.Dense("lm_head", vocab_size, use_bias=False),
+    ]
+    return SegmentedModel(tuple(layers), (seq_len,), input_dtype="int32")
+
+
+def llama_moe_tiny(
+    *,
+    vocab_size: int = 256,
+    dim: int = 32,
+    depth: int = 2,
+    num_heads: int = 4,
+    num_kv_heads: int = 2,
+    ffn_dim: int = 32,
+    n_experts: int = 4,
+    top_k: int = 2,
+    seq_len: int = 16,
+) -> SegmentedModel:
+    """Miniature MoE decoder — tests / CPU smoke / multi-chip dryruns."""
+    return llama_moe(
+        vocab_size=vocab_size, dim=dim, depth=depth, num_heads=num_heads,
+        num_kv_heads=num_kv_heads, head_dim=dim // num_heads,
+        ffn_dim=ffn_dim, n_experts=n_experts, top_k=top_k,
+        rope_theta=10000.0, seq_len=seq_len,
+    )
+
+
 def llama3_8b(seq_len: int = 2048) -> SegmentedModel:
     """Llama-3-8B: 32 blocks, dim 4096, 32 query / 8 KV heads, FFN 14336,
     vocab 128256 — the BASELINE.json FSDP fine-tune target.  ~8.0B params."""

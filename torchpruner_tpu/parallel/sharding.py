@@ -97,6 +97,16 @@ def _tp_target_specs(spec, size: int) -> Dict[str, P]:
                 "wv": P(None, "model", None), "bv": P("model", None),
             })
         return out
+    if isinstance(spec, L.MoE) and spec.n_experts % size == 0:
+        # expert parallelism: each device holds n_experts/size experts and
+        # computes their partial contributions; XLA reduces (the dense-
+        # formulation equivalent of all-to-all expert dispatch)
+        return {
+            "wg": P("model", None, None),
+            "wu": P("model", None, None),
+            "wo": P("model", None, None),
+            "router": P(None, "model"),
+        }
     return {}
 
 
@@ -117,6 +127,12 @@ def _tp_consumer_specs(spec, in_width: int, size: int) -> Dict[str, P]:
             "wq": P("model", None, None),
             "wk": P("model", None, None),
             "wv": P("model", None, None),
+        }
+    if isinstance(spec, L.MoE):
+        return {
+            "router": P("model", None),
+            "wg": P(None, "model", None),
+            "wu": P(None, "model", None),
         }
     return {}
 
