@@ -24,6 +24,7 @@ from torchpruner_tpu.parallel.sharding import (
 from torchpruner_tpu.parallel.scoring import DistributedScorer
 from torchpruner_tpu.parallel.train import ShardedTrainer
 from torchpruner_tpu.parallel.ring import ring_attention, ring_attention_local
+from torchpruner_tpu.parallel.pipeline import PipelineParallel, balance_stages
 
 __all__ = [
     "make_mesh",
@@ -39,4 +40,6 @@ __all__ = [
     "ShardedTrainer",
     "ring_attention",
     "ring_attention_local",
+    "PipelineParallel",
+    "balance_stages",
 ]

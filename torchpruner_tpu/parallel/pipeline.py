@@ -1,0 +1,336 @@
+"""Pipeline parallelism — GPipe-style microbatched stage execution.
+
+A :class:`SegmentedModel` is already a pipeline of pure segments, so stage
+partitioning is native: split the top-level layers into ``n_stages``
+contiguous spans (balanced by parameter count), pin each span's params to
+its own device, and stream microbatches through.  Each stage function is an
+independently-jitted computation whose placement follows its (committed)
+operands, and JAX's async dispatch overlaps the per-device work: while
+stage 1 runs microbatch k, stage 0 is already executing microbatch k+1 —
+the GPipe schedule emerges from the dependency graph without an explicit
+scheduler (Huang et al., 2019).
+
+Training chains per-stage ``jax.vjp``s: forward saves residuals on each
+stage's device, the backward walks stages in reverse (activation gradients
+hop device-to-device like activations did), and parameter gradients
+accumulate across microbatches — on-device, in the stage's own memory.
+
+This is the honest JAX formulation of pipeline parallelism for one process
+with several local devices (a TPU host's chips).  Cross-host pipelining
+composes with the mesh layers (DP/FSDP/TP shard *within* a stage via
+``ShardedTrainer``); a fused 1F1B schedule inside one XLA program is the
+later optimization.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+import optax
+
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.segment import SegmentedModel
+
+
+def _layer_param_count(spec, in_shape) -> int:
+    """Static per-layer parameter count (no arrays)."""
+    total = 0
+    if isinstance(spec, L.Residual):
+        shape = tuple(in_shape)
+        for child in spec.body:
+            total += _layer_param_count(child, shape)
+            shape = L.out_shape(child, shape)
+        shape = tuple(in_shape)
+        for child in spec.shortcut:
+            total += _layer_param_count(child, shape)
+            shape = L.out_shape(child, shape)
+        return total
+    d = in_shape[-1] if in_shape else 0
+    if isinstance(spec, L.Dense):
+        return d * spec.features + (spec.features if spec.use_bias else 0)
+    if isinstance(spec, L.Conv):
+        kh, kw = spec.kernel_size
+        return kh * kw * d * spec.features + (
+            spec.features if spec.use_bias else 0
+        )
+    if isinstance(spec, (L.BatchNorm,)):
+        return 2 * d
+    if isinstance(spec, L.LayerNorm):
+        return d * (2 if spec.use_bias else 1)
+    if isinstance(spec, L.RMSNorm):
+        return d
+    if isinstance(spec, L.Embedding):
+        return spec.vocab_size * spec.features
+    if isinstance(spec, L.PosEmbed):
+        return spec.max_len * d
+    if isinstance(spec, L.ClsToken):
+        return d
+    if isinstance(spec, L.MultiHeadAttention):
+        H, KV, Dh = spec.num_heads, spec.kv_heads, spec.head_dim
+        d_out = spec.out_features if spec.out_features is not None else d
+        n = d * H * Dh + 2 * d * KV * Dh + H * Dh * d_out
+        if spec.use_bias:
+            n += H * Dh + 2 * KV * Dh + d_out
+        return n
+    if isinstance(spec, L.GatedDense):
+        return 2 * d * spec.features + (
+            2 * spec.features if spec.use_bias else 0
+        )
+    if isinstance(spec, L.MoE):
+        E, F = spec.n_experts, spec.ffn_dim
+        return d * E + 3 * E * d * F
+    return 0
+
+
+def balance_stages(model: SegmentedModel, n_stages: int) -> List[Tuple[int, int]]:
+    """Split top-level layer indices into ``n_stages`` contiguous spans
+    ``[(start, stop), ...]`` with roughly equal parameter counts (greedy:
+    cut when the running count passes the ideal per-stage share)."""
+    if not (1 <= n_stages <= len(model.layers)):
+        raise ValueError(
+            f"n_stages {n_stages} out of range [1, {len(model.layers)}]"
+        )
+    counts = [
+        _layer_param_count(spec, shp[0])
+        for spec, shp in zip(model.layers, model.shapes)
+    ]
+    total = sum(counts)
+    spans: List[Tuple[int, int]] = []
+    start, acc = 0, 0
+    remaining = n_stages
+    for i, c in enumerate(counts):
+        acc += c
+        layers_left = len(counts) - i - 1
+        stages_after = remaining - 1
+        if (
+            remaining > 1
+            and acc >= total / n_stages
+            and layers_left >= stages_after
+        ):
+            spans.append((start, i + 1))
+            start, acc = i + 1, 0
+            remaining -= 1
+    spans.append((start, len(counts)))
+    while len(spans) < n_stages:  # degenerate: pad with empty-param spans
+        s, e = spans[-1]
+        if e - s < 2:
+            raise ValueError(f"cannot split {model.names} into {n_stages}")
+        spans[-1] = (s, e - 1)
+        spans.append((e - 1, e))
+    return spans
+
+
+def _split_tree(tree: Dict[str, Any], names: Sequence[str]) -> Dict[str, Any]:
+    return {k: tree[k] for k in names if k in tree}
+
+
+@dataclass
+class PipelineParallel:
+    """Microbatched pipeline executor over local devices.
+
+    ``stage_params[i]`` / ``stage_state[i]`` live committed on
+    ``devices[i]``; ``forward`` and ``train_step`` stream microbatches
+    through the stages (async dispatch overlaps the devices).
+    """
+
+    model: SegmentedModel
+    spans: List[Tuple[int, int]]
+    devices: List[Any]
+    stage_params: List[Dict[str, Any]]
+    stage_state: List[Dict[str, Any]]
+    loss_fn: Optional[Callable] = None
+    tx: Any = None
+    opt_state: Any = None
+    n_microbatches: int = 4
+    _fwd_fns: List[Any] = field(default_factory=list, repr=False)
+
+    @classmethod
+    def create(
+        cls,
+        model: SegmentedModel,
+        n_stages: int,
+        *,
+        loss_fn: Optional[Callable] = None,
+        tx=None,
+        devices: Optional[Sequence] = None,
+        seed: int = 0,
+        n_microbatches: int = 4,
+        params=None,
+        state=None,
+    ) -> "PipelineParallel":
+        devices = list(devices if devices is not None else jax.devices())
+        if len(devices) < n_stages:
+            raise ValueError(
+                f"{n_stages} stages need {n_stages} devices, have "
+                f"{len(devices)}"
+            )
+        devices = devices[:n_stages]
+        if params is None:
+            params, state = model.init(jax.random.PRNGKey(seed))
+        state = state if state is not None else {}
+        spans = balance_stages(model, n_stages)
+        stage_params, stage_state = [], []
+        for (s, e), dev in zip(spans, devices):
+            names = [l.name for l in model.layers[s:e]]
+            stage_params.append(
+                jax.device_put(_split_tree(params, names), dev)
+            )
+            stage_state.append(jax.device_put(_split_tree(state, names), dev))
+        tx = tx
+        opt_state = None
+        if tx is not None:
+            opt_state = [
+                jax.device_put(tx.init(p), dev)
+                for p, dev in zip(stage_params, devices)
+            ]
+        pp = cls(
+            model=model, spans=spans, devices=devices,
+            stage_params=stage_params, stage_state=stage_state,
+            loss_fn=loss_fn, tx=tx, opt_state=opt_state,
+            n_microbatches=n_microbatches,
+        )
+        pp._build_fns()
+        return pp
+
+    def _build_fns(self):
+        self._fwd_fns = []
+        for s, e in self.spans:
+            frm = None if s == 0 else self.model.layers[s - 1].name
+            to = self.model.layers[e - 1].name
+            model = self.model
+
+            def fn(params, state, x, train, _frm=frm, _to=to):
+                y, new_state = model.apply(
+                    params, x, state=state, train=train,
+                    from_layer=_frm, to_layer=_to,
+                )
+                return y, new_state
+
+            self._fwd_fns.append(
+                jax.jit(fn, static_argnames=("train",))
+            )
+
+    # -- inference ----------------------------------------------------------
+
+    def forward(self, x) -> jnp.ndarray:
+        """Pipelined eval forward; microbatches stream through the stages."""
+        outs = []
+        for mb in _microbatches(x, self.n_microbatches):
+            z = jax.device_put(mb, self.devices[0])
+            for i, fn in enumerate(self._fwd_fns):
+                z, _ = fn(self.stage_params[i], self.stage_state[i], z, False)
+                if i + 1 < len(self._fwd_fns):
+                    z = jax.device_put(z, self.devices[i + 1])
+            outs.append(z)
+        return jnp.concatenate([jax.device_put(o, self.devices[-1])
+                                for o in outs], axis=0)
+
+    # -- training -----------------------------------------------------------
+
+    def train_step(self, x, y) -> float:
+        """GPipe step: all microbatch forwards (saving per-stage vjps), then
+        the backward chain in reverse, gradients accumulated per stage
+        on-device; one optimizer update per stage."""
+        if self.tx is None or self.loss_fn is None:
+            raise ValueError("train_step needs tx= and loss_fn= at create()")
+        n_stage = len(self.spans)
+        grads = [None] * n_stage
+        new_states = list(self.stage_state)
+        total_loss = 0.0
+        mbs_x = _microbatches(x, self.n_microbatches)
+        mbs_y = _microbatches(y, self.n_microbatches)
+
+        # forward phase: per microbatch, chain vjps
+        saved = []  # per microbatch: list of vjp fns + final activation
+        for mb_x in mbs_x:
+            z = jax.device_put(jnp.asarray(mb_x), self.devices[0])
+            vjps = []
+            for i, (s, e) in enumerate(self.spans):
+                frm = None if s == 0 else self.model.layers[s - 1].name
+                to = self.model.layers[e - 1].name
+                st = self.stage_state[i]
+                model = self.model
+
+                def fwd(p, z_, _frm=frm, _to=to, _st=st):
+                    y_, ns = model.apply(
+                        p, z_, state=_st, train=True, from_layer=_frm,
+                        to_layer=_to,
+                    )
+                    return y_, ns
+
+                (z, ns), vjp = _vjp_with_aux(fwd, self.stage_params[i], z)
+                new_states[i] = ns
+                vjps.append(vjp)
+                if i + 1 < n_stage:
+                    z = jax.device_put(z, self.devices[i + 1])
+            saved.append((vjps, z))
+
+        # backward phase (reverse microbatch order, GPipe)
+        for (vjps, z_out), mb_y in zip(reversed(saved), reversed(mbs_y)):
+            yb = jax.device_put(jnp.asarray(mb_y), self.devices[-1])
+
+            def loss_f(z_):
+                return jnp.mean(self.loss_fn(z_, yb))
+
+            lval, g = jax.value_and_grad(loss_f)(z_out)
+            total_loss += float(lval) / len(saved)
+            for i in range(n_stage - 1, -1, -1):
+                dp, g = vjps[i](g)
+                grads[i] = dp if grads[i] is None else jax.tree_util.tree_map(
+                    jnp.add, grads[i], dp
+                )
+                if i > 0:
+                    g = jax.device_put(g, self.devices[i - 1])
+
+        # update per stage
+        inv = 1.0 / len(saved)
+        for i in range(n_stage):
+            gi = jax.tree_util.tree_map(lambda a: a * inv, grads[i])
+            updates, self.opt_state[i] = self.tx.update(
+                gi, self.opt_state[i], self.stage_params[i]
+            )
+            self.stage_params[i] = optax.apply_updates(
+                self.stage_params[i], updates
+            )
+        self.stage_state = new_states
+        return total_loss
+
+    # -- utilities ----------------------------------------------------------
+
+    def gather_params(self) -> Dict[str, Any]:
+        """Merge stage params back into one (host-local) tree."""
+        out: Dict[str, Any] = {}
+        for p in self.stage_params:
+            out.update(jax.device_get(p))
+        return out
+
+    def gather_state(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for s in self.stage_state:
+            out.update(jax.device_get(s))
+        return out
+
+
+def _vjp_with_aux(fwd, params, z):
+    """``jax.vjp`` of a ``(y, state)`` function w.r.t. (params, z), keeping
+    the state as untouched aux output and a vjp over ``y`` only."""
+    (y, ns), vjp = jax.vjp(fwd, params, z, has_aux=False)
+
+    def vjp_y(g):
+        dp, dz = vjp((g, jax.tree_util.tree_map(jnp.zeros_like, ns)))
+        return dp, dz
+
+    return (y, ns), vjp_y
+
+
+def _microbatches(x, n: int):
+    x = np.asarray(x) if not isinstance(x, jnp.ndarray) else x
+    b = x.shape[0]
+    if b % n:
+        raise ValueError(f"batch {b} not divisible by {n} microbatches")
+    size = b // n
+    return [x[i * size : (i + 1) * size] for i in range(n)]
