@@ -1,34 +1,76 @@
-"""Benchmark: the reference's headline prune workload on TPU.
+"""Benchmark: the reference's headline workloads on TPU.
 
-Reproduces the "Pruning Untrained Networks" MNIST experiment end to end
-(BASELINE.md: 28 s wall-clock on a CUDA GPU): untrained 784-2024-2024-10 FC
-net, Shapley attribution (sv_samples=5) on 1000 validation examples for both
-hidden layers (outermost first), pruning all negative-attribution units —
-including all JIT compilation and the shape-changing recompile between the
-two prune steps.
+Three legs (BASELINE.md):
+
+1. ``mnist_prune`` — the "Pruning Untrained Networks" MNIST experiment end
+   to end (28 s on the reference's CUDA GPU): untrained 784-2024-2024-10 FC
+   net, Shapley attribution (sv_samples=5) on 1000 validation examples for
+   both hidden layers (outermost first), pruning all negative-attribution
+   units — including all JIT compilation and the shape-changing recompile
+   between the two prune steps.
+2. ``vgg16_robustness`` — the north-star 6.5 h layerwise-robustness sweep
+   (15 layers × 8-method panel, 3 runs for stochastic methods, 1000 test
+   examples).  The bench measures the full 14-run panel on one
+   representative 512-unit conv layer and projects to all 15 layers; the
+   per-(layer,method) ablation walk is a single ``lax.scan`` per batch
+   (experiments/robustness.py) instead of the reference's per-unit Python
+   forwards.
+3. ``vgg16_train`` — steady-state VGG16-bn training-step time, img/s per
+   chip, and MFU (achieved FLOPs / peak) via XLA cost analysis.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": seconds, "unit": "s", "vs_baseline": 28/seconds}
-(vs_baseline > 1 means faster than the reference.)
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+(vs_baseline > 1 means faster than the reference.)  On TPU the headline is
+the projected sweep wall-clock vs the 6.5 h baseline; on the CPU fallback
+only the MNIST leg runs (the VGG legs are TPU-sized) and it is the headline.
+
+Robustness contract (round-1 postmortem: BENCH_r01.json was a raw traceback
+because the experimental TPU plugin died during backend init): the default
+invocation is an *orchestrator* that runs the measurement in a child
+process, retries once after a short wait on failure, then falls back to a
+CPU measurement (clearly labelled), and — only if even that fails — emits a
+parseable diagnostic JSON line instead of a traceback. ``--run`` executes
+one measurement in-process (what the orchestrator spawns).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-BASELINE_SECONDS = 28.0  # reference wall-clock (BASELINE.md, MNIST FC prune)
+MNIST_BASELINE_S = 28.0  # reference MNIST FC prune wall-clock (BASELINE.md)
+SWEEP_BASELINE_S = 6.5 * 3600.0  # reference 15-layer × 8-method sweep
+SWEEP_PANEL_RUNS = 14  # 5 deterministic + 3 stochastic × 3 runs per layer
+SWEEP_N_LAYERS = 15
+
+# bf16 peak FLOP/s per chip by device_kind prefix (public spec sheets)
+_PEAK_FLOPS = {
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+    "TPU7": 2307e12,
+}
 
 
-def main() -> dict:
-    if "--cpu" in sys.argv:
-        import jax
+def _peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "") or ""
+    for prefix in sorted(_PEAK_FLOPS, key=len, reverse=True):
+        if kind.startswith(prefix):
+            return _PEAK_FLOPS[prefix]
+    return None
 
-        jax.config.update("jax_platforms", "cpu")
+
+def _leg_mnist(smoke: bool) -> dict:
+    """Leg 1: untrained-MNIST Shapley prune, timed end to end."""
     import jax
-    import numpy as np
 
     from torchpruner_tpu.attributions import ShapleyAttributionMetric
     from torchpruner_tpu.core.graph import pruning_graph
@@ -39,7 +81,6 @@ def main() -> dict:
     from torchpruner_tpu.utils.flops import param_count
     from torchpruner_tpu.utils.losses import cross_entropy_loss
 
-    smoke = "--smoke" in sys.argv  # tiny config to validate the path on CPU
     if smoke:
         from torchpruner_tpu.models.mlp import fc_net
 
@@ -69,17 +110,224 @@ def main() -> dict:
         model, params, state = res.model, res.params, res.state
     jax.block_until_ready(params)
     elapsed = time.perf_counter() - t0
-
     return {
-        "metric": "mnist_fc_shapley_prune_wall_clock",
         "value": round(elapsed, 3),
         "unit": "s",
-        "vs_baseline": round(BASELINE_SECONDS / elapsed, 3),
-        "platform": jax.devices()[0].platform,
+        "vs_baseline": round(MNIST_BASELINE_S / elapsed, 3),
         "params_before": params_before,
         "params_after": param_count(params),
     }
 
 
+def _leg_vgg_robustness(smoke: bool) -> dict:
+    """Leg 2: the 8-method panel on one 512-unit conv layer of VGG16-bn
+    (1000 test examples, reference protocol), projected to the full
+    15-layer sweep."""
+    from torchpruner_tpu.core.segment import init_model
+    from torchpruner_tpu.data import load_dataset
+    from torchpruner_tpu.experiments.robustness import (
+        auc_summary,
+        layerwise_robustness,
+    )
+    from torchpruner_tpu.experiments.prune_retrain import build_metric
+    from torchpruner_tpu.models import vgg16_bn
+    from torchpruner_tpu.utils.losses import cross_entropy_loss
+
+    if smoke:
+        model = vgg16_bn(width_multiplier=0.125, classifier_width=64)
+        n_examples, bs, probe = 64, 32, "conv8"
+    else:
+        model = vgg16_bn()
+        n_examples, bs, probe = 1000, 250, "conv8"
+    params, state = init_model(model, seed=0)
+    test = load_dataset("cifar10", "test", n=n_examples, seed=0)
+    batches = test.batches(bs)
+
+    def factory(method, reduction="mean", **kw):
+        def make():
+            return build_metric(
+                method, model, params, batches, cross_entropy_loss,
+                state=state, reduction=reduction, seed=0, **kw,
+            )
+        return make
+
+    methods = {
+        "random": factory("random"),
+        "weight_norm": factory("weight_norm"),
+        "apoz": factory("apoz"),
+        "sensitivity": factory("sensitivity"),
+        "taylor": factory("taylor"),
+        "taylor_signed": factory("taylor", signed=True),
+        "sv": factory("shapley", sv_samples=5),
+        "sv_mean+2std": factory("shapley", reduction="mean+2std",
+                                sv_samples=5),
+    }
+    t0 = time.perf_counter()
+    results = layerwise_robustness(
+        model, params, state, batches, methods, cross_entropy_loss,
+        layers=[probe], verbose=False,
+    )
+    panel_s = time.perf_counter() - t0
+    projected = panel_s * SWEEP_N_LAYERS
+    return {
+        "value": round(projected, 1),
+        "unit": "s",
+        "vs_baseline": round(SWEEP_BASELINE_S / projected, 3),
+        "panel_seconds": round(panel_s, 2),
+        "panel_runs": SWEEP_PANEL_RUNS,
+        "probe_layer": probe,
+        "projection": f"panel on {probe} × {SWEEP_N_LAYERS} layers",
+        "auc": {k: round(v, 4) for k, v in auc_summary(results).items()},
+    }
+
+
+def _leg_vgg_train(smoke: bool) -> dict:
+    """Leg 3: steady-state VGG16-bn train-step time, img/s/chip, MFU."""
+    import jax
+    import numpy as np
+    import optax
+
+    from torchpruner_tpu.models import vgg16_bn
+    from torchpruner_tpu.train.loop import Trainer
+    from torchpruner_tpu.utils.flops import model_cost
+    from torchpruner_tpu.utils.losses import cross_entropy_loss
+    from torchpruner_tpu.utils.profiling import time_fn
+
+    if smoke:
+        model = vgg16_bn(width_multiplier=0.125, classifier_width=64)
+        batch = 16
+    else:
+        model = vgg16_bn()
+        batch = 256
+    trainer = Trainer.create(model, optax.sgd(0.05, momentum=0.9),
+                             cross_entropy_loss, seed=0)
+    rng = np.random.default_rng(0)
+    x = jax.numpy.asarray(
+        rng.normal(size=(batch, 32, 32, 3)).astype("float32"))
+    y = jax.numpy.asarray(
+        rng.integers(0, 10, size=(batch,)).astype("int32"))
+    stats = time_fn(trainer.step, x, y, iters=10, warmup=3)
+    step_s = stats["p50_s"]
+    img_per_s = batch / step_s
+    _, fwd_flops = model_cost(model, trainer.params, trainer.state,
+                              batch_size=batch)
+    peak = _peak_flops(jax.devices()[0])
+    mfu = None
+    if fwd_flops and peak:
+        # forward+backward ≈ 3× forward FLOPs (standard approximation)
+        mfu = round((3.0 * fwd_flops / step_s) / peak, 4)
+    return {
+        "value": round(step_s * 1e3, 3),
+        "unit": "ms/step",
+        "batch": batch,
+        "img_per_s_per_chip": round(img_per_s, 1),
+        "mfu": mfu,
+        "compile_s": round(stats["compile_s"], 2),
+    }
+
+
+def main() -> dict:
+    if "--cpu" in sys.argv:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    smoke = "--smoke" in sys.argv  # tiny config to validate the path on CPU
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    legs: dict = {}
+    legs["mnist_prune"] = _leg_mnist(smoke)
+    if on_tpu or smoke or "--all-legs" in sys.argv:
+        legs["vgg16_robustness"] = _leg_vgg_robustness(smoke)
+        legs["vgg16_train"] = _leg_vgg_train(smoke)
+
+    if "vgg16_robustness" in legs and not smoke:
+        head_name, head = "vgg16_layerwise_sweep_projected_wall_clock", \
+            legs["vgg16_robustness"]
+    else:
+        head_name, head = "mnist_fc_shapley_prune_wall_clock", \
+            legs["mnist_prune"]
+    out = {
+        "metric": head_name,
+        "value": head["value"],
+        "unit": head["unit"],
+        "vs_baseline": head.get("vs_baseline"),
+        "platform": platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", None),
+        "legs": legs,
+    }
+    if "vgg16_train" in legs:
+        out["mfu"] = legs["vgg16_train"]["mfu"]
+        out["img_per_s_per_chip"] = legs["vgg16_train"]["img_per_s_per_chip"]
+    return out
+
+
+def orchestrate() -> dict:
+    """Run the measurement in a child process with retry + CPU fallback.
+
+    Attempt 1: default platform (TPU when available). Attempt 2: same,
+    after a 15 s pause (transient plugin/tunnel failures). Attempt 3:
+    ``--cpu`` so a broken TPU backend still yields a real measurement,
+    labelled with the forced platform. The fallback is the flag (an
+    in-process ``jax.config.update("jax_platforms", "cpu")``), NOT the
+    ``JAX_PLATFORMS`` env var: with the experimental axon plugin installed
+    the env var still blocks in plugin discovery, while the config update
+    cleanly skips it (measured on the round-2 box: env var hangs > 120 s,
+    config update returns in 16 ms). Always returns a dict.
+    """
+    passthrough = [a for a in sys.argv[1:] if a != "--run"]
+    cmd = [sys.executable, os.path.abspath(__file__), "--run", *passthrough]
+    attempts: list[dict] = []
+    plans = [(0.0, False), (15.0, False), (0.0, True)]
+    i = 0
+    while i < len(plans):
+        pause, force_cpu = plans[i]
+        if pause:
+            time.sleep(pause)
+        attempt_cmd = cmd + (["--cpu"] if force_cpu and "--cpu" not in cmd else [])
+        try:
+            proc = subprocess.run(
+                attempt_cmd, capture_output=True, text=True, timeout=900,
+            )
+            rc, out, err = proc.returncode, proc.stdout, proc.stderr
+        except subprocess.TimeoutExpired as e:
+            rc, out = -1, (e.stdout or "")
+            err = f"timeout after 900s: {e.stderr or ''}"
+        result = None
+        for line in reversed(out.strip().splitlines()):
+            try:
+                cand = json.loads(line)
+            except (json.JSONDecodeError, ValueError):
+                continue
+            if isinstance(cand, dict) and "metric" in cand:
+                result = cand
+                break
+        if rc == 0 and result is not None:
+            if attempts:
+                result["attempts"] = attempts
+            return result
+        attempts.append({
+            "attempt": i + 1,
+            "rc": rc,
+            "forced_platform": "cpu" if force_cpu else None,
+            "stderr_tail": err.strip()[-500:],
+        })
+        # a hang (timeout) won't be cured by a quick retry — go straight
+        # to the CPU fallback instead of burning another timeout window
+        i = len(plans) - 1 if (rc == -1 and not force_cpu) else i + 1
+    return {
+        "metric": "mnist_fc_shapley_prune_wall_clock",
+        "value": None,
+        "unit": "s",
+        "vs_baseline": None,
+        "error": "all bench attempts failed (see attempts)",
+        "attempts": attempts,
+    }
+
+
 if __name__ == "__main__":
-    print(json.dumps(main()))
+    if "--run" in sys.argv:
+        print(json.dumps(main()), flush=True)
+    else:
+        print(json.dumps(orchestrate()), flush=True)

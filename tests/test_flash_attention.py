@@ -49,6 +49,10 @@ def test_blocking_selection():
     assert _pick_blocks(64) == (64, 64)
     assert _pick_blocks(96) == (96, 96)  # < 128: single block
     assert _pick_blocks(200) == (8, 8)  # 200 = 8 * 25: halve down to 8
+    # awkward lengths must take the XLA fallback, not a (1, 1)-tile kernel
+    assert _pick_blocks(2047) is None  # odd > 128: halves all the way to 1
+    assert _pick_blocks(132) is None  # 132 = 4 * 33: stops below MIN_BLOCK
+    assert _pick_blocks(4) is None  # shorter than the minimum block
 
 
 def test_odd_length_still_matches():

@@ -69,3 +69,24 @@ def test_prefetch_drop_remainder(lib):
     ds = Dataset(np.zeros((10, 2), np.float32), np.zeros((10,), np.int32))
     batches = list(native.prefetch_batches(ds, 4, drop_remainder=True))
     assert [len(b[0]) for b in batches] == [4, 4]
+
+
+def test_gather_rejects_out_of_range_on_both_paths():
+    src = np.arange(20, dtype=np.float32).reshape(10, 2)
+    for bad in ([0, 10], [-1, 3], [11]):
+        idx = np.asarray(bad, dtype=np.int64)
+        with pytest.raises(IndexError):
+            native.gather_rows(src, idx)
+
+
+def test_prefetch_propagates_worker_errors():
+    class Broken:
+        """Dataset whose second row gather explodes."""
+        x = np.zeros((8, 2), np.float32)
+        y = np.zeros((8,), np.int32)
+
+        def __len__(self):
+            return 12  # lies: indices 8..11 are out of range
+
+    with pytest.raises(IndexError):
+        list(native.prefetch_batches(Broken(), 4))

@@ -74,6 +74,17 @@ def main(argv=None) -> int:
 
         summary = run_robustness_config(cfg)
         print(json.dumps(summary))
+    elif cfg.experiment == "train":
+        from torchpruner_tpu.experiments.train_model import run_train
+
+        _trainer, history = run_train(cfg)
+        last = history[-1] if history else None
+        print(json.dumps({
+            "experiment": cfg.name,
+            "epochs": len(history),
+            "final_test_acc": last["test_acc"] if last else None,
+            "final_test_loss": last["test_loss"] if last else None,
+        }))
     else:
         from torchpruner_tpu.experiments.prune_retrain import run_prune_retrain
 

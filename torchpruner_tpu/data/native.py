@@ -104,9 +104,17 @@ def gather_rows(src: np.ndarray, idx: np.ndarray,
     C++ (GIL released) when available."""
     lib = _load_library()
     src = np.ascontiguousarray(src)
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    # validate up front so both paths agree: the C++ loop is a raw memcpy
+    # (out-of-range would read out of bounds), and numpy would accept
+    # negative indices the native path can't
+    if idx.size and (idx.min() < 0 or idx.max() >= len(src)):
+        bad = idx[(idx < 0) | (idx >= len(src))][0]
+        raise IndexError(
+            f"index {bad} out of range for gather over {len(src)} rows"
+        )
     if lib is None:
         return src[idx]
-    idx = np.ascontiguousarray(idx, dtype=np.int64)
     out = np.empty((len(idx),) + src.shape[1:], dtype=src.dtype)
     row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
     lib.tp_gather_rows(
@@ -136,6 +144,7 @@ def prefetch_batches(
     stop = n - (n % batch_size) if drop_remainder else n
     q: "queue.Queue" = queue.Queue(maxsize=max(1, prefetch))
     _SENTINEL = object()
+    _ERROR = object()
 
     def worker():
         try:
@@ -143,7 +152,9 @@ def prefetch_batches(
                 j = idx[i : i + batch_size]
                 q.put((gather_rows(dataset.x, j, n_threads),
                        gather_rows(dataset.y, j, n_threads)))
-        finally:
+        except BaseException as exc:  # propagate, never truncate silently
+            q.put((_ERROR, exc))
+        else:
             q.put(_SENTINEL)
 
     t = threading.Thread(target=worker, daemon=True)
@@ -152,5 +163,8 @@ def prefetch_batches(
         item = q.get()
         if item is _SENTINEL:
             break
+        if isinstance(item, tuple) and len(item) == 2 and item[0] is _ERROR:
+            t.join()
+            raise item[1]
         yield item
     t.join()

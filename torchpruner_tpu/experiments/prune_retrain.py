@@ -129,8 +129,42 @@ def filter_targets(targets, cfg: ExperimentConfig):
     return [t for t in targets if any(s in t for s in cfg.target_filter)]
 
 
-def make_optimizer(cfg: ExperimentConfig):
-    tx = optax.sgd(cfg.lr, momentum=cfg.momentum or None)
+def make_lr_schedule(cfg: ExperimentConfig, steps_per_epoch: int = 1,
+                     total_epochs: Optional[int] = None):
+    """``cfg.lr_schedule`` as an optax schedule (or the constant lr).
+
+    Milestones/epoch counts are in *epochs* (matching the reference's
+    MultiStepLR, cifar10.py:94-99); ``steps_per_epoch`` converts them to the
+    optimizer's step domain.  ``total_epochs`` sizes the decaying schedules
+    — callers whose optimizer survives several fine-tune passes (the
+    prune-retrain loop carries opt_state across all prune targets) must
+    pass the *whole run's* epoch count, or every pass after the first
+    would sit at the decayed floor.
+    """
+    spe = max(1, steps_per_epoch)
+    if cfg.lr_schedule == "constant":
+        return cfg.lr
+    if cfg.lr_schedule == "multistep":
+        return optax.piecewise_constant_schedule(
+            cfg.lr, {int(m) * spe: cfg.lr_gamma for m in cfg.lr_milestones}
+        )
+    if total_epochs is None:
+        total_epochs = cfg.epochs or cfg.finetune_epochs or 1
+    total = max(1, total_epochs) * spe
+    if cfg.lr_schedule == "cosine":
+        return optax.cosine_decay_schedule(cfg.lr, decay_steps=total)
+    # warmup_cosine
+    warmup = cfg.lr_warmup_epochs * spe
+    return optax.warmup_cosine_decay_schedule(
+        0.0, cfg.lr, warmup_steps=max(1, warmup),
+        decay_steps=max(total, warmup + 1),
+    )
+
+
+def make_optimizer(cfg: ExperimentConfig, steps_per_epoch: int = 1,
+                   total_epochs: Optional[int] = None):
+    lr = make_lr_schedule(cfg, steps_per_epoch, total_epochs)
+    tx = optax.sgd(lr, momentum=cfg.momentum or None)
     if cfg.weight_decay:
         tx = optax.chain(optax.add_decayed_weights(cfg.weight_decay), tx)
     return tx
@@ -163,16 +197,21 @@ def run_prune_retrain(
     """
     model, (train, val, test) = resolve_model_and_data(cfg, model, datasets)
 
-    tx = make_optimizer(cfg)
+    groups = list(pruning_graph(model))
+    if cfg.prune_order == "reverse":
+        groups = groups[::-1]  # outermost layer first (reference recipe)
+    targets = filter_targets([g.target for g in groups], cfg)
+
+    # one opt_state spans every target's fine-tune pass, so decaying
+    # schedules must be sized for the whole run, not one pass
+    tx = make_optimizer(
+        cfg, steps_per_epoch=max(1, len(train) // cfg.batch_size),
+        total_epochs=cfg.finetune_epochs * max(1, len(targets)),
+    )
     loss_fn = LOSS_REGISTRY[cfg.loss]
     trainer = Trainer.create(model, tx, loss_fn, seed=cfg.seed)
     logger = CSVLogger(cfg.log_path, experiment=cfg.name)
     history: List[PruneStepRecord] = []
-
-    groups = list(pruning_graph(trainer.model))
-    if cfg.prune_order == "reverse":
-        groups = groups[::-1]  # outermost layer first (reference recipe)
-    targets = filter_targets([g.target for g in groups], cfg)
 
     val_batches = val.batches(cfg.eval_batch_size)
     test_batches = test.batches(cfg.eval_batch_size)

@@ -117,15 +117,25 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     )(q, k, v)
 
 
+MIN_BLOCK = 8  # below this the kernel degrades to tiny-tile scalar work
+
+
 def _pick_blocks(S: int):
-    """Largest clean blocking <= default; None if S doesn't block."""
+    """Largest clean blocking <= default; None if S doesn't block.
+
+    The halving loops always terminate at 1 (everything divides S), so the
+    real fallback condition is a *minimum* block size: an awkward length
+    like 2047 would otherwise run the kernel with (1, 1) tiles — B*H*S grid
+    programs each doing an S-iteration loop over 1x1 tiles — instead of
+    taking the intended XLA path.
+    """
     bq = min(DEFAULT_BLOCK_Q, S)
     while bq > 1 and S % bq:
         bq //= 2
     bk = min(DEFAULT_BLOCK_K, S)
     while bk > 1 and S % bk:
         bk //= 2
-    if S % bq or S % bk:
+    if bq < MIN_BLOCK or bk < MIN_BLOCK:
         return None
     return bq, bk
 

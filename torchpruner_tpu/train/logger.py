@@ -33,6 +33,7 @@ CSV_FIELDS = [
     "widths",
     "prune_time",
     "prune_ratio",
+    "train_loss",     # from-scratch training rows only (run_train)
 ]
 
 
@@ -82,13 +83,51 @@ class CSVLogger:
             "prune_time": f"{prune_time:.3f}",
             "prune_ratio": prune_ratio if prune_ratio is not None else "",
         }
-        with open(self.path, "a", newline="") as f:
-            csv.DictWriter(f, CSV_FIELDS).writerow(row)
-        with open(self.path + ".jsonl", "a") as f:
-            f.write(json.dumps(row) + "\n")
+        self._write(row)
         log.info(
             "prune step %d [%s/%s]: loss %.4f→%.4f acc %.4f→%.4f params %d",
             self._step, layer, method, test_loss, test_loss_pp,
             test_acc, test_acc_pp, n_params,
         )
         self._step += 1
+
+    def log_epoch(
+        self,
+        *,
+        epoch: int,
+        train_loss: float,
+        test_loss: float,
+        test_acc: float,
+        seconds: float = 0.0,
+    ):
+        """One from-scratch training epoch (run_train): test metrics land in
+        their real columns, the training loss in its own."""
+        row = {
+            "timestamp": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "experiment": self.experiment,
+            "step": self._step,
+            "layer": f"epoch{epoch}",
+            "method": "train",
+            "test_loss": f"{test_loss:.6f}",
+            "test_acc": f"{test_acc:.6f}",
+            "test_loss_pp": "",
+            "test_acc_pp": "",
+            "n_params": "",
+            "flops": "",
+            "widths": "",
+            "prune_time": f"{seconds:.3f}",
+            "prune_ratio": "",
+            "train_loss": f"{train_loss:.6f}",
+        }
+        self._write(row)
+        log.info(
+            "epoch %d: train %.4f test %.4f acc %.4f",
+            epoch, train_loss, test_loss, test_acc,
+        )
+        self._step += 1
+
+    def _write(self, row: dict):
+        with open(self.path, "a", newline="") as f:
+            csv.DictWriter(f, CSV_FIELDS).writerow(row)
+        with open(self.path + ".jsonl", "a") as f:
+            f.write(json.dumps(row) + "\n")

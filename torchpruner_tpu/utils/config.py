@@ -36,25 +36,46 @@ class ExperimentConfig:
     prune_order: str = "reverse"     # outermost layer first (reference recipe)
     score_examples: int = 1000       # val examples used for scoring
 
-    # fine-tune loop
+    # fine-tune / training loop
     finetune_epochs: int = 0
+    epochs: int = 0                  # from-scratch training length ("train")
     batch_size: int = 64
     eval_batch_size: int = 250
     lr: float = 0.01
     momentum: float = 0.0
     weight_decay: float = 0.0
+    #: constant | multistep | cosine | warmup_cosine.  "multistep" is the
+    #: reference's MultiStepLR (cifar10.py:94-99: milestones in epochs,
+    #: lr *= gamma at each); cosine variants cover the transformer configs.
+    lr_schedule: str = "constant"
+    lr_milestones: Tuple[int, ...] = (30, 60, 90, 120, 150)
+    lr_gamma: float = 0.5
+    lr_warmup_epochs: int = 0
 
     # distribution
     mesh: Dict[str, int] = field(default_factory=dict)  # e.g. {"data": 4, "model": 2}
+
+    # data pipeline / checkpointing
+    augment: bool = False            # flip + pad/crop image augmentation
+    prefetch: bool = True            # native background batch assembly
+    checkpoint_path: str = ""        # save/resume training checkpoints here
+    checkpoint_every_epochs: int = 0  # 0 = only at the end
 
     seed: int = 0
     log_path: str = "logs/experiment.csv"
 
     def __post_init__(self):
-        if self.experiment not in ("prune_retrain", "robustness"):
+        if self.experiment not in ("prune_retrain", "robustness", "train"):
             raise ValueError(
                 f"unknown experiment {self.experiment!r} "
-                "(use 'prune_retrain' or 'robustness')"
+                "(use 'prune_retrain', 'robustness' or 'train')"
+            )
+        if self.lr_schedule not in (
+            "constant", "multistep", "cosine", "warmup_cosine"
+        ):
+            raise ValueError(
+                f"unknown lr_schedule {self.lr_schedule!r} (use 'constant', "
+                "'multistep', 'cosine' or 'warmup_cosine')"
             )
 
     def to_json(self, path: str):
@@ -69,6 +90,7 @@ class ExperimentConfig:
         unknown = set(raw) - known
         if unknown:
             raise ValueError(f"unknown config keys: {sorted(unknown)}")
-        if "target_filter" in raw:  # JSON has no tuples
-            raw["target_filter"] = tuple(raw["target_filter"])
+        for key in ("target_filter", "lr_milestones"):  # JSON has no tuples
+            if key in raw:
+                raw[key] = tuple(raw[key])
         return cls(**raw)
