@@ -226,6 +226,46 @@ def _leg_vgg_train(smoke: bool) -> dict:
     }
 
 
+def _leg_flash_attention(smoke: bool) -> dict:
+    """Flash (Pallas fwd+bwd kernels) vs XLA einsum attention: steady-state
+    grad-step time and compiled temp memory at long sequence length — the
+    O(S*Dh) vs O(S^2) backward-memory claim, measured."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchpruner_tpu.ops.flash_attention import (
+        _xla_attention,
+        flash_attention,
+    )
+    from torchpruner_tpu.utils.profiling import time_fn
+
+    B, S, H, Dh = (1, 512, 2, 32) if smoke else (4, 2048, 8, 64)
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H, Dh), jnp.bfloat16)
+               for kk in ks)
+
+    def make(fn):
+        def loss(q_, k_, v_):
+            return jnp.sum(fn(q_, k_, v_, causal=True).astype(jnp.float32))
+        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    out = {}
+    for name, fn in (("flash", flash_attention), ("xla", _xla_attention)):
+        g = make(fn)
+        stats = time_fn(g, q, k, v, iters=5, warmup=2)
+        out[f"{name}_ms"] = round(stats["p50_s"] * 1e3, 3)
+        try:
+            mem = g.lower(q, k, v).compile().memory_analysis()
+            out[f"{name}_temp_mb"] = round(
+                mem.temp_size_in_bytes / 2**20, 1)
+        except Exception:
+            out[f"{name}_temp_mb"] = None
+    if out.get("xla_ms") and out.get("flash_ms"):
+        out["speedup"] = round(out["xla_ms"] / out["flash_ms"], 3)
+    out["shape"] = f"B{B} S{S} H{H} Dh{Dh} bf16 causal"
+    return out
+
+
 def main() -> dict:
     if "--cpu" in sys.argv:
         import jax
@@ -241,6 +281,7 @@ def main() -> dict:
     if on_tpu or smoke or "--all-legs" in sys.argv:
         legs["vgg16_robustness"] = _leg_vgg_robustness(smoke)
         legs["vgg16_train"] = _leg_vgg_train(smoke)
+        legs["flash_attention"] = _leg_flash_attention(smoke)
 
     if "vgg16_robustness" in legs and not smoke:
         head_name, head = "vgg16_layerwise_sweep_projected_wall_clock", \

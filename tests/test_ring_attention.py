@@ -55,3 +55,37 @@ def test_ring_bf16_output_dtype():
     q, k, v = (t.astype(jnp.bfloat16) for t in qkv(S=16))
     out = ring_attention(q, k, v, mesh, causal=True)
     assert out.dtype == jnp.bfloat16
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_chunk_streaming_matches_single_block(causal):
+    """The blocked (streamed) chunk path must match the one-shot einsum
+    path exactly — values and gradients — so ring attention's peak score
+    memory can shrink without changing numerics."""
+    from torchpruner_tpu.parallel.ring import _block_stats, _chunk_stats
+
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    q = jax.random.normal(ks[0], (2, 8, 2, 4), jnp.float32)
+    k = jax.random.normal(ks[1], (2, 64, 2, 4), jnp.float32)
+    v = jax.random.normal(ks[2], (2, 64, 2, 4), jnp.float32)
+
+    # q_off INSIDE the chunk (queries at 32..39, keys at 0..63): causal
+    # masking then differs per KV block, exercising the streamed offsets
+    want = _block_stats(q, k, v, 32, 0, causal)
+    got = _chunk_stats(q, k, v, 32, 0, causal, block_k=16)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   atol=1e-5, rtol=1e-5)
+
+    def loss(fn):
+        def f(q_, k_, v_):
+            m, l, acc = fn(q_, k_, v_)
+            return jnp.sum(acc / l[..., None])
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    got_g = loss(lambda a, b, c: _chunk_stats(a, b, c, 32, 0, causal,
+                                              block_k=16))
+    want_g = loss(lambda a, b, c: _block_stats(a, b, c, 32, 0, causal))
+    for g, w in zip(got_g, want_g):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   atol=1e-5, rtol=1e-4)

@@ -1,18 +1,26 @@
-"""Flash attention for TPU — Pallas forward kernel with online softmax.
+"""Flash attention for TPU — Pallas forward AND backward kernels.
 
 The hot op of the transformer families (ViT/BERT/Llama head pruning,
-BASELINE.json configs 3-5).  The forward never materializes the ``(S, S)``
-score matrix: the grid runs over ``(batch, heads, query blocks)`` and each
-program streams KV blocks from VMEM with the numerically-stable running
-``(max, sum, acc)`` update (Dao et al., 2022).  Matmuls are
-``preferred_element_type=float32`` so bf16 inputs still accumulate in f32 on
-the MXU.
+BASELINE.json configs 3-5).  Neither direction ever materializes the
+``(S, S)`` score matrix:
 
-The backward is a ``custom_vjp`` that recomputes attention with the XLA
-einsum path and differentiates that — O(S^2) memory in the backward only.
-Inputs whose shapes don't block cleanly (sequence not divisible by the block
-size) fall back to the XLA path entirely; on CPU the kernel runs in
-interpreter mode so tests exercise the same code path as TPU.
+- **Forward** (Dao et al., 2022): the grid runs over ``(batch, heads,
+  query blocks)``; each program streams KV blocks through VMEM with the
+  numerically-stable running ``(max, sum, acc)`` update, and additionally
+  writes the per-query log-sum-exp (LSE) used by the backward.
+- **Backward** (FlashAttention-2): two kernels sharing the forward's LSE
+  and the precomputed ``delta = rowsum(dO * O)``.  The dQ kernel runs over
+  query blocks streaming KV; the dK/dV kernel runs over KV blocks streaming
+  queries.  Probabilities are *recomputed* blockwise from LSE — O(S * Dh)
+  memory total, vs the O(S^2) score tensor a recompute-through-XLA backward
+  materializes.
+
+Matmuls are ``preferred_element_type=float32`` so bf16 inputs still
+accumulate in f32 on the MXU.  Causal masking skips whole blocks strictly
+above (dQ) / below (dK/dV) the diagonal.  Inputs whose sequence length
+doesn't block cleanly (min block 8) fall back to the XLA einsum path in
+both directions; on CPU the kernels run in interpreter mode so tests
+exercise the same code path as TPU.
 """
 
 from __future__ import annotations
@@ -29,10 +37,12 @@ _NEG_INF = -1e30
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
+MIN_BLOCK = 8  # below this the kernel degrades to tiny-tile scalar work
 
 
 def _xla_attention(q, k, v, *, causal: bool):
-    """Reference einsum path on (B, S, H, Dh); also the backward's recompute."""
+    """Reference einsum path on (B, S, H, Dh); also the non-blocking
+    shapes' fallback (forward and, via autodiff, backward)."""
     scale = 1.0 / math.sqrt(q.shape[-1])
     logits = jnp.einsum(
         "bshk,bthk->bhst", q, k, preferred_element_type=jnp.float32
@@ -45,9 +55,16 @@ def _xla_attention(q, k, v, *, causal: bool):
     return jnp.einsum("bhst,bthk->bshk", w, v)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_k):
+# --------------------------------------------------------------------------
+# forward kernel
+# --------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                *, scale, causal, block_q, block_k):
     """One (batch, head, query-block) program: stream KV blocks with the
-    online-softmax running state carried through ``fori_loop``."""
+    online-softmax running state carried through ``fori_loop``; emit the
+    normalized output block and its LSE row."""
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)  # (block_q, Dh)
     dh = q.shape[-1]
@@ -91,11 +108,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_k):
     acc0 = jnp.zeros((block_q, dh), jnp.float32)
     m, l, acc = lax.fori_loop(0, n_run, body, (m0, l0, acc0))
     o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    """(B, H, S, Dh) layout in, same out."""
+    """(B, H, S, Dh) layout in; returns (out, lse) with lse (B, H, S) f32."""
     B, H, S, Dh = q.shape
     scale = 1.0 / math.sqrt(Dh)
     grid = (B, H, S // block_q)
@@ -111,13 +129,167 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, S, Dh), lambda b, h, i: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, S, Dh), lambda b, h, i: (b, h, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, Dh), lambda b, h, i: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, S, Dh), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, Dh), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, Dh), q.dtype),
+            jax.ShapeDtypeStruct((B, H, S), jnp.float32),
+        ],
         interpret=interpret,
     )(q, k, v)
 
 
-MIN_BLOCK = 8  # below this the kernel degrades to tiny-tile scalar work
+# --------------------------------------------------------------------------
+# backward kernels
+# --------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               *, scale, causal, block_q, block_k):
+    """One (batch, head, query-block) program: stream KV blocks,
+    recompute P from LSE, accumulate dQ = sum_j dS_j K_j * scale."""
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)       # (block_q, Dh)
+    do = do_ref[0, 0].astype(jnp.float32)     # (block_q, Dh)
+    lse = lse_ref[0, 0]                       # (block_q,)
+    delta = delta_ref[0, 0]                   # (block_q,)
+    dh = q.shape[-1]
+    S = k_ref.shape[2]
+    n_kv = S // block_k
+    if causal:
+        n_run = lax.div((qi + 1) * block_q + block_k - 1, block_k)
+        n_run = jnp.minimum(n_run, n_kv)
+    else:
+        n_run = n_kv
+
+    def body(j, dq):
+        k = k_ref[0, 0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            qpos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])                 # masked rows -> 0
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = lax.fori_loop(0, n_run, body, jnp.zeros((block_q, dh), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale, causal, block_q, block_k):
+    """One (batch, head, KV-block) program: stream query blocks,
+    recompute P from LSE, accumulate dV = sum_i P_i^T dO_i and
+    dK = sum_i dS_i^T Q_i * scale."""
+    ki = pl.program_id(2)
+    k = k_ref[0, 0].astype(jnp.float32)       # (block_k, Dh)
+    v = v_ref[0, 0].astype(jnp.float32)       # (block_k, Dh)
+    dh = k.shape[-1]
+    S = q_ref.shape[2]
+    n_q = S // block_q
+    # causal: the first query block whose last position reaches this KV
+    # block's first position; earlier blocks are entirely masked
+    i_start = lax.div(ki * block_k, block_q) if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, 0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.dslice(i * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.dslice(i * block_q, block_q)]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (block_q, block_k)
+        if causal:
+            qpos = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    z = jnp.zeros((block_k, dh), jnp.float32)
+    dk, dv = lax.fori_loop(i_start, n_q, body, (z, z))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
+def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
+    """(B, H, S, Dh) layout; returns (dq, dk, dv)."""
+    B, H, S, Dh = q.shape
+    scale = 1.0 / math.sqrt(Dh)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    seq_spec = pl.BlockSpec((1, 1, S, Dh), lambda b, h, i: (b, h, 0, 0))
+    row_full = pl.BlockSpec((1, 1, S), lambda b, h, i: (b, h, 0))
+    qblk = pl.BlockSpec((1, 1, block_q, Dh), lambda b, h, i: (b, h, i, 0))
+    qrow = pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i))
+    kblk = pl.BlockSpec((1, 1, block_k, Dh), lambda b, h, i: (b, h, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(B, H, S // block_q),
+        in_specs=[qblk, seq_spec, seq_spec, qblk, qrow, qrow],
+        out_specs=qblk,
+        out_shape=jax.ShapeDtypeStruct((B, H, S, Dh), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(B, H, S // block_k),
+        in_specs=[seq_spec, kblk, kblk, seq_spec, row_full, row_full],
+        out_specs=[kblk, kblk],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, Dh), k.dtype),
+            jax.ShapeDtypeStruct((B, H, S, Dh), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# dispatch + custom VJP
+# --------------------------------------------------------------------------
 
 
 def _pick_blocks(S: int):
@@ -140,29 +312,43 @@ def _pick_blocks(S: int):
     return bq, bk
 
 
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _flash_attention(q, k, v, causal):
-    blocks = _pick_blocks(q.shape[1])
-    if blocks is None:
-        return _xla_attention(q, k, v, causal=causal)
-    bq, bk = blocks
-    interpret = jax.default_backend() != "tpu"
-    # (B, S, H, Dh) -> (B, H, S, Dh) for clean per-(batch, head) blocking
-    qt, kt, vt = (jnp.moveaxis(t, 2, 1) for t in (q, k, v))
-    out = _flash_fwd(qt, kt, vt, causal, bq, bk, interpret)
-    return jnp.moveaxis(out, 1, 2)
+    out, _ = _flash_vjp_fwd(q, k, v, causal)
+    return out
 
 
 def _flash_vjp_fwd(q, k, v, causal):
-    return _flash_attention(q, k, v, causal), (q, k, v)
+    blocks = _pick_blocks(q.shape[1])
+    if blocks is None:
+        return _xla_attention(q, k, v, causal=causal), (q, k, v, None, None)
+    bq, bk = blocks
+    # (B, S, H, Dh) -> (B, H, S, Dh) for clean per-(batch, head) blocking
+    qt, kt, vt = (jnp.moveaxis(t, 2, 1) for t in (q, k, v))
+    out, lse = _flash_fwd(qt, kt, vt, causal, bq, bk, _interpret())
+    out = jnp.moveaxis(out, 1, 2)
+    # residual `out` is the SAME array that flows on as the activation, so
+    # autodiff keeps one copy, not an extra (B, H, S, Dh) transpose
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _xla_attention(q_, k_, v_, causal=causal), q, k, v
-    )
-    return vjp(g)
+    q, k, v, o, lse = res
+    if lse is None:  # non-blocking shapes: differentiate the XLA path
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _xla_attention(q_, k_, v_, causal=causal),
+            q, k, v,
+        )
+        return vjp(g)
+    bq, bk = _pick_blocks(q.shape[1])
+    qt, kt, vt, ot, gt = (jnp.moveaxis(t, 2, 1) for t in (q, k, v, o, g))
+    dq, dk, dv = _flash_bwd(qt, kt, vt, ot, lse, gt, causal, bq, bk,
+                            _interpret())
+    return tuple(jnp.moveaxis(t, 1, 2) for t in (dq, dk, dv))
 
 
 _flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

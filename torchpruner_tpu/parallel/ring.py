@@ -5,9 +5,11 @@ all — SURVEY.md §5.7): the sequence axis is sharded over a ``seq`` mesh axis;
 each device keeps its local query block and the KV shards rotate around the
 ring with ``lax.ppermute`` (one hop per step, riding ICI), while a running
 online-softmax state ``(max, sumexp, acc)`` merges each arriving chunk (Liu
-et al., 2023).  Peak memory per device is O(S_local^2) scores + two KV
-shards, independent of the global sequence length; compute overlaps with the
-next chunk's transfer inside one compiled XLA program.
+et al., 2023).  Each chunk is itself streamed in KV blocks (``_chunk_stats``),
+so peak live score memory per device is O(S_local × block) — not
+O(S_local^2) — plus two KV shards, independent of the global sequence
+length; compute overlaps with the next chunk's transfer inside one compiled
+XLA program.
 
 ``ring_attention`` is the user-facing wrapper (global arrays in, shard_map
 inside); ``ring_attention_local`` is the per-shard computation for callers
@@ -28,12 +30,19 @@ from jax import shard_map
 _NEG_INF = -1e30
 
 
-def _chunk_stats(q, k, v, q_off, k_off, causal):
-    """Attention of local queries against one KV chunk, returning the
-    online-softmax statistics instead of normalized output.
+#: KV sub-block length for streaming inside one ring chunk.  A chunk's
+#: score tensor is only ever (B, H, Sq, _BLOCK_K) live at once.
+_BLOCK_K = 512
 
-    ``q``: (B, Sq, H, Dh); ``k``/``v``: (B, Sk, H, Dh); offsets are the
-    chunks' global sequence positions (for causal masking across the ring).
+
+def _block_stats(q, k, v, q_off, k_off, causal):
+    """Online-softmax statistics of local queries against one KV block —
+    the flash-attention core as an XLA computation (autodiff-exact, so the
+    ring's backward comes from plain ``jax.grad``; the single-device Pallas
+    kernels live in ops/flash_attention.py).
+
+    ``q``: (B, Sq, H, Dh); ``k``/``v``: (B, Sk, H, Dh); offsets are global
+    sequence positions (for causal masking across the ring).
     Returns ``m``: (B, H, Sq), ``l``: (B, H, Sq), ``acc``: (B, H, Sq, Dh).
     """
     scale = 1.0 / math.sqrt(q.shape[-1])
@@ -61,6 +70,61 @@ def _chunk_stats(q, k, v, q_off, k_off, causal):
     return m, l, acc
 
 
+def _merge_stats(m, l, acc, cm, cl, cacc):
+    """Numerically-stable merge of two online-softmax partial states."""
+    m_new = jnp.maximum(m, cm)
+    a_old = jnp.exp(m - m_new)
+    a_new = jnp.exp(cm - m_new)
+    return (
+        m_new,
+        l * a_old + cl * a_new,
+        acc * a_old[..., None] + cacc * a_new[..., None],
+    )
+
+
+def _chunk_stats(q, k, v, q_off, k_off, causal, block_k: int = _BLOCK_K):
+    """Statistics of local queries against one ring chunk, *streaming* the
+    chunk in ``block_k``-length KV blocks: peak live score memory is
+    (B, H, Sq, block_k) rather than the whole (B, H, Sq, Sk) chunk.  The
+    per-block computation is rematerialized (``jax.checkpoint``) so the
+    backward recomputes blocks instead of saving every block's scores.
+    Non-dividing lengths halve the block until it divides (like
+    flash_attention's ``_pick_blocks``) so streaming stays active for
+    non-power-of-two shard lengths."""
+    Sk = k.shape[1]
+    while block_k > 64 and Sk % block_k:
+        block_k //= 2
+    if Sk <= block_k or Sk % block_k:
+        return _block_stats(q, k, v, q_off, k_off, causal)
+
+    B, Sq, H, Dh = q.shape
+    n_blocks = Sk // block_k
+    kb = k.reshape(B, n_blocks, block_k, H, Dh)
+    vb = v.reshape(B, n_blocks, block_k, H, Dh)
+    block = jax.checkpoint(
+        lambda kv_j, off_j: _block_stats(q, kv_j[0], kv_j[1], q_off, off_j,
+                                         causal),
+        static_argnums=(),
+    )
+
+    def step(carry, inp):
+        kv_j, off_j = inp
+        cm, cl, cacc = block(kv_j, off_j)
+        return _merge_stats(*carry, cm, cl, cacc), None
+
+    init = (
+        jnp.full((B, H, Sq), _NEG_INF, jnp.float32),
+        jnp.zeros((B, H, Sq), jnp.float32),
+        jnp.zeros((B, H, Sq, Dh), jnp.float32),
+    )
+    offs = k_off + block_k * jnp.arange(n_blocks, dtype=jnp.int32)
+    (m, l, acc), _ = lax.scan(
+        step, init,
+        ((jnp.moveaxis(kb, 1, 0), jnp.moveaxis(vb, 1, 0)), offs),
+    )
+    return m, l, acc
+
+
 def ring_attention_local(q, k, v, *, axis: str, causal: bool = False):
     """Per-shard ring attention; must run under ``shard_map`` with the
     sequence dim of q/k/v sharded over mesh axis ``axis``.
@@ -83,12 +147,7 @@ def ring_attention_local(q, k, v, *, axis: str, causal: bool = False):
         cm, cl, cacc = _chunk_stats(
             q, k_cur, v_cur, idx * S_loc, src * S_loc, causal
         )
-        m_new = jnp.maximum(m, cm)
-        a_old = jnp.exp(m - m_new)
-        a_new = jnp.exp(cm - m_new)
-        l = l * a_old + cl * a_new
-        acc = acc * a_old[..., None] + cacc * a_new[..., None]
-        return m_new, l, acc
+        return _merge_stats(m, l, acc, cm, cl, cacc)
 
     def step(t, carry):
         m, l, acc, k_cur, v_cur = carry
