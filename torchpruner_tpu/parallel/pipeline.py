@@ -15,11 +15,30 @@ stage's device, the backward walks stages in reverse (activation gradients
 hop device-to-device like activations did), and parameter gradients
 accumulate across microbatches — on-device, in the stage's own memory.
 
+``train_step`` issues work in **1F1B order** (PipeDream-flush: Narayanan et
+al., 2019): each stage runs ``n_stages − 1 − s`` warm-up forwards, then
+alternates one forward with one backward, then drains.  Because each JAX
+device executes its enqueued computations in issue order, the per-stage
+issue sequence *is* the schedule — no explicit scheduler thread.  Compared
+to plain GPipe (all forwards, then all backwards) this bounds the number of
+live activation residuals per stage at ``n_stages − s`` instead of
+``n_microbatches``, which is what lets microbatch counts scale without
+activation memory scaling with them.  The microbatch loss is accumulated
+into a single on-device scalar on the last stage and fetched **once** per
+step — there are no per-microbatch host syncs to serialize the schedule.
+
+Mutable state (BatchNorm running stats) is threaded *through* the
+microbatch sequence at each stage — microbatch ``k+1``'s forward sees the
+state microbatch ``k`` produced — so a PP step updates running statistics
+from the full batch, matching sequential microbatch processing on one
+device (parameter updates still use pre-step params for every microbatch,
+as in GPipe).
+
 This is the honest JAX formulation of pipeline parallelism for one process
 with several local devices (a TPU host's chips).  Cross-host pipelining
 composes with the mesh layers (DP/FSDP/TP shard *within* a stage via
-``ShardedTrainer``); a fused 1F1B schedule inside one XLA program is the
-later optimization.
+``ShardedTrainer``); a fused schedule inside one XLA program is the later
+optimization.
 """
 
 from __future__ import annotations
@@ -147,6 +166,12 @@ class PipelineParallel:
     opt_state: Any = None
     n_microbatches: int = 4
     _fwd_fns: List[Any] = field(default_factory=list, repr=False)
+    _loss_grad_fn: Any = field(default=None, repr=False)
+    #: filled by ``train_step``: per-stage peak live vjp residuals and the
+    #: issued op sequence — deterministic evidence of the 1F1B schedule
+    #: (``max_live_residuals[s] <= n_stages - s``, vs ``n_microbatches``
+    #: under GPipe) without relying on wall-clock timing.
+    last_step_stats: Optional[Dict[str, Any]] = field(default=None, repr=False)
 
     @classmethod
     def create(
@@ -213,6 +238,16 @@ class PipelineParallel:
             self._fwd_fns.append(
                 jax.jit(fn, static_argnames=("train",))
             )
+        if self.loss_fn is not None:
+            loss_fn = self.loss_fn
+
+            def loss_and_grad(z, yb):
+                def f(z_):
+                    return jnp.mean(loss_fn(z_, yb))
+
+                return jax.value_and_grad(f)(z)
+
+            self._loss_grad_fn = jax.jit(loss_and_grad)
 
     # -- inference ----------------------------------------------------------
 
@@ -232,63 +267,95 @@ class PipelineParallel:
     # -- training -----------------------------------------------------------
 
     def train_step(self, x, y) -> float:
-        """GPipe step: all microbatch forwards (saving per-stage vjps), then
-        the backward chain in reverse, gradients accumulated per stage
-        on-device; one optimizer update per stage."""
+        """One 1F1B pipeline step.
+
+        Issues per-stage forwards/backwards in PipeDream-flush order (see
+        module docstring), accumulating per-stage parameter gradients and
+        the scalar loss on-device; one optimizer update per stage and ONE
+        device→host fetch (the loss) at the very end.
+        """
         if self.tx is None or self.loss_fn is None:
             raise ValueError("train_step needs tx= and loss_fn= at create()")
-        n_stage = len(self.spans)
-        grads = [None] * n_stage
-        new_states = list(self.stage_state)
-        total_loss = 0.0
-        mbs_x = _microbatches(x, self.n_microbatches)
-        mbs_y = _microbatches(y, self.n_microbatches)
+        S = len(self.spans)
+        M = self.n_microbatches
+        mbs_x = _microbatches(x, M)
+        mbs_y = _microbatches(y, M)
+        sched = _1f1b_schedule(S, M)
 
-        # forward phase: per microbatch, chain vjps
-        saved = []  # per microbatch: list of vjp fns + final activation
-        for mb_x in mbs_x:
-            z = jax.device_put(jnp.asarray(mb_x), self.devices[0])
-            vjps = []
-            for i, (s, e) in enumerate(self.spans):
-                frm = None if s == 0 else self.model.layers[s - 1].name
-                to = self.model.layers[e - 1].name
-                st = self.stage_state[i]
-                model = self.model
+        grads: List[Any] = [None] * S
+        cur_state = list(self.stage_state)  # threaded through microbatches
+        vjps: Dict[Tuple[int, int], Any] = {}  # (stage, mb) -> residuals
+        outs: Dict[Tuple[int, int], Any] = {}  # (stage, mb) -> activation
+        pending_g: Dict[Tuple[int, int], Any] = {}  # (stage, mb) -> act grad
+        live = [0] * S
+        max_live = [0] * S
+        issued: List[List[Tuple[str, int]]] = [[] for _ in range(S)]
+        loss_acc = None  # device scalar on the last stage
 
-                def fwd(p, z_, _frm=frm, _to=to, _st=st):
-                    y_, ns = model.apply(
-                        p, z_, state=_st, train=True, from_layer=_frm,
-                        to_layer=_to,
+        ptr = [0] * S
+        issued_f = [set() for _ in range(S)]
+        while any(ptr[s] < len(sched[s]) for s in range(S)):
+            progress = False
+            for s in range(S):
+                if ptr[s] >= len(sched[s]):
+                    continue
+                op, k = sched[s][ptr[s]]
+                if op == "F":
+                    if s > 0 and k not in issued_f[s - 1]:
+                        continue  # upstream activation not issued yet
+                    if s == 0:
+                        z_in = jax.device_put(
+                            jnp.asarray(mbs_x[k]), self.devices[0]
+                        )
+                    else:
+                        z_in = jax.device_put(
+                            outs.pop((s - 1, k)), self.devices[s]
+                        )
+
+                    def f(p, z_, _fn=self._fwd_fns[s], _st=cur_state[s]):
+                        return _fn(p, _st, z_, True)
+
+                    z_out, vjp, ns = jax.vjp(
+                        f, self.stage_params[s], z_in, has_aux=True
                     )
-                    return y_, ns
-
-                (z, ns), vjp = _vjp_with_aux(fwd, self.stage_params[i], z)
-                new_states[i] = ns
-                vjps.append(vjp)
-                if i + 1 < n_stage:
-                    z = jax.device_put(z, self.devices[i + 1])
-            saved.append((vjps, z))
-
-        # backward phase (reverse microbatch order, GPipe)
-        for (vjps, z_out), mb_y in zip(reversed(saved), reversed(mbs_y)):
-            yb = jax.device_put(jnp.asarray(mb_y), self.devices[-1])
-
-            def loss_f(z_):
-                return jnp.mean(self.loss_fn(z_, yb))
-
-            lval, g = jax.value_and_grad(loss_f)(z_out)
-            total_loss += float(lval) / len(saved)
-            for i in range(n_stage - 1, -1, -1):
-                dp, g = vjps[i](g)
-                grads[i] = dp if grads[i] is None else jax.tree_util.tree_map(
-                    jnp.add, grads[i], dp
-                )
-                if i > 0:
-                    g = jax.device_put(g, self.devices[i - 1])
+                    cur_state[s] = ns
+                    vjps[(s, k)] = vjp
+                    outs[(s, k)] = z_out
+                    live[s] += 1
+                    max_live[s] = max(max_live[s], live[s])
+                    issued_f[s].add(k)
+                else:  # backward
+                    if k not in issued_f[s]:
+                        continue
+                    if s == S - 1:
+                        yb = jax.device_put(
+                            jnp.asarray(mbs_y[k]), self.devices[-1]
+                        )
+                        lval, g = self._loss_grad_fn(outs.pop((S - 1, k)), yb)
+                        loss_acc = lval if loss_acc is None else loss_acc + lval
+                    else:
+                        if (s, k) not in pending_g:
+                            continue  # downstream backward not issued yet
+                        g = jax.device_put(
+                            pending_g.pop((s, k)), self.devices[s]
+                        )
+                    dp, dz = vjps.pop((s, k))(g)
+                    live[s] -= 1
+                    grads[s] = (
+                        dp
+                        if grads[s] is None
+                        else jax.tree_util.tree_map(jnp.add, grads[s], dp)
+                    )
+                    if s > 0:
+                        pending_g[(s - 1, k)] = dz
+                issued[s].append((op, k))
+                ptr[s] += 1
+                progress = True
+            assert progress, "1F1B schedule deadlocked (bug)"
 
         # update per stage
-        inv = 1.0 / len(saved)
-        for i in range(n_stage):
+        inv = 1.0 / M
+        for i in range(S):
             gi = jax.tree_util.tree_map(lambda a: a * inv, grads[i])
             updates, self.opt_state[i] = self.tx.update(
                 gi, self.opt_state[i], self.stage_params[i]
@@ -296,8 +363,14 @@ class PipelineParallel:
             self.stage_params[i] = optax.apply_updates(
                 self.stage_params[i], updates
             )
-        self.stage_state = new_states
-        return total_loss
+        self.stage_state = cur_state
+        self.last_step_stats = {
+            "schedule": "1f1b",
+            "max_live_residuals": max_live,
+            "issued": issued,
+            "host_syncs": 1,
+        }
+        return float(loss_acc) * inv  # the single device->host fetch
 
     # -- utilities ----------------------------------------------------------
 
@@ -315,16 +388,30 @@ class PipelineParallel:
         return out
 
 
-def _vjp_with_aux(fwd, params, z):
-    """``jax.vjp`` of a ``(y, state)`` function w.r.t. (params, z), keeping
-    the state as untouched aux output and a vjp over ``y`` only."""
-    (y, ns), vjp = jax.vjp(fwd, params, z, has_aux=False)
+def _1f1b_schedule(
+    n_stages: int, n_microbatches: int
+) -> List[List[Tuple[str, int]]]:
+    """Per-stage op sequences for non-interleaved 1F1B (PipeDream-flush).
 
-    def vjp_y(g):
-        dp, dz = vjp((g, jax.tree_util.tree_map(jnp.zeros_like, ns)))
-        return dp, dz
-
-    return (y, ns), vjp_y
+    Stage ``s`` runs ``min(n_stages − 1 − s, M)`` warm-up forwards, then
+    alternates forward/backward until all ``M`` forwards are issued, then
+    drains the remaining backwards.  Every stage issues exactly ``M``
+    forwards and ``M`` backwards; at most ``n_stages − s`` forwards are
+    outstanding (un-backwarded) at stage ``s`` at any point.
+    """
+    per_stage: List[List[Tuple[str, int]]] = []
+    for s in range(n_stages):
+        warmup = min(n_stages - 1 - s, n_microbatches)
+        seq: List[Tuple[str, int]] = [("F", k) for k in range(warmup)]
+        f_next, b_next = warmup, 0
+        while b_next < n_microbatches:
+            if f_next < n_microbatches:
+                seq.append(("F", f_next))
+                f_next += 1
+            seq.append(("B", b_next))
+            b_next += 1
+        per_stage.append(seq)
+    return per_stage
 
 
 def _microbatches(x, n: int):
