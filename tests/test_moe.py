@@ -193,8 +193,129 @@ def test_producer_feeding_moe_or_untied_attention_is_pinned():
     assert y.shape == (2, 5)
 
 
+def sparse_moe_net(n_experts=4, top_k=2, capacity_factor=1.25):
+    return SegmentedModel(
+        layers=(
+            L.Embedding("emb", 32, 16),
+            L.MoE("moe", n_experts, 24, top_k=top_k, dispatch="sparse",
+                  capacity_factor=capacity_factor),
+            L.GlobalPool("pool", "seq_mean"),
+            L.Dense("head", 5),
+        ),
+        input_shape=(8,),
+        input_dtype="int32",
+    )
+
+
+def test_sparse_dispatch_matches_dense_when_nothing_dropped():
+    """With capacity_factor = E/top_k the capacity equals the token count,
+    nothing can be dropped, and the sparse gather/scatter formulation must
+    reproduce the dense one — outputs AND parameter gradients."""
+    E, K = 4, 2
+    dense = moe_net(E, K)
+    sparse = sparse_moe_net(E, K, capacity_factor=E / K)
+    params, state = init_model(dense, seed=0)
+    x = dense.example_input(3)
+    y_d, _ = dense.apply(params, x, state=state)
+    y_s, _ = sparse.apply(params, x, state=state)
+    np.testing.assert_allclose(
+        np.asarray(y_d), np.asarray(y_s), atol=1e-5
+    )
+
+    from torchpruner_tpu.utils.losses import cross_entropy_loss
+
+    yt = jnp.zeros((3,), jnp.int32)
+
+    def loss(model):
+        def f(p):
+            out, _ = model.apply(p, x, state=state)
+            return jnp.mean(cross_entropy_loss(out, yt))
+        return f
+
+    g_d = jax.grad(loss(dense))(params)
+    g_s = jax.grad(loss(sparse))(params)
+    for leaf_d, leaf_s in zip(
+        jax.tree_util.tree_leaves(g_d), jax.tree_util.tree_leaves(g_s)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(leaf_d), np.asarray(leaf_s), atol=1e-5
+        )
+
+
+def test_sparse_dispatch_cuts_flops_by_expert_ratio():
+    """cost_analysis FLOPs of the MoE block must drop roughly E/top_k x
+    (the dense formulation runs every expert on every token)."""
+    E, K = 8, 1
+    d, F, S = 64, 256, 32
+
+    def net(dispatch):
+        return SegmentedModel(
+            layers=(
+                L.MoE("moe", E, F, top_k=K, dispatch=dispatch,
+                      capacity_factor=1.0),
+            ),
+            input_shape=(S, d),
+        )
+
+    dense, sparse = net("dense"), net("sparse")
+    params, state = init_model(dense, seed=0)
+    x = dense.example_input(4)
+
+    def flops(model):
+        f = jax.jit(lambda p, x_: model.apply(p, x_, state=state)[0])
+        return f.lower(params, x).compile().cost_analysis()["flops"]
+
+    fd, fs = flops(dense), flops(sparse)
+    # sparse pays router+sort overhead; demand at least half the ideal 8x
+    assert fd / fs > (E / K) / 2, (fd, fs)
+
+
+def test_sparse_dispatch_drops_overflow_tokens():
+    """With a tiny capacity and a router forced to send every token to one
+    expert, over-capacity contributions are zero (GShard drop semantics) and
+    the output stays finite."""
+    model = sparse_moe_net(4, 1, capacity_factor=0.25)
+    params, state = init_model(model, seed=0)
+    # every token picks expert 0: its column dominates
+    params["moe"]["router"] = (
+        jnp.zeros_like(params["moe"]["router"]).at[:, 0].set(1e3)
+    )
+    params["emb"]["emb"] = jnp.abs(params["emb"]["emb"]) + 0.1
+    x = model.example_input(2)
+    y, _ = model.apply(params, x, state=state)
+    assert np.all(np.isfinite(np.asarray(y)))
+    # capacity C = ceil(16 tokens * 1/4 * 0.25) = 1 slot for expert 0; the
+    # dense-equivalent (no-drop) output must differ because 15 pairs shed
+    dense_equiv = moe_net(4, 1)
+    y_d, _ = dense_equiv.apply(params, x, state=state)
+    assert not np.allclose(np.asarray(y), np.asarray(y_d), atol=1e-6)
+
+
+def test_sparse_moe_trains_under_expert_parallel_sharding():
+    mesh = make_mesh({"data": 2, "model": 4})
+    model = llama_moe_tiny(dispatch="sparse", capacity_factor=2.0)
+    t = ShardedTrainer.create(
+        model, optax.adam(1e-3), lm_cross_entropy_loss, mesh,
+        seed=0, min_shard_size=0, partition="tp",
+    )
+    x = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0, 256), np.int32
+    )
+    l0 = float(t.step(x, x))
+    l1 = float(t.step(x, x))
+    assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0
+
+
+def test_moe_spec_validation():
+    with pytest.raises(ValueError):
+        L.MoE("m", 4, 8, dispatch="magic")
+    with pytest.raises(ValueError):
+        L.MoE("m", 4, 8, capacity_factor=0.0)
+
+
 def test_moe_checkpoint_roundtrip_spec():
     from torchpruner_tpu.checkpoint import spec_from_dict, spec_to_dict
 
-    for m in (llama_moe_tiny(),):
+    for m in (llama_moe_tiny(),
+              llama_moe_tiny(dispatch="sparse", capacity_factor=2.0)):
         assert spec_from_dict(spec_to_dict(m)) == m

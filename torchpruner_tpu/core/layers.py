@@ -285,24 +285,36 @@ class MoE:
     """Mixture-of-experts SwiGLU FFN on ``(B, S, d)`` (Mixtral-style).
 
     Router picks ``top_k`` of ``n_experts``; gates are the softmax over the
-    selected logits.  Compute is the *dense* formulation — every expert's
-    contribution weighted by its (mostly zero) gate — which is exactly what
-    makes it jittable, differentiable, and **expert-parallel by sharding**:
-    partition the expert axis of ``wg``/``wu``/``wo`` over a mesh axis and
-    each device computes only its experts' partial sums, XLA inserting the
-    reduction (capacity-based all-to-all dispatch is the later optimization
-    for large expert counts).
+    selected logits.  Two compute formulations, selected by ``dispatch``:
+
+    - ``"dense"``: every expert's contribution weighted by its (mostly
+      zero) gate — simple, exactly differentiable, expert-parallel by pure
+      sharding (partition the expert axis of ``wg``/``wu``/``wo`` over a
+      mesh axis; XLA inserts the reduction).  FLOPs are ``E/top_k`` times
+      the useful work.
+    - ``"sparse"``: capacity-based gather/scatter dispatch.  Token-expert
+      pairs are grouped by expert (stable argsort), gathered into per-
+      expert buffers of static capacity
+      ``C = ceil(tokens * top_k / E * capacity_factor)``, run through the
+      three expert matmuls at ``(E, C, ·)``, and scattered back weighted
+      by their gates — per-token FLOPs scale with ``top_k/E``, all shapes
+      static.  Pairs beyond an expert's capacity are dropped (contribution
+      zero), the standard GShard/Switch trade; ``capacity_factor >=
+      n_experts/top_k`` guarantees no drops (then C = tokens) and bit-
+      equivalence with the dense formulation.
 
     Prunable: the unit is the **expert** (``n_units = n_experts``); the unit
-    site is the gate tensor ``(B, S, E)``, so attribution metrics score
-    expert utility and pruning removes whole experts (router column +
-    expert weights)."""
+    site is the gate tensor ``(B, S, E)`` in both formulations, so
+    attribution metrics score expert utility and pruning removes whole
+    experts (router column + expert weights)."""
 
     name: str
     n_experts: int
     ffn_dim: int
     top_k: int = 2
     fn: str = "silu"
+    dispatch: str = "dense"
+    capacity_factor: float = 1.25
 
     def __post_init__(self):
         if self.fn not in ACTIVATION_FNS:
@@ -311,6 +323,10 @@ class MoE:
             raise ValueError(
                 f"top_k {self.top_k} out of range [1, {self.n_experts}]"
             )
+        if self.dispatch not in ("dense", "sparse"):
+            raise ValueError(f"unknown dispatch {self.dispatch!r}")
+        if self.capacity_factor <= 0:
+            raise ValueError("capacity_factor must be positive")
 
 
 @dataclass(frozen=True)
@@ -917,6 +933,8 @@ def apply_layer(
         gates = jax.nn.softmax(logits, axis=-1)  # (B, S, E)
         if taps is not None and not taps.empty():
             gates = taps.at_site(path, gates)  # expert unit site
+        if spec.dispatch == "sparse" and spec.top_k < E:
+            return _moe_sparse(spec, params, x, gates), state
         g = jnp.einsum("bsd,edf->bsef", x, params["wg"])
         u = jnp.einsum("bsd,edf->bsef", x, params["wu"])
         h = ACTIVATION_FNS[spec.fn](g) * u  # (B, S, E, F)
@@ -948,6 +966,54 @@ def apply_layer(
         return y + sc, new_state
 
     raise TypeError(f"unknown layer spec {type(spec)}")
+
+
+def _moe_sparse(spec: MoE, params, x, gates):
+    """Capacity-based sparse expert dispatch (see :class:`MoE`).
+
+    Shapes are fully static: ``P = tokens * top_k`` token-expert pairs are
+    stable-sorted by expert, each pair's slot within its expert computed
+    from an exclusive prefix sum of expert loads, pairs beyond the static
+    capacity ``C`` routed to a shed slot that is sliced off.  The expert
+    matmuls run at ``(E, C, ·)`` — per-token FLOPs scale with ``top_k/E``
+    instead of the dense formulation's every-expert-every-token.  The
+    gather/scatter is differentiable (scatter-add transposes to gather), so
+    gradients match the dense path exactly whenever nothing is dropped.
+    """
+    B, S, d = x.shape
+    E, K = spec.n_experts, spec.top_k
+    N = B * S
+    xf = x.reshape(N, d)
+    gf = gates.reshape(N, E)
+    # the K nonzero gates per token (the softmax zeroed the rest); top_k on
+    # gate values reproduces the routing choice made on logits above
+    top_g, top_e = lax.top_k(gf, K)  # (N, K)
+    P = N * K
+    e_flat = top_e.reshape(P)
+    g_flat = top_g.reshape(P)
+    t_flat = jnp.repeat(jnp.arange(N), K)
+    C = min(N, int(math.ceil(N * K / E * spec.capacity_factor)))
+
+    order = jnp.argsort(e_flat, stable=True)  # group pairs by expert
+    e_s, g_s, t_s = e_flat[order], g_flat[order], t_flat[order]
+    counts = jnp.bincount(e_flat, length=E)
+    starts = jnp.cumsum(counts) - counts  # exclusive prefix: group offsets
+    pos = jnp.arange(P) - starts[e_s]  # slot within the expert's buffer
+    keep = pos < C
+    slot = jnp.where(keep, pos, C)  # shed slot C is sliced off below
+
+    buf = (
+        jnp.zeros((E, C + 1, d), xf.dtype).at[e_s, slot].set(xf[t_s])[:, :C]
+    )
+    g = jnp.einsum("ecd,edf->ecf", buf, params["wg"])
+    u = jnp.einsum("ecd,edf->ecf", buf, params["wu"])
+    h = ACTIVATION_FNS[spec.fn](g) * u  # (E, C, F)
+    out = jnp.einsum("ecf,efd->ecd", h, params["wo"])
+    contrib = out[e_s, jnp.minimum(slot, C - 1)] * jnp.where(
+        keep, g_s, 0.0
+    )[:, None]
+    y = jnp.zeros((N, d), out.dtype).at[t_s].add(contrib)
+    return y.reshape(B, S, d)
 
 
 # ---------------------------------------------------------------------------

@@ -70,6 +70,8 @@ def llama_moe(
     top_k: int = 2,
     rope_theta: float = 1e6,
     seq_len: int = 2048,
+    dispatch: str = "dense",
+    capacity_factor: float = 1.25,
 ) -> SegmentedModel:
     """Mixtral-style sparse-MoE decoder: the dense FFN replaced by a
     top-k-routed expert mixture.  The expert axis is the prunable unit
@@ -88,7 +90,8 @@ def llama_moe(
             )),
             L.Residual(f"block{i}_moe", (
                 L.RMSNorm("norm"),
-                L.MoE("experts", n_experts, ffn_dim, top_k=top_k),
+                L.MoE("experts", n_experts, ffn_dim, top_k=top_k,
+                      dispatch=dispatch, capacity_factor=capacity_factor),
             )),
         ]
     layers += [
@@ -109,13 +112,16 @@ def llama_moe_tiny(
     n_experts: int = 4,
     top_k: int = 2,
     seq_len: int = 16,
+    dispatch: str = "dense",
+    capacity_factor: float = 1.25,
 ) -> SegmentedModel:
     """Miniature MoE decoder — tests / CPU smoke / multi-chip dryruns."""
     return llama_moe(
         vocab_size=vocab_size, dim=dim, depth=depth, num_heads=num_heads,
         num_kv_heads=num_kv_heads, head_dim=dim // num_heads,
         ffn_dim=ffn_dim, n_experts=n_experts, top_k=top_k,
-        rope_theta=10000.0, seq_len=seq_len,
+        rope_theta=10000.0, seq_len=seq_len, dispatch=dispatch,
+        capacity_factor=capacity_factor,
     )
 
 

@@ -38,6 +38,7 @@ _NEG_INF = -1e30
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 MIN_BLOCK = 8  # below this the kernel degrades to tiny-tile scalar work
+_LANE = 128  # TPU lane width: minor dim of the LSE/delta row layout
 
 
 def _xla_attention(q, k, v, *, causal: bool):
@@ -108,12 +109,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     acc0 = jnp.zeros((block_q, dh), jnp.float32)
     m, l, acc = lax.fori_loop(0, n_run, body, (m0, l0, acc0))
     o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
+    # LSE row broadcast across the 128-lane minor dim: TPU block shapes
+    # need the last two dims tileable to (sublane, lane), so a bare
+    # (1, 1, block_q) block is not lowerable — same layout the reference
+    # TPU kernel uses for its l/m outputs.
+    lse_ref[0, 0] = jnp.broadcast_to(m + jnp.log(l), (block_q, _LANE))
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    """(B, H, S, Dh) layout in; returns (out, lse) with lse (B, H, S) f32."""
+    """(B, H, S, Dh) layout in; returns (out, lse) with lse (B, H, S, 128)
+    f32 (the per-query LSE broadcast across the minor lane dim)."""
     B, H, S, Dh = q.shape
     scale = 1.0 / math.sqrt(Dh)
     grid = (B, H, S // block_q)
@@ -131,11 +137,13 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, Dh), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i)),
+            pl.BlockSpec(
+                (1, 1, block_q, _LANE), lambda b, h, i: (b, h, i, 0)
+            ),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, S, Dh), q.dtype),
-            jax.ShapeDtypeStruct((B, H, S), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, S, _LANE), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -153,8 +161,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)       # (block_q, Dh)
     do = do_ref[0, 0].astype(jnp.float32)     # (block_q, Dh)
-    lse = lse_ref[0, 0]                       # (block_q,)
-    delta = delta_ref[0, 0]                   # (block_q,)
+    lse = lse_ref[0, 0, :, 0:1]               # (block_q, 1)
+    delta = delta_ref[0, 0, :, 0:1]           # (block_q, 1)
     dh = q.shape[-1]
     S = k_ref.shape[2]
     n_kv = S // block_k
@@ -179,12 +187,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(qpos >= kpos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])                 # masked rows -> 0
+        p = jnp.exp(s - lse)                          # masked rows -> 0
         dp = lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         return dq + lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -213,8 +221,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, 0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, 0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.dslice(i * block_q, block_q)]
-        delta = delta_ref[0, 0, pl.dslice(i * block_q, block_q)]
+        lse = lse_ref[0, 0, pl.dslice(i * block_q, block_q), 0:1]  # (bq, 1)
+        delta = delta_ref[0, 0, pl.dslice(i * block_q, block_q), 0:1]
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -227,7 +235,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(qpos >= kpos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dv = dv + lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -236,7 +244,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         dk = dk + lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -254,12 +262,20 @@ def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
     """(B, H, S, Dh) layout; returns (dq, dk, dv)."""
     B, H, S, Dh = q.shape
     scale = 1.0 / math.sqrt(Dh)
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    # LSE arrives as the single-lane residual; restore the lane layout
+    lse = jnp.broadcast_to(lse, (B, H, S, _LANE))
+    # delta rows live in the same broadcast-across-lanes layout as LSE
+    delta = jnp.broadcast_to(
+        jnp.sum(
+            do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+        )[..., None],
+        (B, H, S, _LANE),
+    )
 
     seq_spec = pl.BlockSpec((1, 1, S, Dh), lambda b, h, i: (b, h, 0, 0))
-    row_full = pl.BlockSpec((1, 1, S), lambda b, h, i: (b, h, 0))
+    row_full = pl.BlockSpec((1, 1, S, _LANE), lambda b, h, i: (b, h, 0, 0))
     qblk = pl.BlockSpec((1, 1, block_q, Dh), lambda b, h, i: (b, h, i, 0))
-    qrow = pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i))
+    qrow = pl.BlockSpec((1, 1, block_q, _LANE), lambda b, h, i: (b, h, i, 0))
     kblk = pl.BlockSpec((1, 1, block_k, Dh), lambda b, h, i: (b, h, i, 0))
 
     dq = pl.pallas_call(
@@ -332,8 +348,11 @@ def _flash_vjp_fwd(q, k, v, causal):
     out, lse = _flash_fwd(qt, kt, vt, causal, bq, bk, _interpret())
     out = jnp.moveaxis(out, 1, 2)
     # residual `out` is the SAME array that flows on as the activation, so
-    # autodiff keeps one copy, not an extra (B, H, S, Dh) transpose
-    return out, (q, k, v, out, lse)
+    # autodiff keeps one copy, not an extra (B, H, S, Dh) transpose.  The
+    # kernel emits LSE broadcast across 128 lanes (TPU layout); keep only
+    # one lane as the residual — the backward re-broadcasts — so the
+    # forward-to-backward HBM cost stays O(S), not O(S * 128).
+    return out, (q, k, v, out, lse[..., :1])
 
 
 def _flash_vjp_bwd(causal, res, g):

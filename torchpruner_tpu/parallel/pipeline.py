@@ -351,7 +351,8 @@ class PipelineParallel:
                 issued[s].append((op, k))
                 ptr[s] += 1
                 progress = True
-            assert progress, "1F1B schedule deadlocked (bug)"
+            if not progress:
+                raise RuntimeError("1F1B schedule deadlocked (bug)")
 
         # update per stage
         inv = 1.0 / M
