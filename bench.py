@@ -372,8 +372,16 @@ def orchestrate() -> dict:
             return result
         if result is not None:
             # headline leg failed but other legs may carry measurements —
-            # keep the richest partial result instead of discarding it
-            best_partial = result
+            # keep the attempt with the most successful legs (a later
+            # all-error CPU fallback must not clobber a TPU partial)
+            def n_ok(r):
+                return sum(
+                    1 for leg in r.get("legs", {}).values()
+                    if isinstance(leg, dict) and "error" not in leg
+                )
+
+            if best_partial is None or n_ok(result) > n_ok(best_partial):
+                best_partial = result
         attempts.append({
             "attempt": i + 1,
             "rc": rc,

@@ -270,6 +270,27 @@ def test_sparse_dispatch_cuts_flops_by_expert_ratio():
     assert fd / fs > (E / K) / 2, (fd, fs)
 
 
+def test_sparse_dispatch_ablation_matches_dense():
+    """Unit-mask ablation (the attribution instrumentation) must behave
+    identically in both formulations: routing comes from pre-tap gates, so
+    zeroing one expert's gate can't pollute other experts' capacity."""
+    from torchpruner_tpu.utils.losses import cross_entropy_loss
+
+    E, K = 4, 2
+    dense = moe_net(E, K)
+    sparse = sparse_moe_net(E, K, capacity_factor=E / K)
+    params, state = init_model(dense, seed=0)
+    x = dense.example_input(4)
+    data = [(x, jnp.zeros((4,), jnp.int32))]
+    sv_d = tp.ShapleyAttributionMetric(
+        dense, params, data, cross_entropy_loss, state=state, sv_samples=3
+    ).run("moe")
+    sv_s = tp.ShapleyAttributionMetric(
+        sparse, params, data, cross_entropy_loss, state=state, sv_samples=3
+    ).run("moe")
+    np.testing.assert_allclose(sv_d, sv_s, atol=1e-4)
+
+
 def test_sparse_dispatch_drops_overflow_tokens():
     """With a tiny capacity and a router forced to send every token to one
     expert, over-capacity contributions are zero (GShard drop semantics) and

@@ -930,11 +930,16 @@ def apply_layer(
             kth = jnp.sort(logits, axis=-1)[..., E - spec.top_k]
             neg = jnp.finfo(logits.dtype).min
             logits = jnp.where(logits >= kth[..., None], logits, neg)
-        gates = jax.nn.softmax(logits, axis=-1)  # (B, S, E)
+        routing = jax.nn.softmax(logits, axis=-1)  # (B, S, E)
+        gates = routing
         if taps is not None and not taps.empty():
             gates = taps.at_site(path, gates)  # expert unit site
         if spec.dispatch == "sparse" and spec.top_k < E:
-            return _moe_sparse(spec, params, x, gates), state
+            # routing decisions come from the PRE-tap gates: ablating an
+            # expert through the tap zeroes its contribution (dense
+            # semantics) without letting zero-gate filler pairs leak into
+            # other experts' capacity
+            return _moe_sparse(spec, params, x, routing, gates), state
         g = jnp.einsum("bsd,edf->bsef", x, params["wg"])
         u = jnp.einsum("bsd,edf->bsef", x, params["wu"])
         h = ACTIVATION_FNS[spec.fn](g) * u  # (B, S, E, F)
@@ -968,7 +973,7 @@ def apply_layer(
     raise TypeError(f"unknown layer spec {type(spec)}")
 
 
-def _moe_sparse(spec: MoE, params, x, gates):
+def _moe_sparse(spec: MoE, params, x, routing, gates):
     """Capacity-based sparse expert dispatch (see :class:`MoE`).
 
     Shapes are fully static: ``P = tokens * top_k`` token-expert pairs are
@@ -979,19 +984,24 @@ def _moe_sparse(spec: MoE, params, x, gates):
     instead of the dense formulation's every-expert-every-token.  The
     gather/scatter is differentiable (scatter-add transposes to gather), so
     gradients match the dense path exactly whenever nothing is dropped.
+
+    ``routing`` (pre-instrumentation gates) decides WHICH experts each
+    token visits; ``gates`` (possibly tapped/ablated by attribution
+    instrumentation) only WEIGHTS the contributions — so unit-mask
+    ablation behaves exactly as in the dense formulation.
     """
     B, S, d = x.shape
     E, K = spec.n_experts, spec.top_k
     N = B * S
     xf = x.reshape(N, d)
-    gf = gates.reshape(N, E)
-    # the K nonzero gates per token (the softmax zeroed the rest); top_k on
-    # gate values reproduces the routing choice made on logits above
-    top_g, top_e = lax.top_k(gf, K)  # (N, K)
+    rf = routing.reshape(N, E)
+    # the K nonzero routing gates per token (the softmax zeroed the rest);
+    # top_k on those values reproduces the routing choice made on logits
+    _, top_e = lax.top_k(rf, K)  # (N, K)
     P = N * K
     e_flat = top_e.reshape(P)
-    g_flat = top_g.reshape(P)
     t_flat = jnp.repeat(jnp.arange(N), K)
+    g_flat = gates.reshape(N, E)[t_flat, e_flat]  # tapped weights
     C = min(N, int(math.ceil(N * K / E * spec.capacity_factor)))
 
     order = jnp.argsort(e_flat, stable=True)  # group pairs by expert

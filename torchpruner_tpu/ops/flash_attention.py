@@ -61,11 +61,12 @@ def _xla_attention(q, k, v, *, causal: bool):
 # --------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None,
                 *, scale, causal, block_q, block_k):
     """One (batch, head, query-block) program: stream KV blocks with the
     online-softmax running state carried through ``fori_loop``; emit the
-    normalized output block and its LSE row."""
+    normalized output block and (when a backward will follow) its LSE
+    row.  Inference calls omit ``lse_ref`` — no wasted HBM writes."""
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)  # (block_q, Dh)
     dh = q.shape[-1]
@@ -109,17 +110,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     acc0 = jnp.zeros((block_q, dh), jnp.float32)
     m, l, acc = lax.fori_loop(0, n_run, body, (m0, l0, acc0))
     o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
-    # LSE row broadcast across the 128-lane minor dim: TPU block shapes
-    # need the last two dims tileable to (sublane, lane), so a bare
-    # (1, 1, block_q) block is not lowerable — same layout the reference
-    # TPU kernel uses for its l/m outputs.
-    lse_ref[0, 0] = jnp.broadcast_to(m + jnp.log(l), (block_q, _LANE))
+    if lse_ref is not None:
+        # LSE row broadcast across the 128-lane minor dim: TPU block shapes
+        # need the last two dims tileable to (sublane, lane), so a bare
+        # (1, 1, block_q) block is not lowerable — same layout the
+        # reference TPU kernel uses for its l/m outputs.
+        lse_ref[0, 0] = jnp.broadcast_to(m + jnp.log(l), (block_q, _LANE))
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "interpret", "with_lse"),
+)
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, with_lse=True):
     """(B, H, S, Dh) layout in; returns (out, lse) with lse (B, H, S, 128)
-    f32 (the per-query LSE broadcast across the minor lane dim)."""
+    f32 (the per-query LSE broadcast across the minor lane dim), or
+    (out, None) when ``with_lse=False`` (inference: skip the LSE writes)."""
     B, H, S, Dh = q.shape
     scale = 1.0 / math.sqrt(Dh)
     grid = (B, H, S // block_q)
@@ -127,7 +133,18 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
         _fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k,
     )
-    return pl.pallas_call(
+    out_specs = [
+        pl.BlockSpec((1, 1, block_q, Dh), lambda b, h, i: (b, h, i, 0)),
+    ]
+    out_shape = [jax.ShapeDtypeStruct((B, H, S, Dh), q.dtype)]
+    if with_lse:
+        out_specs.append(
+            pl.BlockSpec((1, 1, block_q, _LANE), lambda b, h, i: (b, h, i, 0))
+        )
+        out_shape.append(
+            jax.ShapeDtypeStruct((B, H, S, _LANE), jnp.float32)
+        )
+    res = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -135,18 +152,11 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, S, Dh), lambda b, h, i: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, S, Dh), lambda b, h, i: (b, h, 0, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, 1, block_q, Dh), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec(
-                (1, 1, block_q, _LANE), lambda b, h, i: (b, h, i, 0)
-            ),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B, H, S, Dh), q.dtype),
-            jax.ShapeDtypeStruct((B, H, S, _LANE), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )(q, k, v)
+    return (res[0], res[1]) if with_lse else (res[0], None)
 
 
 # --------------------------------------------------------------------------
@@ -334,8 +344,16 @@ def _interpret() -> bool:
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _flash_attention(q, k, v, causal):
-    out, _ = _flash_vjp_fwd(q, k, v, causal)
-    return out
+    # primal (inference) path: no backward will consume an LSE, so the
+    # kernel skips the (B, H, S, 128) LSE writes entirely
+    blocks = _pick_blocks(q.shape[1])
+    if blocks is None:
+        return _xla_attention(q, k, v, causal=causal)
+    bq, bk = blocks
+    qt, kt, vt = (jnp.moveaxis(t, 2, 1) for t in (q, k, v))
+    out, _ = _flash_fwd(qt, kt, vt, causal, bq, bk, _interpret(),
+                        with_lse=False)
+    return jnp.moveaxis(out, 1, 2)
 
 
 def _flash_vjp_fwd(q, k, v, causal):
