@@ -63,3 +63,27 @@ def test_checkpoint_roundtrip_after_prune(tmp_path):
     up, _ = tx.update(g2, o2, p2)
     p3 = optax.apply_updates(p2, up)
     assert jax.tree_util.tree_structure(p3) == jax.tree_util.tree_structure(p2)
+
+
+def test_checkpoint_refuses_cross_optimizer_restore(tmp_path):
+    """sgd(momentum) and rmsprop flatten to identical leaf counts AND
+    shapes (one per-param slot each) — only the recorded treedef tells
+    them apart.  Restoring under the wrong optimizer must raise, not
+    silently wire momentum buffers into rms accumulators."""
+    import pytest
+
+    from torchpruner_tpu.models.mlp import fc_net
+
+    model = fc_net(8, hidden=(8,), n_classes=3)
+    params, state = init_model(model, seed=0)
+    tx_save = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx_save.init(params)
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, model, params, state, opt_state)
+
+    # same optimizer: restores fine
+    _, _, _, o2, _ = restore_checkpoint(path, tx=optax.sgd(0.1, momentum=0.9))
+    assert o2 is not None
+
+    with pytest.raises(ValueError, match="structure mismatch"):
+        restore_checkpoint(path, tx=optax.rmsprop(0.1))

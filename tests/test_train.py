@@ -118,3 +118,71 @@ def test_dataset_batching():
     assert [len(b[0]) for b in bs] == [32, 32, 32, 4]
     bs2 = ds.batches(32, drop_remainder=True)
     assert [len(b[0]) for b in bs2] == [32, 32, 32]
+
+
+def test_mixed_precision_training_keeps_f32_master_state():
+    """bf16 compute: params/opt-state/BN stats stay f32, loss decreases,
+    and one step tracks the f32 step closely."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from torchpruner_tpu.core import layers as L
+    from torchpruner_tpu.core.segment import SegmentedModel, init_model
+    from torchpruner_tpu.train.loop import Trainer
+    from torchpruner_tpu.utils.losses import cross_entropy_loss
+
+    model = SegmentedModel(
+        (
+            L.Conv("conv1", 8, kernel_size=(3, 3), padding="SAME"),
+            L.BatchNorm("bn1"),
+            L.Activation("act1", "relu"),
+            L.Flatten("flatten"),
+            L.Dense("out", 5),
+        ),
+        (8, 8, 2),
+    )
+    params, state = init_model(model, seed=0)
+    x = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(1), (16, 8, 8, 2)), np.float32
+    )
+    y = np.asarray(np.arange(16) % 5, np.int32)
+    tx = optax.sgd(0.05, momentum=0.9)
+    def copy(tree):
+        # each trainer donates its buffers — they can't share arrays
+        return jax.tree_util.tree_map(lambda a: jnp.array(a), tree)
+
+    mp = Trainer.create(model, tx, cross_entropy_loss, params=copy(params),
+                        state=copy(state), compute_dtype=jnp.bfloat16)
+    fp = Trainer.create(model, tx, cross_entropy_loss, params=copy(params),
+                        state=copy(state))
+    losses_mp = [float(mp.step(x, y)) for _ in range(5)]
+    losses_fp = [float(fp.step(x, y)) for _ in range(5)]
+    assert losses_mp[-1] < losses_mp[0]
+    assert abs(losses_mp[0] - losses_fp[0]) < 0.05
+    for tree in (mp.params, mp.state, mp.opt_state):
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if jnp.issubdtype(jnp.result_type(leaf), jnp.floating):
+                assert jnp.result_type(leaf) == jnp.float32
+    # BN running stats track the f32 run closely — the EMA arithmetic is
+    # f32 (norm rules compute in f32), not bf16-rounded
+    np.testing.assert_allclose(
+        np.asarray(mp.state["bn1"]["mean"]),
+        np.asarray(fp.state["bn1"]["mean"]), atol=5e-3,
+    )
+    # a tiny EMA increment below bf16 resolution must not round away
+    from torchpruner_tpu.core import layers as L
+
+    spec = [l for l in model.layers if l.name == "bn1"][0]
+    st = {"mean": jnp.full((8,), 1.0), "var": jnp.ones((8,))}
+    # 1 + 2^-7 is exactly representable in bf16; the EMA increment
+    # (1-decay) * 2^-7 lands between bf16 steps around 1.0 and would
+    # round away under bf16 arithmetic
+    tiny = jnp.full((16, 8, 8, 8), 1.0 + 2.0**-7, jnp.bfloat16)
+    _, ns = L.apply_layer(
+        spec,
+        {k: v.astype(jnp.bfloat16) for k, v in mp.params["bn1"].items()},
+        st, tiny, train=True,
+    )
+    expected = 1.0 + (1.0 - spec.decay) * 2.0**-7
+    np.testing.assert_allclose(float(ns["mean"][0]), expected, rtol=1e-5)

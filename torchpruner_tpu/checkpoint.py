@@ -99,6 +99,11 @@ def save_checkpoint(
         "prune_history": prune_history or [],
         "extra": extra or {},
     }
+    if opt_state is not None:
+        # the optax pytree structure (node types included) — restore
+        # refuses to rebuild under a *different* optimizer whose state
+        # happens to flatten to the same leaf count/shapes
+        meta["opt_treedef"] = str(jax.tree_util.tree_structure(opt_state))
     with open(os.path.join(path, "spec.json"), "w") as f:
         json.dump(meta, f, indent=2)
 
@@ -111,12 +116,16 @@ def save_checkpoint(
     ckptr.save(os.path.join(path, "arrays"), tree, force=True)
 
 
-def restore_checkpoint(path: str, tx=None):
+def restore_checkpoint(path: str, tx=None, *, check_opt_structure: bool = True):
     """Restore ``(model, params, state, opt_state, meta)``.
 
     ``opt_state`` needs ``tx`` to rebuild the optax pytree *structure* at the
     pruned shapes (orbax restores raw arrays; structure comes from
-    ``tx.init`` on the restored params).
+    ``tx.init`` on the restored params).  ``check_opt_structure`` compares
+    the recorded optimizer treedef against ``tx``'s and refuses a mismatch
+    (two optimizers can flatten to identical leaf layouts); pass ``False``
+    only when a jax/optax upgrade changed the treedef *repr* of the SAME
+    optimizer and the leaf-count/shape checks are trusted instead.
     """
     import orbax.checkpoint as ocp
 
@@ -133,6 +142,20 @@ def restore_checkpoint(path: str, tx=None):
         template = jax.eval_shape(tx.init, params)
         flat_template, treedef = jax.tree_util.tree_flatten(template)
         flat_restored = jax.tree_util.tree_leaves(restored["opt_state"])
+        saved_treedef = meta.get("opt_treedef")
+        if (
+            check_opt_structure
+            and saved_treedef is not None
+            and saved_treedef != str(treedef)
+        ):
+            raise ValueError(
+                "optimizer-state structure mismatch: the checkpoint was "
+                f"saved with {saved_treedef[:200]}... but tx.init gives "
+                f"{str(treedef)[:200]}... — restoring under a different "
+                "optimizer would silently cross-wire its slots (pass "
+                "check_opt_structure=False if this is the same optimizer "
+                "across a jax/optax upgrade)"
+            )
         if len(flat_template) != len(flat_restored):
             raise ValueError(
                 "optimizer-state layout mismatch: checkpoint has "

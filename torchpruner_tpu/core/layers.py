@@ -793,33 +793,51 @@ def apply_layer(
             y = y + params["b"]
         return y, state
 
+    # Norms compute in f32 regardless of the activation dtype and cast the
+    # result back — the canonical mixed-precision policy: a bf16 batch's
+    # statistics and the BN running-stat EMA would otherwise round small
+    # increments (|Δ| < 2^-8 of the running value) to zero, silently
+    # freezing the statistics over a long bf16 run.
     if isinstance(spec, BatchNorm):
+        xf = x.astype(jnp.float32)
         if train:
             axes = tuple(range(x.ndim - 1))
-            mean = jnp.mean(x, axes)
-            var = jnp.var(x, axes)
+            mean = jnp.mean(xf, axes)
+            var = jnp.var(xf, axes)
             new_state = {
-                "mean": spec.decay * state["mean"] + (1 - spec.decay) * mean,
-                "var": spec.decay * state["var"] + (1 - spec.decay) * var,
+                "mean": spec.decay * state["mean"].astype(jnp.float32)
+                + (1 - spec.decay) * mean,
+                "var": spec.decay * state["var"].astype(jnp.float32)
+                + (1 - spec.decay) * var,
             }
         else:
-            mean, var = state["mean"], state["var"]
+            mean = state["mean"].astype(jnp.float32)
+            var = state["var"].astype(jnp.float32)
             new_state = state
         inv = lax.rsqrt(var + spec.eps)
-        y = (x - mean) * inv * params["scale"] + params["bias"]
-        return y, new_state
+        y = (xf - mean) * inv * params["scale"].astype(jnp.float32) + params[
+            "bias"
+        ].astype(jnp.float32)
+        return y.astype(x.dtype), new_state
 
     if isinstance(spec, LayerNorm):
-        mean = jnp.mean(x, axis=-1, keepdims=True)
-        var = jnp.var(x, axis=-1, keepdims=True)
-        y = (x - mean) * lax.rsqrt(var + spec.eps) * params["scale"]
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mean) * lax.rsqrt(var + spec.eps) * params[
+            "scale"
+        ].astype(jnp.float32)
         if "bias" in params:
-            y = y + params["bias"]
-        return y, state
+            y = y + params["bias"].astype(jnp.float32)
+        return y.astype(x.dtype), state
 
     if isinstance(spec, RMSNorm):
-        ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
-        return x * lax.rsqrt(ms + spec.eps) * params["scale"], state
+        xf = x.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        y = xf * lax.rsqrt(ms + spec.eps) * params["scale"].astype(
+            jnp.float32
+        )
+        return y.astype(x.dtype), state
 
     if isinstance(spec, Activation):
         return ACTIVATION_FNS[spec.fn](x), state

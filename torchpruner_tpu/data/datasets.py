@@ -20,7 +20,15 @@ DATASET_SHAPES = {
     "imagenet": ((224, 224, 3), 1000),
     "imagenet64": ((64, 64, 3), 1000),
     "tiny_images16": ((16, 16, 3), 10),
+    # scikit-learn's bundled handwritten-digits set (1,797 REAL 8x8 scans,
+    # no download): the in-CI real-data vehicle for the reference's
+    # untrained-net-pruning and method-ranking experiments
+    "digits": ((8, 8, 1), 10),
+    "digits_flat": ((64,), 10),
 }
+
+#: fixed deterministic split of the 1,797 digits examples
+_DIGITS_SPLIT = {"train": (0, 1297), "val": (1297, 1497), "test": (1497, 1797)}
 
 #: (seq_len, vocab_size, n_classes) — token datasets; ``n_classes=None``
 #: marks language-modeling data (targets = inputs, next-token loss).
@@ -146,6 +154,26 @@ def synthetic_token_dataset(
     return Dataset(x, x, name)
 
 
+def _load_digits(name: str, split: str) -> Optional[Dataset]:
+    """The real scikit-learn digits data (bundled with sklearn, no
+    network).  Pixels scaled to [0, 1] (raw range 0..16); a fixed
+    permutation (seed 0) makes the train/val/test split deterministic."""
+    try:
+        from sklearn.datasets import load_digits as _sk_load
+    except ImportError:  # pragma: no cover - sklearn is in the base image
+        return None
+    raw = _sk_load()
+    x = (raw.data / 16.0).astype(np.float32)  # (1797, 64)
+    y = raw.target.astype(np.int32)
+    idx = np.random.default_rng(0).permutation(len(x))
+    lo, hi = _DIGITS_SPLIT.get(split, _DIGITS_SPLIT["val"])
+    sel = idx[lo:hi]
+    x = x[sel]
+    if name == "digits":
+        x = x.reshape(-1, 8, 8, 1)
+    return Dataset(x, y[sel], f"{name}:{split}")
+
+
 def load_dataset(
     name: str, split: str = "train", n: Optional[int] = None, seed: int = 0
 ) -> Dataset:
@@ -175,6 +203,8 @@ def load_dataset(
         )
     shape, n_classes = DATASET_SHAPES[name]
     ds = _load_from_disk(name, split, dtype=np.float32)
+    if ds is None and name in ("digits", "digits_flat"):
+        ds = _load_digits(name, split)
     if ds is None:
         defaults = {"train": 50000, "val": 1000, "test": 10000}
         count = n or defaults.get(split, 1000)

@@ -29,6 +29,7 @@ from torchpruner_tpu.models import (
     bert_base,
     bert_tiny,
     cifar10_fc,
+    digits_fc,
     fmnist_convnet,
     llama3_8b,
     llama_tiny,
@@ -65,6 +66,7 @@ METRIC_REGISTRY = {
 MODEL_REGISTRY = {
     "mnist_fc": (mnist_fc, "mnist_flat"),
     "cifar10_fc": (cifar10_fc, "cifar10_flat"),
+    "digits_fc": (digits_fc, "digits_flat"),
     "fmnist_convnet": (fmnist_convnet, "fashion_mnist"),
     "vgg16_bn": (vgg16_bn, "cifar10"),
     "vgg16_bn_tiny": (
@@ -209,7 +211,13 @@ def run_prune_retrain(
         total_epochs=cfg.finetune_epochs * max(1, len(targets)),
     )
     loss_fn = LOSS_REGISTRY[cfg.loss]
-    trainer = Trainer.create(model, tx, loss_fn, seed=cfg.seed)
+    import jax.numpy as jnp
+
+    trainer = Trainer.create(
+        model, tx, loss_fn, seed=cfg.seed,
+        compute_dtype=jnp.bfloat16 if cfg.compute_dtype == "bfloat16"
+        else None,
+    )
     logger = CSVLogger(cfg.log_path, experiment=cfg.name)
     history: List[PruneStepRecord] = []
 

@@ -120,10 +120,14 @@ def layerwise_robustness(
     """The full sweep: every prunable layer × every method (×
     ``runs_stochastic`` repeats for stochastic methods).
 
-    ``methods`` maps display names to zero-arg metric factories (so each run
-    can draw fresh randomness).  Returns
+    ``methods`` maps display names to metric factories taking an optional
+    run index (``factory(run)``), so stochastic repeats draw DIFFERENT
+    randomness — seed the metric with ``base_seed + run`` (zero-arg
+    factories are accepted but make the repeats identical).  Returns
     ``results[layer][method] = [ {scores, loss, acc, auc, seconds}, ... ]``.
     """
+    import inspect
+
     if layers is None:
         layers = [g.target for g in pruning_graph(model)]
     results: Dict[str, Dict[str, List[Dict]]] = {}
@@ -135,10 +139,11 @@ def layerwise_robustness(
                 if any(s in name.lower() for s in stochastic)
                 else 1
             )
+            takes_run = bool(inspect.signature(factory).parameters)
             runs = []
-            for _ in range(n_runs):
+            for run_idx in range(n_runs):
                 t0 = time.perf_counter()
-                metric = factory()
+                metric = factory(run_idx) if takes_run else factory()
                 scores = metric.run(
                     layer,
                     find_best_evaluation_layer=find_best_evaluation_layer_,
@@ -190,12 +195,19 @@ def auc_summary(results) -> Dict[str, float]:
 
 
 def run_robustness_config(cfg, *, model=None, datasets=None,
+                          params=None, state=None,
                           verbose: bool = True) -> Dict[str, float]:
     """Config-driven sweep entry (the CLI's robustness path).
 
     ``cfg.method == "all"`` runs the reference's full method panel
     (6 metrics + signed Taylor + SV mean+2std — VGG notebook cell 8);
     otherwise just the configured method.  Returns the AUC summary.
+
+    The reference sweep runs on a *pretrained* VGG16 (notebook cells 3-4);
+    pass trained ``params``/``state``, or set ``cfg.checkpoint_path`` to a
+    training checkpoint to restore it — a fresh init (the fallback) only
+    makes sense for smoke runs, since method rankings on random weights
+    are not the reference's experiment.
     """
     from torchpruner_tpu.core.segment import init_model
     from torchpruner_tpu.experiments.prune_retrain import (
@@ -208,15 +220,29 @@ def run_robustness_config(cfg, *, model=None, datasets=None,
     model, (_, _, test) = resolve_model_and_data(cfg, model, datasets)
     if len(test) > cfg.score_examples:
         test = test.subset(cfg.score_examples, seed=cfg.seed)
-    params, state = init_model(model, seed=cfg.seed)
+    if params is None and cfg.checkpoint_path:
+        import os
+
+        from torchpruner_tpu.checkpoint import restore_checkpoint
+
+        if not os.path.exists(cfg.checkpoint_path):
+            raise FileNotFoundError(
+                f"cfg.checkpoint_path {cfg.checkpoint_path!r} does not "
+                "exist — refusing to silently run the sweep on random "
+                "weights (clear the field for an explicit fresh-init "
+                "smoke run)"
+            )
+        model, params, state, _, _ = restore_checkpoint(cfg.checkpoint_path)
+    if params is None:
+        params, state = init_model(model, seed=cfg.seed)
     loss_fn = LOSS_REGISTRY[cfg.loss]
     test_batches = test.batches(cfg.eval_batch_size)
 
     def factory(method, reduction="mean", **kw):
-        def make():
+        def make(run=0):
             return build_metric(
                 method, model, params, test_batches, loss_fn, state=state,
-                reduction=reduction, seed=cfg.seed, **kw,
+                reduction=reduction, seed=cfg.seed + run, **kw,
             )
         return make
 

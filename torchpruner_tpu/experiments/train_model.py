@@ -97,10 +97,13 @@ def run_train(
         resolve_model_and_data,
     )
 
+    import jax.numpy as jnp
+
     model, (train, _val, test) = resolve_model_and_data(cfg, model, datasets)
     steps_per_epoch = max(1, len(train) // cfg.batch_size)
     tx = make_optimizer(cfg, steps_per_epoch=steps_per_epoch)
     loss_fn = LOSS_REGISTRY[cfg.loss]
+    cdtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else None
 
     start_epoch = 0
     if cfg.checkpoint_path and os.path.exists(cfg.checkpoint_path):
@@ -108,7 +111,8 @@ def run_train(
             cfg.checkpoint_path, tx=tx
         )
         trainer = Trainer.create(model, tx, loss_fn, seed=cfg.seed,
-                                 params=params, state=state)
+                                 params=params, state=state,
+                                 compute_dtype=cdtype)
         if opt_state is not None:
             trainer.opt_state = opt_state
         start_epoch = int(meta.get("extra", {}).get("epoch", 0))
@@ -116,7 +120,8 @@ def run_train(
             print(f"[{cfg.name}] resumed from {cfg.checkpoint_path} "
                   f"at epoch {start_epoch}", flush=True)
     else:
-        trainer = Trainer.create(model, tx, loss_fn, seed=cfg.seed)
+        trainer = Trainer.create(model, tx, loss_fn, seed=cfg.seed,
+                                 compute_dtype=cdtype)
 
     logger = CSVLogger(cfg.log_path, experiment=cfg.name)
     test_batches = test.batches(cfg.eval_batch_size)

@@ -37,3 +37,11 @@ def cifar10_fc() -> SegmentedModel:
     """Same architecture for flattened 32×32×3 CIFAR-10 input (reference
     experiments/models/cifar10.py:10-36)."""
     return fc_net(32 * 32 * 3)
+
+
+def digits_fc() -> SegmentedModel:
+    """The reference MNIST-FC architecture scaled to the 8×8 sklearn digits
+    (the always-available REAL dataset): 64-512-512-10 LeakyReLU.  Same
+    depth/activation/overparameterization regime as reference
+    experiments/models/mnist.py:14-23, ~8× input downscale."""
+    return fc_net(64, hidden=(512, 512))

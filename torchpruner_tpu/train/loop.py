@@ -26,15 +26,41 @@ from torchpruner_tpu.core.segment import SegmentedModel
 from torchpruner_tpu.utils.losses import accuracy
 
 
-def make_train_step(model: SegmentedModel, tx, loss_fn, donate: bool = True):
+def _cast_floats(tree, dtype):
+    """Cast every floating leaf to ``dtype`` (ints/bools pass through)."""
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(dtype)
+        if jnp.issubdtype(jnp.result_type(a), jnp.floating)
+        else a,
+        tree,
+    )
+
+
+def make_train_step(model: SegmentedModel, tx, loss_fn, donate: bool = True,
+                    compute_dtype=None):
     """(params, state, opt_state, x, y, rng) -> (params, state, opt_state,
-    loss).  Donation reuses the input buffers for the outputs."""
+    loss).  Donation reuses the input buffers for the outputs.
+
+    ``compute_dtype`` (e.g. ``jnp.bfloat16``) enables mixed precision the
+    TPU-native way: master params, optimizer state, mutable state (the
+    norm apply rules compute statistics in f32 and cast back — see
+    core/layers.py), loss and update math stay float32; the
+    forward/backward run with params and inputs cast to ``compute_dtype``
+    (MXU-rate matmuls), logits promoted back to f32 before the loss,
+    gradients arriving in f32 through the cast's transpose."""
 
     def step(params, state, opt_state, x, y, rng):
         def loss(p):
+            if compute_dtype is not None:
+                p = _cast_floats(p, compute_dtype)
+                xin = _cast_floats(x, compute_dtype)
+            else:
+                xin = x
             out, new_state = model.apply(
-                p, x, state=state, train=True, rng=rng
+                p, xin, state=state, train=True, rng=rng
             )
+            if compute_dtype is not None:
+                out = out.astype(jnp.float32)
             return jnp.mean(loss_fn(out, y)), new_state
 
         (l, new_state), grads = jax.value_and_grad(loss, has_aux=True)(params)
@@ -111,11 +137,14 @@ class Trainer:
     opt_state: Any
     loss_fn: Callable
     rng: Any
+    #: None = full f32; jnp.bfloat16 = mixed precision (see make_train_step)
+    compute_dtype: Any = None
     _step_fn: Any = field(default=None, repr=False)
     step_count: int = 0
 
     @classmethod
-    def create(cls, model, tx, loss_fn, seed: int = 0, params=None, state=None):
+    def create(cls, model, tx, loss_fn, seed: int = 0, params=None,
+               state=None, compute_dtype=None):
         key = jax.random.PRNGKey(seed)
         if params is None:
             params, state = model.init(key)
@@ -127,11 +156,15 @@ class Trainer:
             opt_state=tx.init(params),
             loss_fn=loss_fn,
             rng=key,
+            compute_dtype=compute_dtype,
         )
 
     def step(self, x, y) -> float:
         if self._step_fn is None:
-            self._step_fn = make_train_step(self.model, self.tx, self.loss_fn)
+            self._step_fn = make_train_step(
+                self.model, self.tx, self.loss_fn,
+                compute_dtype=self.compute_dtype,
+            )
         self.rng, sub = jax.random.split(self.rng)
         self.params, self.state, self.opt_state, l = self._step_fn(
             self.params, self.state, self.opt_state, x, y, sub
@@ -148,6 +181,7 @@ class Trainer:
             opt_state=opt_state,
             loss_fn=self.loss_fn,
             rng=self.rng,
+            compute_dtype=self.compute_dtype,
             step_count=self.step_count,
         )
 

@@ -55,6 +55,11 @@ class ExperimentConfig:
     # distribution
     mesh: Dict[str, int] = field(default_factory=dict)  # e.g. {"data": 4, "model": 2}
 
+    #: float32 | bfloat16 — bf16 runs the fwd/bwd at MXU rate with f32
+    #: master params/updates (mixed precision, the TPU-native default for
+    #: large models; see train.loop.make_train_step)
+    compute_dtype: str = "float32"
+
     # data pipeline / checkpointing
     augment: bool = False            # flip + pad/crop image augmentation
     prefetch: bool = True            # native background batch assembly
@@ -76,6 +81,11 @@ class ExperimentConfig:
             raise ValueError(
                 f"unknown lr_schedule {self.lr_schedule!r} (use 'constant', "
                 "'multistep', 'cosine' or 'warmup_cosine')"
+            )
+        if self.compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"unknown compute_dtype {self.compute_dtype!r} "
+                "(use 'float32' or 'bfloat16')"
             )
 
     def to_json(self, path: str):
