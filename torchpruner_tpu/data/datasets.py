@@ -162,11 +162,16 @@ def _load_digits(name: str, split: str) -> Optional[Dataset]:
         from sklearn.datasets import load_digits as _sk_load
     except ImportError:  # pragma: no cover - sklearn is in the base image
         return None
+    if split not in _DIGITS_SPLIT:
+        raise KeyError(
+            f"unknown digits split {split!r} (use one of "
+            f"{sorted(_DIGITS_SPLIT)})"
+        )
     raw = _sk_load()
     x = (raw.data / 16.0).astype(np.float32)  # (1797, 64)
     y = raw.target.astype(np.int32)
     idx = np.random.default_rng(0).permutation(len(x))
-    lo, hi = _DIGITS_SPLIT.get(split, _DIGITS_SPLIT["val"])
+    lo, hi = _DIGITS_SPLIT[split]
     sel = idx[lo:hi]
     x = x[sel]
     if name == "digits":

@@ -232,11 +232,15 @@ def write_parity_report(
         "| run | acc before | acc after | params before | params after "
         "| prune wall-clock |",
         "|---|---|---|---|---|---|",
-        "| reference MNIST-FC (GPU) | 7.16% | 50.94% | 5,707,690 | "
-        "2,421,737 | 28 s |",
-        "| reference CIFAR10-FC (GPU) | 10.99% | 19.89% | 10,338,602 | "
-        "5,079,077 | 33.5 s |",
     ]
+    for key, label in (("untrained_mnist", "reference MNIST-FC (GPU)"),
+                       ("untrained_cifar10", "reference CIFAR10-FC (GPU)")):
+        r = REFERENCE_NUMBERS[key]
+        lines.append(
+            f"| {label} | {r['acc_before']:.2%} | {r['acc_after']:.2%} | "
+            f"{r['params_before']:,} | {r['params_after']:,} | "
+            f"{r['prune_seconds']} s |"
+        )
     for name, r in (untrained or {}).items():
         lines.append(
             f"| ours {name} | {r['acc_before']:.2%} | "
@@ -251,10 +255,11 @@ def write_parity_report(
         "",
         "## 2. Method-ranking AUC on a trained model",
         "",
-        "Reference (pretrained 92.5% VGG16, 15 layers): "
-        "SV mean+2std 0.31 < SV 0.35 < Taylor 0.47 = Sensitivity 0.47 = "
-        "WeightNorm 0.47 < Random 0.48 < APoZ 0.56 < Taylor-signed 0.64 "
-        "(lower = better ranking).",
+        f"Reference (pretrained "
+        f"{REFERENCE_NUMBERS['vgg16_test_acc']:.1%} VGG16, 15 layers), "
+        "AUC order best→worst (lower = better ranking): "
+        + " < ".join(f"`{m}`" for m in REFERENCE_NUMBERS["auc_order"])
+        + " (0.31 / 0.35 / 0.47 / 0.47 / 0.47 / 0.48 / 0.56 / 0.64).",
         "",
     ]
     if robustness:
