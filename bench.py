@@ -107,9 +107,10 @@ def _leg_mnist(smoke: bool) -> dict:
     t0 = time.perf_counter()
     targets = [g.target for g in pruning_graph(model)][::-1]  # fc2 then fc1
     for target in targets:
+        # scoring forwards in bf16 (MXU rate); loss deltas accumulate f32
         metric = ShapleyAttributionMetric(
             model, params, batches, cross_entropy_loss, state=state,
-            sv_samples=5, seed=0,
+            sv_samples=5, seed=0, compute_dtype=jax.numpy.bfloat16,
         )
         scores = metric.run(target)
         res = prune_by_scores(model, params, target, scores,

@@ -184,3 +184,36 @@ def test_conv_metrics_smoke():
                                   state=state, sv_samples=2)
     attr = sv.run("conv1", find_best_evaluation_layer=True)
     assert attr.shape == (32,)
+
+
+def test_bf16_scoring_preserves_ranking():
+    """compute_dtype=bfloat16 runs the scoring forwards in bf16 (f32 loss
+    accumulation); rankings must track the f32 scores closely."""
+    import jax.numpy as jnp
+
+    from torchpruner_tpu.data import load_dataset
+    from torchpruner_tpu.models import digits_fc
+    from torchpruner_tpu.core.segment import init_model
+
+    model = digits_fc()
+    params, state = init_model(model, seed=0)
+    val = load_dataset("digits_flat", "val")
+    data = val.batches(100)
+
+    from torchpruner_tpu.attributions import (
+        ShapleyAttributionMetric as SV,
+        TaylorAttributionMetric as Taylor,
+    )
+    from torchpruner_tpu.utils.losses import cross_entropy_loss as ce
+
+    for cls, kw in ((SV, {"sv_samples": 3}), (Taylor, {})):
+        f32 = cls(model, params, data, ce, state=state,
+                  seed=0, **kw).run("fc2")
+        bf16 = cls(model, params, data, ce, state=state,
+                   seed=0, compute_dtype=jnp.bfloat16, **kw).run("fc2")
+        assert bf16.dtype == np.float32  # rows always land f32 on host
+        # Spearman rank correlation
+        r_f, r_b = np.argsort(np.argsort(f32)), np.argsort(np.argsort(bf16))
+        n = len(f32)
+        rho = 1 - 6 * np.sum((r_f - r_b) ** 2) / (n * (n**2 - 1))
+        assert rho > 0.95, (cls.__name__, rho)

@@ -162,3 +162,29 @@ def test_shard_params_layouts():
     # fc1 w (16,64): 64 divisible by 4 -> sharded on model axis
     s = placed["fc1"]["w"].sharding
     assert s.spec == jax.sharding.PartitionSpec(None, "model")
+
+
+def test_distributed_scoring_honours_compute_dtype():
+    """DistributedScorer must produce the same rows as metric.run() under
+    bf16 scoring — the cast happens on both paths."""
+    import jax.numpy as jnp
+
+    from torchpruner_tpu.attributions import TaylorAttributionMetric
+    from torchpruner_tpu.core.segment import init_model
+    from torchpruner_tpu.data import load_dataset
+    from torchpruner_tpu.models import digits_fc
+    from torchpruner_tpu.parallel import DistributedScorer, make_mesh
+    from torchpruner_tpu.utils.losses import cross_entropy_loss
+
+    model = digits_fc()
+    params, state = init_model(model, seed=0)
+    data = load_dataset("digits_flat", "val").batches(
+        40, drop_remainder=True
+    )
+    metric = TaylorAttributionMetric(
+        model, params, data, cross_entropy_loss, state=state,
+        compute_dtype=jnp.bfloat16,
+    )
+    local = metric.run("fc2")
+    dist = DistributedScorer(metric, make_mesh({"data": 8})).run("fc2")
+    np.testing.assert_allclose(local, dist, rtol=2e-5, atol=1e-7)

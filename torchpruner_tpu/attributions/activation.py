@@ -24,6 +24,11 @@ from torchpruner_tpu.attributions.base import (
 
 
 def _finish(mode, z, g):
+    # row math in f32 even under bf16 scoring: the spatial sum over a
+    # feature map accumulates thousands of terms — the 'rows stay f32'
+    # guarantee (base.py) starts here, not at the host cast
+    z = z.astype(jnp.float32)
+    g = g.astype(jnp.float32)
     if mode == "sensitivity":
         # abs first, then spatial sum (reference sensitivity.py:27-30)
         return spatial_sum(jnp.abs(g))

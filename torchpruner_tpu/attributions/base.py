@@ -46,6 +46,12 @@ class AttributionMetric:
       (torchpruner_tpu.utils.losses).
     - ``reduction``: ``"mean" | "sum" | "none"`` or a callable on the
       ``(N, n_units)`` row matrix (reference attributions.py:91-106).
+    - ``compute_dtype`` (e.g. ``jnp.bfloat16``): run the scoring forwards
+      (and vjps) with params/inputs cast to that dtype — MXU-rate matmuls.
+      Loss math and row accumulation stay f32 (utils/losses upcasts), so
+      the marginal deltas Shapley chains keep f32 resolution; scores from
+      bf16 activations carry bf16-level noise — fine for rankings, opt in
+      deliberately for exact-value comparisons.
     """
 
     #: whether evaluation-point shifting applies (False for weight-only
@@ -62,6 +68,7 @@ class AttributionMetric:
         state=None,
         reduction="mean",
         seed: int = 0,
+        compute_dtype=None,
     ):
         self.model = model
         self.params = params
@@ -70,6 +77,7 @@ class AttributionMetric:
         self.loss_fn = loss_fn
         self.reduction = reduction
         self.seed = seed
+        self.compute_dtype = compute_dtype
 
     # ------------------------------------------------------------------ api
 
@@ -126,11 +134,21 @@ class AttributionMetric:
         # attention, whose unit is the query head)
         return self.model.site_shape(eval_layer)[-1]
 
+    def _cast(self, tree):
+        if self.compute_dtype is None:
+            return tree
+        from torchpruner_tpu.utils.dtypes import cast_floats
+
+        return cast_floats(tree, self.compute_dtype)
+
     def _collect(self, row_fn) -> np.ndarray:
-        """Run ``row_fn`` over the dataset, stacking per-example rows."""
+        """Run ``row_fn`` over the dataset, stacking per-example rows
+        (always f32 on host, whatever the compute dtype)."""
+        params = self._cast(self.params)
         out = []
         for x, y in self.batches():
-            out.append(np.asarray(row_fn(self.params, self.state, x, y)))
+            rows = row_fn(params, self.state, self._cast(jnp.asarray(x)), y)
+            out.append(np.asarray(jnp.asarray(rows, jnp.float32)))
         return np.concatenate(out, axis=0)
 
 

@@ -48,11 +48,24 @@ def shapley_rows_fn(model, eval_layer: str, loss_fn, use_partial: bool):
                 params, x, state=state, train=False, to_layer=eval_layer
             )
             base = suffix(params, state, z, y)  # (B,) per-example loss
+            mask_dt = z.dtype  # matches the activation: a f32 mask would
+            # promote a bf16 suffix back to f32 and forfeit the MXU rate
 
             def masked_loss(mask):
                 return suffix(params, state, z * mask, y)
 
         else:
+            # the mask multiplies the site activation mid-forward; match
+            # the dtype the model computes in (first floating param leaf —
+            # x may be integer tokens) or a f32 mask would promote a bf16
+            # forward back to f32
+            from torchpruner_tpu.utils.dtypes import float_dtype_of
+
+            mask_dt = (
+                x.dtype
+                if jnp.issubdtype(x.dtype, jnp.floating)
+                else float_dtype_of(params)
+            )
 
             def masked_loss(mask):
                 preds, _ = model.apply(
@@ -64,7 +77,7 @@ def shapley_rows_fn(model, eval_layer: str, loss_fn, use_partial: bool):
                 )
                 return loss_fn(preds, y)
 
-            base = masked_loss(jnp.ones((n,), x.dtype))
+            base = masked_loss(jnp.ones((n,), mask_dt))
 
         def per_perm(perm):
             def step(carry, u):
@@ -73,7 +86,7 @@ def shapley_rows_fn(model, eval_layer: str, loss_fn, use_partial: bool):
                 loss = masked_loss(mask)
                 return (mask, loss), loss - prev
 
-            init = (jnp.ones((n,), base.dtype), base)
+            init = (jnp.ones((n,), mask_dt), base)
             _, deltas = jax.lax.scan(step, init, perm)  # (n, B), perm order
             return jnp.zeros_like(deltas).at[perm].set(deltas)  # unit order
 

@@ -224,11 +224,13 @@ def run_prune_retrain(
     val_batches = val.batches(cfg.eval_batch_size)
     test_batches = test.batches(cfg.eval_batch_size)
 
+    score_dtype = jnp.bfloat16 if cfg.score_dtype == "bfloat16" else None
     for target in targets:
         metric = build_metric(
             cfg.method, trainer.model, trainer.params, val_batches,
             loss_fn, state=trainer.state,
-            reduction=cfg.reduction, seed=cfg.seed, **cfg.method_kwargs,
+            reduction=cfg.reduction, seed=cfg.seed,
+            compute_dtype=score_dtype, **cfg.method_kwargs,
         )
         t0 = time.perf_counter()
         scores = metric.run(

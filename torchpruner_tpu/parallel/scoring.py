@@ -61,6 +61,10 @@ class DistributedScorer:
             or reduction is mean_plus_2std
         )
 
+        # honour the metric's compute_dtype exactly like the local
+        # _collect path (same cast -> same rows on either path)
+        params = metric._cast(metric.params)
+
         if momentish:
             red = (
                 "mean+2std"
@@ -71,7 +75,10 @@ class DistributedScorer:
             n = 0
             for batch in metric.batches():
                 x, y = shard_batch(batch, self.mesh, self.axis)
-                rows = row_fn(metric.params, metric.state, x, y)
+                rows = jnp.asarray(
+                    row_fn(params, metric.state, metric._cast(x), y),
+                    jnp.float32,
+                )
                 b1 = jnp.sum(rows, axis=0)   # cross-device psum via XLA
                 b2 = jnp.sum(rows * rows, axis=0)
                 s1 = b1 if s1 is None else s1 + b1
@@ -85,5 +92,6 @@ class DistributedScorer:
         out = []
         for batch in metric.batches():
             x, y = shard_batch(batch, self.mesh, self.axis)
-            out.append(np.asarray(row_fn(metric.params, metric.state, x, y)))
+            rows = row_fn(params, metric.state, metric._cast(x), y)
+            out.append(np.asarray(jnp.asarray(rows, jnp.float32)))
         return metric.aggregate_over_samples(np.concatenate(out, axis=0))

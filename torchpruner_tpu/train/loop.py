@@ -26,14 +26,7 @@ from torchpruner_tpu.core.segment import SegmentedModel
 from torchpruner_tpu.utils.losses import accuracy
 
 
-def _cast_floats(tree, dtype):
-    """Cast every floating leaf to ``dtype`` (ints/bools pass through)."""
-    return jax.tree_util.tree_map(
-        lambda a: a.astype(dtype)
-        if jnp.issubdtype(jnp.result_type(a), jnp.floating)
-        else a,
-        tree,
-    )
+from torchpruner_tpu.utils.dtypes import cast_floats as _cast_floats
 
 
 def make_train_step(model: SegmentedModel, tx, loss_fn, donate: bool = True,

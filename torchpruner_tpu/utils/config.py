@@ -59,6 +59,10 @@ class ExperimentConfig:
     #: master params/updates (mixed precision, the TPU-native default for
     #: large models; see train.loop.make_train_step)
     compute_dtype: str = "float32"
+    #: float32 | bfloat16 — dtype of the ATTRIBUTION scoring forwards,
+    #: independent of the training dtype (bf16 scoring shifts rankings at
+    #: bf16 noise level; opt in separately)
+    score_dtype: str = "float32"
 
     # data pipeline / checkpointing
     augment: bool = False            # flip + pad/crop image augmentation
@@ -82,11 +86,12 @@ class ExperimentConfig:
                 f"unknown lr_schedule {self.lr_schedule!r} (use 'constant', "
                 "'multistep', 'cosine' or 'warmup_cosine')"
             )
-        if self.compute_dtype not in ("float32", "bfloat16"):
-            raise ValueError(
-                f"unknown compute_dtype {self.compute_dtype!r} "
-                "(use 'float32' or 'bfloat16')"
-            )
+        for fld in ("compute_dtype", "score_dtype"):
+            if getattr(self, fld) not in ("float32", "bfloat16"):
+                raise ValueError(
+                    f"unknown {fld} {getattr(self, fld)!r} "
+                    "(use 'float32' or 'bfloat16')"
+                )
 
     def to_json(self, path: str):
         with open(path, "w") as f:

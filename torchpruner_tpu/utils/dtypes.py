@@ -1,0 +1,27 @@
+"""Dtype helpers shared by the mixed-precision paths (train loop,
+attribution scoring)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cast_floats(tree, dtype):
+    """Cast every floating leaf of a pytree to ``dtype`` (ints/bools pass
+    through)."""
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(dtype)
+        if jnp.issubdtype(jnp.result_type(a), jnp.floating)
+        else a,
+        tree,
+    )
+
+
+def float_dtype_of(tree, default=jnp.float32):
+    """Dtype of the first floating leaf (the activation dtype a model with
+    integer inputs will compute in), or ``default`` if none."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if jnp.issubdtype(jnp.result_type(leaf), jnp.floating):
+            return jnp.result_type(leaf)
+    return default

@@ -12,15 +12,23 @@ import jax
 import jax.numpy as jnp
 
 
+def _f32(x):
+    """Loss math runs in f32 regardless of the activation dtype (bf16
+    logits from a mixed-precision forward would otherwise round the
+    softmax/log and the small marginal deltas attribution relies on)."""
+    return x.astype(jnp.float32) if jnp.issubdtype(
+        jnp.result_type(x), jnp.floating) else x
+
+
 def mse_loss(preds, targets):
     """Mean-squared error, averaged over non-batch dims -> (batch,)."""
-    d = (preds - targets) ** 2
+    d = (_f32(preds) - _f32(targets)) ** 2
     return d.reshape(d.shape[0], -1).mean(axis=1)
 
 
 def cross_entropy_loss(logits, labels):
     """Softmax cross-entropy with integer labels -> (batch,)."""
-    logp = jax.nn.log_softmax(logits, axis=-1)
+    logp = jax.nn.log_softmax(_f32(logits), axis=-1)
     return -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
 
 
@@ -28,7 +36,8 @@ def nll_loss(log_probs, labels):
     """Negative log-likelihood on log-probabilities (reference
     experiments/models/fmnist.py:80-81 pairs NLL with an in-model
     log_softmax) -> (batch,)."""
-    return -jnp.take_along_axis(log_probs, labels[:, None], axis=-1)[:, 0]
+    return -jnp.take_along_axis(
+        _f32(log_probs), labels[:, None], axis=-1)[:, 0]
 
 
 def lm_cross_entropy_loss(logits, tokens):
@@ -39,7 +48,7 @@ def lm_cross_entropy_loss(logits, tokens):
     per-example value is the mean over the S-1 predicted positions, keeping
     the per-example-first attribution contract (SURVEY.md §2.1).
     """
-    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    logp = jax.nn.log_softmax(_f32(logits[:, :-1]), axis=-1)
     tgt = tokens[:, 1:]
     nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
     return nll.mean(axis=-1)
