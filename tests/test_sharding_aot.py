@@ -134,9 +134,16 @@ def test_llama3_8b_train_step_lowers_on_abstract_pod_mesh(partition):
     B, S = 16, 2048
     x_s = jax.ShapeDtypeStruct((B, S), jnp.int32, sharding=batch_sh)
 
+    from torchpruner_tpu.utils.dtypes import cast_floats
+
     def step(params, opt_state, x):
+        # the honest 8B training config: bf16 compute (f32 masters) with
+        # recompute-in-backward blocks — what a real v5p run would compile
         def loss_fn(p):
-            out, _ = model.apply(p, x, state=state)
+            out, _ = model.apply(
+                cast_floats(p, jnp.bfloat16), x, state=state, train=True,
+                remat=True,
+            )
             return jnp.mean(lm_cross_entropy_loss(out, x))
 
         loss, grads = jax.value_and_grad(loss_fn)(params)

@@ -134,21 +134,31 @@ class AttributionMetric:
         # attention, whose unit is the query head)
         return self.model.site_shape(eval_layer)[-1]
 
-    def _cast(self, tree):
+    def cast(self, tree):
+        """Apply the metric's ``compute_dtype`` to a pytree's float leaves
+        (identity when no compute dtype is set).  Public: the distributed
+        scorer applies the SAME cast so local and SPMD rows agree."""
         if self.compute_dtype is None:
             return tree
         from torchpruner_tpu.utils.dtypes import cast_floats
 
         return cast_floats(tree, self.compute_dtype)
 
+    def run_rows(self, row_fn, params, x, y):
+        """One batch of rows under the metric's compute dtype — inputs
+        cast, rows coerced to f32 (the single definition of the
+        'bf16 forwards, f32 rows' invariant; ``params`` must already be
+        ``self.cast``-ed once by the caller)."""
+        rows = row_fn(params, self.state, self.cast(jnp.asarray(x)), y)
+        return jnp.asarray(rows, jnp.float32)
+
     def _collect(self, row_fn) -> np.ndarray:
         """Run ``row_fn`` over the dataset, stacking per-example rows
         (always f32 on host, whatever the compute dtype)."""
-        params = self._cast(self.params)
+        params = self.cast(self.params)
         out = []
         for x, y in self.batches():
-            rows = row_fn(params, self.state, self._cast(jnp.asarray(x)), y)
-            out.append(np.asarray(jnp.asarray(rows, jnp.float32)))
+            out.append(np.asarray(self.run_rows(row_fn, params, x, y)))
         return np.concatenate(out, axis=0)
 
 

@@ -699,11 +699,21 @@ def apply_seq(
     rng=None,
     taps: Optional[Taps] = None,
     prefix: Tuple[str, ...] = (),
+    remat: bool = False,
 ):
     """Run a sequential pipeline of layers.  The shared runner behind
     ``SegmentedModel.apply`` and ``Residual`` bodies: threads state and rng,
     and applies output-site taps after every non-attention layer (attention
-    handles its own head site internally)."""
+    handles its own head site internally).
+
+    ``remat=True`` wraps each composite block (``Residual``) in
+    ``jax.checkpoint``: the backward recomputes the block's forward instead
+    of saving its internals — activation memory per block drops to the
+    block boundaries, the standard trade for training transformer stacks
+    at long sequence length.  Only applies when no taps instrument the
+    forward (attribution capture escapes a remat region by object
+    mutation, which is unsound under recomputation — scoring never needs
+    remat)."""
     state = state if state is not None else {}
     new_state = dict(state)
     for spec in layers:
@@ -714,9 +724,22 @@ def apply_seq(
         else:
             sub = None
         path = prefix + (spec.name,)
-        x, s2 = apply_layer(
-            spec, p, s, x, train=train, rng=sub, taps=taps, path=path
-        )
+        if (
+            remat
+            and isinstance(spec, Residual)
+            and (taps is None or taps.empty())
+        ):
+            def block(p_, s_, x_, r_, _spec=spec, _path=path):
+                return apply_layer(
+                    _spec, p_, s_, x_, train=train, rng=r_, taps=None,
+                    path=_path,
+                )
+
+            x, s2 = jax.checkpoint(block)(p, s, x, sub)
+        else:
+            x, s2 = apply_layer(
+                spec, p, s, x, train=train, rng=sub, taps=taps, path=path
+            )
         if (
             taps is not None
             and not taps.empty()

@@ -195,6 +195,7 @@ class SegmentedModel:
         unit_mask: Optional[Tuple[str, Any]] = None,
         perturb: Optional[Tuple[str, Any]] = None,
         capture: Optional[str] = None,
+        remat: bool = False,
     ):
         """Run the segment after ``from_layer`` through ``to_layer`` inclusive.
 
@@ -209,6 +210,9 @@ class SegmentedModel:
         - ``perturb=(site, delta)`` adds ``delta`` at the site — differentiate
           w.r.t. ``delta`` at zero for activation-gradient attributions.
         - ``capture=site`` additionally returns the activation at the site.
+        - ``remat=True`` checkpoints each composite block (recompute-in-
+          backward; see ``layers.apply_seq``) — the training-memory lever
+          for deep transformer stacks.
 
         Returns ``(y, new_state)``, or ``(y, new_state, captured)`` when
         ``capture`` is given.
@@ -226,7 +230,7 @@ class SegmentedModel:
             taps = L.Taps(unit_mask=unit_mask, perturb=perturb, capture=capture)
         y, new_state = L.apply_seq(
             self.layers[start:stop], params, state, x,
-            train=train, rng=rng, taps=taps,
+            train=train, rng=rng, taps=taps, remat=remat,
         )
         # merge: untouched layers keep their previous state entries
         merged = dict(state)

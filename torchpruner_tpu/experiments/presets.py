@@ -65,6 +65,7 @@ def bert_glue_sensitivity(smoke: bool = False) -> ExperimentConfig:
         batch_size=16 if smoke else 32,
         eval_batch_size=64 if smoke else 128,
         lr=3e-3,
+        compute_dtype="float32" if smoke else "bfloat16",
     )
 
 
@@ -104,6 +105,10 @@ def llama3_ffn_taylor(smoke: bool = False) -> ExperimentConfig:
         eval_batch_size=16 if smoke else 32,
         lr=1e-4,
         mesh={} if smoke else {"data": 8, "model": 8},
+        # TPU-native at 8B scale: bf16 fwd/bwd (f32 masters) and
+        # recompute-in-backward blocks so S=2048 activations fit HBM
+        compute_dtype="float32" if smoke else "bfloat16",
+        remat=not smoke,
     )
 
 

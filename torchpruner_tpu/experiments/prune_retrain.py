@@ -217,6 +217,7 @@ def run_prune_retrain(
         model, tx, loss_fn, seed=cfg.seed,
         compute_dtype=jnp.bfloat16 if cfg.compute_dtype == "bfloat16"
         else None,
+        remat=cfg.remat,
     )
     logger = CSVLogger(cfg.log_path, experiment=cfg.name)
     history: List[PruneStepRecord] = []

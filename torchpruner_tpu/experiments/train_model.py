@@ -112,7 +112,7 @@ def run_train(
         )
         trainer = Trainer.create(model, tx, loss_fn, seed=cfg.seed,
                                  params=params, state=state,
-                                 compute_dtype=cdtype)
+                                 compute_dtype=cdtype, remat=cfg.remat)
         if opt_state is not None:
             trainer.opt_state = opt_state
         start_epoch = int(meta.get("extra", {}).get("epoch", 0))
@@ -121,7 +121,7 @@ def run_train(
                   f"at epoch {start_epoch}", flush=True)
     else:
         trainer = Trainer.create(model, tx, loss_fn, seed=cfg.seed,
-                                 compute_dtype=cdtype)
+                                 compute_dtype=cdtype, remat=cfg.remat)
 
     logger = CSVLogger(cfg.log_path, experiment=cfg.name)
     test_batches = test.batches(cfg.eval_batch_size)

@@ -61,9 +61,9 @@ class DistributedScorer:
             or reduction is mean_plus_2std
         )
 
-        # honour the metric's compute_dtype exactly like the local
-        # _collect path (same cast -> same rows on either path)
-        params = metric._cast(metric.params)
+        # the metric's own cast + f32-rows invariant (base.run_rows), so
+        # local and SPMD rows agree bit-for-bit in policy
+        params = metric.cast(metric.params)
 
         if momentish:
             red = (
@@ -75,10 +75,7 @@ class DistributedScorer:
             n = 0
             for batch in metric.batches():
                 x, y = shard_batch(batch, self.mesh, self.axis)
-                rows = jnp.asarray(
-                    row_fn(params, metric.state, metric._cast(x), y),
-                    jnp.float32,
-                )
+                rows = metric.run_rows(row_fn, params, x, y)
                 b1 = jnp.sum(rows, axis=0)   # cross-device psum via XLA
                 b2 = jnp.sum(rows * rows, axis=0)
                 s1 = b1 if s1 is None else s1 + b1
@@ -92,6 +89,5 @@ class DistributedScorer:
         out = []
         for batch in metric.batches():
             x, y = shard_batch(batch, self.mesh, self.axis)
-            rows = row_fn(params, metric.state, metric._cast(x), y)
-            out.append(np.asarray(jnp.asarray(rows, jnp.float32)))
+            out.append(np.asarray(metric.run_rows(row_fn, params, x, y)))
         return metric.aggregate_over_samples(np.concatenate(out, axis=0))

@@ -43,16 +43,22 @@ def make_sharded_train_step(
     state_shardings,
     opt_shardings,
     data_axis: str = "data",
+    compute_dtype=None,
+    remat: bool = False,
 ):
-    """Compile the SPMD train step with explicit in/out shardings."""
+    """Compile the SPMD train step with explicit in/out shardings.
+    Mixed precision / remat come from the shared
+    ``train.loop.make_loss_closure`` — one forward policy for the local
+    and the SPMD steps."""
+    from torchpruner_tpu.train.loop import make_loss_closure
+
+    loss_c = make_loss_closure(model, loss_fn, compute_dtype, remat)
     bs = batch_sharding(mesh, data_axis)
     rep = replicate(mesh)
 
     def step(params, state, opt_state, x, y, rng):
         def loss(p):
-            out, new_state = model.apply(p, x, state=state, train=True,
-                                         rng=rng)
-            return jnp.mean(loss_fn(out, y)), new_state
+            return loss_c(p, state, x, y, rng)
 
         (l, new_state), grads = jax.value_and_grad(loss, has_aux=True)(params)
         updates, new_opt = tx.update(grads, opt_state, params)
@@ -86,6 +92,10 @@ class ShardedTrainer:
     #: "fsdp" = shard each large param's largest axis; "tp" = pruning-graph
     #: tensor parallelism (column/row-parallel pairs) with FSDP fallback
     partition: str = "fsdp"
+    #: None = f32; jnp.bfloat16 = mixed precision (f32 masters)
+    compute_dtype: Any = None
+    #: checkpoint composite blocks (recompute-in-backward)
+    remat: bool = False
     _step_fn: Any = field(default=None, repr=False)
     step_count: int = 0
 
@@ -101,6 +111,8 @@ class ShardedTrainer:
         model_axis: str = "model",
         min_shard_size: int = 2**14,
         partition: str = "fsdp",
+        compute_dtype=None,
+        remat: bool = False,
     ) -> "ShardedTrainer":
         key = jax.random.PRNGKey(seed)
         params, state = model.init(key)
@@ -110,6 +122,7 @@ class ShardedTrainer:
             opt_state=opt_state, loss_fn=loss_fn, rng=key, mesh=mesh,
             data_axis=data_axis, model_axis=model_axis,
             min_shard_size=min_shard_size, partition=partition,
+            compute_dtype=compute_dtype, remat=remat,
         )
         t._place()
         return t
@@ -146,7 +159,8 @@ class ShardedTrainer:
         self.opt_state = jax.device_put(self.opt_state, os_)
         self._step_fn = make_sharded_train_step(
             self.model, self.tx, self.loss_fn, self.mesh, ps, ss, os_,
-            self.data_axis,
+            self.data_axis, compute_dtype=self.compute_dtype,
+            remat=self.remat,
         )
 
     # -- training ----------------------------------------------------------
@@ -170,7 +184,8 @@ class ShardedTrainer:
             tx=self.tx, opt_state=opt_state, loss_fn=self.loss_fn,
             rng=self.rng, mesh=self.mesh, data_axis=self.data_axis,
             model_axis=self.model_axis, min_shard_size=self.min_shard_size,
-            partition=self.partition, step_count=self.step_count,
+            partition=self.partition, compute_dtype=self.compute_dtype,
+            remat=self.remat, step_count=self.step_count,
         )
         t._place()
         return t

@@ -29,10 +29,11 @@ from torchpruner_tpu.utils.losses import accuracy
 from torchpruner_tpu.utils.dtypes import cast_floats as _cast_floats
 
 
-def make_train_step(model: SegmentedModel, tx, loss_fn, donate: bool = True,
-                    compute_dtype=None):
-    """(params, state, opt_state, x, y, rng) -> (params, state, opt_state,
-    loss).  Donation reuses the input buffers for the outputs.
+def make_loss_closure(model: SegmentedModel, loss_fn, compute_dtype=None,
+                      remat: bool = False):
+    """``(params, state, x, y, rng) -> (mean loss, new_state)`` — the ONE
+    definition of the training forward policy, shared by the local and the
+    SPMD train steps.
 
     ``compute_dtype`` (e.g. ``jnp.bfloat16``) enables mixed precision the
     TPU-native way: master params, optimizer state, mutable state (the
@@ -40,23 +41,34 @@ def make_train_step(model: SegmentedModel, tx, loss_fn, donate: bool = True,
     core/layers.py), loss and update math stay float32; the
     forward/backward run with params and inputs cast to ``compute_dtype``
     (MXU-rate matmuls), logits promoted back to f32 before the loss,
-    gradients arriving in f32 through the cast's transpose."""
+    gradients arriving in f32 through the cast's transpose.  ``remat``
+    checkpoints composite blocks (recompute-in-backward)."""
+
+    def loss(params, state, x, y, rng):
+        if compute_dtype is not None:
+            params = _cast_floats(params, compute_dtype)
+            x = _cast_floats(x, compute_dtype)
+        out, new_state = model.apply(
+            params, x, state=state, train=True, rng=rng, remat=remat
+        )
+        if compute_dtype is not None:
+            out = out.astype(jnp.float32)
+        return jnp.mean(loss_fn(out, y)), new_state
+
+    return loss
+
+
+def make_train_step(model: SegmentedModel, tx, loss_fn, donate: bool = True,
+                    compute_dtype=None, remat: bool = False):
+    """(params, state, opt_state, x, y, rng) -> (params, state, opt_state,
+    loss).  Donation reuses the input buffers for the outputs.  Mixed
+    precision / remat per :func:`make_loss_closure`."""
+    loss_c = make_loss_closure(model, loss_fn, compute_dtype, remat)
 
     def step(params, state, opt_state, x, y, rng):
-        def loss(p):
-            if compute_dtype is not None:
-                p = _cast_floats(p, compute_dtype)
-                xin = _cast_floats(x, compute_dtype)
-            else:
-                xin = x
-            out, new_state = model.apply(
-                p, xin, state=state, train=True, rng=rng
-            )
-            if compute_dtype is not None:
-                out = out.astype(jnp.float32)
-            return jnp.mean(loss_fn(out, y)), new_state
-
-        (l, new_state), grads = jax.value_and_grad(loss, has_aux=True)(params)
+        (l, new_state), grads = jax.value_and_grad(
+            lambda p: loss_c(p, state, x, y, rng), has_aux=True
+        )(params)
         updates, new_opt = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
         return new_params, new_state, new_opt, l
@@ -132,12 +144,14 @@ class Trainer:
     rng: Any
     #: None = full f32; jnp.bfloat16 = mixed precision (see make_train_step)
     compute_dtype: Any = None
+    #: checkpoint composite blocks (recompute-in-backward; see apply_seq)
+    remat: bool = False
     _step_fn: Any = field(default=None, repr=False)
     step_count: int = 0
 
     @classmethod
     def create(cls, model, tx, loss_fn, seed: int = 0, params=None,
-               state=None, compute_dtype=None):
+               state=None, compute_dtype=None, remat: bool = False):
         key = jax.random.PRNGKey(seed)
         if params is None:
             params, state = model.init(key)
@@ -150,6 +164,7 @@ class Trainer:
             loss_fn=loss_fn,
             rng=key,
             compute_dtype=compute_dtype,
+            remat=remat,
         )
 
     def step(self, x, y) -> float:
@@ -157,6 +172,7 @@ class Trainer:
             self._step_fn = make_train_step(
                 self.model, self.tx, self.loss_fn,
                 compute_dtype=self.compute_dtype,
+                remat=self.remat,
             )
         self.rng, sub = jax.random.split(self.rng)
         self.params, self.state, self.opt_state, l = self._step_fn(
@@ -175,6 +191,7 @@ class Trainer:
             loss_fn=self.loss_fn,
             rng=self.rng,
             compute_dtype=self.compute_dtype,
+            remat=self.remat,
             step_count=self.step_count,
         )
 

@@ -63,6 +63,9 @@ class ExperimentConfig:
     #: independent of the training dtype (bf16 scoring shifts rankings at
     #: bf16 noise level; opt in separately)
     score_dtype: str = "float32"
+    #: checkpoint composite blocks during training (recompute-in-backward;
+    #: the activation-memory lever for deep transformer stacks)
+    remat: bool = False
 
     # data pipeline / checkpointing
     augment: bool = False            # flip + pad/crop image augmentation
