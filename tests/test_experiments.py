@@ -205,3 +205,43 @@ def test_augment_images_shapes_and_determinism():
     # flat inputs pass through untouched
     flat = rng.normal(size=(4, 16)).astype(np.float32)
     np.testing.assert_array_equal(augment_images(flat, rng), flat)
+
+
+def test_robustness_config_writes_figures(tmp_path):
+    import os
+
+    from torchpruner_tpu.experiments.robustness import run_robustness_config
+
+    cfg = ExperimentConfig(
+        name="plots", model="digits_fc", dataset="digits_flat",
+        experiment="robustness", method="taylor", score_examples=64,
+        eval_batch_size=64, target_filter=("fc2",),
+        plot_dir=str(tmp_path / "figs"),
+        log_path=str(tmp_path / "log.csv"),
+    )
+    aucs = run_robustness_config(cfg, verbose=False)
+    assert "taylor" in aucs
+    assert os.path.getsize(tmp_path / "figs" / "robustness_fc2.png") > 0
+    assert os.path.getsize(tmp_path / "figs" / "auc_summary.png") > 0
+
+
+def test_prune_retrain_over_configured_mesh():
+    """cfg.mesh drives the SPMD loop: ShardedTrainer training, data-
+    parallel scoring, prune->reshard->step — the full distributed recipe
+    from one config."""
+    from torchpruner_tpu.experiments.prune_retrain import run_prune_retrain
+
+    cfg = ExperimentConfig(
+        name="mesh_prune", model="llama_tiny", dataset="lm_tiny",
+        loss="lm_cross_entropy", method="taylor", policy="fraction",
+        fraction=0.25, target_filter=("_ffn/",), finetune_epochs=1,
+        score_examples=32, batch_size=8, eval_batch_size=16,
+        mesh={"data": 2, "model": 4}, partition="tp",
+        compute_dtype="bfloat16", remat=True,
+        log_path="logs/test_mesh_prune.csv",
+    )
+    records = run_prune_retrain(cfg, verbose=False)
+    assert len(records) >= 1
+    for r in records:
+        assert np.isfinite(r.post_loss)
+        assert r.n_dropped > 0

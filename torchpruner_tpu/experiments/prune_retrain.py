@@ -213,12 +213,23 @@ def run_prune_retrain(
     loss_fn = LOSS_REGISTRY[cfg.loss]
     import jax.numpy as jnp
 
-    trainer = Trainer.create(
-        model, tx, loss_fn, seed=cfg.seed,
-        compute_dtype=jnp.bfloat16 if cfg.compute_dtype == "bfloat16"
-        else None,
-        remat=cfg.remat,
-    )
+    cdtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else None
+    mesh = None
+    if cfg.mesh:
+        # SPMD loop: sharded training over the configured mesh and
+        # data-parallel scoring over its data axis (SURVEY.md §5.8)
+        from torchpruner_tpu.parallel import ShardedTrainer, make_mesh
+
+        mesh = make_mesh(cfg.mesh)
+        trainer = ShardedTrainer.create(
+            model, tx, loss_fn, mesh, seed=cfg.seed,
+            partition=cfg.partition, compute_dtype=cdtype, remat=cfg.remat,
+        )
+    else:
+        trainer = Trainer.create(
+            model, tx, loss_fn, seed=cfg.seed,
+            compute_dtype=cdtype, remat=cfg.remat,
+        )
     logger = CSVLogger(cfg.log_path, experiment=cfg.name)
     history: List[PruneStepRecord] = []
 
@@ -234,9 +245,18 @@ def run_prune_retrain(
             compute_dtype=score_dtype, **cfg.method_kwargs,
         )
         t0 = time.perf_counter()
-        scores = metric.run(
-            target, find_best_evaluation_layer=cfg.find_best_evaluation_layer
-        )
+        if mesh is not None and "data" in cfg.mesh:
+            from torchpruner_tpu.parallel import DistributedScorer
+
+            scores = DistributedScorer(metric, mesh).run(
+                target,
+                find_best_evaluation_layer=cfg.find_best_evaluation_layer,
+            )
+        else:
+            scores = metric.run(
+                target,
+                find_best_evaluation_layer=cfg.find_best_evaluation_layer,
+            )
         pre_loss, pre_acc = trainer.evaluate(test_batches)
         res = prune_by_scores(
             trainer.model, trainer.params, target, scores,

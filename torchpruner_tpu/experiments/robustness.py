@@ -274,4 +274,27 @@ def run_robustness_config(cfg, *, model=None, datasets=None,
         find_best_evaluation_layer_=cfg.find_best_evaluation_layer,
         verbose=verbose,
     )
-    return auc_summary(results)
+    aucs = auc_summary(results)
+    if cfg.plot_dir:
+        import os
+
+        from torchpruner_tpu.utils.plotting import (
+            plot_auc_summary,
+            plot_robustness_curves,
+        )
+
+        os.makedirs(cfg.plot_dir, exist_ok=True)
+        for layer in results:
+            plot_robustness_curves(
+                results, layer,
+                save_path=os.path.join(
+                    cfg.plot_dir, f"robustness_{layer.replace('/', '_')}.png"
+                ),
+            )
+        plot_auc_summary(
+            aucs, save_path=os.path.join(cfg.plot_dir, "auc_summary.png")
+        )
+        if verbose:
+            print(f"[robustness] wrote figures to {cfg.plot_dir}",
+                  flush=True)
+    return aucs

@@ -54,6 +54,9 @@ class ExperimentConfig:
 
     # distribution
     mesh: Dict[str, int] = field(default_factory=dict)  # e.g. {"data": 4, "model": 2}
+    #: parameter partitioning over the mesh: "fsdp" or "tp" (pruning-
+    #: graph-derived tensor parallelism); used when mesh is non-empty
+    partition: str = "fsdp"
 
     #: float32 | bfloat16 — bf16 runs the fwd/bwd at MXU rate with f32
     #: master params/updates (mixed precision, the TPU-native default for
@@ -75,6 +78,9 @@ class ExperimentConfig:
 
     seed: int = 0
     log_path: str = "logs/experiment.csv"
+    #: when set, the robustness sweep writes its figures here (per-layer
+    #: curves + the AUC summary; utils/plotting)
+    plot_dir: str = ""
 
     def __post_init__(self):
         if self.experiment not in ("prune_retrain", "robustness", "train"):
@@ -88,6 +94,10 @@ class ExperimentConfig:
             raise ValueError(
                 f"unknown lr_schedule {self.lr_schedule!r} (use 'constant', "
                 "'multistep', 'cosine' or 'warmup_cosine')"
+            )
+        if self.partition not in ("fsdp", "tp"):
+            raise ValueError(
+                f"unknown partition {self.partition!r} (use 'fsdp' or 'tp')"
             )
         for fld in ("compute_dtype", "score_dtype"):
             if getattr(self, fld) not in ("float32", "bfloat16"):

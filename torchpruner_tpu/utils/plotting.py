@@ -101,6 +101,7 @@ def plot_robustness_curves(
     fig.tight_layout()
     if save_path:
         fig.savefig(save_path)
+        plt.close(fig)  # saved figures don't accumulate in the manager
     return fig
 
 
@@ -143,6 +144,7 @@ def plot_auc_summary(
     fig.tight_layout()
     if save_path:
         fig.savefig(save_path)
+        plt.close(fig)  # saved figures don't accumulate in the manager
     return fig
 
 
@@ -176,4 +178,5 @@ def plot_prune_history(
     fig.tight_layout()
     if save_path:
         fig.savefig(save_path)
+        plt.close(fig)  # saved figures don't accumulate in the manager
     return fig
