@@ -225,20 +225,21 @@ def test_robustness_config_writes_figures(tmp_path):
     assert os.path.getsize(tmp_path / "figs" / "auc_summary.png") > 0
 
 
-def test_prune_retrain_over_configured_mesh():
+def test_prune_retrain_over_configured_mesh(tmp_path):
     """cfg.mesh drives the SPMD loop: ShardedTrainer training, data-
     parallel scoring, prune->reshard->step — the full distributed recipe
-    from one config."""
+    from one config.  score_examples=30 leaves a remainder batch, which
+    mesh mode must drop rather than crash on."""
     from torchpruner_tpu.experiments.prune_retrain import run_prune_retrain
 
     cfg = ExperimentConfig(
         name="mesh_prune", model="llama_tiny", dataset="lm_tiny",
         loss="lm_cross_entropy", method="taylor", policy="fraction",
         fraction=0.25, target_filter=("_ffn/",), finetune_epochs=1,
-        score_examples=32, batch_size=8, eval_batch_size=16,
+        score_examples=30, batch_size=8, eval_batch_size=16,
         mesh={"data": 2, "model": 4}, partition="tp",
         compute_dtype="bfloat16", remat=True,
-        log_path="logs/test_mesh_prune.csv",
+        log_path=str(tmp_path / "mesh_prune.csv"),
     )
     records = run_prune_retrain(cfg, verbose=False)
     assert len(records) >= 1

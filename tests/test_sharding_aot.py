@@ -164,3 +164,39 @@ def test_llama3_8b_train_step_lowers_on_abstract_pod_mesh(partition):
     )
     hlo = lowered.as_text()
     assert "sdy.sharding" in hlo or "mhlo.sharding" in hlo or "sharding" in hlo
+
+
+def test_llama3_8b_training_memory_budget_fits_v5p():
+    """The scaling-methodology planning step: the 8B adam FSDP config on
+    the {data: 8, model: 8} pod must budget within a v5p chip's HBM —
+    computed exactly from shapes and shardings, no arrays."""
+    import optax
+
+    from torchpruner_tpu.parallel import HBM_BYTES, training_memory
+
+    model, params, _ = _shapes()
+    # ZeRO-style FSDP over the FULL 64-chip mesh (both axes)
+    shardings = fsdp_sharding(params, MESH, axis=("data", "model"))
+    budget = training_memory(
+        model, shardings, dict(MESH.shape), tx=optax.adam(1e-4),
+        batch_per_chip=2, compute_dtype=jnp.bfloat16, remat=True,
+    )
+    # 8.03B f32 params over 64 chips ~ 0.47 GiB; x4 for grads+adam m/v
+    gib = 2.0**30
+    assert 0.3 * gib < budget.params_bytes < 0.7 * gib
+    assert budget.opt_bytes > 1.5 * budget.params_bytes  # m + v + counts
+    assert budget.fits(HBM_BYTES["TPU v5p"]), budget.report()
+    # sharding over the model axis alone costs ~8x the parameter bytes
+    b_model_only = training_memory(
+        model, fsdp_sharding(params, MESH), dict(MESH.shape),
+    )
+    assert b_model_only.params_bytes > 7 * budget.params_bytes
+    # and the same model replicated on one chip must NOT fit a v5e
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rep = jax.tree_util.tree_map(
+        lambda _: NamedSharding(MESH, P()), params,
+    )
+    b1 = training_memory(model, rep, dict(MESH.shape), tx=optax.adam(1e-4))
+    assert not b1.fits(HBM_BYTES["TPU v5e"])
+    assert b1.largest_replicated[1] > 1 * gib  # the embedding

@@ -233,7 +233,10 @@ def run_prune_retrain(
     logger = CSVLogger(cfg.log_path, experiment=cfg.name)
     history: List[PruneStepRecord] = []
 
-    val_batches = val.batches(cfg.eval_batch_size)
+    # sharded paths split batches over the data axis — remainder batches
+    # can't shard (sharding.shard_batch contract), so mesh mode drops them
+    drop = mesh is not None
+    val_batches = val.batches(cfg.eval_batch_size, drop_remainder=drop)
     test_batches = test.batches(cfg.eval_batch_size)
 
     score_dtype = jnp.bfloat16 if cfg.score_dtype == "bfloat16" else None
@@ -248,15 +251,12 @@ def run_prune_retrain(
         if mesh is not None and "data" in cfg.mesh:
             from torchpruner_tpu.parallel import DistributedScorer
 
-            scores = DistributedScorer(metric, mesh).run(
-                target,
-                find_best_evaluation_layer=cfg.find_best_evaluation_layer,
-            )
+            scorer = DistributedScorer(metric, mesh)
         else:
-            scores = metric.run(
-                target,
-                find_best_evaluation_layer=cfg.find_best_evaluation_layer,
-            )
+            scorer = metric
+        scores = scorer.run(
+            target, find_best_evaluation_layer=cfg.find_best_evaluation_layer
+        )
         pre_loss, pre_acc = trainer.evaluate(test_batches)
         res = prune_by_scores(
             trainer.model, trainer.params, target, scores,
@@ -272,7 +272,8 @@ def run_prune_retrain(
         for epoch in range(cfg.finetune_epochs):
             train_epoch(
                 trainer, train.batches(cfg.batch_size, shuffle=True,
-                                       seed=cfg.seed + epoch),
+                                       seed=cfg.seed + epoch,
+                                       drop_remainder=drop),
                 epoch=epoch, verbose=False,
             )
 

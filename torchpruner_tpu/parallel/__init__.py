@@ -21,6 +21,11 @@ from torchpruner_tpu.parallel.sharding import (
     tp_sharding,
     tp_specs,
 )
+from torchpruner_tpu.parallel.memory import (
+    HBM_BYTES,
+    MemoryBudget,
+    training_memory,
+)
 from torchpruner_tpu.parallel.scoring import DistributedScorer
 from torchpruner_tpu.parallel.train import ShardedTrainer
 from torchpruner_tpu.parallel.ring import ring_attention, ring_attention_local
@@ -37,6 +42,9 @@ __all__ = [
     "tp_sharding",
     "tp_specs",
     "DistributedScorer",
+    "HBM_BYTES",
+    "MemoryBudget",
+    "training_memory",
     "ShardedTrainer",
     "ring_attention",
     "ring_attention_local",

@@ -1,0 +1,177 @@
+"""Per-chip memory accounting for sharded training — plan before you pod.
+
+Given a model's shape tree and a sharding assignment, compute exactly how
+many bytes of parameters, gradients and optimizer slots land on each chip,
+without materializing anything (``jax.eval_shape`` + the sharding rules are
+pure functions of shapes).  This is the planning step the scaling
+methodology prescribes — pick a mesh, annotate shardings, CHECK THE BYTES,
+then compile — and what the reference never needed at single-GPU scale.
+
+The activation estimate is deliberately coarse (per-layer output sizes for
+one microbatch, halved by remat to block boundaries); exact activation
+footprints come from ``jit(...).lower().compile().memory_analysis()`` on
+real hardware.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+#: HBM per chip (bytes) by device kind prefix — public spec sheets
+HBM_BYTES = {
+    "TPU v3": 16 * 2**30,
+    "TPU v4": 32 * 2**30,
+    "TPU v5 lite": 16 * 2**30,
+    "TPU v5e": 16 * 2**30,
+    "TPU v5p": 95 * 2**30,
+    "TPU v5": 95 * 2**30,
+    "TPU v6 lite": 32 * 2**30,
+    "TPU v6e": 32 * 2**30,
+}
+
+
+@dataclass
+class MemoryBudget:
+    """Per-chip byte accounting for one training configuration."""
+
+    params_bytes: int
+    grads_bytes: int
+    opt_bytes: int
+    activations_bytes: int  # coarse estimate, one microbatch
+    largest_replicated: tuple  # (path, bytes) — the first thing to shard
+
+    @property
+    def total_bytes(self) -> int:
+        return (self.params_bytes + self.grads_bytes + self.opt_bytes
+                + self.activations_bytes)
+
+    def fits(self, hbm_bytes: int, headroom: float = 0.85) -> bool:
+        """True when the budget fits within ``headroom`` of the chip HBM
+        (the rest goes to XLA temps, collectives buffers, programs)."""
+        return self.total_bytes <= hbm_bytes * headroom
+
+    def report(self) -> str:
+        gib = 2.0**30
+        path, rb = self.largest_replicated
+        return (
+            f"per-chip: params {self.params_bytes / gib:.2f} GiB, "
+            f"grads {self.grads_bytes / gib:.2f} GiB, "
+            f"opt {self.opt_bytes / gib:.2f} GiB, "
+            f"activations ~{self.activations_bytes / gib:.2f} GiB "
+            f"(total {self.total_bytes / gib:.2f} GiB); "
+            f"largest replicated tensor: {path} ({rb / gib:.2f} GiB)"
+        )
+
+
+def _sharded_bytes(shape, dtype, spec, mesh_shape: Dict[str, int]) -> int:
+    """Bytes of one array's shard on a single chip under ``spec``."""
+    n = int(np.prod(shape)) if shape else 1
+    denom = 1
+    for axis in spec:
+        if axis is None:
+            continue
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        for a in axes:
+            denom *= mesh_shape[a]
+    return (n // max(1, denom)) * jnp.dtype(dtype).itemsize
+
+
+def training_memory(
+    model,
+    shardings,
+    mesh_shape: Dict[str, int],
+    *,
+    tx=None,
+    batch_per_chip: int = 1,
+    param_dtype=jnp.float32,
+    compute_dtype=None,
+    remat: bool = False,
+    seed: int = 0,
+) -> MemoryBudget:
+    """Per-chip byte budget for training ``model`` under ``shardings``.
+
+    ``shardings`` is a pytree of ``NamedSharding``/``PartitionSpec``
+    matching the param tree (build it with ``fsdp_sharding`` /
+    ``tp_sharding`` over an ``AbstractMesh`` — no devices needed).
+    Gradients mirror the parameter shardings; optimizer slots are counted
+    from ``jax.eval_shape(tx.init, params)`` with param-shaped leaves
+    sharded like their param.
+    """
+    from torchpruner_tpu.core.segment import init_model
+
+    params, _ = jax.eval_shape(
+        lambda k: init_model(model, seed=seed), jax.random.PRNGKey(seed)
+    )
+    flat_p, _ = jax.tree_util.tree_flatten_with_path(params)
+    flat_s = jax.tree_util.tree_leaves(
+        shardings, is_leaf=lambda x: hasattr(x, "spec") or _is_pspec(x)
+    )
+    if len(flat_p) != len(flat_s):
+        raise ValueError(
+            f"shardings tree has {len(flat_s)} leaves, params {len(flat_p)}"
+        )
+
+    p_bytes = 0
+    largest_rep = ("", 0)
+    specs = []
+    for (path, leaf), sh in zip(flat_p, flat_s):
+        spec = sh.spec if hasattr(sh, "spec") else sh
+        specs.append(spec)
+        b = _sharded_bytes(leaf.shape, param_dtype, spec, mesh_shape)
+        p_bytes += b
+        if all(a is None for a in spec):
+            full = int(np.prod(leaf.shape)) * jnp.dtype(param_dtype).itemsize
+            if full > largest_rep[1]:
+                name = "/".join(str(getattr(k, "key", k)) for k in path)
+                largest_rep = (name, full)
+    # gradients arrive in the params' dtype/sharding (bf16 grads when the
+    # whole backward is bf16 would halve this; masters stay f32 here)
+    g_bytes = p_bytes
+
+    opt_bytes = 0
+    if tx is not None:
+        opt_shapes = jax.eval_shape(tx.init, params)
+        # count param-shaped slots as sharded, scalars as replicated
+        shape_to_spec = {}
+        for (path, leaf), spec in zip(flat_p, specs):
+            shape_to_spec.setdefault(tuple(leaf.shape), spec)
+        for leaf in jax.tree_util.tree_leaves(opt_shapes):
+            spec = shape_to_spec.get(tuple(leaf.shape))
+            if spec is None:
+                opt_bytes += int(np.prod(leaf.shape) or 1) * jnp.dtype(
+                    leaf.dtype
+                ).itemsize
+            else:
+                opt_bytes += _sharded_bytes(
+                    leaf.shape, leaf.dtype, spec, mesh_shape
+                )
+
+    act_dtype = compute_dtype if compute_dtype is not None else param_dtype
+    act = 0
+    for shp in getattr(model, "shapes", ()):
+        out_shape = shp[1] if isinstance(shp, tuple) and len(shp) == 2 else shp
+        act += int(np.prod(out_shape)) * batch_per_chip
+    act_bytes = act * jnp.dtype(act_dtype).itemsize
+    if remat:
+        # saved activations shrink to block boundaries; the recompute
+        # peak is roughly one block's internals
+        act_bytes //= 2
+
+    return MemoryBudget(
+        params_bytes=int(p_bytes),
+        grads_bytes=int(g_bytes),
+        opt_bytes=int(opt_bytes),
+        activations_bytes=int(act_bytes),
+        largest_replicated=largest_rep,
+    )
+
+
+def _is_pspec(x) -> bool:
+    from jax.sharding import PartitionSpec
+
+    return isinstance(x, PartitionSpec)

@@ -38,26 +38,37 @@ def replicate(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def fsdp_spec(shape, mesh: Mesh, axis: str = "model", min_size: int = 2**14):
+def fsdp_spec(shape, mesh: Mesh, axis="model", min_size: int = 2**14):
     """PartitionSpec for one array: shard the largest dim divisible by the
-    mesh axis; replicate small or indivisible arrays."""
-    if axis not in mesh.axis_names:
+    mesh axis; replicate small or indivisible arrays.
+
+    ``axis`` may be a tuple of mesh axes (e.g. ``("data", "model")``) for
+    ZeRO-style sharding over the FULL mesh — per-chip parameter bytes then
+    divide by the product of the axis sizes, at the cost of gathers over
+    the data axis too.  Falls back to the first axis alone when a dim
+    divides it but not the product."""
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    axes = tuple(a for a in axes if a in mesh.axis_names)
+    if not axes:
         return P()
-    size = mesh.shape[axis]
+    size = int(np.prod([mesh.shape[a] for a in axes]))
     if size == 1 or int(np.prod(shape)) < min_size:
         return P()
     dims = sorted(range(len(shape)), key=lambda d: -shape[d])
     for d in dims:
         if shape[d] % size == 0:
             spec = [None] * len(shape)
-            spec[d] = axis
+            spec[d] = axes if len(axes) > 1 else axes[0]
             return P(*spec)
+    if len(axes) > 1:  # partial: shard over the first axis alone
+        return fsdp_spec(shape, mesh, axes[0], min_size)
     return P()
 
 
-def fsdp_sharding(tree, mesh: Mesh, axis: str = "model",
+def fsdp_sharding(tree, mesh: Mesh, axis="model",
                   min_size: int = 2**14):
-    """Sharding pytree (same structure as ``tree``) under the FSDP rule."""
+    """Sharding pytree (same structure as ``tree``) under the FSDP rule.
+    ``axis`` may be a tuple for ZeRO-style full-mesh sharding."""
     return jax.tree_util.tree_map(
         lambda leaf: NamedSharding(
             mesh, fsdp_spec(np.shape(leaf), mesh, axis, min_size)
