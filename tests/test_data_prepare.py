@@ -110,3 +110,20 @@ def test_prepare_digits_materializes_loader_output(tmp_path):
 def test_prepare_mnist_missing_files_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         prepare_mnist(str(tmp_path), str(tmp_path / "out"))
+
+
+def test_disk_datasets_are_memory_mapped(tmp_path, monkeypatch):
+    """Real on-disk datasets load as memmaps (imagenet-scale arrays never
+    fully materialize) and batch identically to an eager load."""
+    x = np.random.default_rng(0).normal(size=(50, 8, 8, 3)).astype(np.float32)
+    y = np.random.default_rng(1).integers(0, 10, size=(50,)).astype(np.int32)
+    np.save(tmp_path / "imagenet64_val_x.npy",
+            x.astype(np.float32))
+    np.save(tmp_path / "imagenet64_val_y.npy", y)
+    monkeypatch.setenv("TORCHPRUNER_TPU_DATA_DIR", str(tmp_path))
+    ds = load_dataset("imagenet64", "val")
+    assert isinstance(ds.x, np.memmap)
+    for (bx, by), i in zip(ds.iter_batches(16), range(4)):
+        np.testing.assert_array_equal(np.asarray(bx), x[i * 16:(i + 1) * 16])
+    sub = ds.subset(10, seed=3)
+    assert len(sub) == 10 and np.isfinite(np.asarray(sub.x)).all()

@@ -218,3 +218,26 @@ def test_zero_style_fsdp_over_full_mesh_trains():
     l0 = float(t.step(x, y))
     l1 = float(t.step(x, y))
     assert np.isfinite(l0) and l1 < l0
+
+
+def test_tuple_axis_rejected_for_tensor_parallelism():
+    from torchpruner_tpu.models import llama_tiny
+    from torchpruner_tpu.parallel.sharding import tp_sharding
+
+    mesh = make_mesh({"data": 2, "model": 4})
+    model = llama_tiny(depth=1)
+    params, _ = init_model(model, seed=0)
+    with pytest.raises(ValueError, match="single mesh axis"):
+        tp_sharding(model, params, mesh, axis=("data", "model"))
+
+
+def test_memory_budget_rounds_shards_up():
+    from jax.sharding import PartitionSpec as P
+
+    from torchpruner_tpu.parallel.memory import _sharded_bytes
+
+    # dim 10 over 8 chips: ceil(10/8)=2 rows per chip, never 1
+    assert _sharded_bytes((10, 4), np.float32, P("model", None),
+                          {"model": 8}) == 2 * 4 * 4
+    assert _sharded_bytes((16, 4), np.float32, P(("data", "model"), None),
+                          {"data": 2, "model": 4}) == 2 * 4 * 4

@@ -1,0 +1,90 @@
+"""Torch checkpoint import: a torchvision-layout VGG16-bn state_dict maps
+onto the framework's (params, state) and the two frameworks' forwards
+agree — the migration path for the reference's pretrained model."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from torchpruner_tpu.utils.torch_import import (
+    _flatten_perm,
+    import_torch_vgg16_bn,
+)
+
+VGG16_CFG = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+             512, 512, 512, "M", 512, 512, 512, "M"]
+
+
+def build_torch_vgg16_bn(n_classes=10, width=512):
+    """The reference checkpoint's architecture via public torch.nn only
+    (torchvision vgg16_bn features + the reference's 512-wide classifier,
+    reference cifar10.py:62-74)."""
+    import torch.nn as nn
+
+    feats, in_c = [], 3
+    for v in VGG16_CFG:
+        if v == "M":
+            feats.append(nn.MaxPool2d(2, 2))
+        else:
+            feats += [nn.Conv2d(in_c, v, 3, padding=1),
+                      nn.BatchNorm2d(v), nn.ReLU(True)]
+            in_c = v
+    return nn.Sequential(
+        nn.Sequential(*feats),
+        nn.Sequential(nn.Dropout(), nn.Linear(512, width), nn.ReLU(True),
+                      nn.Dropout(), nn.Linear(width, width), nn.ReLU(True),
+                      nn.Linear(width, n_classes)),
+    )
+
+
+def _rename(sd):
+    """nn.Sequential(0=features, 1=classifier) keys -> torchvision names."""
+    out = {}
+    for k, v in sd.items():
+        k = k.replace("0.", "features.", 1) if k.startswith("0.") else \
+            k.replace("1.", "classifier.", 1)
+        out[k] = v
+    return out
+
+
+def test_vgg16_bn_import_matches_torch_forward():
+    torch.manual_seed(0)
+    tm = build_torch_vgg16_bn().eval()
+    # exercise non-trivial BN statistics
+    with torch.no_grad():
+        for bn in [m for m in tm.modules()
+                   if isinstance(m, torch.nn.BatchNorm2d)]:
+            bn.running_mean.normal_(0, 0.1)
+            bn.running_var.uniform_(0.5, 1.5)
+
+    model, params, state = import_torch_vgg16_bn(_rename(tm.state_dict()))
+    assert model.layer("conv13").features == 512
+    assert model.layer("out").features == 10
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 32, 32, 3)).astype(np.float32)
+    with torch.no_grad():
+        # torch runs NCHW; flatten happens inside Sequential boundary
+        feats = tm[0](torch.from_numpy(x.transpose(0, 3, 1, 2)))
+        want = tm[1](torch.flatten(feats, 1)).numpy()
+    got, _ = model.apply(params, x, state=state, train=False)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-3, atol=1e-3)
+
+
+def test_flatten_perm_round_trips():
+    """torch C-major flatten vs our HWC flatten: permuting the Linear's
+    input rows must make both paths equal for spatial maps > 1x1."""
+    H, W, C = 2, 3, 4
+    x = np.arange(H * W * C).reshape(H, W, C)
+    torch_flat = x.transpose(2, 0, 1).reshape(-1)  # what torch sees
+    ours_flat = x.reshape(-1)
+    perm = _flatten_perm((H, W, C))
+    np.testing.assert_array_equal(torch_flat[perm], ours_flat)
+
+
+def test_import_rejects_wrong_layout():
+    sd = {"features.0.weight": np.zeros((64, 3, 3, 3)),
+          "features.0.bias": np.zeros((64,))}
+    with pytest.raises(ValueError, match="13 conv"):
+        import_torch_vgg16_bn(sd)

@@ -28,6 +28,7 @@ from torchpruner_tpu.core.graph import (
 )
 from torchpruner_tpu.core.plan import PruneGroup, Consumer, PrunePlan
 from torchpruner_tpu.core.pruner import prune, prune_by_scores, Pruner
+from torchpruner_tpu.utils.torch_import import import_torch_vgg16_bn
 from torchpruner_tpu.attributions import (
     RandomAttributionMetric,
     WeightNormAttributionMetric,
@@ -40,6 +41,7 @@ from torchpruner_tpu.attributions import (
 __version__ = "0.1.0"
 
 __all__ = [
+    "import_torch_vgg16_bn",
     "SegmentedModel",
     "init_model",
     "layers",

@@ -104,13 +104,26 @@ def synthetic_dataset(
 
 def _load_from_disk(name: str, split: str, dtype) -> Optional[Dataset]:
     """``$TORCHPRUNER_TPU_DATA_DIR/{name}_{split}_{x,y}.npy`` if present
-    (real data drops in for any dataset name, image or token)."""
+    (real data drops in for any dataset name, image or token).
+
+    ``x`` is memory-mapped: imagenet-scale arrays never fully
+    materialize in host RAM — batching slices copy only the touched rows
+    (labels are small and load eagerly).  The dtype conversion is skipped
+    when the file already carries the requested dtype (what
+    ``data/prepare.py`` writes), preserving the mapping; a mismatched
+    dtype forces a one-time conversion in memory."""
     data_dir = os.environ.get("TORCHPRUNER_TPU_DATA_DIR", "")
     fx = os.path.join(data_dir, f"{name}_{split}_x.npy")
     fy = os.path.join(data_dir, f"{name}_{split}_y.npy")
     if data_dir and os.path.exists(fx) and os.path.exists(fy):
-        x, y = np.load(fx), np.load(fy)
-        return Dataset(x.astype(dtype), y.astype(np.int32), name)
+        x = np.load(fx, mmap_mode="r")
+        if x.dtype != dtype:
+            x = np.asarray(x).astype(dtype)
+        # y maps too: for LM datasets the target file is corpus-sized
+        y = np.load(fy, mmap_mode="r")
+        if y.dtype != np.int32:
+            y = np.asarray(y).astype(np.int32)
+        return Dataset(x, y, name)
     return None
 
 

@@ -304,6 +304,14 @@ def write_parity_report(
         "python -m torchpruner_tpu.experiments.parity --robustness vgg16_bn:cifar10 --epochs 160",
         "```",
         "",
+        "Holders of the reference's pretrained checkpoint (the 92.5% "
+        "`cifar10_vgg16_bn.pt` its notebook downloads) can skip the "
+        "training step entirely: "
+        "`tp.import_torch_vgg16_bn(torch.load(path))` maps it onto this "
+        "framework's `(model, params, state)` (forward-parity tested "
+        "against torch), and `run_robustness_config(cfg, model=..., "
+        "params=..., state=...)` runs the sweep on those exact weights.",
+        "",
     ]
     text = "\n".join(lines)
     with open(path, "w") as f:

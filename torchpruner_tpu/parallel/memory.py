@@ -69,16 +69,20 @@ class MemoryBudget:
 
 
 def _sharded_bytes(shape, dtype, spec, mesh_shape: Dict[str, int]) -> int:
-    """Bytes of one array's shard on a single chip under ``spec``."""
-    n = int(np.prod(shape)) if shape else 1
-    denom = 1
-    for axis in spec:
-        if axis is None:
+    """Bytes of one array's shard on a single chip under ``spec``.
+
+    Per-dim extents round UP (a dim of 10 sharded 8 ways puts ceil(10/8)=2
+    rows on a chip, padded) — budgets must never undercount."""
+    extents = list(shape)
+    for d, axis in enumerate(spec):
+        if axis is None or d >= len(extents):
             continue
-        axes = axis if isinstance(axis, tuple) else (axis,)
-        for a in axes:
-            denom *= mesh_shape[a]
-    return (n // max(1, denom)) * jnp.dtype(dtype).itemsize
+        k = 1
+        for a in (axis if isinstance(axis, tuple) else (axis,)):
+            k *= mesh_shape[a]
+        extents[d] = -(-extents[d] // k)  # ceil division
+    n = int(np.prod(extents)) if extents else 1
+    return n * jnp.dtype(dtype).itemsize
 
 
 def training_memory(
@@ -135,13 +139,29 @@ def training_memory(
 
     opt_bytes = 0
     if tx is not None:
+        import optax
+
         opt_shapes = jax.eval_shape(tx.init, params)
-        # count param-shaped slots as sharded, scalars as replicated
-        shape_to_spec = {}
-        for (path, leaf), spec in zip(flat_p, specs):
-            shape_to_spec.setdefault(tuple(leaf.shape), spec)
-        for leaf in jax.tree_util.tree_leaves(opt_shapes):
-            spec = shape_to_spec.get(tuple(leaf.shape))
+        # map specs onto the optimizer state STRUCTURALLY (each param-
+        # shaped slot gets exactly its param's spec, same-shape params
+        # with different specs included) — the same rule the trainer uses
+        # for real placement (parallel/train.py _shardings)
+        spec_tree = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(params), specs
+        )
+        opt_specs = optax.tree_map_params(
+            tx,
+            lambda _leaf, spec: spec,
+            opt_shapes,
+            spec_tree,
+            transform_non_params=lambda _leaf: None,
+        )
+        for leaf, spec in zip(
+            jax.tree_util.tree_leaves(opt_shapes),
+            jax.tree_util.tree_leaves(
+                opt_specs, is_leaf=lambda x: x is None or _is_pspec(x)
+            ),
+        ):
             if spec is None:
                 opt_bytes += int(np.prod(leaf.shape) or 1) * jnp.dtype(
                     leaf.dtype

@@ -184,7 +184,17 @@ def tp_sharding(model, params, mesh: Mesh, axis: str = "model",
                 min_size: int = 2**14):
     """Sharding pytree for ``params``: pruning-graph-derived TP specs where
     they apply, the FSDP rule everywhere else (embeddings, norms, the
-    residual-pinned projections)."""
+    residual-pinned projections).
+
+    ``axis`` must be a single mesh axis: TP's column/row-parallel pairs
+    communicate over ONE axis by construction (ZeRO-style tuple axes are
+    an FSDP concept — use ``partition="fsdp"`` for those)."""
+    if isinstance(axis, tuple):
+        raise ValueError(
+            "tensor parallelism shards over a single mesh axis; tuple "
+            f"axes {axis!r} are only meaningful for the FSDP rule "
+            "(partition='fsdp')"
+        )
     assigned = tp_specs(model, mesh, axis)
 
     def spec_for(path, leaf):
