@@ -88,3 +88,85 @@ def test_import_rejects_wrong_layout():
           "features.0.bias": np.zeros((64,))}
     with pytest.raises(ValueError, match="13 conv"):
         import_torch_vgg16_bn(sd)
+
+
+def test_hf_llama_import_matches_transformers_forward():
+    """A HuggingFace LlamaForCausalLM state_dict (random init, tiny
+    config, built locally — no network) imports onto our llama() and the
+    two frameworks' logits agree."""
+    transformers = pytest.importorskip("transformers")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from torchpruner_tpu.utils.torch_import import import_hf_llama
+
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0, tie_word_embeddings=False,
+        attention_bias=False, mlp_bias=False,
+    )
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(cfg).eval()
+
+    model, params, state = import_hf_llama(
+        hf.state_dict(), vocab_size=128, dim=32, depth=2, num_heads=4,
+        num_kv_heads=2, ffn_dim=48, rope_theta=10000.0, seq_len=16,
+    )
+    x = np.random.default_rng(0).integers(0, 128, size=(2, 16))
+    with torch.no_grad():
+        want = hf(torch.from_numpy(x)).logits.numpy()
+    got, _ = model.apply(params, x.astype(np.int32), state=state)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-3, atol=2e-3)
+
+
+def test_hf_llama_import_then_prune_and_train():
+    """The migration composes with the framework's defining operation:
+    import -> FFN prune -> train step."""
+    transformers = pytest.importorskip("transformers")
+    import optax
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from torchpruner_tpu.core.pruner import prune
+    from torchpruner_tpu.train.loop import Trainer
+    from torchpruner_tpu.utils.losses import lm_cross_entropy_loss
+    from torchpruner_tpu.utils.torch_import import import_hf_llama
+
+    cfg = LlamaConfig(
+        vocab_size=64, hidden_size=16, intermediate_size=24,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        tie_word_embeddings=True, attention_bias=False, mlp_bias=False,
+    )
+    hf = LlamaForCausalLM(cfg)
+    model, params, state = import_hf_llama(
+        hf.state_dict(), vocab_size=64, dim=16, depth=1, num_heads=2,
+        num_kv_heads=2, ffn_dim=24, seq_len=8,
+    )
+    res = prune(model, params, "block1_ffn/gate", [0, 5], state=state)
+    t = Trainer.create(res.model, optax.adam(1e-3), lm_cross_entropy_loss,
+                       params=res.params, state=res.state)
+    x = np.random.default_rng(0).integers(0, 64, size=(4, 8)).astype(np.int32)
+    l0 = float(t.step(x, x))
+    l1 = float(t.step(x, x))
+    assert np.isfinite(l0) and l1 < l0
+
+
+def test_import_handles_bf16_checkpoints():
+    """Real llama3 checkpoints ship torch bfloat16 — the importer must
+    widen, not crash."""
+    transformers = pytest.importorskip("transformers")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from torchpruner_tpu.utils.torch_import import import_hf_llama
+
+    hf = LlamaForCausalLM(LlamaConfig(
+        vocab_size=64, hidden_size=16, intermediate_size=24,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        tie_word_embeddings=True, attention_bias=False, mlp_bias=False,
+    )).to(torch.bfloat16)
+    model, params, _ = import_hf_llama(
+        hf.state_dict(), vocab_size=64, dim=16, depth=1, num_heads=2,
+        num_kv_heads=2, ffn_dim=24, seq_len=8,
+    )
+    x = np.zeros((1, 8), np.int32)
+    out, _ = model.apply(params, x)
+    assert np.isfinite(np.asarray(out)).all()

@@ -28,7 +28,10 @@ from torchpruner_tpu.core.graph import (
 )
 from torchpruner_tpu.core.plan import PruneGroup, Consumer, PrunePlan
 from torchpruner_tpu.core.pruner import prune, prune_by_scores, Pruner
-from torchpruner_tpu.utils.torch_import import import_torch_vgg16_bn
+from torchpruner_tpu.utils.torch_import import (
+    import_hf_llama,
+    import_torch_vgg16_bn,
+)
 from torchpruner_tpu.attributions import (
     RandomAttributionMetric,
     WeightNormAttributionMetric,
@@ -42,6 +45,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "import_torch_vgg16_bn",
+    "import_hf_llama",
     "SegmentedModel",
     "init_model",
     "layers",

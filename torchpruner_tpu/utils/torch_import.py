@@ -30,7 +30,12 @@ from torchpruner_tpu.core.segment import SegmentedModel
 
 def _to_np(t) -> np.ndarray:
     if hasattr(t, "detach"):  # torch tensor, no torch import needed
-        t = t.detach().cpu().numpy()
+        t = t.detach().cpu()
+        if "bfloat16" in str(t.dtype):
+            # numpy has no torch-bf16 bridge (real llama3 checkpoints
+            # ship bf16); widen to f32 first
+            t = t.float()
+        t = t.numpy()
     return np.asarray(t)
 
 
@@ -135,29 +140,124 @@ def _pre_flatten_shape(model: SegmentedModel) -> Tuple[int, ...]:
     raise ValueError("model has no Flatten layer")
 
 
-def _validate_shapes(model: SegmentedModel, params, state):
-    from torchpruner_tpu.core.segment import init_model
-
+def _named_leaves(tree):
     import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return {
+        "/".join(str(getattr(k, "key", k)) for k in path): leaf
+        for path, leaf in flat
+    }
+
+
+def _validate_shapes(model: SegmentedModel, params, state):
+    import jax
+
+    from torchpruner_tpu.core.segment import init_model
 
     ref_p, ref_s = jax.eval_shape(
         lambda k: init_model(model, seed=0), jax.random.PRNGKey(0)
     )
     for tree, ref, what in ((params, ref_p, "params"), (state, ref_s, "state")):
-        for layer, entry in tree.items():
-            for pname, arr in entry.items():
-                want = tuple(ref[layer][pname].shape)
-                if tuple(arr.shape) != want:
-                    raise ValueError(
-                        f"{what} {layer}/{pname}: checkpoint shape "
-                        f"{arr.shape} vs model {want}"
-                    )
+        if what == "state" and not tree:
+            continue  # stateless import (RMSNorm-only models)
+        got, want = _named_leaves(tree), _named_leaves(ref)
+        if set(got) != set(want):
+            raise ValueError(
+                f"{what} tree mismatch: missing {sorted(set(want) - set(got))[:5]}, "
+                f"unexpected {sorted(set(got) - set(want))[:5]}"
+            )
+        for name, arr in got.items():
+            if tuple(arr.shape) != tuple(want[name].shape):
+                raise ValueError(
+                    f"{what} {name}: checkpoint shape {arr.shape} vs "
+                    f"model {tuple(want[name].shape)}"
+                )
 
 
 def _as_jnp(tree):
     import jax.numpy as jnp
 
-    return {
-        k: {p: jnp.asarray(a, jnp.float32) for p, a in v.items()}
-        for k, v in tree.items()
+    def conv(v):
+        if isinstance(v, dict):
+            return {k: conv(x) for k, x in v.items()}
+        return jnp.asarray(v, jnp.float32)
+
+    return {k: conv(v) for k, v in tree.items()}
+
+
+def import_hf_llama(
+    state_dict,
+    *,
+    vocab_size: int,
+    dim: int,
+    depth: int,
+    num_heads: int,
+    num_kv_heads: int,
+    ffn_dim: int,
+    rope_theta: float = 500000.0,
+    seq_len: int = 2048,
+) -> Tuple[SegmentedModel, Dict[str, Any], Dict[str, Any]]:
+    """Map a HuggingFace ``LlamaForCausalLM`` ``state_dict`` onto this
+    framework's :func:`~torchpruner_tpu.models.llama` trees — the
+    migration path for the llama3_8b BASELINE config.
+
+    Layout conversions (HF stores every projection as a torch Linear
+    ``(out, in)``):
+
+    - ``q_proj (H*Dh, d)`` -> ``wq (d, H, Dh)``; ``k/v_proj (KV*Dh, d)``
+      -> ``wk/wv (d, KV, Dh)``; ``o_proj (d, H*Dh)`` -> ``wo (H, Dh, d)``.
+    - ``gate_proj``/``up_proj`` -> ``GatedDense wg/wu (d, F)``;
+      ``down_proj`` -> ``down.w (F, d)``.
+    - ``input_layernorm`` -> the attention block's RMSNorm;
+      ``post_attention_layernorm`` -> the FFN block's; ``model.norm`` ->
+      ``final_norm``; ``embed_tokens``/``lm_head`` pass through
+      (``lm_head`` may be absent when tied — the embedding is reused).
+
+    Both frameworks apply the same half-split rotary embedding
+    (``rotate_half``), so no permutation of head channels is needed.
+    """
+    from torchpruner_tpu.models import llama
+
+    model = llama(
+        vocab_size=vocab_size, dim=dim, depth=depth, num_heads=num_heads,
+        num_kv_heads=num_kv_heads, head_dim=dim // num_heads,
+        ffn_dim=ffn_dim, rope_theta=rope_theta, seq_len=seq_len,
+    )
+    sd = {k.removeprefix("model."): _to_np(v) for k, v in state_dict.items()}
+    H, KV = num_heads, num_kv_heads
+    Dh = dim // num_heads
+
+    def lin(key):  # torch Linear weight -> (in, out)
+        return sd[key].T
+
+    params: Dict[str, Any] = {
+        "tok_emb": {"emb": sd["embed_tokens.weight"]},
+        "final_norm": {"scale": sd["norm.weight"]},
+        "lm_head": {
+            "w": (sd["lm_head.weight"].T if "lm_head.weight" in sd
+                  else sd["embed_tokens.weight"].T)  # tied embeddings
+        },
     }
+    for i in range(1, depth + 1):
+        p = f"layers.{i - 1}."
+        params[f"block{i}_attn"] = {
+            "norm": {"scale": sd[p + "input_layernorm.weight"]},
+            "attn": {
+                "wq": lin(p + "self_attn.q_proj.weight").reshape(dim, H, Dh),
+                "wk": lin(p + "self_attn.k_proj.weight").reshape(dim, KV, Dh),
+                "wv": lin(p + "self_attn.v_proj.weight").reshape(dim, KV, Dh),
+                # o_proj (d, H*Dh) -> transpose -> (H*Dh, d) -> (H, Dh, d)
+                "wo": lin(p + "self_attn.o_proj.weight").reshape(H, Dh, dim),
+            },
+        }
+        params[f"block{i}_ffn"] = {
+            "norm": {"scale": sd[p + "post_attention_layernorm.weight"]},
+            "gate": {
+                "wg": lin(p + "mlp.gate_proj.weight"),
+                "wu": lin(p + "mlp.up_proj.weight"),
+            },
+            "down": {"w": lin(p + "mlp.down_proj.weight")},
+        }
+    _validate_shapes(model, params, {})
+    return model, _as_jnp(params), {}
