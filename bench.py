@@ -301,6 +301,18 @@ def main() -> dict:
     import jax
 
     smoke = "--smoke" in sys.argv  # tiny config to validate the path on CPU
+    cache_dir = None
+    # same spelling as the CLI's flag, with the short form as an alias
+    if not ({"--no-cache", "--no-compilation-cache"} & set(sys.argv)):
+        # persistent XLA compilation cache: repeated shapes (re-runs, config
+        # sweeps, resume-after-preemption) skip compilation entirely — the
+        # dominant cost of the small workloads.  compile_s in the train leg
+        # still reports what this run actually paid.
+        from torchpruner_tpu.utils.compilation_cache import (
+            enable_persistent_cache,
+        )
+
+        cache_dir = enable_persistent_cache()
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
     legs: dict = {}
@@ -344,6 +356,7 @@ def main() -> dict:
         "vs_baseline": head.get("vs_baseline"),
         "platform": platform,
         "device_kind": getattr(jax.devices()[0], "device_kind", None),
+        "compilation_cache": cache_dir,
         "legs": legs,
     }
     if ok("vgg16_train"):

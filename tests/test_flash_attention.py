@@ -55,6 +55,25 @@ def test_blocking_selection():
     assert _pick_blocks(4) is None  # shorter than the minimum block
 
 
+def test_block_size_override_matches():
+    """Caller-tuned tile sizes (forward and backward) must not change
+    numerics — only scheduling."""
+    assert _pick_blocks(512, 256, 64) == (256, 64)
+    q, k, v = qkv(S=64)
+
+    out = flash_attention(q, k, v, causal=True, block_q=16, block_k=32)
+    ref = _xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def loss(fn):
+        return jax.grad(lambda a: jnp.sum(fn(a, k, v)))(q)
+
+    got = loss(lambda a, b=k, c=v: flash_attention(
+        a, b, c, causal=True, block_q=16, block_k=32))
+    want = loss(lambda a, b=k, c=v: _xla_attention(a, b, c, causal=True))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
+
+
 def test_odd_length_still_matches():
     q, k, v = qkv(S=17)  # prime-ish length: single (17, 17) block
     out = flash_attention(q, k, v, causal=True)

@@ -1,0 +1,94 @@
+"""Ulysses (all-to-all head-scatter) sequence parallelism on the 8-device
+CPU mesh: numerics vs the single-device reference, causal masking, gradient
+flow through both all-to-alls, and the ring/ulysses strategy dispatch."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from torchpruner_tpu.ops.flash_attention import _xla_attention
+from torchpruner_tpu.parallel import (
+    choose_sp_strategy,
+    make_mesh,
+    sequence_parallel_attention,
+    ulysses_attention,
+)
+
+
+def qkv(B=2, S=32, H=8, Dh=8, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (B, S, H, Dh)) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("n_seq", [2, 8])
+def test_ulysses_matches_single_device(causal, n_seq):
+    mesh = make_mesh({"seq": n_seq}, devices=jax.devices()[:n_seq])
+    q, k, v = qkv()
+    out = ulysses_attention(q, k, v, mesh, causal=causal)
+    ref = _xla_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    mesh = make_mesh({"seq": 8})
+    q, k, v = qkv(H=6)  # 6 % 8 != 0
+    with pytest.raises(ValueError, match="heads"):
+        ulysses_attention(q, k, v, mesh)
+
+
+def test_ulysses_rejects_indivisible_sequence():
+    mesh = make_mesh({"seq": 8})
+    q, k, v = qkv(S=30)
+    with pytest.raises(ValueError, match="sequence"):
+        ulysses_attention(q, k, v, mesh)
+
+
+def test_ulysses_gradients_match_single_device():
+    mesh = make_mesh({"seq": 4}, devices=jax.devices()[:4])
+    q, k, v = qkv(S=16, H=4)
+    g = jax.random.normal(jax.random.PRNGKey(7), q.shape)
+
+    def grads(fn):
+        return jax.grad(
+            lambda q_, k_, v_: jnp.sum(fn(q_, k_, v_) * g), argnums=(0, 1, 2)
+        )(q, k, v)
+
+    got = grads(lambda a, b, c: ulysses_attention(a, b, c, mesh, causal=True))
+    want = grads(lambda a, b, c: _xla_attention(a, b, c, causal=True))
+    for ga, gw in zip(got, want):
+        np.testing.assert_allclose(np.asarray(ga), np.asarray(gw), atol=1e-4)
+
+
+def test_ulysses_bf16_output_dtype():
+    mesh = make_mesh({"seq": 2}, devices=jax.devices()[:2])
+    q, k, v = (t.astype(jnp.bfloat16) for t in qkv(S=16))
+    out = ulysses_attention(q, k, v, mesh, causal=True)
+    assert out.dtype == jnp.bfloat16
+
+
+def test_strategy_dispatch_follows_head_count():
+    mesh = make_mesh({"seq": 8})
+    # 8 heads divide the axis -> ulysses; pruned to 6 heads -> ring
+    assert choose_sp_strategy(8, mesh) == "ulysses"
+    assert choose_sp_strategy(6, mesh) == "ring"
+
+
+@pytest.mark.parametrize("H,expected", [(8, "ulysses"), (6, "ring")])
+def test_auto_dispatch_matches_reference(H, expected):
+    """After pruning heads to a non-divisible count the auto dispatcher must
+    fall back to ring and still match the single-device reference."""
+    mesh = make_mesh({"seq": 4}, devices=jax.devices()[:4])
+    q, k, v = qkv(S=16, H=H)
+    assert choose_sp_strategy(H, mesh) == expected
+    out = sequence_parallel_attention(q, k, v, mesh, causal=True)
+    ref = _xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_unknown_strategy_rejected():
+    mesh = make_mesh({"seq": 2}, devices=jax.devices()[:2])
+    q, k, v = qkv(S=16)
+    with pytest.raises(ValueError, match="strategy"):
+        sequence_parallel_attention(q, k, v, mesh, strategy="nope")

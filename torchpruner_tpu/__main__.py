@@ -36,6 +36,10 @@ def main(argv=None) -> int:
         "--list", action="store_true", help="list presets and exit"
     )
     p.add_argument(
+        "--no-compilation-cache", action="store_true",
+        help="disable the persistent XLA compilation cache",
+    )
+    p.add_argument(
         "--dump-config", metavar="PATH",
         help="write the resolved config JSON to PATH and exit",
     )
@@ -52,6 +56,13 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+
+    if not args.no_compilation_cache:
+        from torchpruner_tpu.utils.compilation_cache import (
+            enable_persistent_cache,
+        )
+
+        enable_persistent_cache()
 
     from torchpruner_tpu.utils.config import ExperimentConfig
 

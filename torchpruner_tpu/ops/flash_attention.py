@@ -318,8 +318,9 @@ def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
 # --------------------------------------------------------------------------
 
 
-def _pick_blocks(S: int):
-    """Largest clean blocking <= default; None if S doesn't block.
+def _pick_blocks(S: int, block_q: int = None, block_k: int = None):
+    """Largest clean blocking <= default (or the requested sizes); None if
+    S doesn't block.
 
     The halving loops always terminate at 1 (everything divides S), so the
     real fallback condition is a *minimum* block size: an awkward length
@@ -327,10 +328,10 @@ def _pick_blocks(S: int):
     programs each doing an S-iteration loop over 1x1 tiles — instead of
     taking the intended XLA path.
     """
-    bq = min(DEFAULT_BLOCK_Q, S)
+    bq = min(block_q or DEFAULT_BLOCK_Q, S)
     while bq > 1 and S % bq:
         bq //= 2
-    bk = min(DEFAULT_BLOCK_K, S)
+    bk = min(block_k or DEFAULT_BLOCK_K, S)
     while bk > 1 and S % bk:
         bk //= 2
     if bq < MIN_BLOCK or bk < MIN_BLOCK:
@@ -342,11 +343,11 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flash_attention(q, k, v, causal):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_attention(q, k, v, causal, block_q, block_k):
     # primal (inference) path: no backward will consume an LSE, so the
     # kernel skips the (B, H, S, 128) LSE writes entirely
-    blocks = _pick_blocks(q.shape[1])
+    blocks = _pick_blocks(q.shape[1], block_q, block_k)
     if blocks is None:
         return _xla_attention(q, k, v, causal=causal)
     bq, bk = blocks
@@ -356,8 +357,8 @@ def _flash_attention(q, k, v, causal):
     return jnp.moveaxis(out, 1, 2)
 
 
-def _flash_vjp_fwd(q, k, v, causal):
-    blocks = _pick_blocks(q.shape[1])
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k):
+    blocks = _pick_blocks(q.shape[1], block_q, block_k)
     if blocks is None:
         return _xla_attention(q, k, v, causal=causal), (q, k, v, None, None)
     bq, bk = blocks
@@ -373,7 +374,7 @@ def _flash_vjp_fwd(q, k, v, causal):
     return out, (q, k, v, out, lse[..., :1])
 
 
-def _flash_vjp_bwd(causal, res, g):
+def _flash_vjp_bwd(causal, block_q, block_k, res, g):
     q, k, v, o, lse = res
     if lse is None:  # non-blocking shapes: differentiate the XLA path
         _, vjp = jax.vjp(
@@ -381,7 +382,7 @@ def _flash_vjp_bwd(causal, res, g):
             q, k, v,
         )
         return vjp(g)
-    bq, bk = _pick_blocks(q.shape[1])
+    bq, bk = _pick_blocks(q.shape[1], block_q, block_k)
     qt, kt, vt, ot, gt = (jnp.moveaxis(t, 2, 1) for t in (q, k, v, o, g))
     dq, dk, dv = _flash_bwd(qt, kt, vt, ot, lse, gt, causal, bq, bk,
                             _interpret())
@@ -391,6 +392,11 @@ def _flash_vjp_bwd(causal, res, g):
 _flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def flash_attention(q, k, v, *, causal: bool = False):
-    """Attention on ``(B, S, H, Dh)`` q/k/v (K/V already at H heads)."""
-    return _flash_attention(q, k, v, causal)
+def flash_attention(q, k, v, *, causal: bool = False,
+                    block_q: int = None, block_k: int = None):
+    """Attention on ``(B, S, H, Dh)`` q/k/v (K/V already at H heads).
+
+    ``block_q``/``block_k`` override the default (128, 128) tile sizes —
+    larger KV blocks amortize per-block loop overhead when S is long and
+    VMEM allows (q/k/v blocks + f32 accumulators must fit in ~16 MB)."""
+    return _flash_attention(q, k, v, causal, block_q, block_k)

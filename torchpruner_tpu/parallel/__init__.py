@@ -29,6 +29,12 @@ from torchpruner_tpu.parallel.memory import (
 from torchpruner_tpu.parallel.scoring import DistributedScorer
 from torchpruner_tpu.parallel.train import ShardedTrainer
 from torchpruner_tpu.parallel.ring import ring_attention, ring_attention_local
+from torchpruner_tpu.parallel.ulysses import (
+    choose_sp_strategy,
+    sequence_parallel_attention,
+    ulysses_attention,
+    ulysses_attention_local,
+)
 from torchpruner_tpu.parallel.pipeline import PipelineParallel, balance_stages
 
 __all__ = [
@@ -48,6 +54,10 @@ __all__ = [
     "ShardedTrainer",
     "ring_attention",
     "ring_attention_local",
+    "choose_sp_strategy",
+    "sequence_parallel_attention",
+    "ulysses_attention",
+    "ulysses_attention_local",
     "PipelineParallel",
     "balance_stages",
 ]

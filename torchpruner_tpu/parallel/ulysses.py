@@ -1,0 +1,144 @@
+"""Ulysses-style sequence parallelism — all-to-all head scatter.
+
+The second long-context strategy next to ring attention (`parallel/ring.py`),
+after DeepSpeed-Ulysses (Jacobs et al., 2023).  Both start from the same
+layout — the sequence dim sharded over a ``seq`` mesh axis — but exchange
+differently:
+
+- **Ring**: KV shards rotate with ``ppermute`` (n-1 hops), queries stay put;
+  communication volume per device is O(S/n * H * Dh * (n-1)) and overlaps
+  chunk compute.  Head count doesn't constrain the mesh.
+- **Ulysses** (this module): one ``all_to_all`` re-shards *seq -> heads*, so
+  each device holds the FULL sequence for ``H/n`` heads and runs an ordinary
+  single-device attention — here the Pallas flash kernel
+  (`ops/flash_attention.py`), keeping the O(S x Dh) memory property — then a
+  second ``all_to_all`` re-shards back *heads -> seq*.  Communication is two
+  all-to-alls (4 counting the backward), each moving O(S/n * H * Dh) per
+  device, usually cheaper than the ring at moderate mesh sizes, but it
+  requires ``H % n == 0``.
+
+The reference has no attention at all (SURVEY.md §5.7); this subsystem
+exists because long-context transformer configs (BASELINE.json's llama rows)
+are first-class targets of the TPU build.  Head pruning composes: prune
+attention heads first, then pick the strategy whose divisibility constraint
+the pruned head count still satisfies (`choose_sp_strategy`).
+
+``ulysses_attention`` is the user-facing wrapper (global arrays in,
+``shard_map`` inside); ``ulysses_attention_local`` is the per-shard function
+for callers already under ``shard_map``.  Gradients flow through both
+all-to-alls and the flash kernel's custom VJP, so ``jax.grad`` works
+unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from torchpruner_tpu.ops.flash_attention import flash_attention
+
+
+def ulysses_attention_local(q, k, v, *, axis: str, causal: bool = False,
+                            attn_fn=None):
+    """Per-shard Ulysses attention; must run under ``shard_map`` with the
+    sequence dim of q/k/v sharded over mesh axis ``axis``.
+
+    ``q``/``k``/``v``: (B, S_local, H, Dh) local shards (KV already expanded
+    to H heads).  Returns the local output shard (B, S_local, H, Dh).
+    ``attn_fn(q, k, v, causal=...)`` is the full-sequence attention run on
+    each device's head subset; default is the Pallas flash kernel.
+    """
+    n = lax.axis_size(axis)
+    H = q.shape[2]
+    if H % n:
+        raise ValueError(
+            f"Ulysses needs heads % seq-axis == 0, got H={H}, {axis}={n}; "
+            f"use ring attention for this head count"
+        )
+    attn = attn_fn or flash_attention
+    # seq-sharded -> head-sharded: split the head dim n ways, concatenate
+    # the gathered sequence blocks; (B, S/n, H, Dh) -> (B, S, H/n, Dh)
+    qh, kh, vh = (
+        lax.all_to_all(t, axis, split_axis=2, concat_axis=1, tiled=True)
+        for t in (q, k, v)
+    )
+    out = attn(qh, kh, vh, causal=causal)
+    # head-sharded -> seq-sharded: the inverse exchange
+    return lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(
+    q, k, v, mesh: Mesh, *, axis: str = "seq", causal: bool = False,
+    attn_fn=None,
+):
+    """Sequence-parallel attention on globally-shaped ``(B, S, H, Dh)``
+    arrays via head-scatter all-to-alls (riding ICI), with the full-sequence
+    flash kernel on each device's head subset."""
+    n = mesh.shape[axis]
+    if q.shape[1] % n:
+        raise ValueError(
+            f"sequence {q.shape[1]} not divisible by mesh axis {axis}={n}"
+        )
+    if k.shape[1] != q.shape[1] or v.shape[1] != q.shape[1]:
+        raise ValueError(
+            f"self-attention: K/V length {k.shape[1]}/{v.shape[1]} must "
+            f"equal Q's {q.shape[1]}"
+        )
+    if q.shape[2] % n:
+        raise ValueError(
+            f"Ulysses needs heads % mesh axis == 0, got H={q.shape[2]}, "
+            f"{axis}={n}; use ring_attention instead"
+        )
+    spec = P(None, axis, None, None)
+    # check_vma=False: the Pallas flash kernel's outputs carry no varying-
+    # mesh-axes annotation, which the checker (newer jax) rejects inside
+    # shard_map even though the computation is correctly per-shard
+    fn = shard_map(
+        functools.partial(
+            ulysses_attention_local, axis=axis, causal=causal,
+            attn_fn=attn_fn,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    sharding = NamedSharding(mesh, spec)
+    return fn(
+        jax.device_put(q, sharding),
+        jax.device_put(k, sharding),
+        jax.device_put(v, sharding),
+    )
+
+
+def choose_sp_strategy(n_heads: int, mesh: Mesh, *, axis: str = "seq") -> str:
+    """``"ulysses"`` when the (possibly pruned) head count divides the
+    sequence axis — two all-to-alls beat n-1 ring hops — else ``"ring"``,
+    which has no head-count constraint."""
+    return "ulysses" if n_heads % mesh.shape[axis] == 0 else "ring"
+
+
+def sequence_parallel_attention(
+    q, k, v, mesh: Mesh, *, axis: str = "seq", causal: bool = False,
+    strategy: str = "auto",
+):
+    """Dispatch between the two SP strategies on global arrays.
+
+    ``strategy``: ``"ring"`` | ``"ulysses"`` | ``"auto"`` (Ulysses when the
+    head count allows it, ring otherwise — e.g. after pruning heads to a
+    count not divisible by the mesh axis).
+    """
+    from torchpruner_tpu.parallel.ring import ring_attention
+
+    if strategy == "auto":
+        strategy = choose_sp_strategy(q.shape[2], mesh, axis=axis)
+    if strategy == "ulysses":
+        return ulysses_attention(q, k, v, mesh, axis=axis, causal=causal)
+    if strategy == "ring":
+        return ring_attention(q, k, v, mesh, axis=axis, causal=causal)
+    raise ValueError(f"unknown SP strategy {strategy!r}")

@@ -2,5 +2,12 @@
 
 from torchpruner_tpu.utils.losses import mse_loss, cross_entropy_loss, nll_loss
 from torchpruner_tpu.utils.reductions import mean_plus_2std
+from torchpruner_tpu.utils.compilation_cache import enable_persistent_cache
 
-__all__ = ["mse_loss", "cross_entropy_loss", "nll_loss", "mean_plus_2std"]
+__all__ = [
+    "mse_loss",
+    "cross_entropy_loss",
+    "nll_loss",
+    "mean_plus_2std",
+    "enable_persistent_cache",
+]

@@ -1,0 +1,54 @@
+"""Persistent XLA compilation cache.
+
+Pruning changes static shapes, so every prune step retraces and recompiles
+its train step and scorers (SURVEY.md §7 "recompilation economics") — on
+small workloads compilation dominates wall-clock (the untrained-MNIST prune
+spends most of its 15 s in two Shapley-scan compiles).  A persistent on-disk
+cache makes every *repeated* shape free: re-running an experiment, resuming
+after preemption, or sweeping a config grid that revisits widths all hit the
+cache instead of XLA.
+
+The reference has no analog (eager PyTorch never compiles); this is the
+TPU-native cost being paid down the TPU-native way — ``jax``'s built-in
+persistent cache pointed at a stable location.
+
+Opt-in per entry point (the bench, the CLI, ``train_model``) rather than at
+import, so library users keep full control of their jax config.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+#: environment override for the cache location (shared across runs/users)
+ENV_VAR = "TORCHPRUNER_TPU_COMPILATION_CACHE"
+
+_DEFAULT = os.path.join(
+    os.path.expanduser("~"), ".cache", "torchpruner_tpu", "xla"
+)
+
+
+def enable_persistent_cache(path: str | None = None) -> str | None:
+    """Point jax's persistent compilation cache at ``path`` (default:
+    ``$TORCHPRUNER_TPU_COMPILATION_CACHE`` or ``~/.cache/torchpruner_tpu/xla``).
+
+    Returns the cache directory, or None if it could not be created (the
+    cache is an optimization — failure to enable it must never break a
+    run).  Thresholds are lowered so even sub-second compiles are cached:
+    the prune loop's many small recompiles are exactly the target.
+    """
+    path = path or os.environ.get(ENV_VAR) or _DEFAULT
+    try:
+        os.makedirs(path, exist_ok=True)
+        # thresholds first, the cache dir LAST: if a threshold option is
+        # missing on this jax version, the failure must leave the cache
+        # disabled (returning None while the cache is active would let
+        # benchmark compile timings silently measure cache hits)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception:  # noqa: BLE001 - optional optimization, never fatal
+        return None
+    return path

@@ -143,10 +143,11 @@ def _pre_flatten_shape(model: SegmentedModel) -> Tuple[int, ...]:
 def _named_leaves(tree):
     import jax
 
+    from torchpruner_tpu.core.plan import _key_name
+
     flat, _ = jax.tree_util.tree_flatten_with_path(tree)
     return {
-        "/".join(str(getattr(k, "key", k)) for k in path): leaf
-        for path, leaf in flat
+        "/".join(_key_name(k) for k in path): leaf for path, leaf in flat
     }
 
 
@@ -224,35 +225,55 @@ def import_hf_llama(
         num_kv_heads=num_kv_heads, head_dim=dim // num_heads,
         ffn_dim=ffn_dim, rope_theta=rope_theta, seq_len=seq_len,
     )
-    sd = {k.removeprefix("model."): _to_np(v) for k, v in state_dict.items()}
+    import jax.numpy as jnp
+
+    # Tensors convert LAZILY, one at a time: torch -> f32 numpy -> jax
+    # buffer, with the source entry popped as it is consumed.  An 8B bf16
+    # checkpoint is ~16 GB; eager whole-dict conversion would hold ~3 full
+    # f32 copies (~96 GB) in host RAM at peak, this holds ~1 copy + one
+    # tensor.
+    raw = {k.removeprefix("model."): v for k, v in state_dict.items()}
     H, KV = num_heads, num_kv_heads
     Dh = dim // num_heads
 
-    def lin(key):  # torch Linear weight -> (in, out)
-        return sd[key].T
+    def take(key) -> np.ndarray:
+        return _to_np(raw.pop(key))
 
+    def j(arr) -> "jnp.ndarray":
+        return jnp.asarray(arr, jnp.float32)
+
+    def lin(key):  # torch Linear weight -> (in, out)
+        return j(take(key).T)
+
+    emb = take("embed_tokens.weight")
+    head = raw.pop("lm_head.weight", None)
     params: Dict[str, Any] = {
-        "tok_emb": {"emb": sd["embed_tokens.weight"]},
-        "final_norm": {"scale": sd["norm.weight"]},
+        "tok_emb": {"emb": j(emb)},
+        "final_norm": {"scale": j(take("norm.weight"))},
         "lm_head": {
-            "w": (sd["lm_head.weight"].T if "lm_head.weight" in sd
-                  else sd["embed_tokens.weight"].T)  # tied embeddings
+            # tied embeddings when lm_head is absent
+            "w": j(_to_np(head).T) if head is not None else j(emb.T)
         },
     }
+    del emb, head
     for i in range(1, depth + 1):
         p = f"layers.{i - 1}."
         params[f"block{i}_attn"] = {
-            "norm": {"scale": sd[p + "input_layernorm.weight"]},
+            "norm": {"scale": j(take(p + "input_layernorm.weight"))},
             "attn": {
-                "wq": lin(p + "self_attn.q_proj.weight").reshape(dim, H, Dh),
-                "wk": lin(p + "self_attn.k_proj.weight").reshape(dim, KV, Dh),
-                "wv": lin(p + "self_attn.v_proj.weight").reshape(dim, KV, Dh),
+                "wq": j(take(p + "self_attn.q_proj.weight").T
+                        .reshape(dim, H, Dh)),
+                "wk": j(take(p + "self_attn.k_proj.weight").T
+                        .reshape(dim, KV, Dh)),
+                "wv": j(take(p + "self_attn.v_proj.weight").T
+                        .reshape(dim, KV, Dh)),
                 # o_proj (d, H*Dh) -> transpose -> (H*Dh, d) -> (H, Dh, d)
-                "wo": lin(p + "self_attn.o_proj.weight").reshape(H, Dh, dim),
+                "wo": j(take(p + "self_attn.o_proj.weight").T
+                        .reshape(H, Dh, dim)),
             },
         }
         params[f"block{i}_ffn"] = {
-            "norm": {"scale": sd[p + "post_attention_layernorm.weight"]},
+            "norm": {"scale": j(take(p + "post_attention_layernorm.weight"))},
             "gate": {
                 "wg": lin(p + "mlp.gate_proj.weight"),
                 "wu": lin(p + "mlp.up_proj.weight"),
@@ -260,4 +281,4 @@ def import_hf_llama(
             "down": {"w": lin(p + "mlp.down_proj.weight")},
         }
     _validate_shapes(model, params, {})
-    return model, _as_jnp(params), {}
+    return model, params, {}
