@@ -215,6 +215,29 @@ def test_prune_by_scores_policies():
     assert res3.model.layer("fc1").features == 7
 
 
+def test_bucketed_pruning_rounds_kept_width_up():
+    from torchpruner_tpu.core.pruner import bucket_drop
+
+    m = small_mlp()
+    p, _ = init_model(m)
+    scores = np.array([-1.0, 2.0, -0.5, 3.0, 1.0, 0.5, -2.0, 4.0])
+    # negative policy alone keeps 5; bucket=4 rounds up to 8 -> un-drops
+    # the 3 highest-scoring dropped units (here: all of them)
+    res = prune_by_scores(m, p, "fc1", scores, policy="negative", bucket=4)
+    assert res.model.layer("fc1").features == 8
+    # fraction=0.75 drops 6, keeps 2; bucket=4 keeps 4 — the extra kept
+    # units must be the HIGHEST-scoring of the dropped set
+    drop = np.argsort(scores)[:6]
+    adjusted = bucket_drop(scores, drop, 4)
+    assert len(scores) - len(adjusted) == 4
+    kept = sorted(set(range(8)) - set(adjusted.tolist()))
+    assert kept == sorted(np.argsort(scores)[-4:].tolist())
+    # bucket=1 is the identity
+    np.testing.assert_array_equal(bucket_drop(scores, drop, 1), drop)
+    # already-aligned kept counts are untouched
+    np.testing.assert_array_equal(bucket_drop(scores, drop, 2), drop)
+
+
 def test_all_negative_never_empties_layer():
     m = small_mlp()
     p, _ = init_model(m)

@@ -27,7 +27,12 @@ from torchpruner_tpu.core.graph import (
     nan_cascade_oracle,
 )
 from torchpruner_tpu.core.plan import PruneGroup, Consumer, PrunePlan
-from torchpruner_tpu.core.pruner import prune, prune_by_scores, Pruner
+from torchpruner_tpu.core.pruner import (
+    Pruner,
+    bucket_drop,
+    prune,
+    prune_by_scores,
+)
 from torchpruner_tpu.utils.torch_import import (
     import_hf_llama,
     import_torch_vgg16_bn,
@@ -57,6 +62,7 @@ __all__ = [
     "PrunePlan",
     "prune",
     "prune_by_scores",
+    "bucket_drop",
     "Pruner",
     "RandomAttributionMetric",
     "WeightNormAttributionMetric",

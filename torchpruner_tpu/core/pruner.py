@@ -175,6 +175,33 @@ def prune(
     return PruneResult(new_model, new_params, new_state, new_opt)
 
 
+def bucket_drop(
+    scores: np.ndarray, drop: np.ndarray, bucket: int
+) -> np.ndarray:
+    """Shrink ``drop`` so the KEPT unit count is a multiple of ``bucket``,
+    un-dropping the highest-scoring dropped units first.
+
+    TPU rationale (SURVEY.md §7 "recompilation economics"): vector lanes are
+    128 wide and sublanes 8 deep, so widths that are multiples of 8/128 tile
+    the MXU/VPU cleanly, and bucketing bounds how many distinct shapes a
+    prune schedule can visit — with the persistent compilation cache, a
+    bounded shape set means a bounded total compile bill.  Rounding the kept
+    count *up* is the conservative direction: it only retains units the
+    policy would have removed, never removes ones it would have kept.
+    """
+    if bucket <= 1:
+        return drop
+    n = len(scores)
+    keep_n = n - len(drop)
+    target_keep = min(n, -(-max(keep_n, 1) // bucket) * bucket)
+    n_undrop = target_keep - keep_n
+    if n_undrop <= 0:
+        return drop
+    order = np.argsort(scores[drop])  # ascending score over dropped units
+    keep_back = drop[order[len(drop) - n_undrop:]]
+    return np.setdiff1d(drop, keep_back)
+
+
 def prune_by_scores(
     model: SegmentedModel,
     params,
@@ -183,6 +210,7 @@ def prune_by_scores(
     *,
     policy: Union[str, Callable[[np.ndarray], np.ndarray]] = "negative",
     fraction: float = 0.5,
+    bucket: int = 1,
     state=None,
     opt_state=None,
 ) -> PruneResult:
@@ -195,6 +223,9 @@ def prune_by_scores(
     - ``policy="negative"``: drop all units with score < 0
     - ``policy="fraction"``: drop the lowest-scoring ``fraction`` of units
     - callable: ``policy(scores) -> drop indices``
+    - ``bucket``: round the kept width UP to a multiple (8 or 128 keeps
+      TPU tiling clean and bounds recompile diversity; see
+      :func:`bucket_drop`)
     """
     scores = np.asarray(scores)
     if callable(policy):
@@ -208,6 +239,7 @@ def prune_by_scores(
         raise ValueError(f"unknown policy {policy!r}")
     if len(drop) >= len(scores):
         drop = drop[: len(scores) - 1]  # never remove a whole layer
+    drop = bucket_drop(scores, np.asarray(drop, dtype=np.int64), bucket)
     return prune(model, params, layer, drop, state=state, opt_state=opt_state)
 
 

@@ -260,7 +260,7 @@ def run_prune_retrain(
         pre_loss, pre_acc = trainer.evaluate(test_batches)
         res = prune_by_scores(
             trainer.model, trainer.params, target, scores,
-            policy=cfg.policy, fraction=cfg.fraction,
+            policy=cfg.policy, fraction=cfg.fraction, bucket=cfg.bucket,
             state=trainer.state, opt_state=trainer.opt_state,
         )
         prune_time = time.perf_counter() - t0

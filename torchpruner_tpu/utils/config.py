@@ -33,6 +33,9 @@ class ExperimentConfig:
     # pruning schedule
     policy: str = "negative"         # negative|fraction
     fraction: float = 0.5
+    bucket: int = 1                  # round kept widths up to a multiple
+                                     # (8/128 = TPU sublane/lane alignment;
+                                     # bounds recompile diversity)
     prune_order: str = "reverse"     # outermost layer first (reference recipe)
     score_examples: int = 1000       # val examples used for scoring
 
