@@ -67,22 +67,49 @@ def ablation_curve(
     loss_fn,
     *,
     eval_layer: Optional[str] = None,
+    mesh=None,
+    data_axis: str = "data",
 ) -> Dict[str, np.ndarray]:
     """Simulated pruning of ``layer``'s units in ``ranking`` order.
 
     Returns ``{"loss": (n,), "acc": (n,), "base_loss": float,
     "base_acc": float}`` — test loss/accuracy after each cumulative removal
     (the reference's cell-8 inner loop, one scan per batch here).
+
+    With ``mesh``, each batch's example dim is sharded over ``data_axis``
+    and params/state are replicated: the same jitted scan runs SPMD, XLA
+    inserting the loss/count all-reduces — the sweep's wall-clock divides
+    by the data-axis size on a pod.  Batch sizes must divide the axis.
     """
     eval_layer = eval_layer or layer
     fn = _ablation_fn(model, eval_layer, loss_fn)
     ranking = jnp.asarray(np.asarray(ranking, dtype=np.int32))
+    put = lambda t: t  # noqa: E731 - identity on a single device
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(mesh, P())
+        params = jax.device_put(params, repl)
+        if state is not None:
+            state = jax.device_put(state, repl)
+        ranking = jax.device_put(ranking, repl)
+        n_shard = mesh.shape[data_axis]
+        batch_sharding = NamedSharding(mesh, P(data_axis))
+
+        def put(t):
+            if t.shape[0] % n_shard:
+                raise ValueError(
+                    f"batch size {t.shape[0]} not divisible by mesh axis "
+                    f"{data_axis}={n_shard}; use drop_remainder batches"
+                )
+            return jax.device_put(t, batch_sharding)
+
     tot_l = tot_c = None
     base_l = base_c = 0.0
     n_examples = 0
     n_preds = 0
     for x, y in (data() if callable(data) else data):
-        l, c, bl, bc, n_pred = fn(params, state, x, y, ranking)
+        l, c, bl, bc, n_pred = fn(params, state, put(x), put(y), ranking)
         tot_l = l if tot_l is None else tot_l + l
         tot_c = c if tot_c is None else tot_c + c
         base_l += float(bl)
@@ -115,6 +142,8 @@ def layerwise_robustness(
     runs_stochastic: int = 3,
     stochastic: Sequence[str] = ("random", "shapley", "sv"),
     find_best_evaluation_layer_: bool = True,
+    mesh=None,
+    data_axis: str = "data",
     verbose: bool = True,
 ) -> Dict[str, Dict[str, List[Dict]]]:
     """The full sweep: every prunable layer × every method (×
@@ -161,7 +190,7 @@ def layerwise_robustness(
                 ranking = np.argsort(scores)
                 curve = ablation_curve(
                     model, params, state, layer, ranking, test_data, loss_fn,
-                    eval_layer=eval_layer,
+                    eval_layer=eval_layer, mesh=mesh, data_axis=data_axis,
                 )
                 runs.append({
                     "scores": scores,
@@ -236,14 +265,31 @@ def run_robustness_config(cfg, *, model=None, datasets=None,
     if params is None:
         params, state = init_model(model, seed=cfg.seed)
     loss_fn = LOSS_REGISTRY[cfg.loss]
-    test_batches = test.batches(cfg.eval_batch_size)
+
+    # SPMD sweep (SURVEY.md §5.8): cfg.mesh shards the ablation batches and
+    # the scoring rows over the data axis; a pod divides the 6.5 h-baseline
+    # workload's wall-clock by the axis size.  Only a data axis helps here
+    # (params are replicated — the sweep is evaluation, not training).
+    mesh = None
+    if cfg.mesh and "data" in cfg.mesh:
+        from torchpruner_tpu.parallel import make_mesh
+
+        mesh = make_mesh(cfg.mesh)
+    test_batches = test.batches(
+        cfg.eval_batch_size, drop_remainder=mesh is not None
+    )
 
     def factory(method, reduction="mean", **kw):
         def make(run=0):
-            return build_metric(
+            metric = build_metric(
                 method, model, params, test_batches, loss_fn, state=state,
                 reduction=reduction, seed=cfg.seed + run, **kw,
             )
+            if mesh is not None:
+                from torchpruner_tpu.parallel import DistributedScorer
+
+                metric = DistributedScorer(metric, mesh)
+            return metric
         return make
 
     if cfg.method == "all":
@@ -272,6 +318,7 @@ def run_robustness_config(cfg, *, model=None, datasets=None,
         model, params, state, test_batches, methods, loss_fn,
         layers=layers,
         find_best_evaluation_layer_=cfg.find_best_evaluation_layer,
+        mesh=mesh,
         verbose=verbose,
     )
     aucs = auc_summary(results)
