@@ -42,6 +42,35 @@ def test_training_reduces_loss():
     assert a1 > a0
 
 
+def test_gradient_accumulation_matches_full_batch():
+    """accum_steps=4 (scanned microbatches, one update) must produce the
+    same parameters and loss as the full-batch step — equal-size
+    microbatches of a mean loss sum to the full-batch gradient."""
+    import jax
+
+    ds = tiny_data(n=64)
+    x, y = next(iter(ds.batches(64)))
+
+    def run(accum):
+        t = Trainer.create(tiny_model(), optax.sgd(1e-2, momentum=0.9),
+                           cross_entropy_loss, seed=0, accum_steps=accum)
+        losses = [float(t.step(x, y)) for _ in range(3)]
+        return t.params, losses
+
+    p1, l1 = run(1)
+    p4, l4 = run(4)
+    np.testing.assert_allclose(l1, l4, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p4)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    # non-dividing batch size is rejected at trace time
+    t = Trainer.create(tiny_model(), optax.sgd(1e-2), cross_entropy_loss,
+                       seed=0, accum_steps=3)
+    with pytest.raises(ValueError, match="divisible"):
+        t.step(x, y)
+
+
 def test_train_prune_train():
     # the reference's behavioral optimizer test, end to end through Trainer
     # (reference tests/test_pruner.py:180-228)

@@ -112,7 +112,8 @@ def run_train(
         )
         trainer = Trainer.create(model, tx, loss_fn, seed=cfg.seed,
                                  params=params, state=state,
-                                 compute_dtype=cdtype, remat=cfg.remat)
+                                 compute_dtype=cdtype, remat=cfg.remat,
+                                 accum_steps=cfg.accum_steps)
         if opt_state is not None:
             trainer.opt_state = opt_state
         start_epoch = int(meta.get("extra", {}).get("epoch", 0))
@@ -121,7 +122,8 @@ def run_train(
                   f"at epoch {start_epoch}", flush=True)
     else:
         trainer = Trainer.create(model, tx, loss_fn, seed=cfg.seed,
-                                 compute_dtype=cdtype, remat=cfg.remat)
+                                 compute_dtype=cdtype, remat=cfg.remat,
+                                 accum_steps=cfg.accum_steps)
 
     logger = CSVLogger(cfg.log_path, experiment=cfg.name)
     test_batches = test.batches(cfg.eval_batch_size)

@@ -59,10 +59,20 @@ def make_loss_closure(model: SegmentedModel, loss_fn, compute_dtype=None,
 
 
 def make_train_step(model: SegmentedModel, tx, loss_fn, donate: bool = True,
-                    compute_dtype=None, remat: bool = False):
+                    compute_dtype=None, remat: bool = False,
+                    accum_steps: int = 1):
     """(params, state, opt_state, x, y, rng) -> (params, state, opt_state,
     loss).  Donation reuses the input buffers for the outputs.  Mixed
-    precision / remat per :func:`make_loss_closure`."""
+    precision / remat per :func:`make_loss_closure`.
+
+    ``accum_steps > 1`` = gradient accumulation: the batch splits into that
+    many microbatches, a ``lax.scan`` inside the SAME jit accumulates their
+    gradients (peak activation memory shrinks by the factor, one optimizer
+    update at the end — how a single chip trains at batch sizes whose
+    activations don't fit HBM).  Equal-size microbatches of a mean loss
+    make the accumulated gradient identical to the full-batch gradient up
+    to float summation order; mutable state (BN statistics) threads through
+    the microbatches sequentially."""
     loss_c = make_loss_closure(model, loss_fn, compute_dtype, remat)
 
     def step(params, state, opt_state, x, y, rng):
@@ -73,8 +83,37 @@ def make_train_step(model: SegmentedModel, tx, loss_fn, donate: bool = True,
         new_params = optax.apply_updates(params, updates)
         return new_params, new_state, new_opt, l
 
+    def step_accum(params, state, opt_state, x, y, rng):
+        B = x.shape[0]
+        if B % accum_steps:
+            raise ValueError(
+                f"batch {B} not divisible by accum_steps={accum_steps}"
+            )
+        m = B // accum_steps
+        xs = x.reshape(accum_steps, m, *x.shape[1:])
+        ys = y.reshape(accum_steps, m, *y.shape[1:])
+        rngs = jax.random.split(rng, accum_steps)
+        grad_fn = jax.value_and_grad(loss_c, has_aux=True)
+
+        def body(carry, inp):
+            st, gacc, lacc = carry
+            xb, yb, r = inp
+            (l, new_st), g = grad_fn(params, st, xb, yb, r)
+            gacc = jax.tree_util.tree_map(jnp.add, gacc, g)
+            return (new_st, gacc, lacc + l), None
+
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        (new_state, gsum, lsum), _ = jax.lax.scan(
+            body, (state, zeros, jnp.float32(0.0)), (xs, ys, rngs)
+        )
+        grads = jax.tree_util.tree_map(lambda g: g / accum_steps, gsum)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_state, new_opt, lsum / accum_steps
+
     donate_argnums = (0, 2) if donate else ()
-    return jax.jit(step, donate_argnums=donate_argnums)
+    return jax.jit(step if accum_steps <= 1 else step_accum,
+                   donate_argnums=donate_argnums)
 
 
 def make_eval_step(model: SegmentedModel, loss_fn):
@@ -146,12 +185,15 @@ class Trainer:
     compute_dtype: Any = None
     #: checkpoint composite blocks (recompute-in-backward; see apply_seq)
     remat: bool = False
+    #: >1 = gradient accumulation over scanned microbatches
+    accum_steps: int = 1
     _step_fn: Any = field(default=None, repr=False)
     step_count: int = 0
 
     @classmethod
     def create(cls, model, tx, loss_fn, seed: int = 0, params=None,
-               state=None, compute_dtype=None, remat: bool = False):
+               state=None, compute_dtype=None, remat: bool = False,
+               accum_steps: int = 1):
         key = jax.random.PRNGKey(seed)
         if params is None:
             params, state = model.init(key)
@@ -165,6 +207,7 @@ class Trainer:
             rng=key,
             compute_dtype=compute_dtype,
             remat=remat,
+            accum_steps=accum_steps,
         )
 
     def step(self, x, y) -> float:
@@ -173,6 +216,7 @@ class Trainer:
                 self.model, self.tx, self.loss_fn,
                 compute_dtype=self.compute_dtype,
                 remat=self.remat,
+                accum_steps=self.accum_steps,
             )
         self.rng, sub = jax.random.split(self.rng)
         self.params, self.state, self.opt_state, l = self._step_fn(
@@ -192,6 +236,7 @@ class Trainer:
             rng=self.rng,
             compute_dtype=self.compute_dtype,
             remat=self.remat,
+            accum_steps=self.accum_steps,
             step_count=self.step_count,
         )
 

@@ -72,6 +72,10 @@ class ExperimentConfig:
     #: checkpoint composite blocks during training (recompute-in-backward;
     #: the activation-memory lever for deep transformer stacks)
     remat: bool = False
+    #: >1 = gradient accumulation: the batch scans through this many
+    #: microbatches inside one jitted step (peak activation memory divides
+    #: by the factor; same update as the full batch)
+    accum_steps: int = 1
 
     # data pipeline / checkpointing
     augment: bool = False            # flip + pad/crop image augmentation
