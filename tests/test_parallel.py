@@ -135,6 +135,25 @@ def test_sharded_trainer_matches_single_device():
     np.testing.assert_allclose(w1, w2, rtol=1e-3, atol=1e-5)
 
 
+def test_sharded_trainer_gradient_accumulation_matches():
+    """SPMD gradient accumulation (scanned microbatches, each still
+    sharded over the data axis) must match the unaccumulated SPMD step."""
+    mesh = make_mesh({"data": 2, "model": 4})
+    tx = optax.sgd(0.05, momentum=0.9)
+    t1 = ShardedTrainer.create(model_8(), tx, cross_entropy_loss, mesh,
+                               seed=0, min_shard_size=0)
+    t4 = ShardedTrainer.create(model_8(), tx, cross_entropy_loss, mesh,
+                               seed=0, min_shard_size=0, accum_steps=4)
+    for x, y in batches_8(n=64, bs=32):
+        l1 = t1.step(x, y)
+        l4 = t4.step(x, y)
+        np.testing.assert_allclose(float(l1), float(l4), rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(t1.params["fc1"]["w"]), np.asarray(t4.params["fc1"]["w"]),
+        rtol=1e-3, atol=1e-5,
+    )
+
+
 def test_sharded_trainer_prune_reshard_recompile():
     mesh = make_mesh({"data": 2, "model": 4})
     t = ShardedTrainer.create(model_8(), optax.adam(1e-3),

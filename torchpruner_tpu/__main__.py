@@ -40,6 +40,11 @@ def main(argv=None) -> int:
         help="disable the persistent XLA compilation cache",
     )
     p.add_argument(
+        "--profile", metavar="DIR",
+        help="capture a jax.profiler trace of the run into DIR "
+             "(view in XProf/TensorBoard)",
+    )
+    p.add_argument(
         "--dump-config", metavar="PATH",
         help="write the resolved config JSON to PATH and exit",
     )
@@ -80,33 +85,48 @@ def main(argv=None) -> int:
         print(f"wrote {args.dump_config}")
         return 0
 
-    if cfg.experiment == "robustness":
-        from torchpruner_tpu.experiments.robustness import run_robustness_config
+    import contextlib
 
-        summary = run_robustness_config(cfg)
-        print(json.dumps(summary))
-    elif cfg.experiment == "train":
-        from torchpruner_tpu.experiments.train_model import run_train
+    profile_ctx = contextlib.nullcontext()
+    if args.profile:
+        from torchpruner_tpu.utils import profiling
 
-        _trainer, history = run_train(cfg)
-        last = history[-1] if history else None
-        print(json.dumps({
-            "experiment": cfg.name,
-            "epochs": len(history),
-            "final_test_acc": last["test_acc"] if last else None,
-            "final_test_loss": last["test_loss"] if last else None,
-        }))
-    else:
-        from torchpruner_tpu.experiments.prune_retrain import run_prune_retrain
+        profile_ctx = profiling.trace(args.profile)
 
-        history = run_prune_retrain(cfg)
-        last = history[-1] if history else None
-        print(json.dumps({
-            "experiment": cfg.name,
-            "steps": len(history),
-            "final_acc": last.post_acc if last else None,
-            "final_params": last.n_params if last else None,
-        }))
+    with profile_ctx:
+        if cfg.experiment == "robustness":
+            from torchpruner_tpu.experiments.robustness import (
+                run_robustness_config,
+            )
+
+            summary = run_robustness_config(cfg)
+            print(json.dumps(summary))
+        elif cfg.experiment == "train":
+            from torchpruner_tpu.experiments.train_model import run_train
+
+            _trainer, history = run_train(cfg)
+            last = history[-1] if history else None
+            print(json.dumps({
+                "experiment": cfg.name,
+                "epochs": len(history),
+                "final_test_acc": last["test_acc"] if last else None,
+                "final_test_loss": last["test_loss"] if last else None,
+            }))
+        else:
+            from torchpruner_tpu.experiments.prune_retrain import (
+                run_prune_retrain,
+            )
+
+            history = run_prune_retrain(cfg)
+            last = history[-1] if history else None
+            print(json.dumps({
+                "experiment": cfg.name,
+                "steps": len(history),
+                "final_acc": last.post_acc if last else None,
+                "final_params": last.n_params if last else None,
+            }))
+    if args.profile:
+        print(f"profiler trace written to {args.profile}", file=sys.stderr)
     return 0
 
 

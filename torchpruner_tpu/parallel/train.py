@@ -45,28 +45,21 @@ def make_sharded_train_step(
     data_axis: str = "data",
     compute_dtype=None,
     remat: bool = False,
+    accum_steps: int = 1,
 ):
     """Compile the SPMD train step with explicit in/out shardings.
-    Mixed precision / remat come from the shared
-    ``train.loop.make_loss_closure`` — one forward policy for the local
-    and the SPMD steps."""
-    from torchpruner_tpu.train.loop import make_loss_closure
+    Mixed precision / remat / gradient accumulation come from the shared
+    ``train.loop`` step body — one forward-and-update policy for the local
+    and the SPMD steps.  With ``accum_steps``, each scanned microbatch
+    keeps its example dim sharded on ``data_axis``."""
+    from torchpruner_tpu.train.loop import make_loss_closure, make_step_body
 
     loss_c = make_loss_closure(model, loss_fn, compute_dtype, remat)
     bs = batch_sharding(mesh, data_axis)
     rep = replicate(mesh)
 
-    def step(params, state, opt_state, x, y, rng):
-        def loss(p):
-            return loss_c(p, state, x, y, rng)
-
-        (l, new_state), grads = jax.value_and_grad(loss, has_aux=True)(params)
-        updates, new_opt = tx.update(grads, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
-        return new_params, new_state, new_opt, l
-
     return jax.jit(
-        step,
+        make_step_body(loss_c, tx, accum_steps),
         in_shardings=(param_shardings, state_shardings, opt_shardings,
                       bs, bs, rep),
         out_shardings=(param_shardings, state_shardings, opt_shardings, rep),
@@ -96,6 +89,8 @@ class ShardedTrainer:
     compute_dtype: Any = None
     #: checkpoint composite blocks (recompute-in-backward)
     remat: bool = False
+    #: >1 = gradient accumulation over scanned microbatches
+    accum_steps: int = 1
     _step_fn: Any = field(default=None, repr=False)
     step_count: int = 0
 
@@ -113,6 +108,7 @@ class ShardedTrainer:
         partition: str = "fsdp",
         compute_dtype=None,
         remat: bool = False,
+        accum_steps: int = 1,
     ) -> "ShardedTrainer":
         key = jax.random.PRNGKey(seed)
         params, state = model.init(key)
@@ -123,6 +119,7 @@ class ShardedTrainer:
             data_axis=data_axis, model_axis=model_axis,
             min_shard_size=min_shard_size, partition=partition,
             compute_dtype=compute_dtype, remat=remat,
+            accum_steps=accum_steps,
         )
         t._place()
         return t
@@ -160,7 +157,7 @@ class ShardedTrainer:
         self._step_fn = make_sharded_train_step(
             self.model, self.tx, self.loss_fn, self.mesh, ps, ss, os_,
             self.data_axis, compute_dtype=self.compute_dtype,
-            remat=self.remat,
+            remat=self.remat, accum_steps=self.accum_steps,
         )
 
     # -- training ----------------------------------------------------------
@@ -185,7 +182,8 @@ class ShardedTrainer:
             rng=self.rng, mesh=self.mesh, data_axis=self.data_axis,
             model_axis=self.model_axis, min_shard_size=self.min_shard_size,
             partition=self.partition, compute_dtype=self.compute_dtype,
-            remat=self.remat, step_count=self.step_count,
+            remat=self.remat, accum_steps=self.accum_steps,
+            step_count=self.step_count,
         )
         t._place()
         return t

@@ -74,6 +74,15 @@ def make_train_step(model: SegmentedModel, tx, loss_fn, donate: bool = True,
     to float summation order; mutable state (BN statistics) threads through
     the microbatches sequentially."""
     loss_c = make_loss_closure(model, loss_fn, compute_dtype, remat)
+    donate_argnums = (0, 2) if donate else ()
+    return jax.jit(make_step_body(loss_c, tx, accum_steps),
+                   donate_argnums=donate_argnums)
+
+
+def make_step_body(loss_c, tx, accum_steps: int = 1):
+    """The un-jitted ``(params, state, opt_state, x, y, rng) -> (params,
+    state, opt_state, loss)`` body shared by the local and SPMD trainers —
+    callers add their own ``jit`` (with explicit shardings for SPMD)."""
 
     def step(params, state, opt_state, x, y, rng):
         (l, new_state), grads = jax.value_and_grad(
@@ -111,9 +120,7 @@ def make_train_step(model: SegmentedModel, tx, loss_fn, donate: bool = True,
         new_params = optax.apply_updates(params, updates)
         return new_params, new_state, new_opt, lsum / accum_steps
 
-    donate_argnums = (0, 2) if donate else ()
-    return jax.jit(step if accum_steps <= 1 else step_accum,
-                   donate_argnums=donate_argnums)
+    return step if accum_steps <= 1 else step_accum
 
 
 def make_eval_step(model: SegmentedModel, loss_fn):
