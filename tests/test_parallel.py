@@ -55,6 +55,36 @@ def test_make_mesh_shapes():
         make_mesh({"data": 3})
 
 
+def test_hybrid_mesh_single_slice_fallback():
+    """Without multi-slice topology (CPU fake devices), make_hybrid_mesh
+    must degrade to a plain mesh with the same named axes, so hybrid-mesh
+    code runs unchanged on one slice."""
+    from torchpruner_tpu.parallel import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh({"model": 4}, {"data": 2})
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "data": 2, "model": 4,
+    }
+    single = make_hybrid_mesh({"model": 8}, {"data": 1})
+    assert dict(zip(single.axis_names, single.devices.shape)) == {
+        "data": 1, "model": 8,
+    }
+    with pytest.raises(ValueError):  # device count must still match
+        make_hybrid_mesh({"model": 4}, {"data": 4})
+    # a ShardedTrainer runs over the hybrid-constructed mesh unchanged
+    t = ShardedTrainer.create(model_8(), optax.sgd(0.05),
+                              cross_entropy_loss, mesh,
+                              seed=0, min_shard_size=0)
+    x, y = next(iter(batches_8(n=16, bs=16)))
+    assert np.isfinite(float(t.step(x, y)))
+
+
+def test_initialize_distributed_noop_without_config():
+    from torchpruner_tpu.parallel import initialize_distributed
+
+    assert initialize_distributed() is False  # no coordinator configured
+
+
 def test_fsdp_spec_rules():
     mesh = make_mesh({"data": 2, "model": 4})
     assert fsdp_spec((128, 64), mesh, min_size=0) == jax.sharding.PartitionSpec("model", None)

@@ -11,7 +11,12 @@ score moments for distributed attribution), riding ICI within a pod and DCN
 across pods.
 """
 
-from torchpruner_tpu.parallel.mesh import make_mesh, mesh_axes
+from torchpruner_tpu.parallel.mesh import (
+    initialize_distributed,
+    make_hybrid_mesh,
+    make_mesh,
+    mesh_axes,
+)
 from torchpruner_tpu.parallel.sharding import (
     batch_sharding,
     fsdp_sharding,
@@ -38,6 +43,8 @@ from torchpruner_tpu.parallel.ulysses import (
 from torchpruner_tpu.parallel.pipeline import PipelineParallel, balance_stages
 
 __all__ = [
+    "initialize_distributed",
+    "make_hybrid_mesh",
     "make_mesh",
     "mesh_axes",
     "batch_sharding",

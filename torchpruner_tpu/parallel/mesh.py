@@ -1,12 +1,21 @@
-"""Device-mesh construction.
+"""Device-mesh construction — single-slice and multi-slice (ICI × DCN).
 
 One mesh, named axes, everything else is sharding annotations — the
 "pick a mesh, annotate shardings, let XLA insert collectives" recipe.
 Default axes: ``data`` (DP / sharded scoring) × ``model`` (FSDP/TP).
+
+Multi-host: each host runs the same SPMD program; call
+:func:`initialize_distributed` once at startup (before any jax call) so
+the hosts form one runtime, then build the mesh over ``jax.devices()``
+(which then lists EVERY host's devices).  Across pod slices, use
+:func:`make_hybrid_mesh`: DCN-parallel axes (data) span slices, ICI axes
+(model/FSDP) stay inside a slice — collectives ride the fast fabric, only
+gradient all-reduces cross the data-center network.
 """
 
 from __future__ import annotations
 
+import os
 from typing import Dict, Optional, Sequence
 
 import jax
@@ -15,6 +24,60 @@ from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
 DEFAULT_AXES = ("data", "model")
+
+
+def initialize_distributed(**kw) -> bool:
+    """Join this process into the multi-host JAX runtime (the
+    communication-backend bring-up NCCL/MPI setups do by hand; here it is
+    one call).  Pass ``coordinator_address``/``num_processes``/
+    ``process_id`` explicitly, or export ``JAX_COORDINATOR_ADDRESS`` (on
+    TPU pods the remaining fields auto-discover from the metadata
+    service).
+
+    Returns True when running distributed, False when the single-process
+    fallback was kept (no ``coordinator_address`` passed and no
+    ``JAX_COORDINATOR_ADDRESS`` in the environment — e.g. local tests).
+    Safe to call unconditionally at entry-point startup.
+    """
+    configured = kw.get("coordinator_address") or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if not configured:
+        return False
+    jax.distributed.initialize(**kw)
+    return True
+
+
+def make_hybrid_mesh(
+    ici_axes: Dict[str, int],
+    dcn_axes: Dict[str, int],
+    *,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Mesh spanning multiple pod slices: ``ici_axes`` partition within a
+    slice (model/FSDP — the bandwidth-hungry collectives), ``dcn_axes``
+    across slices (data parallelism — one gradient all-reduce per step).
+
+    ``make_hybrid_mesh({"model": 4}, {"data": 2})`` on 2×4-chip slices
+    gives the same named axes as ``make_mesh({"data": 2, "model": 4})``
+    on one 8-chip slice — shardings and trainers are layout-agnostic, so
+    code written against the hybrid mesh runs unchanged on a single slice
+    (the fallback when the devices carry no slice topology, e.g. CPU
+    tests or one pod slice).
+    """
+    names = tuple(dcn_axes.keys()) + tuple(ici_axes.keys())
+    sizes = tuple(dcn_axes.values()) + tuple(ici_axes.values())
+    try:
+        mesh_devices = mesh_utils.create_hybrid_device_mesh(
+            tuple(ici_axes.values()),
+            dcn_mesh_shape=tuple(dcn_axes.values()),
+            devices=devices,
+        )
+    except (ValueError, AssertionError):
+        # no multi-slice topology available: same axis names/sizes as a
+        # plain mesh (device count must still match — make_mesh checks)
+        return make_mesh(dict(zip(names, sizes)), devices=devices)
+    return Mesh(mesh_devices, names)
 
 
 def make_mesh(
