@@ -216,6 +216,46 @@ def test_run_train_end_to_end_with_resume(tmp_path):
     assert [h["epoch"] for h in hist2] == [2]
 
 
+def test_run_train_elastic_recovers_from_mid_run_failure(tmp_path,
+                                                         monkeypatch):
+    """An injected mid-training crash (a preemption stand-in) must restart
+    from the last checkpoint and finish all epochs."""
+    from torchpruner_tpu.experiments.train_model import run_train_elastic
+    from torchpruner_tpu.train import Trainer
+
+    calls = {"n": 0}
+    orig = Trainer.step
+
+    def flaky(self, x, y):
+        calls["n"] += 1
+        if calls["n"] == 10:  # inside epoch 1, after epoch 0's checkpoint
+            raise RuntimeError("injected preemption")
+        return orig(self, x, y)
+
+    monkeypatch.setattr(Trainer, "step", flaky)
+    cfg = ExperimentConfig(
+        name="elastic", experiment="train", epochs=3, batch_size=32,
+        eval_batch_size=32, lr=0.05,
+        checkpoint_path=str(tmp_path / "ckpt"),
+        checkpoint_every_epochs=1, log_path=str(tmp_path / "t.csv"),
+    )
+    trainer, history = run_train_elastic(
+        cfg, model=tiny_model(), datasets=tiny_sets(), verbose=False
+    )
+    assert history[-1]["epoch"] == 2       # completed all epochs
+    assert history[0]["epoch"] >= 1        # resumed, not from scratch
+    assert calls["n"] > 10                 # training continued past the crash
+
+    # refuses to run without a checkpoint path (restart-from-scratch trap)
+    import pytest
+
+    with pytest.raises(ValueError, match="checkpoint_path"):
+        run_train_elastic(
+            ExperimentConfig(name="x", experiment="train", epochs=1),
+            verbose=False,
+        )
+
+
 def test_run_train_prefetch_matches_inmemory_bitwise(tmp_path):
     """The native prefetch path and the in-memory path draw the same
     splitmix64 shuffle — training through either must produce identical

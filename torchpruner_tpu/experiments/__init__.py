@@ -12,6 +12,7 @@ from torchpruner_tpu.experiments.robustness import (
     layerwise_robustness,
     loss_increase_auc,
 )
+from torchpruner_tpu.experiments.train_model import run_train, run_train_elastic
 
 __all__ = [
     "build_metric",
@@ -20,4 +21,6 @@ __all__ = [
     "ablation_curve",
     "layerwise_robustness",
     "loss_increase_auc",
+    "run_train",
+    "run_train_elastic",
 ]

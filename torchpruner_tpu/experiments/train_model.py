@@ -164,3 +164,45 @@ def run_train(
                 extra={"epoch": epoch + 1},
             )
     return trainer, history
+
+
+def run_train_elastic(
+    cfg: ExperimentConfig,
+    *,
+    max_restarts: int = 3,
+    verbose: bool = True,
+    **kw,
+) -> Tuple[Trainer, list]:
+    """:func:`run_train` with failure recovery — the checkpoint-restart
+    elasticity long pod runs need (SURVEY.md §5.3: the reference has no
+    failure handling at all; preemptions and transient device loss are
+    normal on TPU fleets).
+
+    A failing run restarts from the last on-disk checkpoint, up to
+    ``max_restarts`` times; because :func:`run_train` already resumes
+    from ``cfg.checkpoint_path``, recovery is a plain re-entry.  Requires
+    ``cfg.checkpoint_path`` (without it a restart would silently retrain
+    from scratch, which is worse than failing).  The returned history is
+    the final successful attempt's (resume epoch onward); ``cfg.log_path``
+    carries every completed epoch across attempts.
+    """
+    if not cfg.checkpoint_path:
+        raise ValueError(
+            "run_train_elastic needs cfg.checkpoint_path — recovery "
+            "without a checkpoint would restart from scratch"
+        )
+    for attempt in range(max_restarts + 1):
+        try:
+            return run_train(cfg, verbose=verbose, **kw)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:  # noqa: BLE001 - elastic by design
+            if attempt == max_restarts:
+                raise
+            if verbose:
+                print(
+                    f"[{cfg.name}] attempt {attempt + 1} failed "
+                    f"({type(e).__name__}: {e}); restarting from "
+                    f"checkpoint", flush=True,
+                )
+    raise AssertionError("unreachable")
