@@ -45,6 +45,47 @@ def test_moe_forward_and_gate_sparsity():
     np.testing.assert_allclose(np.asarray(gates.sum(-1)), 1.0, atol=1e-6)
 
 
+def test_moe_aux_loss_collection_and_balance_floor():
+    """collect_aux returns the Switch-style load-balancing loss per MoE
+    layer: >= ~1 (1.0 = perfectly balanced dispatch), collected only
+    during training forwards."""
+    model = moe_net()
+    params, state = init_model(model, seed=0)
+    x = model.example_input(4)
+    _, _, aux = model.apply(params, x, state=state, train=True,
+                            collect_aux=True,
+                            rng=jax.random.PRNGKey(0))
+    assert set(aux) == {"moe"}
+    val = float(aux["moe"])
+    assert np.isfinite(val) and val >= 0.99
+    # eval forwards collect nothing (no balancing term at test time)
+    _, _, aux_eval = model.apply(params, x, state=state, train=False,
+                                 collect_aux=True)
+    assert aux_eval == {}
+
+
+def test_moe_aux_weight_in_training_loss():
+    """A Trainer with moe_aux_weight adds weight x aux to the step loss;
+    the remat path must carry the aux through jax.checkpoint (same value
+    as the unremat step)."""
+    from torchpruner_tpu.train import Trainer
+
+    toks = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0, 256), np.int32
+    )
+
+    def first_loss(**kw):
+        t = Trainer.create(llama_moe_tiny(), optax.adam(1e-3),
+                           lm_cross_entropy_loss, seed=0, **kw)
+        return float(t.step(toks, toks))
+
+    base = first_loss()
+    with_aux = first_loss(moe_aux_weight=0.5)
+    assert with_aux > base + 0.4  # aux >= ~1, so +0.5 x aux >= ~0.5
+    remat_aux = first_loss(moe_aux_weight=0.5, remat=True)
+    np.testing.assert_allclose(with_aux, remat_aux, rtol=1e-5)
+
+
 def test_moe_top1_and_dense_routing():
     for k, n in ((1, 4), (4, 4)):
         model = moe_net(top_k=k)

@@ -649,13 +649,16 @@ def parse_path(name) -> Tuple[str, ...]:
 
 
 class Taps:
-    """Per-trace instrumentation: unit masking, additive perturbation, and
-    activation capture at named sites (paths).  Created fresh per ``apply``
-    call, so the ``captured`` side-slot is trace-local and jit-safe."""
+    """Per-trace instrumentation: unit masking, additive perturbation,
+    activation capture at named sites (paths), and auxiliary-loss
+    collection (MoE load balancing).  Created fresh per ``apply`` call, so
+    the side-slots are trace-local and jit-safe."""
 
-    __slots__ = ("unit_mask", "perturb", "capture", "captured")
+    __slots__ = ("unit_mask", "perturb", "capture", "captured",
+                 "collect_aux", "aux")
 
-    def __init__(self, unit_mask=None, perturb=None, capture=None):
+    def __init__(self, unit_mask=None, perturb=None, capture=None,
+                 collect_aux=False):
         self.unit_mask = (
             None if unit_mask is None else (parse_path(unit_mask[0]), unit_mask[1])
         )
@@ -664,6 +667,8 @@ class Taps:
         )
         self.capture = None if capture is None else parse_path(capture)
         self.captured = None
+        self.collect_aux = collect_aux
+        self.aux = {}  # {path string: scalar} per collecting layer
 
     def empty(self) -> bool:
         return (
@@ -729,13 +734,24 @@ def apply_seq(
             and isinstance(spec, Residual)
             and (taps is None or taps.empty())
         ):
+            # aux losses (MoE balancing) survive rematerialization by being
+            # block OUTPUTS: the checkpointed closure collects them into a
+            # fresh Taps and returns the dict (a pytree), so the backward
+            # recomputation stays sound — unlike capture, which escapes by
+            # object mutation and therefore disables remat
+            collect = taps is not None and taps.collect_aux
+
             def block(p_, s_, x_, r_, _spec=spec, _path=path):
-                return apply_layer(
-                    _spec, p_, s_, x_, train=train, rng=r_, taps=None,
+                t = Taps(collect_aux=True) if collect else None
+                y_, st_ = apply_layer(
+                    _spec, p_, s_, x_, train=train, rng=r_, taps=t,
                     path=_path,
                 )
+                return y_, st_, (t.aux if collect else {})
 
-            x, s2 = jax.checkpoint(block)(p, s, x, sub)
+            x, s2, aux = jax.checkpoint(block)(p, s, x, sub)
+            if collect:
+                taps.aux.update(aux)
         else:
             x, s2 = apply_layer(
                 spec, p, s, x, train=train, rng=sub, taps=taps, path=path
@@ -965,7 +981,8 @@ def apply_layer(
 
     if isinstance(spec, MoE):
         E = spec.n_experts
-        logits = x @ params["router"]  # (B, S, E)
+        raw_logits = x @ params["router"]  # (B, S, E)
+        logits = raw_logits
         if spec.top_k < E:
             # keep the top-k logits per token; softmax over those only
             kth = jnp.sort(logits, axis=-1)[..., E - spec.top_k]
@@ -975,6 +992,16 @@ def apply_layer(
         gates = routing
         if taps is not None and not taps.empty():
             gates = taps.at_site(path, gates)  # expert unit site
+        if taps is not None and taps.collect_aux and train:
+            # Switch/Mixtral load-balancing loss: E * sum_e f_e * P_e with
+            # f_e the dispatch fraction (top-k membership / top_k) and P_e
+            # the mean FULL-softmax router probability; equals 1.0 when
+            # perfectly balanced, grows as experts collapse
+            full_p = jax.nn.softmax(raw_logits, axis=-1)
+            chosen = (routing > 0).astype(jnp.float32)
+            f = jnp.mean(chosen, axis=(0, 1)) / spec.top_k
+            p_mean = jnp.mean(full_p, axis=(0, 1))
+            taps.aux["/".join(path)] = E * jnp.sum(f * p_mean)
         if spec.dispatch == "sparse" and spec.top_k < E:
             # routing decisions come from the PRE-tap gates: ablating an
             # expert through the tap zeroes its contribution (dense

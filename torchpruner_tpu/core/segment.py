@@ -195,6 +195,7 @@ class SegmentedModel:
         unit_mask: Optional[Tuple[str, Any]] = None,
         perturb: Optional[Tuple[str, Any]] = None,
         capture: Optional[str] = None,
+        collect_aux: bool = False,
         remat: bool = False,
     ):
         """Run the segment after ``from_layer`` through ``to_layer`` inclusive.
@@ -210,12 +211,16 @@ class SegmentedModel:
         - ``perturb=(site, delta)`` adds ``delta`` at the site — differentiate
           w.r.t. ``delta`` at zero for activation-gradient attributions.
         - ``capture=site`` additionally returns the activation at the site.
+        - ``collect_aux=True`` additionally returns the auxiliary training
+          losses emitted by layers (MoE load balancing) as
+          ``{layer_path: scalar}`` — empty for models without such layers.
         - ``remat=True`` checkpoints each composite block (recompute-in-
           backward; see ``layers.apply_seq``) — the training-memory lever
           for deep transformer stacks.
 
-        Returns ``(y, new_state)``, or ``(y, new_state, captured)`` when
-        ``capture`` is given.
+        Returns ``(y, new_state)``; with ``capture`` also the captured
+        activation; with ``collect_aux`` also the aux-loss dict (in that
+        order when both are requested).
         """
         state = state if state is not None else {}
         start = 0 if from_layer is None else self.index(from_layer) + 1
@@ -226,8 +231,10 @@ class SegmentedModel:
                     f"empty segment: from {from_layer!r} to {to_layer!r}"
                 )
         taps = None
-        if unit_mask is not None or perturb is not None or capture is not None:
-            taps = L.Taps(unit_mask=unit_mask, perturb=perturb, capture=capture)
+        if (unit_mask is not None or perturb is not None
+                or capture is not None or collect_aux):
+            taps = L.Taps(unit_mask=unit_mask, perturb=perturb,
+                          capture=capture, collect_aux=collect_aux)
         y, new_state = L.apply_seq(
             self.layers[start:stop], params, state, x,
             train=train, rng=rng, taps=taps, remat=remat,
@@ -235,9 +242,12 @@ class SegmentedModel:
         # merge: untouched layers keep their previous state entries
         merged = dict(state)
         merged.update(new_state)
+        out = (y, merged)
         if capture is not None:
-            return y, merged, taps.captured
-        return y, merged
+            out = out + (taps.captured,)
+        if collect_aux:
+            out = out + (taps.aux,)
+        return out
 
     # -- pruning-adjacent helpers ------------------------------------------
 

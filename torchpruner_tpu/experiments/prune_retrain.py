@@ -224,11 +224,13 @@ def run_prune_retrain(
         trainer = ShardedTrainer.create(
             model, tx, loss_fn, mesh, seed=cfg.seed,
             partition=cfg.partition, compute_dtype=cdtype, remat=cfg.remat,
+            accum_steps=cfg.accum_steps, moe_aux_weight=cfg.moe_aux_weight,
         )
     else:
         trainer = Trainer.create(
             model, tx, loss_fn, seed=cfg.seed,
             compute_dtype=cdtype, remat=cfg.remat,
+            accum_steps=cfg.accum_steps, moe_aux_weight=cfg.moe_aux_weight,
         )
     logger = CSVLogger(cfg.log_path, experiment=cfg.name)
     history: List[PruneStepRecord] = []

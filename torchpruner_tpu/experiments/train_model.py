@@ -113,7 +113,8 @@ def run_train(
         trainer = Trainer.create(model, tx, loss_fn, seed=cfg.seed,
                                  params=params, state=state,
                                  compute_dtype=cdtype, remat=cfg.remat,
-                                 accum_steps=cfg.accum_steps)
+                                 accum_steps=cfg.accum_steps,
+                                 moe_aux_weight=cfg.moe_aux_weight)
         if opt_state is not None:
             trainer.opt_state = opt_state
         start_epoch = int(meta.get("extra", {}).get("epoch", 0))
@@ -123,7 +124,8 @@ def run_train(
     else:
         trainer = Trainer.create(model, tx, loss_fn, seed=cfg.seed,
                                  compute_dtype=cdtype, remat=cfg.remat,
-                                 accum_steps=cfg.accum_steps)
+                                 accum_steps=cfg.accum_steps,
+                                 moe_aux_weight=cfg.moe_aux_weight)
 
     logger = CSVLogger(cfg.log_path, experiment=cfg.name)
     test_batches = test.batches(cfg.eval_batch_size)

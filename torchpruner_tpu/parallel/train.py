@@ -46,6 +46,7 @@ def make_sharded_train_step(
     compute_dtype=None,
     remat: bool = False,
     accum_steps: int = 1,
+    moe_aux_weight: float = 0.0,
 ):
     """Compile the SPMD train step with explicit in/out shardings.
     Mixed precision / remat / gradient accumulation come from the shared
@@ -54,7 +55,8 @@ def make_sharded_train_step(
     keeps its example dim sharded on ``data_axis``."""
     from torchpruner_tpu.train.loop import make_loss_closure, make_step_body
 
-    loss_c = make_loss_closure(model, loss_fn, compute_dtype, remat)
+    loss_c = make_loss_closure(model, loss_fn, compute_dtype, remat,
+                               moe_aux_weight)
     bs = batch_sharding(mesh, data_axis)
     rep = replicate(mesh)
 
@@ -91,6 +93,8 @@ class ShardedTrainer:
     remat: bool = False
     #: >1 = gradient accumulation over scanned microbatches
     accum_steps: int = 1
+    #: >0 adds that multiple of the MoE load-balancing loss
+    moe_aux_weight: float = 0.0
     _step_fn: Any = field(default=None, repr=False)
     step_count: int = 0
 
@@ -109,6 +113,7 @@ class ShardedTrainer:
         compute_dtype=None,
         remat: bool = False,
         accum_steps: int = 1,
+        moe_aux_weight: float = 0.0,
     ) -> "ShardedTrainer":
         key = jax.random.PRNGKey(seed)
         params, state = model.init(key)
@@ -119,7 +124,7 @@ class ShardedTrainer:
             data_axis=data_axis, model_axis=model_axis,
             min_shard_size=min_shard_size, partition=partition,
             compute_dtype=compute_dtype, remat=remat,
-            accum_steps=accum_steps,
+            accum_steps=accum_steps, moe_aux_weight=moe_aux_weight,
         )
         t._place()
         return t
@@ -158,6 +163,7 @@ class ShardedTrainer:
             self.model, self.tx, self.loss_fn, self.mesh, ps, ss, os_,
             self.data_axis, compute_dtype=self.compute_dtype,
             remat=self.remat, accum_steps=self.accum_steps,
+            moe_aux_weight=self.moe_aux_weight,
         )
 
     # -- training ----------------------------------------------------------
@@ -183,6 +189,7 @@ class ShardedTrainer:
             model_axis=self.model_axis, min_shard_size=self.min_shard_size,
             partition=self.partition, compute_dtype=self.compute_dtype,
             remat=self.remat, accum_steps=self.accum_steps,
+            moe_aux_weight=self.moe_aux_weight,
             step_count=self.step_count,
         )
         t._place()

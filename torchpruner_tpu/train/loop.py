@@ -30,7 +30,7 @@ from torchpruner_tpu.utils.dtypes import cast_floats as _cast_floats
 
 
 def make_loss_closure(model: SegmentedModel, loss_fn, compute_dtype=None,
-                      remat: bool = False):
+                      remat: bool = False, moe_aux_weight: float = 0.0):
     """``(params, state, x, y, rng) -> (mean loss, new_state)`` — the ONE
     definition of the training forward policy, shared by the local and the
     SPMD train steps.
@@ -42,25 +42,38 @@ def make_loss_closure(model: SegmentedModel, loss_fn, compute_dtype=None,
     forward/backward run with params and inputs cast to ``compute_dtype``
     (MXU-rate matmuls), logits promoted back to f32 before the loss,
     gradients arriving in f32 through the cast's transpose.  ``remat``
-    checkpoints composite blocks (recompute-in-backward)."""
+    checkpoints composite blocks (recompute-in-backward).
+    ``moe_aux_weight`` > 0 adds that multiple of the MoE load-balancing
+    loss (Switch-style; collected from every MoE layer, 1.0 when expert
+    dispatch is perfectly balanced)."""
 
     def loss(params, state, x, y, rng):
         if compute_dtype is not None:
             params = _cast_floats(params, compute_dtype)
             x = _cast_floats(x, compute_dtype)
-        out, new_state = model.apply(
-            params, x, state=state, train=True, rng=rng, remat=remat
-        )
+        if moe_aux_weight:
+            out, new_state, aux = model.apply(
+                params, x, state=state, train=True, rng=rng, remat=remat,
+                collect_aux=True,
+            )
+        else:
+            out, new_state = model.apply(
+                params, x, state=state, train=True, rng=rng, remat=remat
+            )
         if compute_dtype is not None:
             out = out.astype(jnp.float32)
-        return jnp.mean(loss_fn(out, y)), new_state
+        total = jnp.mean(loss_fn(out, y))
+        if moe_aux_weight:
+            for a in aux.values():
+                total = total + moe_aux_weight * a.astype(jnp.float32)
+        return total, new_state
 
     return loss
 
 
 def make_train_step(model: SegmentedModel, tx, loss_fn, donate: bool = True,
                     compute_dtype=None, remat: bool = False,
-                    accum_steps: int = 1):
+                    accum_steps: int = 1, moe_aux_weight: float = 0.0):
     """(params, state, opt_state, x, y, rng) -> (params, state, opt_state,
     loss).  Donation reuses the input buffers for the outputs.  Mixed
     precision / remat per :func:`make_loss_closure`.
@@ -73,7 +86,8 @@ def make_train_step(model: SegmentedModel, tx, loss_fn, donate: bool = True,
     make the accumulated gradient identical to the full-batch gradient up
     to float summation order; mutable state (BN statistics) threads through
     the microbatches sequentially."""
-    loss_c = make_loss_closure(model, loss_fn, compute_dtype, remat)
+    loss_c = make_loss_closure(model, loss_fn, compute_dtype, remat,
+                               moe_aux_weight)
     donate_argnums = (0, 2) if donate else ()
     return jax.jit(make_step_body(loss_c, tx, accum_steps),
                    donate_argnums=donate_argnums)
@@ -194,13 +208,15 @@ class Trainer:
     remat: bool = False
     #: >1 = gradient accumulation over scanned microbatches
     accum_steps: int = 1
+    #: >0 adds that multiple of the MoE load-balancing loss
+    moe_aux_weight: float = 0.0
     _step_fn: Any = field(default=None, repr=False)
     step_count: int = 0
 
     @classmethod
     def create(cls, model, tx, loss_fn, seed: int = 0, params=None,
                state=None, compute_dtype=None, remat: bool = False,
-               accum_steps: int = 1):
+               accum_steps: int = 1, moe_aux_weight: float = 0.0):
         key = jax.random.PRNGKey(seed)
         if params is None:
             params, state = model.init(key)
@@ -215,6 +231,7 @@ class Trainer:
             compute_dtype=compute_dtype,
             remat=remat,
             accum_steps=accum_steps,
+            moe_aux_weight=moe_aux_weight,
         )
 
     def step(self, x, y) -> float:
@@ -224,6 +241,7 @@ class Trainer:
                 compute_dtype=self.compute_dtype,
                 remat=self.remat,
                 accum_steps=self.accum_steps,
+                moe_aux_weight=self.moe_aux_weight,
             )
         self.rng, sub = jax.random.split(self.rng)
         self.params, self.state, self.opt_state, l = self._step_fn(
@@ -244,6 +262,7 @@ class Trainer:
             compute_dtype=self.compute_dtype,
             remat=self.remat,
             accum_steps=self.accum_steps,
+            moe_aux_weight=self.moe_aux_weight,
             step_count=self.step_count,
         )
 

@@ -76,6 +76,9 @@ class ExperimentConfig:
     #: microbatches inside one jitted step (peak activation memory divides
     #: by the factor; same update as the full batch)
     accum_steps: int = 1
+    #: >0 adds that multiple of the MoE load-balancing auxiliary loss
+    #: (Switch-style; no-op for models without MoE layers)
+    moe_aux_weight: float = 0.0
 
     # data pipeline / checkpointing
     augment: bool = False            # flip + pad/crop image augmentation
