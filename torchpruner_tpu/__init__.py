@@ -33,6 +33,7 @@ from torchpruner_tpu.core.pruner import (
     prune,
     prune_by_scores,
 )
+from torchpruner_tpu.generate import generate, init_cache, make_decode_step
 from torchpruner_tpu.utils.torch_import import (
     import_hf_llama,
     import_torch_vgg16_bn,
@@ -63,6 +64,9 @@ __all__ = [
     "prune",
     "prune_by_scores",
     "bucket_drop",
+    "generate",
+    "init_cache",
+    "make_decode_step",
     "Pruner",
     "RandomAttributionMetric",
     "WeightNormAttributionMetric",

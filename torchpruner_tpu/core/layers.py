@@ -768,12 +768,15 @@ def apply_seq(
     return x, new_state
 
 
-def _rope(x, theta: float):
-    """Rotary position embedding on ``(B, S, H, Dh)`` (Su et al., 2021)."""
+def _rope(x, theta: float, offset=0):
+    """Rotary position embedding on ``(B, S, H, Dh)`` (Su et al., 2021).
+    ``offset`` shifts the absolute positions — the KV-cache decode path
+    (generate.py) embeds a length-1 sequence at position ``pos``."""
     S, Dh = x.shape[1], x.shape[-1]
     half = Dh // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    ang = jnp.arange(S, dtype=jnp.float32)[:, None] * freqs[None, :]  # (S, half)
+    pos = offset + jnp.arange(S, dtype=jnp.float32)
+    ang = pos[:, None] * freqs[None, :]  # (S, half)
     cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
     sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
     x1, x2 = x[..., :half], x[..., half:]

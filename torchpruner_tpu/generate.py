@@ -1,0 +1,227 @@
+"""Autoregressive decoding with a KV cache — inference for the LM families.
+
+The reference is a vision-only pruning library with no inference loop; this
+framework's LM families (Llama/GQA, MoE decoders — BASELINE.json configs
+3-5) need one so *pruned* models can actually be served and sampled.  The
+design is TPU-first:
+
+- **Static shapes everywhere**: the cache is a fixed ``(B, max_len, H, Dh)``
+  buffer per attention layer, written at position ``pos`` with
+  ``lax.dynamic_update_slice``; attention masks positions ``> pos`` instead
+  of slicing a dynamic length, so one compiled step serves every position.
+- **One jitted computation**: prefill and generation are ``lax.scan``s of
+  the same single-token step — no per-token retrace, no host round-trips
+  inside the loop; sampling (greedy or temperature) happens on-device.
+- **Layer reuse**: position-independent layers (norms, Dense/GatedDense,
+  MoE, activations) run through the SAME ``apply_layer`` rules as training
+  (core/layers.py), so decode automatically tracks pruning — a model with
+  pruned heads/FFN channels/experts decodes at the pruned shapes.  Only
+  attention (cache read/write, RoPE at an offset) and position embeddings
+  have decode-specific paths.
+
+Decode-vs-forward parity (every position's logits equal the full causal
+forward's) is the correctness contract — tests/test_generate.py checks it
+for dense, pruned, and MoE models.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.segment import SegmentedModel
+
+_NEG_INF = -1e30
+
+
+def _attn_layers(layers, prefix=()):
+    """Yield (path, spec) for every attention layer, recursing residuals."""
+    for spec in layers:
+        path = prefix + (spec.name,)
+        if isinstance(spec, L.MultiHeadAttention):
+            yield path, spec
+        elif isinstance(spec, L.Residual):
+            yield from _attn_layers(spec.body, path)
+            yield from _attn_layers(spec.shortcut, path)
+
+
+def init_cache(
+    model: SegmentedModel, batch: int, max_len: int, dtype=jnp.float32
+) -> Dict[str, Any]:
+    """Zeroed KV buffers for every attention layer.
+
+    K/V are cached *expanded to the query-head count* (post-GQA take), so
+    irregular pruned groupings need no per-step gather; memory per layer is
+    ``2 * B * max_len * H * Dh``.
+    """
+    cache: Dict[str, Any] = {}
+    for path, spec in _attn_layers(model.layers):
+        shape = (batch, max_len, spec.num_heads, spec.head_dim)
+        cache["/".join(path)] = {
+            "k": jnp.zeros(shape, dtype),
+            "v": jnp.zeros(shape, dtype),
+        }
+    return cache
+
+
+def _decode_attention(spec, params, entry, x, pos):
+    """Single-position attention against the cache.
+
+    ``x``: (B, 1, d); ``entry``: this layer's {"k", "v"} cache buffers;
+    ``pos``: scalar absolute position of this token.  Returns (y, entry').
+    """
+    q = jnp.einsum("bsd,dhk->bshk", x, params["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", x, params["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", x, params["wv"])
+    if "bq" in params:
+        q = q + params["bq"]
+        k = k + params["bk"]
+        v = v + params["bv"]
+    if spec.rope:
+        q = L._rope(q, spec.rope_theta, offset=pos)
+        k = L._rope(k, spec.rope_theta, offset=pos)
+    if spec.kv_heads != spec.num_heads or spec.kv_group is not None:
+        idx = jnp.asarray(spec.head_kv_index())
+        k = jnp.take(k, idx, axis=2)
+        v = jnp.take(v, idx, axis=2)
+    k_cache = lax.dynamic_update_slice(
+        entry["k"], k.astype(entry["k"].dtype), (0, pos, 0, 0)
+    )
+    v_cache = lax.dynamic_update_slice(
+        entry["v"], v.astype(entry["v"].dtype), (0, pos, 0, 0)
+    )
+    # scores against the whole static buffer; mask the unwritten future
+    scale = 1.0 / np.sqrt(spec.head_dim)
+    s = jnp.einsum(
+        "bqhk,bthk->bhqt", q, k_cache, preferred_element_type=jnp.float32
+    ) * scale  # (B, H, 1, max_len)
+    t = jnp.arange(k_cache.shape[1])
+    s = jnp.where((t <= pos)[None, None, None, :], s, _NEG_INF)
+    w = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
+    ctx = jnp.einsum("bhqt,bthk->bqhk", w, v_cache)
+    y = jnp.einsum("bshk,hkd->bsd", ctx, params["wo"])
+    if "bo" in params:
+        y = y + params["bo"]
+    return y, {"k": k_cache, "v": v_cache}
+
+
+def _decode_seq(layers, params, cache, x, pos, prefix=()):
+    """One token through a layer sequence in decode mode; returns
+    ``(y, cache')`` with the attention entries replaced functionally."""
+    for spec in layers:
+        path = prefix + (spec.name,)
+        key = "/".join(path)
+        p = params.get(spec.name, {}) if params else {}
+        if isinstance(spec, L.MultiHeadAttention):
+            x, entry = _decode_attention(spec, p, cache[key], x, pos)
+            cache = {**cache, key: entry}
+        elif isinstance(spec, L.Residual):
+            y, cache = _decode_seq(spec.body, p, cache, x, pos, path)
+            if spec.shortcut:
+                sc, cache = _decode_seq(spec.shortcut, p, cache, x, pos, path)
+            else:
+                sc = x
+            x = y + sc
+        elif isinstance(spec, L.PosEmbed):
+            x = x + jnp.take(p["emb"], pos, axis=0)[None, None, :]
+        elif isinstance(spec, L.BatchNorm):
+            raise NotImplementedError(
+                "BatchNorm in decode mode (LM families use LayerNorm/RMSNorm)"
+            )
+        else:
+            # position-independent layers: the training apply rules work
+            # unchanged on a length-1 sequence (eval mode, no taps)
+            x, _ = L.apply_layer(
+                spec, p, {}, x, train=False, rng=None, taps=None, path=path
+            )
+    return x, cache
+
+
+def make_decode_step(model: SegmentedModel):
+    """jit: ``(params, cache, tok (B, 1) int32, pos scalar) ->
+    (logits (B, vocab), cache')`` — the single-token decode step."""
+
+    @jax.jit
+    def step(params, cache, tok, pos):
+        x, cache = _decode_seq(model.layers, params, cache, tok, pos)
+        return x[:, 0], cache
+
+    return step
+
+
+def generate(
+    model: SegmentedModel,
+    params,
+    prompt,
+    n_new: int,
+    *,
+    max_len: Optional[int] = None,
+    temperature: float = 0.0,
+    rng=None,
+    cache_dtype=jnp.float32,
+) -> jnp.ndarray:
+    """Sample ``n_new`` tokens after ``prompt`` (B, S) — returns (B, n_new).
+
+    Greedy at ``temperature=0`` (default), else softmax sampling at the
+    given temperature (``rng`` required).  Prefill and generation are two
+    ``lax.scan``s of the single-token step inside one jit per
+    (shape, n_new) — the decode loop never leaves the device.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    B, S = prompt.shape
+    total = S + n_new
+    max_len = max_len or total
+    if max_len < total:
+        raise ValueError(f"max_len {max_len} < prompt + n_new = {total}")
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature sampling needs an rng")
+    cache = init_cache(model, B, max_len, cache_dtype)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    vocab = _vocab_size(model)
+
+    @jax.jit
+    def run(params, cache, prompt, rng):
+        def step_body(cache, tok, pos):
+            x, cache = _decode_seq(model.layers, params, cache, tok, pos)
+            return x[:, 0], cache
+
+        def prefill(carry, inp):
+            cache, _ = carry
+            tok, pos = inp
+            logits, cache = step_body(cache, tok[:, None], pos)
+            return (cache, logits), None
+
+        (cache_f, logits), _ = lax.scan(
+            prefill,
+            (cache, jnp.zeros((B, vocab), jnp.float32)),
+            (jnp.moveaxis(prompt, 1, 0), jnp.arange(S)),
+        )
+
+        def sample(logits, r):
+            if temperature == 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return jax.random.categorical(
+                r, logits / temperature, axis=-1
+            ).astype(jnp.int32)
+
+        def gen(carry, pos):
+            cache, logits, r = carry
+            r, sub = jax.random.split(r)
+            tok = sample(logits, sub)
+            new_logits, cache = step_body(cache, tok[:, None], pos)
+            return (cache, new_logits, r), tok
+
+        _, toks = lax.scan(gen, (cache_f, logits, rng), S + jnp.arange(n_new))
+        return jnp.moveaxis(toks, 0, 1)  # (B, n_new)
+
+    return run(params, cache, prompt, rng)
+
+
+def _vocab_size(model: SegmentedModel) -> int:
+    out_shape = model.shapes[-1][1]
+    return int(out_shape[-1])
