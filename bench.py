@@ -299,6 +299,40 @@ def _leg_flash_attention(smoke: bool) -> dict:
     return out
 
 
+def _leg_llama_decode(smoke: bool) -> dict:
+    """KV-cache decode throughput (tokens/s) on the llama family — the
+    serving-path number for pruned LMs (no reference baseline; the
+    reference has no inference loop)."""
+    import time as _t
+
+    import jax
+    import numpy as np
+
+    from torchpruner_tpu.core.segment import init_model
+    from torchpruner_tpu.generate import generate
+    from torchpruner_tpu.models import llama_tiny
+
+    model = llama_tiny()
+    params, _ = init_model(model, seed=0)
+    B, S, n_new = (2, 8, 16) if smoke else (8, 64, 128)
+    prompt = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(0), (B, S), 0, 256), np.int32
+    )
+    t0 = _t.perf_counter()
+    out = generate(model, params, prompt, n_new)
+    jax.block_until_ready(out)
+    compile_and_first = _t.perf_counter() - t0
+    t0 = _t.perf_counter()
+    jax.block_until_ready(generate(model, params, prompt, n_new))
+    steady = _t.perf_counter() - t0
+    return {
+        "tokens_per_s": round(B * n_new / steady, 1),
+        "steady_s": round(steady, 3),
+        "first_call_s": round(compile_and_first, 2),
+        "shape": f"B{B} prompt{S} new{n_new}",
+    }
+
+
 def main() -> dict:
     if "--cpu" in sys.argv:
         import jax
@@ -351,6 +385,7 @@ def main() -> dict:
         run_leg("vgg16_robustness", _leg_vgg_robustness)
         run_leg("vgg16_train", _leg_vgg_train)
         run_leg("flash_attention", _leg_flash_attention)
+        run_leg("llama_decode", _leg_llama_decode)
 
     def ok(name):
         return name in legs and "error" not in legs[name]

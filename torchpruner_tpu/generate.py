@@ -26,6 +26,7 @@ for dense, pruned, and MoE models.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, Optional
 
 import jax
@@ -182,10 +183,23 @@ def generate(
         raise ValueError("temperature sampling needs an rng")
     cache = init_cache(model, B, max_len, cache_dtype)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    vocab = _vocab_size(model)
+    run = _generate_fn(model, S, n_new, float(temperature))
+    return run(params, cache, prompt, rng)
+
+
+@functools.lru_cache(maxsize=256)
+def _generate_fn(model: SegmentedModel, S: int, n_new: int,
+                 temperature: float):
+    """Compiled prefill+generate program, cached per (model spec, lengths,
+    temperature) so repeated generate() calls reuse the jit executable
+    (the model spec is hashable; B/max_len specialize via jit's own
+    shape-keyed cache)."""
 
     @jax.jit
     def run(params, cache, prompt, rng):
+        B = prompt.shape[0]
+        vocab = _vocab_size(model)
+
         def step_body(cache, tok, pos):
             x, cache = _decode_seq(model.layers, params, cache, tok, pos)
             return x[:, 0], cache
@@ -219,7 +233,7 @@ def generate(
         _, toks = lax.scan(gen, (cache_f, logits, rng), S + jnp.arange(n_new))
         return jnp.moveaxis(toks, 0, 1)  # (B, n_new)
 
-    return run(params, cache, prompt, rng)
+    return run
 
 
 def _vocab_size(model: SegmentedModel) -> int:
