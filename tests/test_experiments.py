@@ -312,6 +312,28 @@ def test_robustness_config_writes_figures(tmp_path):
     assert os.path.getsize(tmp_path / "figs" / "auc_summary.png") > 0
 
 
+def test_robustness_config_writes_results_json(tmp_path):
+    """cfg.results_path dumps the full sweep (curves, scores, AUCs) as
+    JSON — the durable artifact the reference keeps as a pickle."""
+    import json
+
+    from torchpruner_tpu.experiments.robustness import run_robustness_config
+
+    cfg = ExperimentConfig(
+        name="dump", model="digits_fc", dataset="digits_flat",
+        experiment="robustness", method="weight_norm", score_examples=64,
+        eval_batch_size=64, target_filter=("fc2",),
+        results_path=str(tmp_path / "out" / "results.json"),
+        log_path=str(tmp_path / "log.csv"),
+    )
+    aucs = run_robustness_config(cfg, verbose=False)
+    blob = json.loads((tmp_path / "out" / "results.json").read_text())
+    assert blob["auc_summary"] == aucs
+    run = blob["results"]["fc2"]["weight_norm"][0]
+    assert len(run["loss"]) == len(run["scores"]) > 0
+    assert isinstance(run["auc"], float)
+
+
 def test_prune_retrain_over_configured_mesh(tmp_path):
     """cfg.mesh drives the SPMD loop: ShardedTrainer training, data-
     parallel scoring, prune->reshard->step — the full distributed recipe

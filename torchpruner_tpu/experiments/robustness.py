@@ -322,6 +322,32 @@ def run_robustness_config(cfg, *, model=None, datasets=None,
         verbose=verbose,
     )
     aucs = auc_summary(results)
+    if cfg.results_path:
+        import json
+        import os
+
+        os.makedirs(os.path.dirname(cfg.results_path) or ".", exist_ok=True)
+
+        def listify(r):
+            return {
+                k: (np.asarray(v).tolist() if isinstance(
+                    v, (np.ndarray, jnp.ndarray)) else v)
+                for k, v in r.items()
+            }
+
+        with open(cfg.results_path, "w") as f:
+            json.dump({
+                "config": cfg.name,
+                "auc_summary": aucs,
+                "results": {
+                    layer: {m: [listify(r) for r in runs]
+                            for m, runs in methods_.items()}
+                    for layer, methods_ in results.items()
+                },
+            }, f)
+        if verbose:
+            print(f"[robustness] wrote results to {cfg.results_path}",
+                  flush=True)
     if cfg.plot_dir:
         import os
 

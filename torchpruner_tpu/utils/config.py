@@ -91,6 +91,10 @@ class ExperimentConfig:
     #: when set, the robustness sweep writes its figures here (per-layer
     #: curves + the AUC summary; utils/plotting)
     plot_dir: str = ""
+    #: when set, the robustness sweep dumps its full results (per-layer ×
+    #: method curves, scores, AUCs) as JSON here — the durable artifact
+    #: the reference keeps as a pickle (VGG notebook cell 8)
+    results_path: str = ""
 
     def __post_init__(self):
         if self.experiment not in ("prune_retrain", "robustness", "train"):
