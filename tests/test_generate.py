@@ -100,6 +100,27 @@ def test_generate_greedy_matches_stepwise_argmax():
     np.testing.assert_array_equal(got, np.stack(want, axis=1))
 
 
+def test_generate_with_tensor_parallel_params():
+    """Distributed serving: generate() with TP-sharded parameters (the
+    pruning-graph column/row placement) must emit the same tokens as the
+    single-device run — GSPMD partitions the cached decode without any
+    decode-specific sharding code."""
+    from torchpruner_tpu.parallel import make_mesh
+    from torchpruner_tpu.parallel.sharding import tp_sharding
+
+    model = llama_tiny()
+    params, _ = init_model(model, seed=0)
+    prompt = np.asarray([[5, 9, 2, 14]], np.int32)
+    want = np.asarray(generate(model, params, prompt, 6))
+
+    mesh = make_mesh({"model": 4}, devices=jax.devices()[:4])
+    params_tp = jax.device_put(
+        params, tp_sharding(model, params, mesh, "model", 0)
+    )
+    got = np.asarray(generate(model, params_tp, prompt, 6))
+    np.testing.assert_array_equal(got, want)
+
+
 def test_generate_temperature_seeded_and_validated():
     model = llama_tiny()
     params, _ = init_model(model, seed=0)
