@@ -325,8 +325,10 @@ def _leg_llama_decode(smoke: bool) -> dict:
     t0 = _t.perf_counter()
     jax.block_until_ready(generate(model, params, prompt, n_new))
     steady = _t.perf_counter() - t0
+    # the timed program executes S prefill + n_new generate steps, all
+    # identical single-token scans — count them all, not just n_new
     return {
-        "tokens_per_s": round(B * n_new / steady, 1),
+        "tokens_per_s": round(B * (S + n_new) / steady, 1),
         "steady_s": round(steady, 3),
         "first_call_s": round(compile_and_first, 2),
         "shape": f"B{B} prompt{S} new{n_new}",

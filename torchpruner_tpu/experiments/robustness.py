@@ -159,6 +159,17 @@ def layerwise_robustness(
 
     if layers is None:
         layers = [g.target for g in pruning_graph(model)]
+    if mesh is not None:
+        # replicate ONCE for the whole sweep; ablation_curve's own
+        # device_put then short-circuits on the already-placed trees
+        # (without this, every layer x method x run curve would re-
+        # broadcast the full model)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(mesh, P())
+        params = jax.device_put(params, repl)
+        if state is not None:
+            state = jax.device_put(state, repl)
     results: Dict[str, Dict[str, List[Dict]]] = {}
     for layer in layers:
         results[layer] = {}
@@ -271,7 +282,14 @@ def run_robustness_config(cfg, *, model=None, datasets=None,
     # workload's wall-clock by the axis size.  Only a data axis helps here
     # (params are replicated — the sweep is evaluation, not training).
     mesh = None
-    if cfg.mesh and "data" in cfg.mesh:
+    if cfg.mesh:
+        if "data" not in cfg.mesh:
+            raise ValueError(
+                f"robustness sweep needs a 'data' axis to shard over, got "
+                f"mesh={cfg.mesh!r} — the sweep is evaluation (params are "
+                f"replicated), so only data parallelism applies; rename "
+                f"the axis or clear cfg.mesh for a single-device run"
+            )
         from torchpruner_tpu.parallel import make_mesh
 
         mesh = make_mesh(cfg.mesh)
