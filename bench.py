@@ -303,8 +303,6 @@ def _leg_llama_decode(smoke: bool) -> dict:
     """KV-cache decode throughput (tokens/s) on the llama family — the
     serving-path number for pruned LMs (no reference baseline; the
     reference has no inference loop)."""
-    import time as _t
-
     import jax
     import numpy as np
 
@@ -318,13 +316,13 @@ def _leg_llama_decode(smoke: bool) -> dict:
     prompt = np.asarray(
         jax.random.randint(jax.random.PRNGKey(0), (B, S), 0, 256), np.int32
     )
-    t0 = _t.perf_counter()
+    t0 = time.perf_counter()
     out = generate(model, params, prompt, n_new)
     jax.block_until_ready(out)
-    compile_and_first = _t.perf_counter() - t0
-    t0 = _t.perf_counter()
+    compile_and_first = time.perf_counter() - t0
+    t0 = time.perf_counter()
     jax.block_until_ready(generate(model, params, prompt, n_new))
-    steady = _t.perf_counter() - t0
+    steady = time.perf_counter() - t0
     # the timed program executes S prefill + n_new generate steps, all
     # identical single-token scans — count them all, not just n_new
     return {

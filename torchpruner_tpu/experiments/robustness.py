@@ -84,17 +84,23 @@ def ablation_curve(
     eval_layer = eval_layer or layer
     fn = _ablation_fn(model, eval_layer, loss_fn)
     ranking = jnp.asarray(np.asarray(ranking, dtype=np.int32))
-    put = lambda t: t  # noqa: E731 - identity on a single device
-    if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
-        repl = NamedSharding(mesh, P())
+    def put(t):  # identity on a single device
+        return t
+
+    if mesh is not None:
+        from torchpruner_tpu.parallel.sharding import (
+            batch_sharding,
+            replicate,
+        )
+
+        repl = replicate(mesh)
         params = jax.device_put(params, repl)
         if state is not None:
             state = jax.device_put(state, repl)
         ranking = jax.device_put(ranking, repl)
         n_shard = mesh.shape[data_axis]
-        batch_sharding = NamedSharding(mesh, P(data_axis))
+        bs = batch_sharding(mesh, data_axis)
 
         def put(t):
             if t.shape[0] % n_shard:
@@ -102,7 +108,7 @@ def ablation_curve(
                     f"batch size {t.shape[0]} not divisible by mesh axis "
                     f"{data_axis}={n_shard}; use drop_remainder batches"
                 )
-            return jax.device_put(t, batch_sharding)
+            return jax.device_put(t, bs)
 
     tot_l = tot_c = None
     base_l = base_c = 0.0
@@ -164,9 +170,9 @@ def layerwise_robustness(
         # device_put then short-circuits on the already-placed trees
         # (without this, every layer x method x run curve would re-
         # broadcast the full model)
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from torchpruner_tpu.parallel.sharding import replicate
 
-        repl = NamedSharding(mesh, P())
+        repl = replicate(mesh)
         params = jax.device_put(params, repl)
         if state is not None:
             state = jax.device_put(state, repl)
