@@ -433,6 +433,30 @@ def orchestrate() -> dict:
     attempts: list[dict] = []
     best_partial: dict | None = None  # parseable result with a null headline
     plans = [(0.0, False), (15.0, False), (0.0, True)]
+    if "--cpu" not in sys.argv:
+        # pre-flight: a hung TPU tunnel parks backend init in retry-sleep
+        # for the WHOLE child timeout (measured: 40 min lost per attempt
+        # during a round-2 outage).  A 120 s device probe tells us up
+        # front; on failure go straight to the CPU fallback and record why.
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                capture_output=True, text=True, timeout=120,
+            )
+            probe_ok = probe.returncode == 0
+            probe_msg = (probe.stderr or "").strip()[-300:]
+        except subprocess.TimeoutExpired as e:
+            probe_ok = False
+            probe_msg = f"device probe hung >120s: {(e.stderr or '')[-200:]}"
+        if not probe_ok:
+            attempts.append({
+                "attempt": 0,
+                "rc": None,
+                "forced_platform": None,
+                "stderr_tail": f"preflight failed, skipping TPU attempts: "
+                               f"{probe_msg}",
+            })
+            plans = [(0.0, True)]
     i = 0
     while i < len(plans):
         pause, force_cpu = plans[i]
