@@ -948,6 +948,20 @@ def apply_layer(
 
     if isinstance(spec, MultiHeadAttention):
         H, KV = spec.num_heads, spec.kv_heads
+        # impl "ring"/"ulysses" = sequence parallelism: this rule is then
+        # running under shard_map with the sequence dim sharded over a
+        # "seq" mesh axis (parallel/sp.py's trainer), so RoPE needs the
+        # GLOBAL position offset of this shard and the attention core is
+        # the SP collective path.
+        sp = spec.impl in ("ring", "ulysses")
+        rope_offset = 0
+        if sp:
+            if taps is not None and not taps.empty():
+                raise NotImplementedError(
+                    "attribution taps under sequence parallelism — score "
+                    "with a single-device or DP/TP placement instead"
+                )
+            rope_offset = lax.axis_index("seq") * x.shape[1]
         q = jnp.einsum("bsd,dhk->bshk", x, params["wq"])
         k = jnp.einsum("bsd,dhk->bshk", x, params["wk"])
         v = jnp.einsum("bsd,dhk->bshk", x, params["wv"])
@@ -956,13 +970,23 @@ def apply_layer(
             k = k + params["bk"]
             v = v + params["bv"]
         if spec.rope:
-            q = _rope(q, spec.rope_theta)
-            k = _rope(k, spec.rope_theta)
+            q = _rope(q, spec.rope_theta, offset=rope_offset)
+            k = _rope(k, spec.rope_theta, offset=rope_offset)
         if KV != H or spec.kv_group is not None:
             idx = jnp.asarray(spec.head_kv_index())
             k = jnp.take(k, idx, axis=2)
             v = jnp.take(v, idx, axis=2)
-        ctx = attention_core(q, k, v, causal=spec.causal, impl=spec.impl)
+        if sp:
+            from torchpruner_tpu.parallel.ring import ring_attention_local
+            from torchpruner_tpu.parallel.ulysses import (
+                ulysses_attention_local,
+            )
+
+            local = (ring_attention_local if spec.impl == "ring"
+                     else ulysses_attention_local)
+            ctx = local(q, k, v, axis="seq", causal=spec.causal)
+        else:
+            ctx = attention_core(q, k, v, causal=spec.causal, impl=spec.impl)
         if taps is not None and not taps.empty():
             # head unit site: (B, S, Dh, H) — head axis last, uniform with
             # channel sites for masking/capture/attribution.

@@ -41,6 +41,7 @@ from torchpruner_tpu.parallel.ulysses import (
     ulysses_attention_local,
 )
 from torchpruner_tpu.parallel.pipeline import PipelineParallel, balance_stages
+from torchpruner_tpu.parallel.sp import SPTrainer, sp_model
 
 __all__ = [
     "initialize_distributed",
@@ -67,4 +68,6 @@ __all__ = [
     "ulysses_attention_local",
     "PipelineParallel",
     "balance_stages",
+    "SPTrainer",
+    "sp_model",
 ]

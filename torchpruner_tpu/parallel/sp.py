@@ -1,0 +1,191 @@
+"""Sequence-parallel training — long-context causal-LM steps over a
+``data × seq`` mesh.
+
+DP/FSDP/TP (parallel/train.py) shard batches and weights; this trainer
+shards the SEQUENCE dim, the axis that grows in long-context training
+(SURVEY.md §5.7, BASELINE.json llama configs).  The whole train step runs
+under ``shard_map``: every position-independent layer (norms, dense, MoE)
+computes on its local sequence shard, and the attention layers — built
+with ``impl="ring"`` or ``"ulysses"`` (core/layers.py) — exchange KV
+shards by ``ppermute`` rotation or heads by ``all_to_all``, with RoPE at
+each shard's global offset.  Per-token losses and gradients are
+``psum``-reduced over both mesh axes; parameters stay replicated (compose
+with gradient accumulation for memory; FSDP×SP composition is a later
+step).
+
+The causal next-token shift crosses shard boundaries, so the trainer
+aligns targets on the host once per batch (``y[:, t]``'s target is
+``y[:, t+1]``): each shard then has a fully local masked loss — no halo
+exchange inside the step.
+
+``SPTrainer`` mirrors the ``Trainer``/``ShardedTrainer`` surface (step /
+rebuild / evaluate) and is equality-tested against the single-device
+trainer in tests/test_sp_trainer.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+import optax
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.segment import SegmentedModel
+
+
+def sp_model(model: SegmentedModel, impl: str = "ring") -> SegmentedModel:
+    """``model`` with every attention layer switched to the ``impl``
+    sequence-parallel core (``"ring"`` | ``"ulysses"``)."""
+    if impl not in ("ring", "ulysses"):
+        raise ValueError(f"unknown SP impl {impl!r}")
+
+    def convert(spec):
+        if isinstance(spec, L.MultiHeadAttention):
+            return dataclasses.replace(spec, impl=impl)
+        if isinstance(spec, L.Residual):
+            return dataclasses.replace(
+                spec,
+                body=tuple(convert(c) for c in spec.body),
+                shortcut=tuple(convert(c) for c in spec.shortcut),
+            )
+        return spec
+
+    return dataclasses.replace(
+        model, layers=tuple(convert(s) for s in model.layers)
+    )
+
+
+def aligned_targets(tokens) -> tuple:
+    """``(targets, mask)`` with ``targets[:, t] = tokens[:, t + 1]`` and the
+    final (targetless) position masked out — the host-side shift that makes
+    the causal-LM loss local to each sequence shard."""
+    tokens = np.asarray(tokens)
+    tgt = np.concatenate(
+        [tokens[:, 1:], np.zeros_like(tokens[:, :1])], axis=1
+    )
+    mask = np.ones(tokens.shape, np.float32)
+    mask[:, -1] = 0.0
+    return tgt, mask
+
+
+@dataclass
+class SPTrainer:
+    """Causal-LM trainer with the sequence dim sharded over ``seq`` (and
+    the batch over ``data``).  Parameters replicated; loss is the masked
+    mean next-token cross-entropy over all predicted positions."""
+
+    model: SegmentedModel
+    params: Any
+    state: Any
+    tx: Any
+    opt_state: Any
+    rng: Any
+    mesh: Mesh
+    impl: str = "ring"
+    _step_fn: Any = field(default=None, repr=False)
+    step_count: int = 0
+
+    @classmethod
+    def create(
+        cls,
+        model: SegmentedModel,
+        tx,
+        mesh: Mesh,
+        seed: int = 0,
+        impl: str = "ring",
+    ) -> "SPTrainer":
+        for axis in ("data", "seq"):
+            if axis not in mesh.axis_names:
+                raise ValueError(
+                    f"SPTrainer needs a '{axis}' mesh axis, got "
+                    f"{mesh.axis_names}"
+                )
+        model = sp_model(model, impl)
+        key = jax.random.PRNGKey(seed)
+        params, state = model.init(key)
+        t = cls(
+            model=model, params=params,
+            state=state if state is not None else {}, tx=tx,
+            opt_state=tx.init(params), rng=key, mesh=mesh, impl=impl,
+        )
+        t._compile()
+        return t
+
+    def _compile(self):
+        model, tx, mesh = self.model, self.tx, self.mesh
+        repl = P()
+        bseq = P("data", "seq")
+
+        def local_step(params, state, opt_state, x, tgt, mask, rng):
+            # distinct dropout streams per shard
+            rng = jax.random.fold_in(
+                rng,
+                lax.axis_index("data") * 4096 + lax.axis_index("seq"),
+            )
+
+            def loss_fn(p):
+                logits, new_state = model.apply(
+                    p, x, state=state, train=True, rng=rng
+                )
+                logp = jax.nn.log_softmax(
+                    logits.astype(jnp.float32), axis=-1
+                )
+                nll = -jnp.take_along_axis(
+                    logp, tgt[..., None], axis=-1
+                )[..., 0]
+                loc_sum = jnp.sum(nll * mask)
+                loc_cnt = jnp.sum(mask)
+                total = lax.psum(loc_sum, ("data", "seq"))
+                count = lax.psum(loc_cnt, ("data", "seq"))
+                return total / count, new_state
+
+            (l, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            grads = lax.psum(grads, ("data", "seq"))
+            updates, new_opt = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_state, new_opt, l
+
+        mapped = shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(repl, repl, repl, bseq, bseq, bseq, repl),
+            out_specs=(repl, repl, repl, repl),
+            check_vma=False,  # the ulysses path runs a Pallas kernel
+        )
+        self._step_fn = jax.jit(mapped, donate_argnums=(0, 2))
+        self._bseq = NamedSharding(mesh, bseq)
+
+    def step(self, tokens) -> float:
+        """One SP train step on a ``(B, S)`` token batch (B divisible by
+        the data axis, S by the seq axis)."""
+        tgt, mask = aligned_targets(tokens)
+        x = jax.device_put(jnp.asarray(tokens), self._bseq)
+        tgt = jax.device_put(jnp.asarray(tgt), self._bseq)
+        mask = jax.device_put(jnp.asarray(mask), self._bseq)
+        self.rng, sub = jax.random.split(self.rng)
+        self.params, self.state, self.opt_state, l = self._step_fn(
+            self.params, self.state, self.opt_state, x, tgt, mask, sub
+        )
+        self.step_count += 1
+        return l
+
+    def rebuild(self, model, params, state, opt_state) -> "SPTrainer":
+        """Adopt pruned pytrees (e.g. after FFN-channel or head pruning)
+        and recompile at the new shapes."""
+        t = SPTrainer(
+            model=sp_model(model, self.impl), params=params,
+            state=state if state is not None else {}, tx=self.tx,
+            opt_state=opt_state, rng=self.rng, mesh=self.mesh,
+            impl=self.impl, step_count=self.step_count,
+        )
+        t._compile()
+        return t
