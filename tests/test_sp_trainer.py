@@ -55,6 +55,21 @@ def test_sp_trainer_prune_rebuild_recompile():
     assert t.model.layer("block1_ffn/gate").features == 61
 
 
+def test_sp_trainer_evaluate_runs_single_device_core():
+    """evaluate() reverts attention to the single-device core and must
+    agree with the reference trainer's evaluation."""
+    mesh = make_mesh({"data": 2, "seq": 4})
+    tx = optax.adam(1e-2)
+    t_sp = SPTrainer.create(llama_tiny(), tx, mesh, seed=0)
+    t_ref = Trainer.create(llama_tiny(), tx, lm_cross_entropy_loss, seed=0)
+    batch = toks()
+    data = [(batch, batch)]
+    l_sp, a_sp = t_sp.evaluate(data, lm_cross_entropy_loss)
+    l_ref, a_ref = t_ref.evaluate(data)
+    np.testing.assert_allclose(l_sp, l_ref, rtol=1e-5)
+    assert a_sp == a_ref
+
+
 def test_sp_model_converts_nested_attention():
     m = sp_model(llama_tiny(), "ring")
     assert m.layer("block1_attn/attn").impl == "ring"

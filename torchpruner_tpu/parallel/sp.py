@@ -42,8 +42,10 @@ from torchpruner_tpu.core.segment import SegmentedModel
 
 def sp_model(model: SegmentedModel, impl: str = "ring") -> SegmentedModel:
     """``model`` with every attention layer switched to the ``impl``
-    sequence-parallel core (``"ring"`` | ``"ulysses"``)."""
-    if impl not in ("ring", "ulysses"):
+    sequence-parallel core (``"ring"`` | ``"ulysses"``) — or back to a
+    single-device core (``"auto"`` | ``"xla"`` | ``"flash"``), which is
+    how :meth:`SPTrainer.evaluate` runs outside ``shard_map``."""
+    if impl not in ("ring", "ulysses", "auto", "xla", "flash"):
         raise ValueError(f"unknown SP impl {impl!r}")
 
     def convert(spec):
@@ -177,6 +179,17 @@ class SPTrainer:
         )
         self.step_count += 1
         return l
+
+    def evaluate(self, data, loss_fn):
+        """Average loss/accuracy over ``data`` — runs the single-device
+        attention core (params are replicated, so evaluation needs no
+        sequence sharding; pass batches of ``(tokens, targets)``)."""
+        from torchpruner_tpu.train.loop import evaluate
+
+        return evaluate(
+            sp_model(self.model, "auto"), self.params, self.state, data,
+            loss_fn,
+        )
 
     def rebuild(self, model, params, state, opt_state) -> "SPTrainer":
         """Adopt pruned pytrees (e.g. after FFN-channel or head pruning)
