@@ -55,6 +55,26 @@ def test_sp_trainer_prune_rebuild_recompile():
     assert t.model.layer("block1_ffn/gate").features == 61
 
 
+def test_sp_trainer_remat_and_bf16():
+    """remat must not change the SP loss; bf16 mixed precision runs and
+    stays close to f32 (bf16 noise level)."""
+    import jax.numpy as jnp
+
+    mesh = make_mesh({"data": 2, "seq": 4})
+    batch = toks()
+    base = float(SPTrainer.create(
+        llama_tiny(), optax.adam(1e-3), mesh, seed=0).step(batch))
+    rem = float(SPTrainer.create(
+        llama_tiny(), optax.adam(1e-3), mesh, seed=0, remat=True
+    ).step(batch))
+    np.testing.assert_allclose(base, rem, rtol=1e-5)
+    b16 = float(SPTrainer.create(
+        llama_tiny(), optax.adam(1e-3), mesh, seed=0,
+        compute_dtype=jnp.bfloat16,
+    ).step(batch))
+    assert np.isfinite(b16) and abs(b16 - base) < 0.1
+
+
 def test_sp_trainer_evaluate_runs_single_device_core():
     """evaluate() reverts attention to the single-device core and must
     agree with the reference trainer's evaluation."""

@@ -91,6 +91,11 @@ class SPTrainer:
     rng: Any
     mesh: Mesh
     impl: str = "ring"
+    #: None = f32; jnp.bfloat16 = mixed precision (f32 masters, same
+    #: policy as train.loop.make_loss_closure)
+    compute_dtype: Any = None
+    #: checkpoint composite blocks (recompute-in-backward)
+    remat: bool = False
     _step_fn: Any = field(default=None, repr=False)
     step_count: int = 0
 
@@ -102,6 +107,8 @@ class SPTrainer:
         mesh: Mesh,
         seed: int = 0,
         impl: str = "ring",
+        compute_dtype=None,
+        remat: bool = False,
     ) -> "SPTrainer":
         for axis in ("data", "seq"):
             if axis not in mesh.axis_names:
@@ -116,12 +123,16 @@ class SPTrainer:
             model=model, params=params,
             state=state if state is not None else {}, tx=tx,
             opt_state=tx.init(params), rng=key, mesh=mesh, impl=impl,
+            compute_dtype=compute_dtype, remat=remat,
         )
         t._compile()
         return t
 
     def _compile(self):
+        from torchpruner_tpu.utils.dtypes import cast_floats
+
         model, tx, mesh = self.model, self.tx, self.mesh
+        compute_dtype, remat = self.compute_dtype, self.remat
         repl = P()
         bseq = P("data", "seq")
 
@@ -133,8 +144,10 @@ class SPTrainer:
             )
 
             def loss_fn(p):
+                if compute_dtype is not None:
+                    p = cast_floats(p, compute_dtype)
                 logits, new_state = model.apply(
-                    p, x, state=state, train=True, rng=rng
+                    p, x, state=state, train=True, rng=rng, remat=remat
                 )
                 logp = jax.nn.log_softmax(
                     logits.astype(jnp.float32), axis=-1
@@ -198,7 +211,8 @@ class SPTrainer:
             model=sp_model(model, self.impl), params=params,
             state=state if state is not None else {}, tx=self.tx,
             opt_state=opt_state, rng=self.rng, mesh=self.mesh,
-            impl=self.impl, step_count=self.step_count,
+            impl=self.impl, compute_dtype=self.compute_dtype,
+            remat=self.remat, step_count=self.step_count,
         )
         t._compile()
         return t
