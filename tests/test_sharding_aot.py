@@ -166,6 +166,66 @@ def test_llama3_8b_train_step_lowers_on_abstract_pod_mesh(partition):
     assert "sdy.sharding" in hlo or "mhlo.sharding" in hlo or "sharding" in hlo
 
 
+def test_llama3_8b_sp_step_lowers_at_128k_context():
+    """Long-context north star: the sequence-parallel train step (ring
+    attention, RoPE at global offsets, psum'd masked loss/grads) traces
+    and lowers for TPU at 8B scale and S = 131072 over an abstract
+    {data: 4, seq: 16} pod mesh — each shard holds 8192 positions, and no
+    (S, S) score tensor exists anywhere in the program."""
+    from jax import lax, shard_map
+
+    from torchpruner_tpu.parallel.sp import sp_model
+    from torchpruner_tpu.utils.dtypes import cast_floats
+
+    mesh = AbstractMesh((4, 16), ("data", "seq"))
+    S = 131072
+    model = sp_model(llama3_8b(seq_len=S), "ring")
+    params, state = jax.eval_shape(
+        lambda k: init_model(model, seed=0), jax.random.PRNGKey(0)
+    )
+
+    def local_step(params, x, tgt, mask):
+        def loss_fn(p):
+            logits, _ = model.apply(
+                cast_floats(p, jnp.bfloat16), x, state=state, train=True,
+                remat=True,
+            )
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+            total = lax.psum(jnp.sum(nll * mask), ("data", "seq"))
+            count = lax.psum(jnp.sum(mask), ("data", "seq"))
+            return total / count
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        return lax.psum(grads, ("data", "seq")), loss
+
+    repl = P()
+    bseq = P("data", "seq")
+    mapped = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(repl, bseq, bseq, bseq),
+        out_specs=(repl, repl),
+        check_vma=False,
+    )
+    B = 4
+    x_s = jax.ShapeDtypeStruct(
+        (B, S), jnp.int32, sharding=NamedSharding(mesh, bseq)
+    )
+    m_s = jax.ShapeDtypeStruct(
+        (B, S), jnp.float32, sharding=NamedSharding(mesh, bseq)
+    )
+    p_s = jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(
+            l.shape, l.dtype, sharding=NamedSharding(mesh, P())
+        ),
+        params,
+    )
+    lowered = jax.jit(mapped).trace(p_s, x_s, x_s, m_s).lower(
+        lowering_platforms=("tpu",)
+    )
+    assert "sharding" in lowered.as_text()
+
+
 def test_llama3_8b_training_memory_budget_fits_v5p():
     """The scaling-methodology planning step: the 8B adam FSDP config on
     the {data: 8, model: 8} pod must budget within a v5p chip's HBM —
