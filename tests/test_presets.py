@@ -80,3 +80,28 @@ def test_cli_list_and_dump(tmp_path, capsys):
     ]) == 0
     cfg = json.loads(dump.read_text())
     assert cfg["model"] == "bert_tiny"
+
+
+def test_cli_runs_config_with_profile_and_cache(tmp_path, monkeypatch):
+    """End-to-end CLI run: config file in, JSON summary out, profiler
+    trace written, compilation cache pointed at the configured dir."""
+    from torchpruner_tpu.__main__ import main
+    from torchpruner_tpu.utils.config import ExperimentConfig
+
+    monkeypatch.setenv(
+        "TORCHPRUNER_TPU_COMPILATION_CACHE", str(tmp_path / "xla")
+    )
+    cfg = ExperimentConfig(
+        name="cli_e2e", model="digits_fc", dataset="digits_flat",
+        experiment="robustness", method="weight_norm", score_examples=32,
+        eval_batch_size=32, target_filter=("fc2",),
+        log_path=str(tmp_path / "log.csv"),
+    )
+    path = tmp_path / "cfg.json"
+    cfg.to_json(str(path))
+    trace_dir = tmp_path / "trace"
+    assert main([
+        "--config", str(path), "--profile", str(trace_dir),
+    ]) == 0
+    assert any(trace_dir.rglob("*.pb")), "no profiler trace written"
+    assert (tmp_path / "xla").exists()
