@@ -134,3 +134,34 @@ def test_generate_temperature_seeded_and_validated():
         generate(model, params, prompt, 2, temperature=0.8)
     with pytest.raises(ValueError, match="max_len"):
         generate(model, params, prompt, 5, max_len=4)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(model, params, prompt, 2, temperature=0.8, top_k=0,
+                 rng=jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, params, prompt, 2, temperature=0.8, top_p=1.5,
+                 rng=jax.random.PRNGKey(0))
+
+
+def test_truncated_sampling_respects_top_k_and_top_p():
+    """top_k=1 must equal greedy regardless of temperature; top_p mass-
+    truncation keeps exactly the smallest prefix reaching the mass."""
+    from torchpruner_tpu.generate import _truncate_logits
+
+    model = llama_tiny()
+    params, _ = init_model(model, seed=0)
+    prompt = np.asarray([[1, 2, 3]], np.int32)
+    greedy = np.asarray(generate(model, params, prompt, 5))
+    k1 = np.asarray(generate(model, params, prompt, 5, temperature=2.0,
+                             top_k=1, rng=jax.random.PRNGKey(4)))
+    np.testing.assert_array_equal(k1, greedy)
+
+    def kept(arr):
+        return set(np.where(np.asarray(arr)[0] > -1e30)[0])
+
+    # analytic nucleus: probs = [0.6, 0.22, 0.08, 0.03, 0.07]
+    logits = jnp.log(jnp.asarray([[0.6, 0.22, 0.08, 0.03, 0.07]]))
+    assert kept(_truncate_logits(logits, None, 0.6)) == {0}  # 0.6 covers
+    # 0.8 needs the top two (0.6 + 0.22)
+    assert kept(_truncate_logits(logits, None, 0.8)) == {0, 1}
+    # top_k=3 keeps exactly the three largest (0.6, 0.22, 0.08)
+    assert kept(_truncate_logits(logits, 3, None)) == {0, 1, 2}

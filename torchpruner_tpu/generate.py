@@ -163,13 +163,17 @@ def generate(
     *,
     max_len: Optional[int] = None,
     temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     rng=None,
     cache_dtype=jnp.float32,
 ) -> jnp.ndarray:
     """Sample ``n_new`` tokens after ``prompt`` (B, S) — returns (B, n_new).
 
     Greedy at ``temperature=0`` (default), else softmax sampling at the
-    given temperature (``rng`` required).  Prefill and generation are two
+    given temperature (``rng`` required), optionally truncated to the
+    ``top_k`` highest-probability tokens and/or the ``top_p`` nucleus
+    (smallest probability mass >= top_p).  Prefill and generation are two
     ``lax.scan``s of the single-token step inside one jit per
     (shape, n_new) — the decode loop never leaves the device.
     """
@@ -181,17 +185,44 @@ def generate(
         raise ValueError(f"max_len {max_len} < prompt + n_new = {total}")
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature sampling needs an rng")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not (0.0 < top_p <= 1.0):
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     cache = init_cache(model, B, max_len, cache_dtype)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    run = _generate_fn(model, S, n_new, float(temperature))
+    run = _generate_fn(model, S, n_new, float(temperature), top_k,
+                       top_p)
     return run(params, cache, prompt, rng)
+
+
+def _truncate_logits(logits, top_k: Optional[int], top_p: Optional[float]):
+    """Mask logits outside the top-k set / the top-p nucleus to -inf."""
+    neg = jnp.finfo(logits.dtype).min
+    if top_k is not None and top_k < logits.shape[-1]:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits >= kth, logits, neg)
+    if top_p is not None and top_p < 1.0:
+        sorted_ = jnp.sort(logits, axis=-1)[..., ::-1]  # descending
+        probs = jax.nn.softmax(sorted_, axis=-1)
+        csum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with mass >= top_p: a token stays if
+        # the mass BEFORE it is < top_p
+        keep_sorted = (csum - probs) < top_p
+        # threshold = smallest kept logit
+        thresh = jnp.min(
+            jnp.where(keep_sorted, sorted_, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits >= thresh, logits, neg)
+    return logits
 
 
 @functools.lru_cache(maxsize=256)
 def _generate_fn(model: SegmentedModel, S: int, n_new: int,
-                 temperature: float):
+                 temperature: float, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None):
     """Compiled prefill+generate program, cached per (model spec, lengths,
-    temperature) so repeated generate() calls reuse the jit executable
+    sampling config) so repeated generate() calls reuse the jit executable
     (the model spec is hashable; B/max_len specialize via jit's own
     shape-keyed cache)."""
 
@@ -219,6 +250,7 @@ def _generate_fn(model: SegmentedModel, S: int, n_new: int,
         def sample(logits, r):
             if temperature == 0.0:
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            logits = _truncate_logits(logits, top_k, top_p)
             return jax.random.categorical(
                 r, logits / temperature, axis=-1
             ).astype(jnp.int32)
