@@ -213,9 +213,11 @@ def write_parity_report(
     path: str = "PARITY.md",
     *,
     untrained: Optional[Dict[str, Dict]] = None,
-    robustness: Optional[Dict[str, object]] = None,
+    robustness=None,
 ) -> str:
-    """Render PARITY.md from experiment outputs (see ``main``)."""
+    """Render PARITY.md from experiment outputs (see ``main``).
+    ``robustness`` is one trained-sweep result dict or a list of them
+    (one section per model family — FC and conv+BN)."""
     lines = [
         "# PARITY — ours vs the reference's real-data numbers",
         "",
@@ -262,13 +264,15 @@ def write_parity_report(
         + " (0.31 / 0.35 / 0.47 / 0.47 / 0.47 / 0.48 / 0.56 / 0.64).",
         "",
     ]
-    if robustness:
-        aucs = robustness["aucs"]
+    if robustness and isinstance(robustness, dict):
+        robustness = [robustness]
+    for rob in robustness or []:
+        aucs = rob["aucs"]
         order = sorted(aucs, key=aucs.get)
         lines += [
-            f"Ours ({robustness['model']} trained {robustness['epochs']} "
-            f"epochs on real {robustness['dataset']}, test acc "
-            f"{robustness['test_acc']:.2%}):",
+            f"Ours ({rob['model']} trained {rob['epochs']} "
+            f"epochs on real {rob['dataset']}, test acc "
+            f"{rob['test_acc']:.2%}):",
             "",
             "| method | AUC (loss increase/unit) |",
             "|---|---|",
@@ -277,6 +281,8 @@ def write_parity_report(
         best, worst = order[0], order[-1]
         agree_best = best in ("sv", "sv_mean+2std")
         agree_worst = worst == "taylor_signed"
+        ref_order = REFERENCE_NUMBERS["auc_order"]
+        n_match = sum(a == b for a, b in zip(order, ref_order))
         lines += [
             "",
             f"Best method: `{best}`"
@@ -285,7 +291,9 @@ def write_parity_report(
                " (the reference ranks an SV variant first)")
             + f"; worst: `{worst}`"
             + (" (agrees with the reference)" if agree_worst else "")
-            + ".",
+            + f". Position-for-position, the ordering matches the "
+            + f"reference's 8-method ranking in {n_match} of 8 places.",
+            "",
         ]
     lines += [
         "",
@@ -327,12 +335,21 @@ def main(argv=None):
                     help="model:dataset for the untrained-prune protocol "
                     "(default: digits_fc:digits_flat + any prepared real "
                     "sets)")
-    ap.add_argument("--robustness", default="digits_fc:digits_flat",
-                    help="model:dataset for the trained AUC sweep")
+    ap.add_argument("--robustness", action="append", default=[],
+                    help="model:dataset for the trained AUC sweep; repeat "
+                    "for several (default: digits FC + digits conv+BN)")
     ap.add_argument("--epochs", type=int, default=30)
     ap.add_argument("--out", default="PARITY.md")
     ap.add_argument("--skip-robustness", action="store_true")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (a hung TPU tunnel "
+                    "otherwise parks backend init indefinitely)")
     args = ap.parse_args(argv)
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     runs = args.untrained or ["digits_fc:digits_flat"]
     if not args.untrained:
@@ -347,13 +364,17 @@ def main(argv=None):
             continue
         untrained[d] = run_untrained_prune_parity(m, d)
 
-    robustness = None
+    robustness = []
     if not args.skip_robustness:
-        m, d = args.robustness.split(":")
-        if _have_real(d):
-            robustness = run_trained_robustness_parity(
-                m, d, epochs=args.epochs
-            )
+        specs = args.robustness or [
+            "digits_fc:digits_flat", "digits_convnet:digits"
+        ]
+        for spec in specs:
+            m, d = spec.split(":")
+            if _have_real(d):
+                robustness.append(run_trained_robustness_parity(
+                    m, d, epochs=args.epochs
+                ))
     write_parity_report(args.out, untrained=untrained, robustness=robustness)
     print(f"wrote {args.out}", flush=True)
 

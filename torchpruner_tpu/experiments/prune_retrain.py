@@ -29,6 +29,7 @@ from torchpruner_tpu.models import (
     bert_base,
     bert_tiny,
     cifar10_fc,
+    digits_convnet,
     digits_fc,
     fmnist_convnet,
     llama3_8b,
@@ -67,6 +68,7 @@ MODEL_REGISTRY = {
     "mnist_fc": (mnist_fc, "mnist_flat"),
     "cifar10_fc": (cifar10_fc, "cifar10_flat"),
     "digits_fc": (digits_fc, "digits_flat"),
+    "digits_convnet": (digits_convnet, "digits"),
     "fmnist_convnet": (fmnist_convnet, "fashion_mnist"),
     "vgg16_bn": (vgg16_bn, "cifar10"),
     "vgg16_bn_tiny": (

@@ -7,7 +7,7 @@ MLP pruning), BERT (Linear pruning), and Llama (FFN channel pruning)."""
 
 from torchpruner_tpu.models.analytic import max_model
 from torchpruner_tpu.models.mlp import mnist_fc, cifar10_fc, digits_fc
-from torchpruner_tpu.models.convnet import fmnist_convnet
+from torchpruner_tpu.models.convnet import digits_convnet, fmnist_convnet
 from torchpruner_tpu.models.vgg import vgg16_bn
 from torchpruner_tpu.models.resnet import resnet18, resnet20_cifar, resnet50
 from torchpruner_tpu.models.vit import vit, vit_b16, vit_tiny
@@ -21,7 +21,8 @@ from torchpruner_tpu.models.llama import (
 )
 
 __all__ = [
-    "max_model", "mnist_fc", "cifar10_fc", "digits_fc", "fmnist_convnet",
+    "max_model", "mnist_fc", "cifar10_fc", "digits_fc", "digits_convnet",
+    "fmnist_convnet",
     "vgg16_bn",
     "resnet18", "resnet20_cifar", "resnet50",
     "vit", "vit_b16", "vit_tiny",

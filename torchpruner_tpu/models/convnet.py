@@ -12,6 +12,30 @@ from torchpruner_tpu.core import layers as L
 from torchpruner_tpu.core.segment import SegmentedModel
 
 
+def digits_convnet() -> SegmentedModel:
+    """The fmnist family at sklearn-digits scale (8x8x1 real scans): the
+    conv-BN-ReLU-pool parity model for the trained-robustness protocol on
+    always-available real data (experiments/parity.py) — the reference's
+    sweep runs on a conv+BN VGG16; this is the same layer vocabulary where
+    CPU-trainable minutes suffice."""
+    layers = (
+        L.Conv("conv1", 16, kernel_size=(3, 3), padding="SAME"),
+        L.BatchNorm("bn1"),
+        L.Activation("act1", "relu"),
+        L.Pool("pool1", "max", (2, 2)),
+        L.Conv("conv2", 32, kernel_size=(3, 3), padding="SAME"),
+        L.BatchNorm("bn2"),
+        L.Activation("act2", "relu"),
+        L.Pool("pool2", "max", (2, 2)),
+        L.Flatten("flatten"),
+        L.Dense("fc1", 128),
+        L.BatchNorm("bn3"),
+        L.Activation("act3", "relu"),
+        L.Dense("out", 10),
+    )
+    return SegmentedModel(layers, (8, 8, 1))
+
+
 def fmnist_convnet(linearize: bool = False) -> SegmentedModel:
     act = "identity" if linearize else "relu"
     pool = "avg" if linearize else "max"
