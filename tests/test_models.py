@@ -138,6 +138,33 @@ def test_resnet20_prune_vs_mask_equivalence():
     )
 
 
+def test_digits_convnet_conv_flatten_cascade_and_mask_equivalence():
+    """The conv+BN parity model (8x8 real-digits family): pruning conv2
+    must cascade through pool2 -> flatten into fc1's input with the 2x2
+    spatial fan-out, and equal masking the same channels (eval mode)."""
+    from torchpruner_tpu.models import digits_convnet
+
+    model = digits_convnet()
+    params, state = init_model(model, seed=0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 8, 1))
+    g = group_for(model, "conv2")
+    fc1 = [c for c in g.consumers if c.layer == "fc1"]
+    assert fc1 and fc1[0].fan_out == 4  # 2x2 post-pool spatial positions
+
+    drop = [0, 9, 31]
+    keep_mask = jnp.ones((32,)).at[jnp.asarray(drop)].set(0.0)
+    y_masked, _ = model.apply(
+        params, x, state=state, unit_mask=("conv2", keep_mask)
+    )
+    res = prune(model, params, "conv2", drop, state=state)
+    assert res.model.layer("conv2").features == 29
+    assert res.params["fc1"]["w"].shape[0] == 29 * 4
+    y_pruned, _ = res.model.apply(res.params, x, state=res.state)
+    np.testing.assert_allclose(
+        np.asarray(y_masked), np.asarray(y_pruned), atol=1e-4
+    )
+
+
 def test_resnet50_static_structure():
     model = resnet50()
     # 16 bottleneck blocks x 2 prunable convs each, + prunable stem (the
