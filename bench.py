@@ -157,11 +157,16 @@ def _leg_vgg_robustness(smoke: bool) -> dict:
     test = load_dataset("cifar10", "test", n=n_examples, seed=0)
     batches = test.batches(bs)
 
+    import jax.numpy as jnp
+
     def factory(method, reduction="mean", **kw):
         def make(run=0):
+            # bf16 scoring forwards (MXU rate), f32 loss accumulation —
+            # the TPU-native sweep configuration
             return build_metric(
                 method, model, params, batches, cross_entropy_loss,
-                state=state, reduction=reduction, seed=run, **kw,
+                state=state, reduction=reduction, seed=run,
+                compute_dtype=jnp.bfloat16, **kw,
             )
         return make
 
@@ -179,7 +184,7 @@ def _leg_vgg_robustness(smoke: bool) -> dict:
     t0 = time.perf_counter()
     results = layerwise_robustness(
         model, params, state, batches, methods, cross_entropy_loss,
-        layers=[probe], verbose=False,
+        layers=[probe], compute_dtype=jnp.bfloat16, verbose=False,
     )
     panel_s = time.perf_counter() - t0
     projected = panel_s * SWEEP_N_LAYERS
@@ -191,6 +196,7 @@ def _leg_vgg_robustness(smoke: bool) -> dict:
         "panel_runs": SWEEP_PANEL_RUNS,
         "probe_layer": probe,
         "projection": f"panel on {probe} × {SWEEP_N_LAYERS} layers",
+        "compute_dtype": "bfloat16",
         "auc": {k: round(v, 4) for k, v in auc_summary(results).items()},
     }
 

@@ -114,6 +114,29 @@ def test_ablation_curve_sharded_matches_single_device():
                        cross_entropy_loss, mesh=mesh)
 
 
+def test_ablation_curve_bf16_close_to_f32():
+    """bf16 ablation forwards (the TPU sweep configuration) must agree
+    with f32 at bf16 noise level — same ranking quality, MXU-rate math."""
+    import jax.numpy as jnp
+
+    model = tiny_model()
+    params, state = init_model(model, seed=0)
+    _, _, test = tiny_sets()
+    ranking = np.arange(16)
+    f32 = ablation_curve(model, params, state, "fc1", ranking,
+                         test.batches(32), cross_entropy_loss)
+    b16 = ablation_curve(model, params, state, "fc1", ranking,
+                         test.batches(32), cross_entropy_loss,
+                         compute_dtype=jnp.bfloat16)
+    assert b16["loss"].dtype == np.float64 or np.issubdtype(
+        b16["loss"].dtype, np.floating)
+    np.testing.assert_allclose(b16["loss"], f32["loss"], rtol=0.05,
+                               atol=0.05)
+    np.testing.assert_allclose(
+        loss_increase_auc(b16), loss_increase_auc(f32), atol=0.05
+    )
+
+
 def test_robustness_config_over_mesh(tmp_path):
     """cfg.mesh shards the whole sweep: DistributedScorer for the metric
     rows, sharded ablation batches; AUCs must match the unsharded run."""

@@ -25,22 +25,38 @@ from torchpruner_tpu.core.segment import SegmentedModel
 
 
 @functools.lru_cache(maxsize=512)
-def _ablation_fn(model: SegmentedModel, eval_layer: str, loss_fn):
+def _ablation_fn(model: SegmentedModel, eval_layer: str, loss_fn,
+                 compute_dtype=None):
     """jit: (params, state, x, y, ranking) -> (loss_sums, correct_counts),
-    both (n_units,): test metrics after each cumulative unit removal."""
+    both (n_units,): test metrics after each cumulative unit removal.
 
+    ``compute_dtype=bfloat16`` runs the ablation forwards at MXU rate
+    (params/activations cast; logits promoted to f32 before the loss, so
+    loss sums accumulate in f32 — the same mixed-precision policy as
+    training and bf16 scoring)."""
+
+    from torchpruner_tpu.utils.dtypes import cast_floats
     from torchpruner_tpu.utils.losses import prediction_counts
 
     @jax.jit
     def fn(params, state, x, y, ranking):
+        if compute_dtype is not None:
+            params = cast_floats(params, compute_dtype)
+            x = cast_floats(x, compute_dtype)
         z, _ = model.apply(params, x, state=state, train=False,
                            to_layer=eval_layer)
         n = z.shape[-1]
 
+        def run_suffix(zz):
+            logits, _ = model.apply(params, zz, state=state,
+                                    train=False, from_layer=eval_layer)
+            if compute_dtype is not None:
+                logits = logits.astype(jnp.float32)
+            return logits
+
         def step(mask, u):
             mask = mask.at[u].set(0.0)
-            logits, _ = model.apply(params, z * mask, state=state,
-                                    train=False, from_layer=eval_layer)
+            logits = run_suffix(z * mask)
             losses = loss_fn(logits, y)
             correct, _ = prediction_counts(logits, y)
             return mask, (jnp.sum(losses), correct)
@@ -48,8 +64,7 @@ def _ablation_fn(model: SegmentedModel, eval_layer: str, loss_fn):
         _, (loss_sums, corrects) = jax.lax.scan(
             step, jnp.ones((n,), z.dtype), ranking
         )
-        base_logits, _ = model.apply(params, z, state=state, train=False,
-                                     from_layer=eval_layer)
+        base_logits = run_suffix(z)
         base_correct, n_pred = prediction_counts(base_logits, y)
         base = (jnp.sum(loss_fn(base_logits, y)), base_correct)
         return loss_sums, corrects, base[0], base[1], n_pred
@@ -69,6 +84,7 @@ def ablation_curve(
     eval_layer: Optional[str] = None,
     mesh=None,
     data_axis: str = "data",
+    compute_dtype=None,
 ) -> Dict[str, np.ndarray]:
     """Simulated pruning of ``layer``'s units in ``ranking`` order.
 
@@ -82,7 +98,7 @@ def ablation_curve(
     by the data-axis size on a pod.  Batch sizes must divide the axis.
     """
     eval_layer = eval_layer or layer
-    fn = _ablation_fn(model, eval_layer, loss_fn)
+    fn = _ablation_fn(model, eval_layer, loss_fn, compute_dtype)
     ranking = jnp.asarray(np.asarray(ranking, dtype=np.int32))
 
     def put(t):  # identity on a single device
@@ -150,6 +166,7 @@ def layerwise_robustness(
     find_best_evaluation_layer_: bool = True,
     mesh=None,
     data_axis: str = "data",
+    compute_dtype=None,
     verbose: bool = True,
 ) -> Dict[str, Dict[str, List[Dict]]]:
     """The full sweep: every prunable layer × every method (×
@@ -208,6 +225,7 @@ def layerwise_robustness(
                 curve = ablation_curve(
                     model, params, state, layer, ranking, test_data, loss_fn,
                     eval_layer=eval_layer, mesh=mesh, data_axis=data_axis,
+                    compute_dtype=compute_dtype,
                 )
                 runs.append({
                     "scores": scores,
@@ -282,6 +300,9 @@ def run_robustness_config(cfg, *, model=None, datasets=None,
     if params is None:
         params, state = init_model(model, seed=cfg.seed)
     loss_fn = LOSS_REGISTRY[cfg.loss]
+    score_dtype = (
+        jnp.bfloat16 if cfg.score_dtype == "bfloat16" else None
+    )
 
     # SPMD sweep (SURVEY.md §5.8): cfg.mesh shards the ablation batches and
     # the scoring rows over the data axis; a pod divides the 6.5 h-baseline
@@ -307,7 +328,8 @@ def run_robustness_config(cfg, *, model=None, datasets=None,
         def make(run=0):
             metric = build_metric(
                 method, model, params, test_batches, loss_fn, state=state,
-                reduction=reduction, seed=cfg.seed + run, **kw,
+                reduction=reduction, seed=cfg.seed + run,
+                compute_dtype=score_dtype, **kw,
             )
             if mesh is not None:
                 from torchpruner_tpu.parallel import DistributedScorer
@@ -343,6 +365,7 @@ def run_robustness_config(cfg, *, model=None, datasets=None,
         layers=layers,
         find_best_evaluation_layer_=cfg.find_best_evaluation_layer,
         mesh=mesh,
+        compute_dtype=score_dtype,
         verbose=verbose,
     )
     aucs = auc_summary(results)
