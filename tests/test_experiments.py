@@ -114,6 +114,28 @@ def test_ablation_curve_sharded_matches_single_device():
                        cross_entropy_loss, mesh=mesh)
 
 
+def test_batched_ablation_matches_per_curve():
+    """ablation_curves_batch (one vmapped scan over all rankings) must
+    reproduce each individual ablation_curve exactly."""
+    from torchpruner_tpu.experiments.robustness import ablation_curves_batch
+
+    model = tiny_model()
+    params, state = init_model(model, seed=0)
+    _, _, test = tiny_sets()
+    rng = np.random.default_rng(3)
+    rankings = np.stack([rng.permutation(16) for _ in range(5)])
+    batched = ablation_curves_batch(
+        model, params, state, "fc1", rankings, test.batches(32),
+        cross_entropy_loss,
+    )
+    for r, curve in zip(rankings, batched):
+        want = ablation_curve(model, params, state, "fc1", r,
+                              test.batches(32), cross_entropy_loss)
+        np.testing.assert_allclose(curve["loss"], want["loss"], rtol=1e-5)
+        np.testing.assert_allclose(curve["acc"], want["acc"], rtol=1e-5)
+        assert curve["base_loss"] == want["base_loss"]
+
+
 def test_ablation_curve_bf16_close_to_f32():
     """bf16 ablation forwards (the TPU sweep configuration) must agree
     with f32 at bf16 noise level — same ranking quality, MXU-rate math."""

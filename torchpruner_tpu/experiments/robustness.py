@@ -72,6 +72,97 @@ def _ablation_fn(model: SegmentedModel, eval_layer: str, loss_fn,
     return fn
 
 
+@functools.lru_cache(maxsize=512)
+def _ablation_fn_batch(model: SegmentedModel, eval_layer: str, loss_fn,
+                       compute_dtype=None):
+    """Like :func:`_ablation_fn` but vmapped over a BATCH of rankings
+    ``(R, n)`` — the sweep runs one layer's whole method panel (8 methods
+    x stochastic repeats = 14 walks) as a single scan whose suffix
+    forwards batch over the R rankings, so small-batch suffix matmuls tile
+    the MXU R x better and the walk launches once per (layer, batch)."""
+
+    from torchpruner_tpu.utils.dtypes import cast_floats
+    from torchpruner_tpu.utils.losses import prediction_counts
+
+    @jax.jit
+    def fn(params, state, x, y, rankings):
+        if compute_dtype is not None:
+            params = cast_floats(params, compute_dtype)
+            x = cast_floats(x, compute_dtype)
+        z, _ = model.apply(params, x, state=state, train=False,
+                           to_layer=eval_layer)
+        n = z.shape[-1]
+
+        def run_suffix(zz):
+            logits, _ = model.apply(params, zz, state=state,
+                                    train=False, from_layer=eval_layer)
+            if compute_dtype is not None:
+                logits = logits.astype(jnp.float32)
+            return logits
+
+        def walk(ranking):
+            def step(mask, u):
+                mask = mask.at[u].set(0.0)
+                logits = run_suffix(z * mask)
+                losses = loss_fn(logits, y)
+                correct, _ = prediction_counts(logits, y)
+                return mask, (jnp.sum(losses), correct)
+
+            _, (loss_sums, corrects) = jax.lax.scan(
+                step, jnp.ones((n,), z.dtype), ranking
+            )
+            return loss_sums, corrects
+
+        loss_sums, corrects = jax.vmap(walk)(rankings)  # (R, n) each
+        base_logits = run_suffix(z)
+        base_correct, n_pred = prediction_counts(base_logits, y)
+        base_loss = jnp.sum(loss_fn(base_logits, y))
+        return loss_sums, corrects, base_loss, base_correct, n_pred
+
+    return fn
+
+
+def ablation_curves_batch(
+    model: SegmentedModel,
+    params,
+    state,
+    layer: str,
+    rankings,
+    data,
+    loss_fn,
+    *,
+    eval_layer: Optional[str] = None,
+    compute_dtype=None,
+) -> List[Dict[str, np.ndarray]]:
+    """Batched :func:`ablation_curve`: ``rankings`` is ``(R, n)``; returns
+    R curve dicts in order.  One vmapped scan per data batch evaluates
+    every ranking simultaneously."""
+    eval_layer = eval_layer or layer
+    fn = _ablation_fn_batch(model, eval_layer, loss_fn, compute_dtype)
+    rankings = jnp.asarray(np.asarray(rankings, dtype=np.int32))
+    tot_l = tot_c = None
+    base_l = base_c = 0.0
+    n_examples = 0
+    n_preds = 0
+    for x, y in (data() if callable(data) else data):
+        l, c, bl, bc, n_pred = fn(params, state, x, y, rankings)
+        tot_l = l if tot_l is None else tot_l + l
+        tot_c = c if tot_c is None else tot_c + c
+        base_l += float(bl)
+        base_c += float(bc)
+        n_examples += x.shape[0]
+        n_preds += int(n_pred)
+    return [
+        {
+            "loss": np.asarray(tot_l[r]) / n_examples,
+            "acc": np.asarray(tot_c[r]) / n_preds,
+            "base_loss": base_l / n_examples,
+            "base_acc": base_c / n_preds,
+        }
+        for r in range(rankings.shape[0])
+    ]
+
+
 def ablation_curve(
     model: SegmentedModel,
     params,
@@ -196,6 +287,18 @@ def layerwise_robustness(
     results: Dict[str, Dict[str, List[Dict]]] = {}
     for layer in layers:
         results[layer] = {}
+        # The ablation mask point is always the post-BN/activation layer,
+        # for every method — matching the reference sweep, which masks at
+        # find_best_module_for_attributions(module) regardless of how
+        # scores were computed (VGG notebook cell 8).  Zeroing there is
+        # what unit removal actually does.
+        eval_layer = (
+            find_best_evaluation_layer(model, layer)
+            if find_best_evaluation_layer_
+            else layer
+        )
+        # phase 1: score every (method, run); collect the rankings
+        pending = []  # (name, scores, score_seconds)
         for name, factory in methods.items():
             n_runs = (
                 runs_stochastic
@@ -203,7 +306,6 @@ def layerwise_robustness(
                 else 1
             )
             takes_run = bool(inspect.signature(factory).parameters)
-            runs = []
             for run_idx in range(n_runs):
                 t0 = time.perf_counter()
                 metric = factory(run_idx) if takes_run else factory()
@@ -211,33 +313,43 @@ def layerwise_robustness(
                     layer,
                     find_best_evaluation_layer=find_best_evaluation_layer_,
                 )
-                # The ablation mask point is always the post-BN/activation
-                # layer, for every method — matching the reference sweep,
-                # which masks at find_best_module_for_attributions(module)
-                # regardless of how scores were computed (VGG notebook
-                # cell 8).  Zeroing there is what unit removal actually does.
-                eval_layer = (
-                    find_best_evaluation_layer(model, layer)
-                    if find_best_evaluation_layer_
-                    else layer
+                pending.append((name, scores, time.perf_counter() - t0))
+
+        # phase 2: ONE batched walk for the whole method panel (each data
+        # batch's suffix forwards vectorize over all rankings); the mesh-
+        # sharded path keeps per-curve walks (the batched fn is
+        # single-device)
+        t0 = time.perf_counter()
+        if mesh is None:
+            curves = ablation_curves_batch(
+                model, params, state, layer,
+                np.stack([np.argsort(s) for _, s, _ in pending]),
+                test_data, loss_fn,
+                eval_layer=eval_layer, compute_dtype=compute_dtype,
+            )
+        else:
+            curves = [
+                ablation_curve(
+                    model, params, state, layer, np.argsort(s), test_data,
+                    loss_fn, eval_layer=eval_layer, mesh=mesh,
+                    data_axis=data_axis, compute_dtype=compute_dtype,
                 )
-                ranking = np.argsort(scores)
-                curve = ablation_curve(
-                    model, params, state, layer, ranking, test_data, loss_fn,
-                    eval_layer=eval_layer, mesh=mesh, data_axis=data_axis,
-                    compute_dtype=compute_dtype,
-                )
-                runs.append({
-                    "scores": scores,
-                    "loss": curve["loss"],
-                    "acc": curve["acc"],
-                    "base_loss": curve["base_loss"],
-                    "base_acc": curve["base_acc"],
-                    "auc": loss_increase_auc(curve),
-                    "seconds": time.perf_counter() - t0,
-                })
-            results[layer][name] = runs
-            if verbose:
+                for _, s, _ in pending
+            ]
+        walk_share = (time.perf_counter() - t0) / max(1, len(pending))
+
+        for (name, scores, score_s), curve in zip(pending, curves):
+            results[layer].setdefault(name, []).append({
+                "scores": scores,
+                "loss": curve["loss"],
+                "acc": curve["acc"],
+                "base_loss": curve["base_loss"],
+                "base_acc": curve["base_acc"],
+                "auc": loss_increase_auc(curve),
+                "seconds": score_s + walk_share,
+            })
+        if verbose:
+            for name, runs in results[layer].items():
                 aucs = [r["auc"] for r in runs]
                 print(
                     f"[robustness] {layer} / {name}: auc "
