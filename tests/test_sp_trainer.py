@@ -98,6 +98,17 @@ def test_sp_model_converts_nested_attention():
         sp_model(llama_tiny(), "nope")
 
 
+def test_sp_model_outside_shard_map_raises_clear_error():
+    """Applying an SP-impl model outside shard_map must explain the fix
+    (sp_model(model, 'auto')), not raise jax's unbound-axis NameError."""
+    from torchpruner_tpu.core.segment import init_model
+
+    m = sp_model(llama_tiny(), "ring")
+    params, state = init_model(llama_tiny(), seed=0)
+    with pytest.raises(RuntimeError, match="sp_model"):
+        m.apply(params, toks(B=1, S=8), state=state)
+
+
 def test_sp_trainer_requires_axes():
     mesh = make_mesh({"data": 8})
     with pytest.raises(ValueError, match="seq"):

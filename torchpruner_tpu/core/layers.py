@@ -961,7 +961,17 @@ def apply_layer(
                     "attribution taps under sequence parallelism — score "
                     "with a single-device or DP/TP placement instead"
                 )
-            rope_offset = lax.axis_index("seq") * x.shape[1]
+            try:
+                rope_offset = lax.axis_index("seq") * x.shape[1]
+            except NameError as e:
+                raise RuntimeError(
+                    f"attention {spec.name!r} has impl={spec.impl!r} "
+                    f"(sequence parallelism) but is running outside "
+                    f"shard_map with a 'seq' axis — use SPTrainer for "
+                    f"training, or convert back with "
+                    f"sp_model(model, 'auto') for single-device "
+                    f"apply/scoring/generation"
+                ) from e
         q = jnp.einsum("bsd,dhk->bshk", x, params["wq"])
         k = jnp.einsum("bsd,dhk->bshk", x, params["wk"])
         v = jnp.einsum("bsd,dhk->bshk", x, params["wv"])

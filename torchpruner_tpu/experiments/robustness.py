@@ -25,61 +25,18 @@ from torchpruner_tpu.core.segment import SegmentedModel
 
 
 @functools.lru_cache(maxsize=512)
-def _ablation_fn(model: SegmentedModel, eval_layer: str, loss_fn,
-                 compute_dtype=None):
-    """jit: (params, state, x, y, ranking) -> (loss_sums, correct_counts),
-    both (n_units,): test metrics after each cumulative unit removal.
-
-    ``compute_dtype=bfloat16`` runs the ablation forwards at MXU rate
-    (params/activations cast; logits promoted to f32 before the loss, so
-    loss sums accumulate in f32 — the same mixed-precision policy as
-    training and bf16 scoring)."""
-
-    from torchpruner_tpu.utils.dtypes import cast_floats
-    from torchpruner_tpu.utils.losses import prediction_counts
-
-    @jax.jit
-    def fn(params, state, x, y, ranking):
-        if compute_dtype is not None:
-            params = cast_floats(params, compute_dtype)
-            x = cast_floats(x, compute_dtype)
-        z, _ = model.apply(params, x, state=state, train=False,
-                           to_layer=eval_layer)
-        n = z.shape[-1]
-
-        def run_suffix(zz):
-            logits, _ = model.apply(params, zz, state=state,
-                                    train=False, from_layer=eval_layer)
-            if compute_dtype is not None:
-                logits = logits.astype(jnp.float32)
-            return logits
-
-        def step(mask, u):
-            mask = mask.at[u].set(0.0)
-            logits = run_suffix(z * mask)
-            losses = loss_fn(logits, y)
-            correct, _ = prediction_counts(logits, y)
-            return mask, (jnp.sum(losses), correct)
-
-        _, (loss_sums, corrects) = jax.lax.scan(
-            step, jnp.ones((n,), z.dtype), ranking
-        )
-        base_logits = run_suffix(z)
-        base_correct, n_pred = prediction_counts(base_logits, y)
-        base = (jnp.sum(loss_fn(base_logits, y)), base_correct)
-        return loss_sums, corrects, base[0], base[1], n_pred
-
-    return fn
-
-
-@functools.lru_cache(maxsize=512)
 def _ablation_fn_batch(model: SegmentedModel, eval_layer: str, loss_fn,
                        compute_dtype=None):
-    """Like :func:`_ablation_fn` but vmapped over a BATCH of rankings
-    ``(R, n)`` — the sweep runs one layer's whole method panel (8 methods
-    x stochastic repeats = 14 walks) as a single scan whose suffix
-    forwards batch over the R rankings, so small-batch suffix matmuls tile
-    the MXU R x better and the walk launches once per (layer, batch)."""
+    """jit: (params, state, x, y, rankings (R, n)) -> per-ranking
+    (loss_sums, corrects) (R, n) + base metrics — the sweep runs one
+    layer's whole method panel (8 methods x stochastic repeats = 14
+    walks) as a single scan whose suffix forwards batch over the R
+    rankings, so small-batch suffix matmuls tile the MXU R x better and
+    the walk launches once per (layer, batch).
+
+    ``compute_dtype=bfloat16`` runs the forwards at MXU rate
+    (params/activations cast; logits promoted to f32 before the loss, so
+    loss sums accumulate in f32 — the shared mixed-precision policy)."""
 
     from torchpruner_tpu.utils.dtypes import cast_floats
     from torchpruner_tpu.utils.losses import prediction_counts
@@ -132,20 +89,50 @@ def ablation_curves_batch(
     loss_fn,
     *,
     eval_layer: Optional[str] = None,
+    mesh=None,
+    data_axis: str = "data",
     compute_dtype=None,
 ) -> List[Dict[str, np.ndarray]]:
     """Batched :func:`ablation_curve`: ``rankings`` is ``(R, n)``; returns
     R curve dicts in order.  One vmapped scan per data batch evaluates
-    every ranking simultaneously."""
+    every ranking simultaneously; with ``mesh`` the batch dim shards over
+    ``data_axis`` (params/rankings replicated) and the same program runs
+    SPMD."""
     eval_layer = eval_layer or layer
     fn = _ablation_fn_batch(model, eval_layer, loss_fn, compute_dtype)
     rankings = jnp.asarray(np.asarray(rankings, dtype=np.int32))
+
+    def put(t):  # identity on a single device
+        return t
+
+    if mesh is not None:
+        from torchpruner_tpu.parallel.sharding import (
+            batch_sharding,
+            replicate,
+        )
+
+        repl = replicate(mesh)
+        params = jax.device_put(params, repl)
+        if state is not None:
+            state = jax.device_put(state, repl)
+        rankings = jax.device_put(rankings, repl)
+        n_shard = mesh.shape[data_axis]
+        bs = batch_sharding(mesh, data_axis)
+
+        def put(t):
+            if t.shape[0] % n_shard:
+                raise ValueError(
+                    f"batch size {t.shape[0]} not divisible by mesh axis "
+                    f"{data_axis}={n_shard}; use drop_remainder batches"
+                )
+            return jax.device_put(t, bs)
+
     tot_l = tot_c = None
     base_l = base_c = 0.0
     n_examples = 0
     n_preds = 0
     for x, y in (data() if callable(data) else data):
-        l, c, bl, bc, n_pred = fn(params, state, x, y, rankings)
+        l, c, bl, bc, n_pred = fn(params, state, put(x), put(y), rankings)
         tot_l = l if tot_l is None else tot_l + l
         tot_c = c if tot_c is None else tot_c + c
         base_l += float(bl)
@@ -181,60 +168,17 @@ def ablation_curve(
 
     Returns ``{"loss": (n,), "acc": (n,), "base_loss": float,
     "base_acc": float}`` — test loss/accuracy after each cumulative removal
-    (the reference's cell-8 inner loop, one scan per batch here).
-
-    With ``mesh``, each batch's example dim is sharded over ``data_axis``
-    and params/state are replicated: the same jitted scan runs SPMD, XLA
-    inserting the loss/count all-reduces — the sweep's wall-clock divides
-    by the data-axis size on a pod.  Batch sizes must divide the axis.
+    (the reference's cell-8 inner loop, one scan per batch here).  The
+    R = 1 case of :func:`ablation_curves_batch` (one implementation for
+    both paths); ``mesh`` shards the example dim over ``data_axis`` for
+    the SPMD sweep.
     """
-    eval_layer = eval_layer or layer
-    fn = _ablation_fn(model, eval_layer, loss_fn, compute_dtype)
-    ranking = jnp.asarray(np.asarray(ranking, dtype=np.int32))
-
-    def put(t):  # identity on a single device
-        return t
-
-    if mesh is not None:
-        from torchpruner_tpu.parallel.sharding import (
-            batch_sharding,
-            replicate,
-        )
-
-        repl = replicate(mesh)
-        params = jax.device_put(params, repl)
-        if state is not None:
-            state = jax.device_put(state, repl)
-        ranking = jax.device_put(ranking, repl)
-        n_shard = mesh.shape[data_axis]
-        bs = batch_sharding(mesh, data_axis)
-
-        def put(t):
-            if t.shape[0] % n_shard:
-                raise ValueError(
-                    f"batch size {t.shape[0]} not divisible by mesh axis "
-                    f"{data_axis}={n_shard}; use drop_remainder batches"
-                )
-            return jax.device_put(t, bs)
-
-    tot_l = tot_c = None
-    base_l = base_c = 0.0
-    n_examples = 0
-    n_preds = 0
-    for x, y in (data() if callable(data) else data):
-        l, c, bl, bc, n_pred = fn(params, state, put(x), put(y), ranking)
-        tot_l = l if tot_l is None else tot_l + l
-        tot_c = c if tot_c is None else tot_c + c
-        base_l += float(bl)
-        base_c += float(bc)
-        n_examples += x.shape[0]
-        n_preds += int(n_pred)
-    return {
-        "loss": np.asarray(tot_l) / n_examples,
-        "acc": np.asarray(tot_c) / n_preds,
-        "base_loss": base_l / n_examples,
-        "base_acc": base_c / n_preds,
-    }
+    return ablation_curves_batch(
+        model, params, state, layer,
+        np.asarray(ranking, dtype=np.int32)[None], data, loss_fn,
+        eval_layer=eval_layer, mesh=mesh, data_axis=data_axis,
+        compute_dtype=compute_dtype,
+    )[0]
 
 
 def loss_increase_auc(curve: Dict[str, np.ndarray]) -> float:
@@ -316,27 +260,19 @@ def layerwise_robustness(
                 pending.append((name, scores, time.perf_counter() - t0))
 
         # phase 2: ONE batched walk for the whole method panel (each data
-        # batch's suffix forwards vectorize over all rankings); the mesh-
-        # sharded path keeps per-curve walks (the batched fn is
-        # single-device)
+        # batch's suffix forwards vectorize over all rankings; under a
+        # mesh the example dim additionally shards over the data axis)
+        if not pending:
+            continue
         t0 = time.perf_counter()
-        if mesh is None:
-            curves = ablation_curves_batch(
-                model, params, state, layer,
-                np.stack([np.argsort(s) for _, s, _ in pending]),
-                test_data, loss_fn,
-                eval_layer=eval_layer, compute_dtype=compute_dtype,
-            )
-        else:
-            curves = [
-                ablation_curve(
-                    model, params, state, layer, np.argsort(s), test_data,
-                    loss_fn, eval_layer=eval_layer, mesh=mesh,
-                    data_axis=data_axis, compute_dtype=compute_dtype,
-                )
-                for _, s, _ in pending
-            ]
-        walk_share = (time.perf_counter() - t0) / max(1, len(pending))
+        curves = ablation_curves_batch(
+            model, params, state, layer,
+            np.stack([np.argsort(s) for _, s, _ in pending]),
+            test_data, loss_fn,
+            eval_layer=eval_layer, mesh=mesh, data_axis=data_axis,
+            compute_dtype=compute_dtype,
+        )
+        walk_share = (time.perf_counter() - t0) / len(pending)
 
         for (name, scores, score_s), curve in zip(pending, curves):
             results[layer].setdefault(name, []).append({

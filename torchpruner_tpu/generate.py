@@ -250,10 +250,12 @@ def _generate_fn(model: SegmentedModel, S: int, n_new: int,
         def sample(logits, r):
             if temperature == 0.0:
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            logits = _truncate_logits(logits, top_k, top_p)
-            return jax.random.categorical(
-                r, logits / temperature, axis=-1
-            ).astype(jnp.int32)
+            # temperature FIRST: the nucleus must reflect the distribution
+            # actually sampled from (top_k is scale-invariant, top_p isn't)
+            logits = _truncate_logits(logits / temperature, top_k, top_p)
+            return jax.random.categorical(r, logits, axis=-1).astype(
+                jnp.int32
+            )
 
         def gen(carry, pos):
             cache, logits, r = carry
