@@ -112,3 +112,38 @@ def test_tp_on_vision_model_conv_chain():
     assert ("patchify", "w") not in specs
     assert specs[("block1_mlp/fc1", "w")] == P(None, "model")
     assert specs[("block1_mlp/fc2", "w")] == P("model", None)
+
+
+def test_attribution_scoring_with_tp_sharded_params():
+    """Models too large for one chip score with TP-sharded parameters
+    unchanged: the metrics' jitted row computations partition via GSPMD
+    (same scores as unsharded) — compose with DistributedScorer's data
+    sharding for the full 8B-scale scoring story."""
+    from torchpruner_tpu.attributions import (
+        ShapleyAttributionMetric,
+        TaylorAttributionMetric,
+    )
+    from torchpruner_tpu.core.segment import init_model
+    from torchpruner_tpu.models import llama_tiny
+    from torchpruner_tpu.parallel.sharding import tp_sharding
+    from torchpruner_tpu.utils.losses import lm_cross_entropy_loss
+
+    model = llama_tiny()
+    params, state = init_model(model, seed=0)
+    toks = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(0), (8, 16), 0, 256),
+        np.int32,
+    )
+    batches = [(toks, toks)]
+    mesh = make_mesh({"model": 4}, devices=jax.devices()[:4])
+    params_tp = jax.device_put(
+        params, tp_sharding(model, params, mesh, "model", 0)
+    )
+    for cls, kw in ((TaylorAttributionMetric, {}),
+                    (ShapleyAttributionMetric, {"sv_samples": 2,
+                                                "seed": 0})):
+        want = cls(model, params, batches, lm_cross_entropy_loss,
+                   state=state, **kw).run("block1_ffn/gate")
+        got = cls(model, params_tp, batches, lm_cross_entropy_loss,
+                  state=state, **kw).run("block1_ffn/gate")
+        np.testing.assert_allclose(got, want, atol=1e-4)
