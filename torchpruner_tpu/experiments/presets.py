@@ -27,6 +27,8 @@ def vgg16_layerwise(smoke: bool = False) -> ExperimentConfig:
         method_kwargs={"sv_samples": 5},
         score_examples=64 if smoke else 1000,
         eval_batch_size=64 if smoke else 250,
+        score_dtype="float32" if smoke else "bfloat16",  # MXU-rate sweep
+        results_path="" if smoke else "logs/vgg16_sweep_results.json",
     )
 
 
