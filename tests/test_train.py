@@ -149,6 +149,23 @@ def test_dataset_batching():
     assert [len(b[0]) for b in bs2] == [32, 32, 32]
 
 
+def test_host_shard_partitions_disjointly():
+    """host_shard(i, n) slices are disjoint, cover the dataset, and are
+    deterministic — the multi-host DP data contract; single-process
+    defaults are the identity."""
+    ds = tiny_data(n=64)
+    shards = [ds.host_shard(i, 4) for i in range(4)]
+    assert sum(len(s) for s in shards) == 64
+    seen = np.concatenate([s.x for s in shards])
+    np.testing.assert_array_equal(
+        np.sort(seen.ravel()), np.sort(ds.x.ravel())
+    )
+    # identity without multi-process config
+    assert ds.host_shard() is ds
+    with pytest.raises(ValueError, match="host index"):
+        ds.host_shard(4, 4)
+
+
 def test_mixed_precision_training_keeps_f32_master_state():
     """bf16 compute: params/opt-state/BN stats stay f32, loss decreases,
     and one step tracks the f32 step closely."""

@@ -57,6 +57,27 @@ class Dataset:
         idx = rng.permutation(len(self.x))[:n]
         return Dataset(self.x[idx], self.y[idx], self.name)
 
+    def host_shard(self, index: Optional[int] = None,
+                   count: Optional[int] = None) -> "Dataset":
+        """This host's slice for multi-host data parallelism: host ``i``
+        of ``count`` takes examples ``i::count`` (a strided view — no
+        copy for memmapped on-disk arrays), so every host feeds its local
+        devices a disjoint shard and global batches assemble by sharded
+        device_put.  Defaults to ``jax.process_index()/process_count()``
+        (identity in single-process runs)."""
+        import jax
+
+        index = jax.process_index() if index is None else index
+        count = jax.process_count() if count is None else count
+        if not 0 <= index < count:
+            raise ValueError(f"host index {index} not in [0, {count})")
+        if count == 1:
+            return self
+        return Dataset(
+            self.x[index::count], self.y[index::count],
+            f"{self.name}[host {index}/{count}]",
+        )
+
     def iter_batches(
         self,
         batch_size: int,
