@@ -1,0 +1,117 @@
+"""Mask-based simulated pruning: the plan-derived masks must reproduce a
+real structural prune's forward exactly (eval mode), stay pinned at zero
+through training via the optax transform, and materialize into the same
+model with one final prune()."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.masking import apply_masks, drop_masks, masked_update
+from torchpruner_tpu.core.pruner import prune
+from torchpruner_tpu.core.segment import SegmentedModel, init_model
+from torchpruner_tpu.models import digits_convnet
+from torchpruner_tpu.utils.losses import cross_entropy_loss
+
+
+def fc():
+    return SegmentedModel(
+        (L.Dense("fc1", 16), L.Activation("r1", "relu"),
+         L.Dense("fc2", 12), L.Activation("r2", "relu"),
+         L.Dense("out", 4)),
+        (8,),
+    )
+
+
+def test_masked_forward_equals_pruned_forward_fc():
+    model = fc()
+    params, state = init_model(model, seed=0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 8))
+    drops = {"fc1": [0, 5, 9], "fc2": [3]}
+
+    pm, _ = drop_masks(model, params, drops)
+    y_masked, _ = model.apply(apply_masks(params, pm), x)
+
+    res_model, res_params = model, params
+    res_state = state
+    for layer, d in drops.items():
+        r = prune(res_model, res_params, layer, d, state=res_state)
+        res_model, res_params, res_state = r.model, r.params, r.state
+    y_pruned, _ = res_model.apply(res_params, x, state=res_state)
+    np.testing.assert_allclose(
+        np.asarray(y_masked), np.asarray(y_pruned), atol=1e-5
+    )
+
+
+def test_masked_forward_equals_pruned_forward_conv_bn_flatten():
+    """Conv channel masks must null the BN scale/bias/stats AND the strided
+    flatten fan-out rows of the dense consumer — the full plan."""
+    model = digits_convnet()
+    params, state = init_model(model, seed=0)
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 8, 8, 1))
+    drops = {"conv2": [1, 7, 30]}
+
+    pm, sm = drop_masks(model, params, drops, state=state)
+    y_masked, _ = model.apply(
+        apply_masks(params, pm), x, state=apply_masks(state, sm)
+    )
+    r = prune(model, params, "conv2", drops["conv2"], state=state)
+    y_pruned, _ = r.model.apply(r.params, x, state=r.state)
+    np.testing.assert_allclose(
+        np.asarray(y_masked), np.asarray(y_pruned), atol=1e-5
+    )
+
+
+def test_masked_training_pins_zeros_and_materializes():
+    """Chained after adam, masked entries stay exactly zero across steps
+    (no recompile between sparsity experiments); the final structural
+    prune of the masked model matches pruning + the same training."""
+    model = fc()
+    params, _ = init_model(model, seed=0)
+    drops = {"fc1": [2, 11]}
+    pm, _ = drop_masks(model, params, drops)
+    tx = optax.chain(optax.adam(1e-2), masked_update(pm))
+    params = apply_masks(params, pm)
+    opt_state = tx.init(params)
+
+    x = jax.random.normal(jax.random.PRNGKey(3), (8, 8))
+    y = jnp.arange(8) % 4
+
+    @jax.jit
+    def step(p, o):
+        def loss(p_):
+            out, _ = model.apply(p_, x)
+            return jnp.mean(cross_entropy_loss(out, y))
+
+        g = jax.grad(loss)(p)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o
+
+    for _ in range(5):
+        params, opt_state = step(params, opt_state)
+
+    w = np.asarray(params["fc1"]["w"])
+    b = np.asarray(params["fc1"]["b"])
+    assert np.all(w[:, [2, 11]] == 0.0) and np.all(b[[2, 11]] == 0.0)
+    assert np.all(np.asarray(params["fc2"]["w"])[[2, 11], :] == 0.0)
+    # surviving entries DID train
+    assert np.any(w[:, [0, 1]] != 0.0)
+
+    # materialize: prune away the masked units; forward unchanged
+    xt = jax.random.normal(jax.random.PRNGKey(4), (4, 8))
+    y_masked, _ = model.apply(params, xt)
+    r = prune(model, params, "fc1", drops["fc1"])
+    y_final, _ = r.model.apply(r.params, xt)
+    np.testing.assert_allclose(
+        np.asarray(y_masked), np.asarray(y_final), atol=1e-5
+    )
+
+
+def test_drop_masks_rejects_unknown_layer():
+    model = fc()
+    params, _ = init_model(model, seed=0)
+    with pytest.raises(KeyError):
+        drop_masks(model, params, {"nope": [0]})

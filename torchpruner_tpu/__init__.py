@@ -27,6 +27,11 @@ from torchpruner_tpu.core.graph import (
     nan_cascade_oracle,
 )
 from torchpruner_tpu.core.plan import PruneGroup, Consumer, PrunePlan
+from torchpruner_tpu.core.masking import (
+    apply_masks,
+    drop_masks,
+    masked_update,
+)
 from torchpruner_tpu.core.pruner import (
     Pruner,
     bucket_drop,
@@ -64,6 +69,9 @@ __all__ = [
     "prune",
     "prune_by_scores",
     "bucket_drop",
+    "apply_masks",
+    "drop_masks",
+    "masked_update",
     "generate",
     "init_cache",
     "make_decode_step",

@@ -1,0 +1,136 @@
+"""Mask-based (simulated) pruning — the no-recompile complement to
+structural surgery.
+
+Structural pruning (core/pruner.py) changes static shapes, which retraces
+and recompiles every jitted computation (SURVEY.md §7 "recompilation
+economics").  During *exploration* — sweeping ratios, iterating schedules,
+fine-tuning toward a sparsity target — that bill can dominate.  This module
+keeps shapes fixed instead: the SAME slices a structural prune would remove
+(derived from the same ``PrunePlan``) are held at zero by masking the
+parameters and, during training, the optimizer updates (an optax
+transform, the JaxPruner-style integration point).  One final
+:func:`~torchpruner_tpu.core.pruner.prune` with the same indices
+materializes the mask into genuinely smaller tensors for deployment.
+
+Forward equivalence with real pruning holds exactly in eval mode: masked
+units produce zero activations, masked consumer rows null their
+contributions, masked norm scale/bias zero the channel — verified against
+``prune()`` in tests/test_masking.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+import optax
+
+from torchpruner_tpu.core import graph as G
+from torchpruner_tpu.core.plan import PruneGroup
+from torchpruner_tpu.core.pruner import plan_for_group
+from torchpruner_tpu.core.segment import SegmentedModel
+
+
+def _set_path(tree, path: Tuple[str, ...], value):
+    if len(path) == 1:
+        return {**tree, path[0]: value}
+    return {**tree, path[0]: _set_path(tree.get(path[0], {}), path[1:], value)}
+
+
+def _get_path(tree, path: Tuple[str, ...]):
+    for k in path:
+        if tree is None or k not in tree:
+            return None
+        tree = tree[k]
+    return tree
+
+
+def drop_masks(
+    model: SegmentedModel,
+    params,
+    drops: Dict[Union[str, PruneGroup], Sequence[int]],
+    *,
+    state=None,
+):
+    """Binary (1.0 = keep) masks for the exact parameter/state slices a
+    structural prune of ``drops`` (``{layer: unit indices}``) would remove.
+
+    Returns ``(param_masks, state_masks)`` shaped like ``params`` /
+    ``state`` (missing optional entries skipped).  Fan-out (conv -> flatten
+    -> dense) and attached-norm slices come from the same ``PrunePlan`` as
+    real surgery, so the two stay in lockstep by construction.
+    """
+    param_masks = jax.tree_util.tree_map(jnp.ones_like, params)
+    state_masks = (
+        jax.tree_util.tree_map(jnp.ones_like, state)
+        if state is not None else None
+    )
+    for layer, drop in drops.items():
+        group = layer if isinstance(layer, PruneGroup) else G.group_for(
+            model, layer
+        )
+        plan = plan_for_group(model, group)
+        drop = np.unique(np.asarray(drop, dtype=np.int64).reshape(-1))
+        for s in plan.slices:
+            tree, masks = (
+                (params, param_masks) if s.collection == "params"
+                else (state, state_masks)
+            )
+            leaf = _get_path(tree, s.path)
+            if leaf is None:
+                if s.optional:
+                    continue
+                raise KeyError(f"missing {'/'.join(s.path)}")
+            # fan_out positions are STRIDED {p * n_units + u} (channels-
+            # last flatten map — plan.ParamSlice), matching expand_keep
+            idx = (
+                np.concatenate([
+                    p * plan.n_units + drop for p in range(s.fan_out)
+                ])
+                if s.fan_out > 1 else drop
+            )
+            mask = _get_path(masks, s.path)
+            mask = mask.at[
+                (slice(None),) * s.axis + (jnp.asarray(idx),)
+            ].set(0.0)
+            if s.collection == "params":
+                param_masks = _set_path(param_masks, s.path, mask)
+            else:
+                state_masks = _set_path(state_masks, s.path, mask)
+    return param_masks, state_masks
+
+
+def apply_masks(tree, masks):
+    """``tree * masks`` leafwise (masks=None is the identity)."""
+    if masks is None:
+        return tree
+    return jax.tree_util.tree_map(lambda t, m: t * m.astype(t.dtype),
+                                  tree, masks)
+
+
+def masked_update(param_masks) -> optax.GradientTransformation:
+    """Optax transform pinning masked parameters at zero through training
+    (the JaxPruner-style sparsity-in-the-optimizer integration): chain it
+    AFTER the inner optimizer so each step's update is masked — with the
+    parameters masked once at the start, masked entries then stay exactly
+    zero under any first-order update (masked grads/momentum can flow, but
+    the masked update never moves the parameter).
+
+    Use::
+
+        masks, _ = drop_masks(model, params, {"conv5": idx}, state=state)
+        tx = optax.chain(optax.adam(1e-3), masked_update(masks))
+        params = apply_masks(params, masks)   # zero once up front
+    """
+
+    def init(params):
+        del params
+        return optax.EmptyState()
+
+    def update(updates, opt_state, params=None):
+        del params
+        return apply_masks(updates, param_masks), opt_state
+
+    return optax.GradientTransformation(init, update)
