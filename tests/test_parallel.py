@@ -165,6 +165,21 @@ def test_sharded_trainer_matches_single_device():
     np.testing.assert_allclose(w1, w2, rtol=1e-3, atol=1e-5)
 
 
+def test_sharded_trainer_evaluate_matches_single_device():
+    """Data-sharded evaluation (including a non-dividing final batch that
+    stays replicated) must equal the single-device evaluation exactly."""
+    mesh = make_mesh({"data": 2, "model": 4})
+    tx = optax.sgd(0.05)
+    t1 = Trainer.create(model_8(), tx, cross_entropy_loss, seed=0)
+    t8 = ShardedTrainer.create(model_8(), tx, cross_entropy_loss, mesh,
+                               seed=0, min_shard_size=0)
+    data = synthetic_dataset((16,), 4, 50, seed=3).batches(16)  # 16,16,16,2
+    l1, a1 = t1.evaluate(data)
+    l8, a8 = t8.evaluate(data)
+    np.testing.assert_allclose(l1, l8, rtol=1e-5)
+    assert a1 == a8
+
+
 def test_sharded_trainer_gradient_accumulation_matches():
     """SPMD gradient accumulation (scanned microbatches, each still
     sharded over the data axis) must match the unaccumulated SPMD step."""

@@ -196,7 +196,22 @@ class ShardedTrainer:
         return t
 
     def evaluate(self, data):
+        """Evaluation with batches sharded over the data axis (XLA
+        all-reduces the loss/count sums); batches that don't divide the
+        axis stay replicated rather than being dropped — evaluation must
+        count every example."""
         from torchpruner_tpu.train.loop import evaluate
 
-        return evaluate(self.model, self.params, self.state, data,
-                        self.loss_fn)
+        bs = batch_sharding(self.mesh, self.data_axis)
+        n = self.mesh.shape[self.data_axis]
+
+        def sharded_stream():
+            for x, y in (data() if callable(data) else data):
+                x, y = jnp.asarray(x), jnp.asarray(y)
+                if x.shape[0] % n == 0:
+                    x = jax.device_put(x, bs)
+                    y = jax.device_put(y, bs)
+                yield x, y
+
+        return evaluate(self.model, self.params, self.state,
+                        sharded_stream, self.loss_fn)
