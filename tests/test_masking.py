@@ -110,6 +110,49 @@ def test_masked_training_pins_zeros_and_materializes():
     )
 
 
+def test_simulated_prune_retrain_matches_structural_accuracy():
+    """cfg.simulate runs the same prune loop with masks — the per-step
+    post-prune test accuracy must equal the structural run's (same
+    policy, same plan), with no shape change anywhere."""
+    from torchpruner_tpu.data import synthetic_dataset
+    from torchpruner_tpu.experiments.prune_retrain import run_prune_retrain
+    from torchpruner_tpu.utils.config import ExperimentConfig
+
+    datasets = tuple(
+        synthetic_dataset((16,), 4, 96, seed=s) for s in (0, 1, 2)
+    )
+    model = SegmentedModel(
+        (L.Dense("fc1", 16), L.Activation("r1", "relu"),
+         L.Dense("fc2", 12), L.Activation("r2", "relu"),
+         L.Dense("out", 4)),
+        (16,),
+    )
+    import os
+
+    kw = dict(
+        name="sim", dataset="synthetic", method="weight_norm",
+        policy="fraction", fraction=0.25, score_examples=64,
+        eval_batch_size=32, log_path=os.devnull,
+    )
+    hist_real = run_prune_retrain(
+        ExperimentConfig(**kw), model=model, datasets=datasets,
+        verbose=False,
+    )
+    hist_sim = run_prune_retrain(
+        ExperimentConfig(**kw, simulate=True), model=model,
+        datasets=datasets, verbose=False,
+    )
+    assert len(hist_real) == len(hist_sim) == 2
+    for r, s in zip(hist_real, hist_sim):
+        assert r.layer == s.layer and r.n_dropped == s.n_dropped
+        np.testing.assert_allclose(r.post_acc, s.post_acc, atol=1e-6)
+        np.testing.assert_allclose(r.post_loss, s.post_loss, atol=1e-5)
+
+    # simulate + finetune is a config error (masks would regrow)
+    with pytest.raises(ValueError, match="masked_update"):
+        ExperimentConfig(**kw, simulate=True, finetune_epochs=1)
+
+
 def test_drop_masks_rejects_unknown_layer():
     model = fc()
     params, _ = init_model(model, seed=0)

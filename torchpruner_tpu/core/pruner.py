@@ -227,6 +227,21 @@ def prune_by_scores(
       TPU tiling clean and bounds recompile diversity; see
       :func:`bucket_drop`)
     """
+    drop = score_drop_indices(scores, policy=policy, fraction=fraction,
+                              bucket=bucket)
+    return prune(model, params, layer, drop, state=state, opt_state=opt_state)
+
+
+def score_drop_indices(
+    scores: np.ndarray,
+    *,
+    policy: Union[str, Callable[[np.ndarray], np.ndarray]] = "negative",
+    fraction: float = 0.5,
+    bucket: int = 1,
+) -> np.ndarray:
+    """The scores→drop-indices policy of :func:`prune_by_scores` alone —
+    shared with mask-based simulated pruning so both modes drop the exact
+    same units."""
     scores = np.asarray(scores)
     if callable(policy):
         drop = np.asarray(policy(scores), dtype=np.int64)
@@ -239,8 +254,7 @@ def prune_by_scores(
         raise ValueError(f"unknown policy {policy!r}")
     if len(drop) >= len(scores):
         drop = drop[: len(scores) - 1]  # never remove a whole layer
-    drop = bucket_drop(scores, np.asarray(drop, dtype=np.int64), bucket)
-    return prune(model, params, layer, drop, state=state, opt_state=opt_state)
+    return bucket_drop(scores, np.asarray(drop, dtype=np.int64), bucket)
 
 
 class Pruner:

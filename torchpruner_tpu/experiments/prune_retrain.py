@@ -23,7 +23,7 @@ from torchpruner_tpu.attributions import (
 )
 from torchpruner_tpu.core import layers as L
 from torchpruner_tpu.core.graph import pruning_graph
-from torchpruner_tpu.core.pruner import prune_by_scores
+from torchpruner_tpu.core.pruner import prune_by_scores, score_drop_indices
 from torchpruner_tpu.data import load_dataset
 from torchpruner_tpu.models import (
     bert_base,
@@ -262,16 +262,36 @@ def run_prune_retrain(
             target, find_best_evaluation_layer=cfg.find_best_evaluation_layer
         )
         pre_loss, pre_acc = trainer.evaluate(test_batches)
-        res = prune_by_scores(
-            trainer.model, trainer.params, target, scores,
-            policy=cfg.policy, fraction=cfg.fraction, bucket=cfg.bucket,
-            state=trainer.state, opt_state=trainer.opt_state,
-        )
-        prune_time = time.perf_counter() - t0
-        n_dropped = L.n_units(trainer.model.layer(target)) - L.n_units(
-            res.model.layer(target)
-        )
-        trainer = trainer.rebuild(res.model, res.params, res.state, res.opt_state)
+        if cfg.simulate:
+            # mask the same slices a real prune would remove — shapes (and
+            # therefore compiled programs) never change across the sweep
+            from torchpruner_tpu.core.masking import apply_masks, drop_masks
+
+            drop_idx = score_drop_indices(
+                scores, policy=cfg.policy, fraction=cfg.fraction,
+                bucket=cfg.bucket,
+            )
+            pm, sm = drop_masks(
+                trainer.model, trainer.params, {target: drop_idx},
+                state=trainer.state,
+            )
+            trainer.params = apply_masks(trainer.params, pm)
+            if trainer.state:
+                trainer.state = apply_masks(trainer.state, sm)
+            prune_time = time.perf_counter() - t0
+            n_dropped = len(drop_idx)
+        else:
+            res = prune_by_scores(
+                trainer.model, trainer.params, target, scores,
+                policy=cfg.policy, fraction=cfg.fraction, bucket=cfg.bucket,
+                state=trainer.state, opt_state=trainer.opt_state,
+            )
+            prune_time = time.perf_counter() - t0
+            n_dropped = L.n_units(trainer.model.layer(target)) - L.n_units(
+                res.model.layer(target)
+            )
+            trainer = trainer.rebuild(res.model, res.params, res.state,
+                                      res.opt_state)
 
         for epoch in range(cfg.finetune_epochs):
             train_epoch(

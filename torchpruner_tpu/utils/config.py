@@ -79,6 +79,11 @@ class ExperimentConfig:
     #: >0 adds that multiple of the MoE load-balancing auxiliary loss
     #: (Switch-style; no-op for models without MoE layers)
     moe_aux_weight: float = 0.0
+    #: simulated pruning: the prune loop MASKS the dropped slices (same
+    #: policy, same plan) instead of re-instantiating — zero recompiles
+    #: across the whole sweep; incompatible with finetune_epochs (chain
+    #: core.masking.masked_update into a custom loop for that)
+    simulate: bool = False
 
     # data pipeline / checkpointing
     augment: bool = False            # flip + pad/crop image augmentation
@@ -119,6 +124,12 @@ class ExperimentConfig:
                     f"unknown {fld} {getattr(self, fld)!r} "
                     "(use 'float32' or 'bfloat16')"
                 )
+        if self.simulate and self.finetune_epochs:
+            raise ValueError(
+                "simulate=True masks parameters without pinning them in "
+                "the optimizer, so fine-tuning would regrow them — chain "
+                "core.masking.masked_update into a custom loop instead"
+            )
 
     def to_json(self, path: str):
         with open(path, "w") as f:
