@@ -1,22 +1,29 @@
 """Benchmark: the reference's headline workloads on TPU.
 
-Three legs (BASELINE.md):
+Five legs (baselines from BASELINE.md where the reference has one):
 
 1. ``mnist_prune`` — the "Pruning Untrained Networks" MNIST experiment end
    to end (28 s on the reference's CUDA GPU): untrained 784-2024-2024-10 FC
-   net, Shapley attribution (sv_samples=5) on 1000 validation examples for
-   both hidden layers (outermost first), pruning all negative-attribution
-   units — including all JIT compilation and the shape-changing recompile
-   between the two prune steps.
+   net, Shapley attribution (sv_samples=5, bf16 forwards) on 1000
+   validation examples for both hidden layers (outermost first), pruning
+   all negative-attribution units — including all JIT compilation and the
+   shape-changing recompile between the two prune steps.
 2. ``vgg16_robustness`` — the north-star 6.5 h layerwise-robustness sweep
    (15 layers × 8-method panel, 3 runs for stochastic methods, 1000 test
    examples).  The bench measures the full 14-run panel on one
    representative 512-unit conv layer and projects to all 15 layers; the
-   per-(layer,method) ablation walk is a single ``lax.scan`` per batch
-   (experiments/robustness.py) instead of the reference's per-unit Python
-   forwards.
+   panel's ablation walks run as ONE vmapped ``lax.scan`` per batch in
+   bf16 (experiments/robustness.py) instead of the reference's per-unit
+   Python forwards.
 3. ``vgg16_train`` — steady-state VGG16-bn training-step time, img/s per
-   chip, and MFU (achieved FLOPs / peak) via XLA cost analysis.
+   chip, and MFU (achieved FLOPs / peak) via XLA cost analysis; bf16
+   mixed precision with the f32 step alongside.
+4. ``flash_attention`` — Pallas flash fwd+bwd kernels vs the XLA einsum
+   path: grad-step time and compiled temp memory at S=2048 (the O(S·Dh)
+   vs O(S²) backward-memory claim, measured).
+5. ``llama_decode`` — KV-cache decode throughput (tokens/s) through
+   ``generate`` (no reference baseline; the reference has no inference
+   loop).
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
