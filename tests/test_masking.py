@@ -153,6 +153,36 @@ def test_simulated_prune_retrain_matches_structural_accuracy():
         ExperimentConfig(**kw, simulate=True, finetune_epochs=1)
 
 
+def test_simulated_prune_over_mesh_runs():
+    """simulate composes with the SPMD loop: masked (sharded) params keep
+    their shardings, so the compiled step is reused across the sweep."""
+    from torchpruner_tpu.data import synthetic_dataset
+    from torchpruner_tpu.experiments.prune_retrain import run_prune_retrain
+    from torchpruner_tpu.utils.config import ExperimentConfig
+
+    datasets = tuple(
+        synthetic_dataset((16,), 4, 64, seed=s) for s in (0, 1, 2)
+    )
+    model = SegmentedModel(
+        (L.Dense("fc1", 16), L.Activation("r1", "relu"),
+         L.Dense("out", 4)),
+        (16,),
+    )
+    import os
+
+    hist = run_prune_retrain(
+        ExperimentConfig(
+            name="sim_mesh", dataset="synthetic", method="weight_norm",
+            policy="fraction", fraction=0.25, score_examples=32,
+            eval_batch_size=32, simulate=True,
+            mesh={"data": 2, "model": 4}, log_path=os.devnull,
+        ),
+        model=model, datasets=datasets, verbose=False,
+    )
+    assert len(hist) == 1 and hist[0].n_dropped == 4
+    assert np.isfinite(hist[0].post_acc)
+
+
 def test_drop_masks_rejects_unknown_layer():
     model = fc()
     params, _ = init_model(model, seed=0)
