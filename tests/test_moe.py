@@ -64,6 +64,19 @@ def test_moe_aux_loss_collection_and_balance_floor():
     assert aux_eval == {}
 
 
+def test_moe_dense_routing_collects_no_aux():
+    """With top_k == n_experts the balancing loss is a gradient-free
+    constant 1.0 — collecting it would make moe_aux_weight>0 a silent
+    no-op, so dense routing must skip aux collection entirely."""
+    model = moe_net(n_experts=4, top_k=4)
+    params, state = init_model(model, seed=0)
+    x = model.example_input(4)
+    _, _, aux = model.apply(params, x, state=state, train=True,
+                            collect_aux=True,
+                            rng=jax.random.PRNGKey(0))
+    assert aux == {}
+
+
 def test_moe_aux_weight_in_training_loss():
     """A Trainer with moe_aux_weight adds weight x aux to the step loss;
     the remat path must carry the aux through jax.checkpoint (same value

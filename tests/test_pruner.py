@@ -215,6 +215,19 @@ def test_prune_by_scores_policies():
     assert res3.model.layer("fc1").features == 7
 
 
+def test_callable_policy_duplicates_deduped_before_bucketing():
+    from torchpruner_tpu.core.pruner import score_drop_indices
+
+    scores = np.array([-1.0, 2.0, -0.5, 3.0, 1.0, 0.5, -2.0, 4.0])
+    dup = lambda s: np.array([0, 0, 2, 2, 6])  # 3 distinct units
+    np.testing.assert_array_equal(
+        score_drop_indices(scores, policy=dup),
+        np.array([0, 2, 6]),
+    )
+    # bucket math must count 3 dropped (keep 5 -> bucket=4 keeps 8), not 5
+    assert len(score_drop_indices(scores, policy=dup, bucket=4)) == 0
+
+
 def test_bucketed_pruning_rounds_kept_width_up():
     from torchpruner_tpu.core.pruner import bucket_drop
 

@@ -115,6 +115,16 @@ def test_sp_trainer_requires_axes():
         SPTrainer.create(llama_tiny(), optax.adam(1e-3), mesh)
 
 
+def test_sp_trainer_rejects_batchnorm_models():
+    """Per-shard-divergent running stats would silently come back as one
+    shard's values under the replicated out_specs — must error instead."""
+    from torchpruner_tpu.models import fmnist_convnet
+
+    mesh = make_mesh({"data": 2, "seq": 4})
+    with pytest.raises(NotImplementedError, match="BatchNorm"):
+        SPTrainer.create(fmnist_convnet(), optax.adam(1e-3), mesh)
+
+
 def test_sp_attention_rejects_taps():
     """Attribution taps under SP are unsupported — the error must be
     explicit, not silently-local scores."""

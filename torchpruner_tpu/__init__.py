@@ -38,7 +38,12 @@ from torchpruner_tpu.core.pruner import (
     prune,
     prune_by_scores,
 )
-from torchpruner_tpu.generate import generate, init_cache, make_decode_step
+from torchpruner_tpu.generate import (
+    clear_generate_cache,
+    generate,
+    init_cache,
+    make_decode_step,
+)
 from torchpruner_tpu.utils.torch_import import (
     import_hf_llama,
     import_torch_vgg16_bn,
@@ -72,6 +77,7 @@ __all__ = [
     "apply_masks",
     "drop_masks",
     "masked_update",
+    "clear_generate_cache",
     "generate",
     "init_cache",
     "make_decode_step",

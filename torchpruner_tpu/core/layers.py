@@ -1029,7 +1029,13 @@ def apply_layer(
         gates = routing
         if taps is not None and not taps.empty():
             gates = taps.at_site(path, gates)  # expert unit site
-        if taps is not None and taps.collect_aux and train:
+        if (
+            taps is not None and taps.collect_aux and train
+            and spec.top_k < E
+        ):
+            # With dense routing (top_k == E) the loss is a gradient-free
+            # constant 1.0 (f uniform, sum(P)=1), so collecting it would
+            # make moe_aux_weight>0 silently do nothing — skip instead.
             # Switch/Mixtral load-balancing loss: E * sum_e f_e * P_e with
             # f_e the dispatch fraction (top-k membership / top_k) and P_e
             # the mean FULL-softmax router probability; equals 1.0 when

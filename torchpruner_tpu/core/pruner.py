@@ -244,7 +244,9 @@ def score_drop_indices(
     same units."""
     scores = np.asarray(scores)
     if callable(policy):
-        drop = np.asarray(policy(scores), dtype=np.int64)
+        # np.unique: a callable may return duplicates, which would make
+        # bucket_drop miscount the kept width (keep_n = n - len(drop)).
+        drop = np.unique(np.asarray(policy(scores), dtype=np.int64))
     elif policy == "negative":
         drop = np.argwhere(scores < 0).flatten()
     elif policy == "fraction":

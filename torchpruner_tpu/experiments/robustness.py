@@ -13,6 +13,7 @@ evaluation is a batched MXU matmul.
 from __future__ import annotations
 
 import functools
+import logging
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
@@ -371,6 +372,18 @@ def run_robustness_config(cfg, *, model=None, datasets=None,
     test_batches = test.batches(
         cfg.eval_batch_size, drop_remainder=mesh is not None
     )
+    if mesh is not None and len(test) % cfg.eval_batch_size:
+        # drop_remainder means the meshed run evaluates fewer examples
+        # than a single-device run of the same config — surface it so
+        # cross-configuration AUC comparisons are interpreted correctly.
+        logging.getLogger("torchpruner_tpu").warning(
+            "mesh sweep drops a %d-example tail (%d examples %% "
+            "eval_batch_size %d); AUCs are comparable across mesh sizes "
+            "with the same batch size, not against a single-device run "
+            "that keeps the tail",
+            len(test) % cfg.eval_batch_size, len(test),
+            cfg.eval_batch_size,
+        )
 
     def factory(method, reduction="mean", **kw):
         def make(run=0):

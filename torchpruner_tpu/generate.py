@@ -217,14 +217,26 @@ def _truncate_logits(logits, top_k: Optional[int], top_p: Optional[float]):
     return logits
 
 
-@functools.lru_cache(maxsize=256)
+def clear_generate_cache():
+    """Drop all cached generate programs (and, via GC of their jit
+    wrappers, the XLA executables they pin).  Call between long pruning
+    sweeps that generate from many distinct pruned specs — each distinct
+    (spec, lengths, sampling config) combination is one cache entry, so a
+    sweep mixing prompt lengths or temperatures fills the 64-entry LRU
+    well before 64 specs."""
+    _generate_fn.cache_clear()
+
+
+@functools.lru_cache(maxsize=64)
 def _generate_fn(model: SegmentedModel, S: int, n_new: int,
                  temperature: float, top_k: Optional[int] = None,
                  top_p: Optional[float] = None):
     """Compiled prefill+generate program, cached per (model spec, lengths,
     sampling config) so repeated generate() calls reuse the jit executable
     (the model spec is hashable; B/max_len specialize via jit's own
-    shape-keyed cache)."""
+    shape-keyed cache).  LRU-bounded at 64 entries; evicted entries free
+    their executables once unreferenced (see :func:`clear_generate_cache`
+    for explicit eviction during pruned-variant sweeps)."""
 
     @jax.jit
     def run(params, cache, prompt, rng):

@@ -64,6 +64,18 @@ def sp_model(model: SegmentedModel, impl: str = "ring") -> SegmentedModel:
     )
 
 
+def _contains_batchnorm(layers) -> bool:
+    for spec in layers:
+        if isinstance(spec, L.BatchNorm):
+            return True
+        if isinstance(spec, L.Residual) and (
+            _contains_batchnorm(spec.body)
+            or _contains_batchnorm(spec.shortcut)
+        ):
+            return True
+    return False
+
+
 def aligned_targets(tokens) -> tuple:
     """``(targets, mask)`` with ``targets[:, t] = tokens[:, t + 1]`` and the
     final (targetless) position masked out — the host-side shift that makes
@@ -116,6 +128,16 @@ class SPTrainer:
                     f"SPTrainer needs a '{axis}' mesh axis, got "
                     f"{mesh.axis_names}"
                 )
+        if _contains_batchnorm(model.layers):
+            # The shard_map step returns replicated out_specs with
+            # check_vma=False; per-shard-divergent running stats would
+            # silently come back as one shard's values.  Same guard as
+            # generate._decode_seq — LM families use LayerNorm/RMSNorm.
+            raise NotImplementedError(
+                "SPTrainer does not support BatchNorm (per-batch running "
+                "stats diverge across sequence shards); use LayerNorm/"
+                "RMSNorm"
+            )
         model = sp_model(model, impl)
         key = jax.random.PRNGKey(seed)
         params, state = model.init(key)
