@@ -37,6 +37,17 @@ def test_digits_is_real_deterministic_and_split():
     assert clf.score(va.x, va.y) > 0.85
 
 
+def test_digits32_upscales_real_digits_to_cifar_geometry():
+    base = load_dataset("digits", "test")
+    ds = load_dataset("digits32", "test")
+    assert ds.x.shape == (300, 32, 32, 3)
+    np.testing.assert_array_equal(ds.y, base.y)
+    # nearest-neighbour 4x upsample, tiled over 3 identical channels
+    np.testing.assert_array_equal(ds.x[:, ::4, ::4, 0], base.x[..., 0])
+    np.testing.assert_array_equal(ds.x[..., 0], ds.x[..., 2])
+    np.testing.assert_array_equal(ds.x[:, 1::4, 2::4, 1], base.x[..., 0])
+
+
 def _write_idx(path, arr):
     ndim = arr.ndim
     with gzip.open(path, "wb") as f:

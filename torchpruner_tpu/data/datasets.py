@@ -25,6 +25,11 @@ DATASET_SHAPES = {
     # untrained-net-pruning and method-ranking experiments
     "digits": ((8, 8, 1), 10),
     "digits_flat": ((64,), 10),
+    # digits upscaled 8x8 -> 32x32 (nearest-neighbour) and tiled to 3
+    # channels: REAL image data at CIFAR-10 geometry, so VGG16-bn-scale
+    # experiments (training + the layerwise-robustness sweep) can run on
+    # a genuinely trained net in environments without the CIFAR files
+    "digits32": ((32, 32, 3), 10),
 }
 
 #: fixed deterministic split of the 1,797 digits examples
@@ -244,6 +249,12 @@ def load_dataset(
     ds = _load_from_disk(name, split, dtype=np.float32)
     if ds is None and name in ("digits", "digits_flat"):
         ds = _load_digits(name, split)
+    if ds is None and name == "digits32":
+        base = _load_digits("digits", split)
+        if base is not None:
+            x = np.kron(base.x, np.ones((1, 4, 4, 1), np.float32))
+            ds = Dataset(np.repeat(x, 3, axis=3), base.y,
+                         f"digits32:{split}")
     if ds is None:
         defaults = {"train": 50000, "val": 1000, "test": 10000}
         count = n or defaults.get(split, 1000)
