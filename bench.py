@@ -1,6 +1,6 @@
 """Benchmark: the reference's headline workloads on TPU.
 
-Five legs (baselines from BASELINE.md where the reference has one):
+Six legs (baselines from BASELINE.md where the reference has one):
 
 1. ``mnist_prune`` — the "Pruning Untrained Networks" MNIST experiment end
    to end (28 s on the reference's CUDA GPU): untrained 784-2024-2024-10 FC
@@ -9,11 +9,12 @@ Five legs (baselines from BASELINE.md where the reference has one):
    all negative-attribution units — including all JIT compilation and the
    shape-changing recompile between the two prune steps.
 2. ``vgg16_robustness`` — the north-star 6.5 h layerwise-robustness sweep
-   (15 layers × 8-method panel, 3 runs for stochastic methods, 1000 test
-   examples).  The bench measures the full 14-run panel on one
-   representative 512-unit conv layer and projects to all 15 layers; the
-   panel's ablation walks run as ONE vmapped ``lax.scan`` per batch in
-   bf16 (experiments/robustness.py) instead of the reference's per-unit
+   (every prunable layer × the 8-method panel, 3 runs for stochastic
+   methods), measured END TO END with no projection, on a VGG16-bn
+   trained in-leg on digits32 (real sklearn digit scans at CIFAR-10
+   geometry) so the AUC table is meaningful.  The panel's ablation walks
+   run as ONE vmapped ``lax.scan`` per batch in bf16
+   (experiments/robustness.py) instead of the reference's per-unit
    Python forwards.
 3. ``vgg16_train`` — steady-state VGG16-bn training-step time, img/s per
    chip, and MFU (achieved FLOPs / peak) via XLA cost analysis; bf16
@@ -23,7 +24,10 @@ Five legs (baselines from BASELINE.md where the reference has one):
    vs O(S²) backward-memory claim, measured).
 5. ``llama_decode`` — KV-cache decode throughput (tokens/s) through
    ``generate`` (no reference baseline; the reference has no inference
-   loop).
+   loop).  Also runs on the CPU fallback (it is CPU-sized).
+6. ``mfu_llama`` — train-step MFU on a ~200M-param Llama whose FLOPs are
+   large MXU-shaped matmuls: the machinery's MFU ceiling, next to the
+   conv-bound VGG16 number.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
@@ -59,7 +63,7 @@ TPU_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #: (every leg compiling from scratch on the 1-core host through the axon
 #: tunnel) can exceed 900 s; the persistent compilation cache brings warm
 #: runs far under it, but the timeout must cover the cold case.
-CHILD_TIMEOUT_S = int(os.environ.get("BENCH_CHILD_TIMEOUT_S", "2400"))
+CHILD_TIMEOUT_S = int(os.environ.get("BENCH_CHILD_TIMEOUT_S", "4800"))
 
 MNIST_BASELINE_S = 28.0  # reference MNIST FC prune wall-clock (BASELINE.md)
 SWEEP_BASELINE_S = 6.5 * 3600.0  # reference 15-layer × 8-method sweep
@@ -141,30 +145,59 @@ def _leg_mnist(smoke: bool) -> dict:
 
 
 def _leg_vgg_robustness(smoke: bool) -> dict:
-    """Leg 2: the 8-method panel on one 512-unit conv layer of VGG16-bn
-    (1000 test examples, reference protocol), projected to the full
-    15-layer sweep."""
-    from torchpruner_tpu.core.segment import init_model
+    """Leg 2: the FULL layerwise-robustness sweep — every prunable layer
+    × the 8-method panel (3 runs for stochastic methods), measured end to
+    end with no projection (reference: 6.5 h for 15 layers × 8 methods).
+
+    The net is TRAINED first, in-leg, on digits32 (real sklearn digit
+    scans at CIFAR-10 geometry — the only real image data guaranteed in
+    the environment), so the AUC table reflects method quality on a
+    genuinely trained net rather than noise on random weights.  Protocol
+    deltas vs the reference (recorded in the output): 300 test examples
+    instead of 1000, digits32 instead of CIFAR-10.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
     from torchpruner_tpu.data import load_dataset
     from torchpruner_tpu.experiments.robustness import (
-        auc_summary,
+        auc_summary_std,
         layerwise_robustness,
     )
     from torchpruner_tpu.experiments.prune_retrain import build_metric
     from torchpruner_tpu.models import vgg16_bn
+    from torchpruner_tpu.train.loop import Trainer
     from torchpruner_tpu.utils.losses import cross_entropy_loss
 
     if smoke:
         model = vgg16_bn(width_multiplier=0.125, classifier_width=64)
-        n_examples, bs, probe = 64, 32, "conv8"
+        n_examples, bs, layers = 64, 32, ["conv8", "fc1"]
+        epochs, train_bs = 1, 64
     else:
         model = vgg16_bn()
-        n_examples, bs, probe = 1000, 250, "conv8"
-    params, state = init_model(model, seed=0)
-    test = load_dataset("cifar10", "test", n=n_examples, seed=0)
-    batches = test.batches(bs)
+        n_examples, bs, layers = 300, 300, None  # None = all 15
+        epochs, train_bs = 12, 128
 
-    import jax.numpy as jnp
+    # -- train to non-degenerate accuracy (bf16 steps, real digit data;
+    # adam reaches >95% digits32 test acc by epoch ~4 where the
+    # reference's SGD recipe, tuned for 150-epoch CIFAR, barely moves) --
+    train = load_dataset("digits32", "train", seed=0)
+    trainer = Trainer.create(model, optax.adam(1e-3),
+                             cross_entropy_loss, seed=0,
+                             compute_dtype=jnp.bfloat16)
+    t0 = time.perf_counter()
+    for epoch in range(epochs):
+        for x, y in train.iter_batches(train_bs, shuffle=True, seed=epoch,
+                                       drop_remainder=True):
+            trainer.step(jnp.asarray(x), jnp.asarray(y))
+    jax.block_until_ready(trainer.params)
+    train_s = time.perf_counter() - t0
+    params, state = trainer.params, trainer.state
+
+    test = load_dataset("digits32", "test", n=n_examples, seed=0)
+    batches = test.batches(bs)
+    test_loss, test_acc = trainer.evaluate(batches)
 
     def factory(method, reduction="mean", **kw):
         def make(run=0):
@@ -191,20 +224,45 @@ def _leg_vgg_robustness(smoke: bool) -> dict:
     t0 = time.perf_counter()
     results = layerwise_robustness(
         model, params, state, batches, methods, cross_entropy_loss,
-        layers=[probe], compute_dtype=jnp.bfloat16, verbose=False,
+        layers=layers, compute_dtype=jnp.bfloat16, verbose=False,
     )
-    panel_s = time.perf_counter() - t0
-    projected = panel_s * SWEEP_N_LAYERS
+    sweep_s = time.perf_counter() - t0
+    per_layer_s = {
+        layer: round(sum(r["seconds"] for runs in by_method.values()
+                         for r in runs), 2)
+        for layer, by_method in results.items()
+    }
+    # scoring and ablation cost scale ~linearly in example count, so the
+    # baseline comparison is stated at the reference's 1000-example
+    # protocol (conservative: our 300-example measurement scaled up 10/3)
+    adjusted_s = sweep_s * (1000.0 / max(1, len(test)))
+    auc_stats = auc_summary_std(results)
     return {
-        "value": round(projected, 1),
+        "value": round(sweep_s, 1),
         "unit": "s",
-        "vs_baseline": round(SWEEP_BASELINE_S / projected, 3),
-        "panel_seconds": round(panel_s, 2),
+        "vs_baseline": round(SWEEP_BASELINE_S / adjusted_s, 3),
+        "projection": None,  # every layer measured, nothing extrapolated
+        "n_layers": len(results),
         "panel_runs": SWEEP_PANEL_RUNS,
-        "probe_layer": probe,
-        "projection": f"panel on {probe} × {SWEEP_N_LAYERS} layers",
+        "per_layer_seconds": per_layer_s,
+        "eval_examples": len(test),
+        "examples_adjusted_s": round(adjusted_s, 1),
         "compute_dtype": "bfloat16",
-        "auc": {k: round(v, 4) for k, v in auc_summary(results).items()},
+        "trained": {
+            "dataset": "digits32 (real sklearn digits, 32x32x3)",
+            "epochs": epochs,
+            "train_seconds": round(train_s, 1),
+            "test_acc": round(float(test_acc), 4),
+            "test_loss": round(float(test_loss), 4),
+        },
+        "protocol_delta": "300 digits32 test examples vs the reference's "
+                          "1000 CIFAR-10 examples; AUCs are on a trained "
+                          "net and ranking-comparable; vs_baseline uses "
+                          "the 1000-example-adjusted wall-clock",
+        # mean ± spread over the per-layer/per-run AUCs (the reference
+        # reports its table as a 3-run mean, BASELINE.md)
+        "auc": {k: round(v["mean"], 4) for k, v in auc_stats.items()},
+        "auc_std": {k: round(v["std"], 4) for k, v in auc_stats.items()},
     }
 
 
@@ -260,7 +318,7 @@ def _leg_vgg_train(smoke: bool) -> dict:
     # reference, without an MFU (its peak differs)
     bf16 = measure(jax.numpy.bfloat16)
     f32 = measure(None, with_mfu=False)
-    return {
+    out = {
         "value": bf16["ms"],
         "unit": "ms/step",
         "batch": batch,
@@ -270,6 +328,83 @@ def _leg_vgg_train(smoke: bool) -> dict:
         "compile_s": bf16["compile_s"],
         "f32": f32,
     }
+    if not smoke and jax.devices()[0].platform == "tpu":
+        # batch scaling: small 32x32 convs underfill the MXU at b256, so
+        # sweep larger batches and surface the best-MFU configuration
+        sweep = {str(batch): {"ms": bf16["ms"], "mfu": bf16["mfu"],
+                              "img_per_s_per_chip":
+                                  bf16["img_per_s_per_chip"]}}
+        for b in (512, 1024):
+            x = jax.numpy.asarray(
+                rng.normal(size=(b, 32, 32, 3)).astype("float32"))
+            y = jax.numpy.asarray(
+                rng.integers(0, 10, size=(b,)).astype("int32"))
+            batch = b  # measure() closes over batch for img/s + MFU
+            try:
+                r = measure(jax.numpy.bfloat16)
+            except Exception as e:  # noqa: BLE001 - OOM ends the sweep
+                sweep[str(b)] = {"error": f"{type(e).__name__}: {e}"[:200]}
+                break
+            sweep[str(b)] = {"ms": r["ms"], "mfu": r["mfu"],
+                             "img_per_s_per_chip": r["img_per_s_per_chip"]}
+        out["batch_sweep"] = sweep
+        best = max(
+            (v for v in sweep.values() if v.get("mfu")),
+            key=lambda v: v["mfu"], default=None,
+        )
+        if best:
+            out["best_mfu"] = best["mfu"]
+    return out
+
+
+def _leg_mfu_llama(smoke: bool) -> dict:
+    """MFU ceiling check on a matmul-dominated workload: train-step MFU
+    for a ~200M-param Llama (dim 1024 × depth 8, 32k vocab, S=1024).
+    VGG16 on 32×32 images is conv-bound with tiny spatial dims — this leg
+    shows what fraction of peak the same Trainer/step machinery reaches
+    when the FLOPs live in large MXU-shaped matmuls."""
+    import jax
+    import numpy as np
+    import optax
+
+    from torchpruner_tpu.models import llama, llama_tiny
+    from torchpruner_tpu.train.loop import Trainer
+    from torchpruner_tpu.utils.flops import model_cost, param_count
+    from torchpruner_tpu.utils.losses import lm_cross_entropy_loss
+    from torchpruner_tpu.utils.profiling import time_fn
+
+    if smoke:
+        model, B = llama_tiny(), 2
+    else:
+        model = llama(vocab_size=32000, dim=1024, depth=8, num_heads=8,
+                      num_kv_heads=8, head_dim=128, ffn_dim=4096,
+                      seq_len=1024)
+        B = 8
+    S = model.input_shape[0]
+    rng = np.random.default_rng(0)
+    toks = jax.numpy.asarray(
+        rng.integers(0, 1000, size=(B, S)).astype("int32"))
+    trainer = Trainer.create(model, optax.adam(3e-4),
+                             lm_cross_entropy_loss, seed=0,
+                             compute_dtype=jax.numpy.bfloat16)
+    stats = time_fn(trainer.step, toks, toks, iters=10, warmup=3)
+    step_s = stats["p50_s"]
+    out = {
+        "ms": round(step_s * 1e3, 3),
+        "tokens_per_s_per_chip": round(B * S / step_s, 1),
+        "params": param_count(trainer.params),
+        "shape": f"B{B} S{S}",
+        "compile_s": round(stats["compile_s"], 2),
+        "compute_dtype": "bfloat16",
+    }
+    peak = _peak_flops(jax.devices()[0])
+    _, fwd_flops = model_cost(model, trainer.params, trainer.state,
+                              batch_size=B)
+    if fwd_flops and peak:
+        out["mfu"] = round((3.0 * fwd_flops / step_s) / peak, 4)
+    else:
+        out["mfu"] = None
+    return out
 
 
 def _leg_flash_attention(smoke: bool) -> dict:
@@ -399,12 +534,18 @@ def main() -> dict:
         run_leg("vgg16_train", _leg_vgg_train)
         run_leg("flash_attention", _leg_flash_attention)
         run_leg("llama_decode", _leg_llama_decode)
+        run_leg("mfu_llama", _leg_mfu_llama)
+    else:
+        # CPU fallback: the VGG legs are TPU-sized, but decode on
+        # llama_tiny is CPU-sized — keep it so every round's artifact has
+        # a decode number on SOME platform (round-2 gap)
+        run_leg("llama_decode", _leg_llama_decode)
 
     def ok(name):
         return name in legs and "error" not in legs[name]
 
     if ok("vgg16_robustness") and not smoke:
-        head_name, head = "vgg16_layerwise_sweep_projected_wall_clock", \
+        head_name, head = "vgg16_layerwise_sweep_wall_clock", \
             legs["vgg16_robustness"]
     elif ok("mnist_prune"):
         head_name, head = "mnist_fc_shapley_prune_wall_clock", \
@@ -450,24 +591,41 @@ def orchestrate() -> dict:
         # pre-flight: a hung TPU tunnel parks backend init in retry-sleep
         # for the WHOLE child timeout (measured: 40 min lost per attempt
         # during a round-2 outage).  A 120 s device probe tells us up
-        # front; on failure go straight to the CPU fallback and record why.
-        try:
-            probe = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                capture_output=True, text=True, timeout=120,
-            )
-            probe_ok = probe.returncode == 0
-            probe_msg = (probe.stderr or "").strip()[-300:]
-        except subprocess.TimeoutExpired as e:
-            probe_ok = False
-            probe_msg = f"device probe hung >120s: {(e.stderr or '')[-200:]}"
+        # front.  Outages last hours but are intermittent (round-2
+        # postmortem), so on failure the probe RE-TRIES at intervals —
+        # BENCH_PROBE_RETRIES × BENCH_PROBE_INTERVAL_S, default 3 × 300 s
+        # — before conceding to the CPU fallback, so a brief outage
+        # window at measurement time can't zero a whole round's numbers.
+        n_probes = 1 + int(os.environ.get("BENCH_PROBE_RETRIES", "3"))
+        probe_interval = float(os.environ.get("BENCH_PROBE_INTERVAL_S",
+                                              "300"))
+        probe_ok, probe_msg = False, ""
+        for p in range(n_probes):
+            if p:
+                time.sleep(probe_interval)
+            try:
+                probe = subprocess.run(
+                    [sys.executable, "-c", "import jax; jax.devices()"],
+                    capture_output=True, text=True, timeout=120,
+                )
+                probe_ok = probe.returncode == 0
+                probe_msg = (probe.stderr or "").strip()[-300:]
+            except subprocess.TimeoutExpired as e:
+                probe_ok = False
+                probe_msg = (f"device probe hung >120s: "
+                             f"{(e.stderr or '')[-200:]}")
+            if probe_ok:
+                break
+            print(f"[bench] preflight probe {p + 1}/{n_probes} failed",
+                  file=sys.stderr, flush=True)
         if not probe_ok:
             attempts.append({
                 "attempt": 0,
                 "rc": None,
                 "forced_platform": None,
-                "stderr_tail": f"preflight failed, skipping TPU attempts: "
-                               f"{probe_msg}",
+                "stderr_tail": f"preflight failed ({n_probes} probes over "
+                               f"{(n_probes - 1) * probe_interval:.0f}s), "
+                               f"skipping TPU attempts: {probe_msg}",
             })
             plans = [(0.0, True)]
     i = 0
