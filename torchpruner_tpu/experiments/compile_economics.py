@@ -1,0 +1,222 @@
+"""Recompilation economics of the prune-retrain loop, measured.
+
+Every prune step changes static shapes, so the train step (and any
+data-dependent scorer) retraces and recompiles — the XLA-honest cost of
+the reference's "on-the-fly" in-place surgery (SURVEY.md §7 "hard
+parts").  Two mitigations exist in the framework: width **bucketing**
+(``bucket=128`` snaps kept widths to multiples, collapsing the space of
+distinct shapes) and the **persistent compilation cache** (repeat shapes
+skip compilation across processes).  This experiment measures both:
+
+  schedule = N prune steps on VGG16-bn (taylor scoring, fraction prune,
+  a few retrain steps per stage), run under 4 conditions:
+  {bucket=1, bucket=128} × {cold cache, warm cache}
+
+Each condition runs in a FRESH subprocess (in-process jit caching would
+fake the warm numbers); cold points the persistent cache at a fresh
+directory, warm re-runs the identical schedule against the directory the
+cold run just filled.  Per step we record the first train-step call
+(compile + run) vs the steady-state step, so the "compile bill"
+Σ(first − steady) and total schedule wall-clock are both reported.
+
+Run on TPU: ``python -m torchpruner_tpu.experiments.compile_economics
+[--steps 5] [--out logs/compile_economics.json]``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+
+def run_schedule(bucket: int, steps: int, smoke: bool) -> dict:
+    """One prune-retrain schedule in THIS process; returns per-step
+    timings.  Invoked by the orchestrator in a fresh subprocess per
+    condition."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from torchpruner_tpu.attributions import TaylorAttributionMetric
+    from torchpruner_tpu.core.graph import pruning_graph
+    from torchpruner_tpu.core.pruner import prune_by_scores
+    from torchpruner_tpu.data import load_dataset
+    from torchpruner_tpu.models import vgg16_bn
+    from torchpruner_tpu.train.loop import Trainer
+    from torchpruner_tpu.utils.losses import cross_entropy_loss
+
+    if smoke:
+        model = vgg16_bn(width_multiplier=0.125, classifier_width=64)
+        batch, score_n = 16, 64
+    else:
+        model = vgg16_bn()
+        batch, score_n = 256, 256
+    train = load_dataset("digits32", "train", seed=0)
+    xb, yb = next(iter(train.iter_batches(batch)))
+    x, y = jnp.asarray(xb), jnp.asarray(yb)
+    score = load_dataset("digits32", "val", n=score_n, seed=0)
+    score_batches = [(jnp.asarray(a), jnp.asarray(b))
+                     for a, b in score.batches(score_n)]
+
+    trainer = Trainer.create(model, optax.adam(1e-3), cross_entropy_loss,
+                             seed=0, compute_dtype=jnp.bfloat16)
+    # prune the wide conv stack back-to-front, the reference's order
+    targets = [g.target for g in pruning_graph(model)][::-1]
+    records = []
+    t_sched = time.perf_counter()
+    for i in range(steps):
+        target = targets[i % len(targets)]
+        t0 = time.perf_counter()
+        trainer.step(x, y)
+        jax.block_until_ready(trainer.params)
+        first_s = time.perf_counter() - t0
+        steady = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            trainer.step(x, y)
+            jax.block_until_ready(trainer.params)
+            steady.append(time.perf_counter() - t0)
+        steady_s = min(steady)
+
+        t0 = time.perf_counter()
+        metric = TaylorAttributionMetric(
+            trainer.model, trainer.params, score_batches,
+            cross_entropy_loss, state=trainer.state,
+            compute_dtype=jnp.bfloat16,
+        )
+        scores = metric.run(target)
+        score_s = time.perf_counter() - t0
+        width_before = len(scores)
+        res = prune_by_scores(
+            trainer.model, trainer.params, target, scores,
+            policy="fraction", fraction=0.15, bucket=bucket,
+            state=trainer.state, opt_state=trainer.opt_state,
+        )
+        trainer = trainer.rebuild(res.model, res.params, res.state,
+                                  res.opt_state)
+        records.append({
+            "step": i,
+            "target": target,
+            "width": f"{width_before}->{res.model.widths().get(target)}",
+            "train_first_s": round(first_s, 3),
+            "train_steady_s": round(steady_s, 4),
+            "train_compile_s": round(max(first_s - steady_s, 0.0), 3),
+            "score_first_s": round(score_s, 3),
+        })
+        print(f"[compile_econ] bucket={bucket} step {i}: "
+              f"compile {records[-1]['train_compile_s']}s "
+              f"steady {steady_s * 1e3:.1f}ms", file=sys.stderr, flush=True)
+    return {
+        "bucket": bucket,
+        "steps": steps,
+        "schedule_wall_s": round(time.perf_counter() - t_sched, 2),
+        "train_compile_bill_s": round(
+            sum(r["train_compile_s"] for r in records), 2),
+        "per_step": records,
+    }
+
+
+def orchestrate(steps: int, smoke: bool, out_path: str) -> dict:
+    conditions = []
+    base = tempfile.mkdtemp(prefix="compile_econ_cache_")
+    for bucket in (1, 128):
+        cache_dir = os.path.join(base, f"bucket{bucket}")
+        for phase in ("cold", "warm"):
+            cmd = [
+                sys.executable, "-m",
+                "torchpruner_tpu.experiments.compile_economics",
+                "--run-one", "--bucket", str(bucket),
+                "--steps", str(steps), "--cache-dir", cache_dir,
+            ]
+            if smoke:
+                cmd += ["--smoke", "--cpu"]
+            t0 = time.perf_counter()
+            cell = {"bucket": bucket, "cache": phase}
+            try:
+                proc = subprocess.run(cmd, capture_output=True, text=True,
+                                      timeout=3600)
+                cell["subprocess_wall_s"] = round(
+                    time.perf_counter() - t0, 1)
+                try:
+                    cell.update(
+                        json.loads(proc.stdout.strip().splitlines()[-1]))
+                except (json.JSONDecodeError, IndexError):
+                    cell["error"] = (proc.stderr or "no output")[-400:]
+            except subprocess.TimeoutExpired as e:
+                # one hung condition (dead TPU tunnel) must not discard
+                # the conditions already measured
+                cell["subprocess_wall_s"] = round(
+                    time.perf_counter() - t0, 1)
+                cell["error"] = (f"timeout after 3600s: "
+                                 f"{(e.stderr or '')[-300:]}")
+            conditions.append(cell)
+            print(f"[compile_econ] {phase} bucket={bucket}: "
+                  f"bill {cell.get('train_compile_bill_s')}s "
+                  f"wall {cell.get('schedule_wall_s')}s",
+                  file=sys.stderr, flush=True)
+    import jax
+
+    result = {
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0].device_kind),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "conditions": conditions,
+    }
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    return result
+
+
+def markdown_table(result: dict) -> str:
+    lines = [
+        "| bucket | cache | compile bill (s) | schedule wall (s) |",
+        "|---|---|---|---|",
+    ]
+    for c in result["conditions"]:
+        lines.append(
+            f"| {c['bucket']} | {c['cache']} "
+            f"| {c.get('train_compile_bill_s', c.get('error', '—'))} "
+            f"| {c.get('schedule_wall_s', '—')} |"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--out", default="logs/compile_economics.json")
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--run-one", action="store_true",
+                    help="internal: run one condition in this process")
+    ap.add_argument("--bucket", type=int, default=1)
+    ap.add_argument("--cache-dir", default="")
+    args = ap.parse_args(argv)
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    if args.run_one:
+        if args.cache_dir:
+            from torchpruner_tpu.utils.compilation_cache import (
+                enable_persistent_cache,
+            )
+
+            enable_persistent_cache(args.cache_dir)
+        print(json.dumps(run_schedule(args.bucket, args.steps, args.smoke)),
+              flush=True)
+        return
+    result = orchestrate(args.steps, args.smoke, args.out)
+    print(markdown_table(result))
+    print(f"wrote {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,189 @@
+"""Flash-attention payoff sweep: flash (Pallas) vs XLA einsum attention
+across sequence lengths.
+
+The round-2 measurement showed the Pallas kernel at speed *parity* with
+XLA at S=2048 with a 15.8× temp-memory win — the payoff claim (longer
+sequences than the O(S²) einsum path can run, and wins at the long end)
+was never demonstrated.  This sweep produces the crossover table:
+
+  for S in {2k, 8k, 16k, 32k}:  fwd+bwd grad-step time and compiled
+  temp memory for both paths (B·H scaled down as S grows so the XLA
+  path's O(S²) logits still have a chance to fit), plus block_q/block_k
+  tuning for the flash kernel at the long end.
+
+Run on TPU:  ``python -m torchpruner_tpu.experiments.flash_sweep
+[--out logs/flash_sweep.json] [--tune]``.  Emits one JSON with every
+cell (errors recorded per cell — an XLA OOM at long S IS the result),
+plus markdown table rows for PERF.md.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Optional
+
+#: (S, B, H) — keep B*S*H*Dh roughly constant so q/k/v stay small while
+#: the XLA path's (B, H, S, S) f32 logits grow 4x per row: 2k -> 1 GB,
+#: 8k -> 4 GB, 16k -> 8 GB, 32k -> 16 GB (past a v5e's HBM *with* the
+#: rest of the step; where it dies, that's the crossover).
+SWEEP = [
+    (2048, 4, 8),
+    (8192, 2, 4),
+    (16384, 1, 4),
+    (32768, 1, 2),
+]
+DH = 64
+
+
+def _measure(fn, q, k, v, *, iters: int = 5, warmup: int = 2,
+             block_q: Optional[int] = None,
+             block_k: Optional[int] = None) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    kw = {}
+    if block_q or block_k:
+        kw = {"block_q": block_q, "block_k": block_k}
+
+    def loss(q_, k_, v_):
+        return jnp.sum(fn(q_, k_, v_, causal=True, **kw)
+                       .astype(jnp.float32))
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    out = {}
+    try:
+        t0 = time.perf_counter()
+        compiled = g.lower(q, k, v).compile()
+        out["compile_s"] = round(time.perf_counter() - t0, 2)
+        mem = compiled.memory_analysis()
+        out["temp_mb"] = round(mem.temp_size_in_bytes / 2**20, 1)
+        out["argument_mb"] = round(mem.argument_size_in_bytes / 2**20, 1)
+    except Exception as e:  # noqa: BLE001 - OOM/lowering failure IS data
+        out["error"] = f"{type(e).__name__}: {e}"[:300]
+        return out
+    try:
+        # time the AOT executable directly — going back through g would
+        # re-trace and pay the (dominant at long S) compile a second time
+        from torchpruner_tpu.utils.profiling import time_fn
+
+        stats = time_fn(compiled, q, k, v, iters=iters, warmup=warmup)
+        out["ms"] = round(stats["p50_s"] * 1e3, 3)
+    except Exception as e:  # noqa: BLE001 - runtime OOM IS data
+        out["error"] = f"{type(e).__name__}: {e}"[:300]
+    return out
+
+
+def run_sweep(tune: bool = False, smoke: bool = False) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from torchpruner_tpu.ops.flash_attention import (
+        _xla_attention,
+        flash_attention,
+    )
+
+    sweep = [(256, 2, 2), (512, 1, 2)] if smoke else SWEEP
+    rows = []
+    for S, B, H in sweep:
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.random.normal(kk, (B, S, H, DH), jnp.bfloat16)
+                   for kk in ks)
+        row = {"S": S, "B": B, "H": H, "Dh": DH}
+        print(f"[flash_sweep] S={S} B={B} H={H} ...", file=sys.stderr,
+              flush=True)
+        row["flash"] = _measure(flash_attention, q, k, v)
+        row["xla"] = _measure(_xla_attention, q, k, v)
+        if row["flash"].get("ms") and row["xla"].get("ms"):
+            row["speedup"] = round(row["xla"]["ms"] / row["flash"]["ms"], 3)
+        if row["flash"].get("temp_mb") and row["xla"].get("temp_mb"):
+            row["mem_ratio"] = round(
+                row["xla"]["temp_mb"] / row["flash"]["temp_mb"], 1)
+        rows.append(row)
+
+    tuning = None
+    if tune:
+        # block tuning at the longest S that ran: bigger KV blocks
+        # amortize loop overhead; VMEM caps the product
+        best = None
+        tuning = []
+        long_rows = [r for r in rows if r["flash"].get("ms")]
+        if long_rows:
+            S, B, H = (lambda r: (r["S"], r["B"], r["H"]))(long_rows[-1])
+            ks = jax.random.split(jax.random.PRNGKey(0), 3)
+            q, k, v = (jax.random.normal(kk, (B, S, H, DH), jnp.bfloat16)
+                       for kk in ks)
+            for bq, bk in ((128, 128), (128, 256), (256, 128), (256, 256),
+                           (128, 512), (512, 128), (256, 512), (512, 512)):
+                cell = {"S": S, "block_q": bq, "block_k": bk}
+                cell.update(_measure(flash_attention, q, k, v,
+                                     block_q=bq, block_k=bk))
+                tuning.append(cell)
+                print(f"[flash_sweep] tune bq={bq} bk={bk}: "
+                      f"{cell.get('ms', cell.get('error'))}",
+                      file=sys.stderr, flush=True)
+                if cell.get("ms") and (best is None or
+                                       cell["ms"] < best["ms"]):
+                    best = cell
+        if best:
+            tuning.append({"best": best})
+
+    out = {
+        "device": str(jax.devices()[0].device_kind),
+        "platform": jax.devices()[0].platform,
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "rows": rows,
+    }
+    if tuning is not None:
+        out["tuning"] = tuning
+    return out
+
+
+def markdown_table(result: dict) -> str:
+    lines = [
+        "| S | B×H | flash ms | xla ms | speedup | flash temp MB "
+        "| xla temp MB | mem ratio |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for r in result["rows"]:
+        f, x = r["flash"], r["xla"]
+        lines.append(
+            f"| {r['S']} | {r['B']}×{r['H']} "
+            f"| {f.get('ms', f.get('error', '—'))} "
+            f"| {x.get('ms', x.get('error', '—'))} "
+            f"| {r.get('speedup', '—')} "
+            f"| {f.get('temp_mb', '—')} | {x.get('temp_mb', '—')} "
+            f"| {r.get('mem_ratio', '—')} |"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="logs/flash_sweep.json")
+    ap.add_argument("--tune", action="store_true",
+                    help="also tune block_q/block_k at the longest "
+                    "runnable S")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes (CPU path validation)")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args(argv)
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    result = run_sweep(tune=args.tune, smoke=args.smoke)
+    import os
+
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(markdown_table(result))
+    print(f"wrote {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
