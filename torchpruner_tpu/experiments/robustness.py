@@ -307,6 +307,22 @@ def auc_summary(results) -> Dict[str, float]:
     return {m: float(np.mean(v)) for m, v in per_method.items()}
 
 
+def auc_summary_std(results) -> Dict[str, Dict[str, float]]:
+    """``{method: {"mean", "std", "n"}}`` over the per-run AUCs — the
+    reference reports its AUC table as mean over 3 runs of the stochastic
+    methods (BASELINE.md); this exposes the spread behind
+    :func:`auc_summary`'s point estimate."""
+    per_method: Dict[str, List[float]] = {}
+    for layer in results.values():
+        for method, runs in layer.items():
+            per_method.setdefault(method, []).extend(r["auc"] for r in runs)
+    return {
+        m: {"mean": float(np.mean(v)), "std": float(np.std(v)),
+            "n": len(v)}
+        for m, v in per_method.items()
+    }
+
+
 def run_robustness_config(cfg, *, model=None, datasets=None,
                           params=None, state=None,
                           verbose: bool = True) -> Dict[str, float]:
