@@ -47,3 +47,51 @@ def test_step_timer_phases():
     s = t.summary()
     assert s["score"]["calls"] == 2 and s["prune"]["calls"] == 1
     assert s["score"]["total_s"] >= 0
+
+
+def test_trace_analysis_summarizes_profiler_output(tmp_path):
+    """profiling.trace -> trace_analysis: the Chrome-trace parser must
+    find the dominant op (a 256x256 matmul here), bucket it as matmul,
+    and exclude Python-frame / runtime events from the totals."""
+    import jax
+
+    from torchpruner_tpu.utils.profiling import trace
+    from torchpruner_tpu.utils.trace_analysis import (
+        markdown_summary,
+        summarize_trace,
+    )
+
+    f = jax.jit(lambda a, b: jnp.tanh(a @ b).sum())
+    a = jnp.ones((256, 256))
+    f(a, a).block_until_ready()  # compile outside the trace
+    with trace(str(tmp_path)):
+        for _ in range(3):
+            f(a, a).block_until_ready()
+    s = summarize_trace(str(tmp_path))
+    assert s["total_ms"] > 0
+    names = [op["name"] for op in s["top_ops"]]
+    assert any(n.startswith("dot_general") for n in names)
+    dot = next(op for op in s["top_ops"]
+               if op["name"].startswith("dot_general"))
+    assert dot["category"] == "matmul" and dot["count"] >= 3
+    assert not any(n.startswith("$") for n in names)
+    md = markdown_summary(s, top=5)
+    assert "| matmul |" in md
+    assert not any(n.startswith("end: ") for n in names)
+    # a second session into the same dir must not double-count: only the
+    # newest plugins/profile/<run> is summarized
+    with trace(str(tmp_path)):
+        f(a, a).block_until_ready()
+    s2 = summarize_trace(str(tmp_path))
+    dot2 = next(op for op in s2["top_ops"]
+                if op["name"].startswith("dot_general"))
+    assert dot2["count"] < dot["count"]
+
+
+def test_trace_analysis_missing_dir_raises(tmp_path):
+    import pytest as _pytest
+
+    from torchpruner_tpu.utils.trace_analysis import summarize_trace
+
+    with _pytest.raises(FileNotFoundError):
+        summarize_trace(str(tmp_path / "nope"))

@@ -1,0 +1,114 @@
+"""Train-step anatomy: profile one model's train step and print where
+the milliseconds go.
+
+The round-2 verdict's MFU question ("where do the VGG16 step's 75.6 ms
+go?") needs a per-op breakdown, not another stopwatch number.  This
+experiment builds a Trainer for a model-zoo entry, runs the compiled
+step under ``jax.profiler``, and prints the
+:mod:`~torchpruner_tpu.utils.trace_analysis` summary — conv vs matmul vs
+fusion vs copy vs infeed — plus the usual steady-state timing for
+cross-checking.
+
+Run: ``python -m torchpruner_tpu.experiments.step_trace --model
+vgg16_bn --batch 256 [--dtype bf16] [--steps 5] [--trace-dir
+logs/step_trace]``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def run(model_name: str, batch: int, dtype: str, steps: int,
+        trace_dir: str, smoke: bool = False) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from torchpruner_tpu.experiments.prune_retrain import MODEL_REGISTRY
+    from torchpruner_tpu.train.loop import Trainer
+    from torchpruner_tpu.utils import profiling
+    from torchpruner_tpu.utils.losses import (
+        cross_entropy_loss,
+        lm_cross_entropy_loss,
+    )
+    from torchpruner_tpu.utils.trace_analysis import (
+        markdown_summary,
+        summarize_trace,
+    )
+
+    model_fn, _ = MODEL_REGISTRY[model_name]
+    model = model_fn()
+    # (S, vocab) output = causal LM (next-token loss, targets = inputs);
+    # (n_classes,) output = classification
+    is_lm = len(model.out_shape()) == 2
+    loss_fn = lm_cross_entropy_loss if is_lm else cross_entropy_loss
+    if smoke:
+        batch = min(batch, 8)
+    compute_dtype = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+                     "f32": None, "float32": None}[dtype]
+    trainer = Trainer.create(model, optax.adam(1e-3), loss_fn, seed=0,
+                             compute_dtype=compute_dtype)
+    x = jnp.asarray(np.asarray(model.example_input(batch)))
+    if is_lm:
+        y = x  # next-token loss on the inputs
+    else:
+        y = jnp.asarray(
+            np.random.default_rng(0).integers(
+                0, model.out_shape()[-1], size=(batch,)
+            ).astype("int32"))
+
+    stats = profiling.time_fn(trainer.step, x, y, iters=max(3, steps),
+                              warmup=3)
+    with profiling.trace(trace_dir):
+        for _ in range(steps):
+            trainer.step(x, y)
+        jax.block_until_ready(trainer.params)
+    summary = summarize_trace(trace_dir)
+    summary["steps_traced"] = steps
+    summary["p50_step_ms"] = round(stats["p50_s"] * 1e3, 3)
+    summary["model"] = model_name
+    summary["batch"] = batch
+    summary["dtype"] = dtype
+    summary["platform"] = jax.devices()[0].platform
+    print(f"model {model_name} batch {batch} {dtype}: p50 step "
+          f"{summary['p50_step_ms']} ms over {steps} traced steps\n",
+          flush=True)
+    print(markdown_summary(summary, top=20))
+    return summary
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", default="vgg16_bn")
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--dtype", default="bf16",
+                    choices=["bf16", "bfloat16", "f32", "float32"])
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--trace-dir", default="logs/step_trace")
+    ap.add_argument("--out", default="",
+                    help="also write the JSON summary here")
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args(argv)
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    summary = run(args.model, args.batch, args.dtype, args.steps,
+                  args.trace_dir, smoke=args.smoke)
+    if args.out:
+        import os
+
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=1)
+        print(f"wrote {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,182 @@
+"""Offline summarizer for ``jax.profiler`` traces.
+
+``jax.profiler.start_trace`` writes a Chrome-trace JSON
+(``plugins/profile/<run>/<host>.trace.json.gz``) whose complete events
+('ph' == 'X') carry per-op device timings.  This module turns that into
+the table PERF.md needs — top ops by total self time, grouped into
+categories (convolution / matmul / fusion / copy / collective / infeed)
+— without TensorBoard or XProf in the loop.
+
+Python-frame events (names starting with ``$``) and PjRt runtime
+plumbing are excluded; when the trace contains device tracks (TPU runs:
+process names like ``/device:TPU:0``), only those are counted, so host
+overhead doesn't dilute the device breakdown.
+
+CLI: ``python -m torchpruner_tpu.utils.trace_analysis LOG_DIR [--top N]``
+(pass the directory given to ``profiling.trace`` / the CLI's
+``--profile``).
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from typing import Dict, List, Optional
+
+#: runtime-internal event names that are not XLA ops
+_RUNTIME_NOISE = (
+    "PjRt", "PjitFunction", "Handle inputs", "ParseArguments",
+    "CommonPjRtBuffer", "copy_to_host", "TransferFromDevice", "Await",
+    "thread_", "process_", "ThunkExecutor",
+)
+
+#: (category, name-prefix) in match order
+_CATEGORIES = (
+    ("convolution", ("convolution", "wrapped_conv", "conv_general")),
+    ("matmul", ("dot_general", "dot", "wrapped_dot")),
+    ("collective", ("all-reduce", "all-gather", "all-to-all",
+                    "collective", "reduce-scatter", "ppermute",
+                    "psum", "fusion.all")),
+    ("copy/layout", ("copy", "transpose", "bitcast", "reshape",
+                     "wrapped_transpose")),
+    ("infeed/outfeed", ("infeed", "outfeed")),
+    ("reduce", ("reduce", "wrapped_reduce")),
+    ("fusion/elementwise", ("fusion", "wrapped_", "loop_", "select",
+                            "broadcast", "compare", "add", "multiply")),
+)
+
+
+def categorize(name: str) -> str:
+    low = name.lower()
+    for cat, prefixes in _CATEGORIES:
+        if any(low.startswith(p) for p in prefixes):
+            return cat
+    return "other"
+
+
+def find_trace_files(log_dir: str, latest_run: bool = True) -> List[str]:
+    """Trace files under ``log_dir``.  ``jax.profiler`` writes one
+    timestamped ``plugins/profile/<run>/`` per session; with
+    ``latest_run`` (default) only the newest run is returned, so re-using
+    a trace directory doesn't double-count earlier sessions."""
+    run_dirs = sorted(glob.glob(
+        os.path.join(log_dir, "plugins", "profile", "*")
+    ))
+    if latest_run and run_dirs:
+        return sorted(glob.glob(
+            os.path.join(run_dirs[-1], "**", "*.trace.json.gz"),
+            recursive=True,
+        ))
+    return sorted(glob.glob(
+        os.path.join(log_dir, "**", "*.trace.json.gz"), recursive=True
+    ))
+
+
+def summarize_trace(log_dir: str, top: int = 25,
+                    latest_run: bool = True) -> Dict:
+    """Aggregate the ``*.trace.json.gz`` of ``log_dir``'s newest profiler
+    run (all runs with ``latest_run=False``).
+
+    Returns ``{"total_ms", "by_category": {cat: ms}, "top_ops":
+    [{"name", "ms", "pct", "category", "count"}, ...], "files"}``.
+    """
+    files = find_trace_files(log_dir, latest_run=latest_run)
+    if not files:
+        raise FileNotFoundError(
+            f"no *.trace.json.gz under {log_dir!r} — pass the directory "
+            f"given to profiling.trace()/--profile"
+        )
+    durs: Dict[str, float] = {}
+    counts: Dict[str, int] = {}
+    for path in files:
+        with gzip.open(path, "rt") as f:
+            data = json.load(f)
+        events = data.get("traceEvents", [])
+        proc_names = {
+            e["pid"]: e.get("args", {}).get("name", "")
+            for e in events
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+        }
+        device_pids = {
+            pid for pid, name in proc_names.items()
+            if "device:" in name.lower() or "tpu" in name.lower()
+        }
+        for e in events:
+            if e.get("ph") != "X" or "dur" not in e:
+                continue
+            if device_pids and e.get("pid") not in device_pids:
+                continue
+            name = e.get("name", "")
+            # '$...' = Python frames; 'end: <op>' = nested completion
+            # markers on host-only traces (counting them double-counts
+            # the enclosing op)
+            if name.startswith(("$", "end: ")) or any(
+                tok in name for tok in _RUNTIME_NOISE
+            ):
+                continue
+            durs[name] = durs.get(name, 0.0) + e["dur"]  # microseconds
+            counts[name] = counts.get(name, 0) + 1
+    total_us = sum(durs.values()) or 1.0
+    by_cat: Dict[str, float] = {}
+    for name, us in durs.items():
+        cat = categorize(name)
+        by_cat[cat] = by_cat.get(cat, 0.0) + us
+    top_ops = [
+        {
+            "name": name,
+            "ms": round(us / 1e3, 3),
+            "pct": round(100.0 * us / total_us, 1),
+            "category": categorize(name),
+            "count": counts[name],
+        }
+        for name, us in sorted(durs.items(), key=lambda kv: -kv[1])[:top]
+    ]
+    return {
+        "total_ms": round(total_us / 1e3, 3),
+        "by_category": {
+            k: round(v / 1e3, 3)
+            for k, v in sorted(by_cat.items(), key=lambda kv: -kv[1])
+        },
+        "top_ops": top_ops,
+        "files": files,
+    }
+
+
+def markdown_summary(summary: Dict, top: Optional[int] = None) -> str:
+    lines = [
+        f"Total op time: {summary['total_ms']:.1f} ms",
+        "",
+        "| category | ms | % |",
+        "|---|---|---|",
+    ]
+    total = summary["total_ms"] or 1.0
+    for cat, ms in summary["by_category"].items():
+        lines.append(f"| {cat} | {ms:.1f} | {100 * ms / total:.1f} |")
+    lines += ["", "| op | category | ms | % | calls |", "|---|---|---|---|---|"]
+    for op in summary["top_ops"][: top or len(summary["top_ops"])]:
+        lines.append(
+            f"| `{op['name']}` | {op['category']} | {op['ms']} "
+            f"| {op['pct']} | {op['count']} |"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("log_dir")
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    summary = summarize_trace(args.log_dir, top=args.top)
+    if args.json:
+        print(json.dumps(summary, indent=1))
+    else:
+        print(markdown_summary(summary))
+
+
+if __name__ == "__main__":
+    main()
