@@ -322,6 +322,39 @@ def test_run_train_prefetch_matches_inmemory_bitwise(tmp_path):
     assert [h["test_loss"] for h in h_pf] == [h["test_loss"] for h in h_mem]
 
 
+def test_run_train_device_prefetch_matches_unprefetched(tmp_path):
+    """device_prefetch stages batches on device ahead of the step (async
+    transfer overlap) — order, contents, and therefore the training
+    trajectory must be unchanged vs the unprefetched path."""
+    from torchpruner_tpu.experiments.train_model import run_train
+
+    def cfg(dp):
+        return ExperimentConfig(
+            name=f"dp{dp}", experiment="train", epochs=2,
+            batch_size=32, eval_batch_size=32, lr=0.05,
+            device_prefetch=dp, log_path=str(tmp_path / f"dp{dp}.csv"),
+        )
+
+    _, h_dp = run_train(cfg(3), model=tiny_model(), datasets=tiny_sets(),
+                        verbose=False)
+    _, h_off = run_train(cfg(0), model=tiny_model(), datasets=tiny_sets(),
+                         verbose=False)
+    assert [h["train_loss"] for h in h_dp] == [h["train_loss"] for h in h_off]
+    assert [h["test_acc"] for h in h_dp] == [h["test_acc"] for h in h_off]
+
+
+def test_device_prefetch_preserves_short_streams():
+    from torchpruner_tpu.data import device_prefetch
+
+    batches = [(np.full((2, 2), i), np.full((2,), i)) for i in range(5)]
+    out = list(device_prefetch(iter(batches), size=8))  # size > stream
+    assert len(out) == 5
+    for i, (x, y) in enumerate(out):
+        np.testing.assert_array_equal(np.asarray(x), batches[i][0])
+        np.testing.assert_array_equal(np.asarray(y), batches[i][1])
+    assert list(device_prefetch(iter([]), size=2)) == []
+
+
 def test_augment_images_shapes_and_determinism():
     from torchpruner_tpu.experiments.train_model import augment_images
 

@@ -18,6 +18,7 @@ from torchpruner_tpu.data.datasets import (
     synthetic_token_dataset,
 )
 from torchpruner_tpu.data.native import (
+    device_prefetch,
     native_available,
     prefetch_batches,
     shuffled_indices,
@@ -29,6 +30,7 @@ __all__ = [
     "synthetic_dataset",
     "synthetic_token_dataset",
     "native_available",
+    "device_prefetch",
     "prefetch_batches",
     "shuffled_indices",
 ]

@@ -16,11 +16,16 @@ import os
 import time
 from typing import Optional, Tuple
 
+import jax
 import numpy as np
 
 from torchpruner_tpu.checkpoint import restore_checkpoint, save_checkpoint
 from torchpruner_tpu.core.segment import SegmentedModel
-from torchpruner_tpu.data.native import prefetch_batches, shuffled_indices
+from torchpruner_tpu.data.native import (
+    device_prefetch,
+    prefetch_batches,
+    shuffled_indices,
+)
 from torchpruner_tpu.train.logger import CSVLogger
 from torchpruner_tpu.train.loop import Trainer
 from torchpruner_tpu.utils.config import ExperimentConfig
@@ -133,8 +138,19 @@ def run_train(
     for epoch in range(start_epoch, cfg.epochs):
         t0 = time.perf_counter()
         losses = []
-        for x, y in epoch_batches(train, cfg, epoch):
-            losses.append(float(trainer.step(x, y)))
+        stream = epoch_batches(train, cfg, epoch)
+        if cfg.device_prefetch:
+            stream = device_prefetch(stream, size=cfg.device_prefetch)
+        for x, y in stream:
+            # keep the loss on device: a float() here would fence every
+            # step and forfeit both async dispatch and the prefetch; the
+            # periodic fence on a loss 8 steps back bounds dispatch
+            # run-ahead (each in-flight step pins its batch in HBM)
+            # without draining the pipeline
+            losses.append(trainer.step(x, y))
+            if len(losses) % 8 == 0:
+                jax.block_until_ready(losses[-8])
+        losses = [float(l) for l in losses]  # full sync once per epoch
         test_loss, test_acc = trainer.evaluate(test_batches)
         dt = time.perf_counter() - t0
         rec = {

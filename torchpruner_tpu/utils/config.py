@@ -88,6 +88,9 @@ class ExperimentConfig:
     # data pipeline / checkpointing
     augment: bool = False            # flip + pad/crop image augmentation
     prefetch: bool = True            # native background batch assembly
+    #: batches kept device-resident ahead of the step (async device_put
+    #: overlaps host->device transfer with compute); 0 disables
+    device_prefetch: int = 2
     checkpoint_path: str = ""        # save/resume training checkpoints here
     checkpoint_every_epochs: int = 0  # 0 = only at the end
 
