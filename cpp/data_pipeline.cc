@@ -73,4 +73,65 @@ void tp_gather_rows(const uint8_t* src, const int64_t* idx, int64_t batch,
   for (auto& th : pool) th.join();
 }
 
+// Random horizontal flip + pad-and-crop augmentation on a float32 NHWC
+// batch (the reference's RandomHorizontalFlip + RandomCrop(32, padding=4),
+// its cifar10.py:105-110) — fused: the padded intermediate is never
+// materialized, out-of-window pixels write zeros directly.
+//
+// Determinism contract (mirrored bit-for-bit by the Python fallback):
+// example i draws from its own splitmix64 stream seeded
+// s = seed ^ ((i+1) * 0xD1B54A32D192ED03); draw1 & 1 = flip,
+// draw2 % (2*pad+1) = dy, draw3 % (2*pad+1) = dx; the output window at
+// (y, x) reads the flipped source at (y + dy - pad, x + dx - pad).
+// Per-example streams make the result independent of thread count.
+void tp_augment_images(const float* src, int64_t n, int64_t h, int64_t w,
+                       int64_t c, int64_t pad, uint64_t seed, float* out,
+                       int32_t n_threads) {
+  const int64_t span = 2 * pad + 1;
+  const int64_t row_elems = w * c;
+  const int64_t img_elems = h * row_elems;
+  auto one = [=](int64_t i) {
+    uint64_t s = seed ^ (0xD1B54A32D192ED03ULL * static_cast<uint64_t>(i + 1));
+    const uint64_t flip = splitmix64(&s) & 1ULL;
+    const int64_t dy = static_cast<int64_t>(splitmix64(&s) % span);
+    const int64_t dx = static_cast<int64_t>(splitmix64(&s) % span);
+    const float* im = src + i * img_elems;
+    float* ot = out + i * img_elems;
+    for (int64_t y = 0; y < h; ++y) {
+      float* orow = ot + y * row_elems;
+      const int64_t sy = y + dy - pad;
+      if (sy < 0 || sy >= h) {
+        std::memset(orow, 0, row_elems * sizeof(float));
+        continue;
+      }
+      const float* irow = im + sy * row_elems;
+      for (int64_t x = 0; x < w; ++x) {
+        int64_t sx = x + dx - pad;
+        if (sx < 0 || sx >= w) {
+          std::memset(orow + x * c, 0, c * sizeof(float));
+          continue;
+        }
+        if (flip) sx = w - 1 - sx;
+        std::memcpy(orow + x * c, irow + sx * c, c * sizeof(float));
+      }
+    }
+  };
+  if (n_threads <= 1 || n < 2 * n_threads) {
+    for (int64_t i = 0; i < n; ++i) one(i);
+    return;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(n_threads);
+  int64_t chunk = (n + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    pool.emplace_back([=] {
+      for (int64_t i = lo; i < hi; ++i) one(i);
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
 }  // extern "C"

@@ -90,3 +90,38 @@ def test_prefetch_propagates_worker_errors():
 
     with pytest.raises(IndexError):
         list(native.prefetch_batches(Broken(), 4))
+
+
+def test_augment_native_matches_python_bitwise(lib):
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(33, 16, 16, 3)).astype(np.float32)
+    for seed in (0, 1, 12345):
+        got = native.augment_batch(x, seed)
+        np.testing.assert_array_equal(
+            got, native._augment_numpy(x, seed, pad=4))
+    # single- vs multi-threaded native: per-example streams make the
+    # result independent of thread count
+    np.testing.assert_array_equal(
+        native.augment_batch(x, 5, n_threads=1),
+        native.augment_batch(x, 5, n_threads=4),
+    )
+
+
+def test_augment_semantics():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(64, 8, 8, 1)).astype(np.float32)
+    out = native.augment_batch(x, seed=9)
+    assert out.shape == x.shape and out.dtype == np.float32
+    # every output image is a shifted (possibly flipped) window of its
+    # source: the multiset of nonzero pixel values is a subset
+    for i in range(8):
+        src_vals = set(np.round(x[i].ravel(), 5).tolist())
+        out_vals = [v for v in np.round(out[i].ravel(), 5).tolist()
+                    if v != 0.0]
+        assert all(v in src_vals for v in out_vals)
+    # deterministic per seed, different across seeds
+    np.testing.assert_array_equal(out, native.augment_batch(x, seed=9))
+    assert not np.array_equal(out, native.augment_batch(x, seed=10))
+    # non-image input passes through
+    flat = rng.normal(size=(4, 10)).astype(np.float32)
+    np.testing.assert_array_equal(native.augment_batch(flat, 0), flat)

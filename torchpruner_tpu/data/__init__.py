@@ -18,6 +18,7 @@ from torchpruner_tpu.data.datasets import (
     synthetic_token_dataset,
 )
 from torchpruner_tpu.data.native import (
+    augment_batch,
     device_prefetch,
     native_available,
     prefetch_batches,
@@ -30,6 +31,7 @@ __all__ = [
     "synthetic_dataset",
     "synthetic_token_dataset",
     "native_available",
+    "augment_batch",
     "device_prefetch",
     "prefetch_batches",
     "shuffled_indices",

@@ -46,6 +46,11 @@ def _load_library(build: bool = True):
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
             ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int32,
         ]
+        lib.tp_augment_images.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_uint64, ctypes.c_void_p, ctypes.c_int32,
+        ]
         _lib = lib
     except (OSError, subprocess.SubprocessError):
         _lib = None
@@ -124,6 +129,75 @@ def gather_rows(src: np.ndarray, idx: np.ndarray,
         ctypes.c_void_p(out.ctypes.data), ctypes.c_int32(n_threads),
     )
     return out
+
+
+def _augment_draws(n: int, seed: int, pad: int):
+    """The augmentation randomness contract, vectorized: per-example
+    splitmix64 streams seeded ``seed ^ ((i+1) * 0xD1B54A32D192ED03)``,
+    three draws each → (flip bool, dy, dx).  Bit-identical to the C++
+    kernel's draws (cpp/data_pipeline.cc tp_augment_images)."""
+    span = np.uint64(2 * pad + 1)
+    s = (np.uint64(seed & _M)
+         ^ (np.arange(1, n + 1, dtype=np.uint64)
+            * np.uint64(0xD1B54A32D192ED03)))
+
+    def draw(state):
+        # uint64 arithmetic wraps mod 2^64 — exactly the C++ semantics
+        state = state + np.uint64(0x9E3779B97F4A7C15)
+        z = state.copy()
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return state, z ^ (z >> np.uint64(31))
+
+    s, r1 = draw(s)
+    s, r2 = draw(s)
+    s, r3 = draw(s)
+    return (
+        (r1 & np.uint64(1)).astype(bool),
+        (r2 % span).astype(np.int64),
+        (r3 % span).astype(np.int64),
+    )
+
+
+def _augment_numpy(x: np.ndarray, seed: int, pad: int) -> np.ndarray:
+    """The pure-numpy augmentation path — same draws, flip-then-pad-crop
+    semantics as the native kernel (the bitwise-parity test compares the
+    kernel against exactly this function)."""
+    n, h, w, _ = x.shape
+    flip, dy, dx = _augment_draws(n, seed, pad)
+    x = np.where(flip[:, None, None, None], x[:, :, ::-1, :], x)
+    padded = np.pad(
+        x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="constant"
+    )
+    rows = dy[:, None] + np.arange(h)[None, :]
+    cols = dx[:, None] + np.arange(w)[None, :]
+    return padded[np.arange(n)[:, None, None], rows[:, :, None],
+                  cols[:, None, :], :]
+
+
+def augment_batch(x: np.ndarray, seed: int, pad: int = 4,
+                  n_threads: int = 4) -> np.ndarray:
+    """Random horizontal flip + ``pad``-pixel shift-and-crop on a
+    channels-last float32 image batch (the reference's
+    RandomHorizontalFlip + RandomCrop(32, padding=4), its
+    cifar10.py:105-110).  Native kernel when built (fused, threaded, no
+    padded intermediate), identical-output numpy fallback otherwise;
+    non-image (non-4D) inputs pass through unchanged."""
+    if x.ndim != 4:
+        return x
+    x = np.ascontiguousarray(x, dtype=np.float32)
+    n, h, w, c = x.shape
+    lib = _load_library()
+    if lib is not None:
+        out = np.empty_like(x)
+        lib.tp_augment_images(
+            ctypes.c_void_p(x.ctypes.data), ctypes.c_int64(n),
+            ctypes.c_int64(h), ctypes.c_int64(w), ctypes.c_int64(c),
+            ctypes.c_int64(pad), ctypes.c_uint64(seed & _M),
+            ctypes.c_void_p(out.ctypes.data), ctypes.c_int32(n_threads),
+        )
+        return out
+    return _augment_numpy(x, seed, pad)
 
 
 def prefetch_batches(

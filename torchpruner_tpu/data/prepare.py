@@ -17,7 +17,7 @@ layout, reproducing the reference's preprocessing exactly:
   (0.229, 0.224, 0.225)), 45k/5k train/val split plus the 10k test set;
   written as ``cifar10`` NHWC and ``cifar10_flat``.  Train-time
   augmentation (random crop + flip, reference cifar10.py:112-117) is NOT
-  baked in — ``experiments.train_model.augment_images`` applies it per
+  baked in — ``data.native.augment_batch`` applies it per
   epoch, matching torchvision's on-the-fly transforms.
 - **digits** needs no input files: scikit-learn bundles the real data, and
   ``load_dataset("digits", ...)`` serves it directly; ``prepare_digits``

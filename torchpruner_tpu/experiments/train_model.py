@@ -22,6 +22,7 @@ import numpy as np
 from torchpruner_tpu.checkpoint import restore_checkpoint, save_checkpoint
 from torchpruner_tpu.core.segment import SegmentedModel
 from torchpruner_tpu.data.native import (
+    augment_batch,
     device_prefetch,
     prefetch_batches,
     shuffled_indices,
@@ -29,29 +30,6 @@ from torchpruner_tpu.data.native import (
 from torchpruner_tpu.train.logger import CSVLogger
 from torchpruner_tpu.train.loop import Trainer
 from torchpruner_tpu.utils.config import ExperimentConfig
-
-
-def augment_images(x: np.ndarray, rng: np.random.Generator,
-                   pad: int = 4) -> np.ndarray:
-    """Random horizontal flip + ``pad``-pixel shift-and-crop on a channels-
-    last image batch (the reference's RandomHorizontalFlip + RandomCrop
-    (32, padding=4), cifar10.py:105-110).  Vectorized on host; the batch
-    shape is unchanged, so the jitted train step never retraces."""
-    if x.ndim != 4:
-        return x  # not image-shaped (flat MLP inputs): no augmentation
-    n, h, w, _ = x.shape
-    flip = rng.random(n) < 0.5
-    x = np.where(flip[:, None, None, None], x[:, :, ::-1, :], x)
-    padded = np.pad(
-        x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="constant"
-    )
-    dy = rng.integers(0, 2 * pad + 1, size=n)
-    dx = rng.integers(0, 2 * pad + 1, size=n)
-    # gather the shifted window per example via advanced indexing
-    rows = dy[:, None] + np.arange(h)[None, :]
-    cols = dx[:, None] + np.arange(w)[None, :]
-    return padded[np.arange(n)[:, None, None], rows[:, :, None],
-                  cols[:, None, :], :]
 
 
 def epoch_batches(dataset, cfg: ExperimentConfig, epoch: int):
@@ -76,9 +54,11 @@ def epoch_batches(dataset, cfg: ExperimentConfig, epoch: int):
     if not cfg.augment:
         yield from stream
         return
-    rng = np.random.default_rng(seed + 77)
-    for x, y in stream:
-        yield augment_images(x, rng), y
+    for b, (x, y) in enumerate(stream):
+        # per-batch seed, same splitmix64 contract on both the native and
+        # numpy augmentation paths — epoch streams are bit-reproducible
+        # regardless of which one is in play
+        yield augment_batch(x, seed=seed * 1_000_003 + b), y
 
 
 def run_train(
