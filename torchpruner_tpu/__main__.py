@@ -101,6 +101,13 @@ def main(argv=None) -> int:
 
             summary = run_robustness_config(cfg)
             print(json.dumps(summary))
+        elif cfg.experiment == "train_robustness":
+            from torchpruner_tpu.experiments.robustness import (
+                run_train_robustness,
+            )
+
+            summary = run_train_robustness(cfg)
+            print(json.dumps(summary))
         elif cfg.experiment == "train":
             from torchpruner_tpu.experiments.train_model import run_train
 

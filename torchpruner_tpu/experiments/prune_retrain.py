@@ -168,6 +168,10 @@ def make_lr_schedule(cfg: ExperimentConfig, steps_per_epoch: int = 1,
 def make_optimizer(cfg: ExperimentConfig, steps_per_epoch: int = 1,
                    total_epochs: Optional[int] = None):
     lr = make_lr_schedule(cfg, steps_per_epoch, total_epochs)
+    if cfg.optimizer == "adam":
+        return optax.adam(lr)
+    if cfg.optimizer == "adamw":
+        return optax.adamw(lr, weight_decay=cfg.weight_decay)
     tx = optax.sgd(lr, momentum=cfg.momentum or None)
     if cfg.weight_decay:
         tx = optax.chain(optax.add_decayed_weights(cfg.weight_decay), tx)

@@ -323,6 +323,37 @@ def auc_summary_std(results) -> Dict[str, Dict[str, float]]:
     }
 
 
+def run_train_robustness(cfg, *, verbose: bool = True) -> Dict[str, float]:
+    """The reference's full two-phase protocol as one command: train
+    ``cfg.model`` on ``cfg.dataset`` (``run_train`` — epochs/optimizer/
+    schedule from the same config), then run the layerwise-robustness
+    sweep on the TRAINED weights.  This is the VGG-notebook recipe
+    (pretrain → 15-layer × 8-method sweep) without a separate checkpoint
+    hand-off; ``cfg.checkpoint_path`` still works for resuming the
+    training phase."""
+    from torchpruner_tpu.experiments.prune_retrain import (
+        resolve_model_and_data,
+    )
+    from torchpruner_tpu.experiments.train_model import run_train
+
+    # resolve ONCE and inject everywhere: run_train and the sweep would
+    # otherwise each reload every split, and an injected trained model
+    # with the default cfg.dataset would only be rejected AFTER the whole
+    # training phase
+    model, datasets = resolve_model_and_data(cfg, None, None)
+    trainer, history = run_train(
+        cfg, model=model, datasets=datasets, verbose=verbose
+    )
+    if verbose and history:
+        print(f"[{cfg.name}] trained: test acc "
+              f"{history[-1]['test_acc']:.4f} — starting sweep",
+              flush=True)
+    return run_robustness_config(
+        cfg, model=trainer.model, datasets=datasets,
+        params=trainer.params, state=trainer.state, verbose=verbose,
+    )
+
+
 def run_robustness_config(cfg, *, model=None, datasets=None,
                           params=None, state=None,
                           verbose: bool = True) -> Dict[str, float]:

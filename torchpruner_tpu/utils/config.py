@@ -19,7 +19,7 @@ class ExperimentConfig:
     dataset: str = "synthetic"       # data module entry
     n_classes: int = 10
     loss: str = "cross_entropy"      # cross_entropy|lm_cross_entropy|nll|mse
-    experiment: str = "prune_retrain"  # prune_retrain|robustness
+    experiment: str = "prune_retrain"  # see __post_init__ for the set
     #: restrict pruning to targets containing any of these substrings
     #: (e.g. ["_ffn/", "_mlp/"] for FFN-channel-only pruning); empty = all
     target_filter: Tuple[str, ...] = ()
@@ -45,6 +45,9 @@ class ExperimentConfig:
     batch_size: int = 64
     eval_batch_size: int = 250
     lr: float = 0.01
+    #: "sgd" (reference recipe, momentum/weight_decay below), "adam", or
+    #: "adamw" (decoupled weight_decay)
+    optimizer: str = "sgd"
     momentum: float = 0.0
     weight_decay: float = 0.0
     #: constant | multistep | cosine | warmup_cosine.  "multistep" is the
@@ -105,10 +108,30 @@ class ExperimentConfig:
     results_path: str = ""
 
     def __post_init__(self):
-        if self.experiment not in ("prune_retrain", "robustness", "train"):
+        if self.experiment not in (
+            "prune_retrain", "robustness", "train", "train_robustness"
+        ):
             raise ValueError(
                 f"unknown experiment {self.experiment!r} "
-                "(use 'prune_retrain', 'robustness' or 'train')"
+                "(use 'prune_retrain', 'robustness', 'train' or "
+                "'train_robustness')"
+            )
+        if self.optimizer not in ("sgd", "adam", "adamw"):
+            raise ValueError(
+                f"unknown optimizer {self.optimizer!r} "
+                "(use 'sgd', 'adam' or 'adamw')"
+            )
+        # reject silently-ignored combinations up front: momentum is an
+        # sgd concept, and plain adam has no decay term (adamw does)
+        if self.optimizer != "sgd" and self.momentum:
+            raise ValueError(
+                f"momentum is only meaningful with optimizer='sgd' "
+                f"(got {self.optimizer!r})"
+            )
+        if self.optimizer == "adam" and self.weight_decay:
+            raise ValueError(
+                "optimizer='adam' ignores weight_decay — use 'adamw' "
+                "for decoupled decay"
             )
         if self.lr_schedule not in (
             "constant", "multistep", "cosine", "warmup_cosine"
