@@ -109,8 +109,6 @@ def test_cli_runs_config_with_profile_and_cache(tmp_path, monkeypatch):
 
 
 def test_optimizer_config_dispatch():
-    import optax
-
     from torchpruner_tpu.experiments.prune_retrain import make_optimizer
     from torchpruner_tpu.utils.config import ExperimentConfig
 

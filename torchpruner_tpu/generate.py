@@ -9,9 +9,11 @@ design is TPU-first:
   buffer per attention layer, written at position ``pos`` with
   ``lax.dynamic_update_slice``; attention masks positions ``> pos`` instead
   of slicing a dynamic length, so one compiled step serves every position.
-- **One jitted computation**: prefill and generation are ``lax.scan``s of
-  the same single-token step — no per-token retrace, no host round-trips
-  inside the loop; sampling (greedy or temperature) happens on-device.
+- **One jitted computation**: prefill runs the WHOLE prompt in one
+  forward (S-long matmuls feed the MXU, causal within the block) and
+  generation is a ``lax.scan`` of the single-token step — no per-token
+  retrace, no host round-trips inside the loop; sampling (greedy or
+  temperature) happens on-device.
 - **Layer reuse**: position-independent layers (norms, Dense/GatedDense,
   MoE, activations) run through the SAME ``apply_layer`` rules as training
   (core/layers.py), so decode automatically tracks pruning — a model with
@@ -71,10 +73,13 @@ def init_cache(
 
 
 def _decode_attention(spec, params, entry, x, pos):
-    """Single-position attention against the cache.
+    """Attention for a token block against the cache.
 
-    ``x``: (B, 1, d); ``entry``: this layer's {"k", "v"} cache buffers;
-    ``pos``: scalar absolute position of this token.  Returns (y, entry').
+    ``x``: (B, s, d) — s = 1 for decode steps, s = prompt length for the
+    one-shot prefill; ``entry``: this layer's {"k", "v"} cache buffers;
+    ``pos``: scalar absolute position of the block's FIRST token.  The
+    block's K/V are written at ``pos..pos+s-1`` and attention is causal
+    within the block.  Returns (y, entry').
     """
     q = jnp.einsum("bsd,dhk->bshk", x, params["wq"])
     k = jnp.einsum("bsd,dhk->bshk", x, params["wk"])
@@ -97,12 +102,16 @@ def _decode_attention(spec, params, entry, x, pos):
         entry["v"], v.astype(entry["v"].dtype), (0, pos, 0, 0)
     )
     # scores against the whole static buffer; mask the unwritten future
+    # (causal per query position within the block)
     scale = 1.0 / np.sqrt(spec.head_dim)
     s = jnp.einsum(
         "bqhk,bthk->bhqt", q, k_cache, preferred_element_type=jnp.float32
-    ) * scale  # (B, H, 1, max_len)
+    ) * scale  # (B, H, s, max_len)
     t = jnp.arange(k_cache.shape[1])
-    s = jnp.where((t <= pos)[None, None, None, :], s, _NEG_INF)
+    q_pos = pos + jnp.arange(q.shape[1])
+    s = jnp.where(
+        (t[None, :] <= q_pos[:, None])[None, None, :, :], s, _NEG_INF
+    )
     w = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
     ctx = jnp.einsum("bhqt,bthk->bqhk", w, v_cache)
     y = jnp.einsum("bshk,hkd->bsd", ctx, params["wo"])
@@ -112,8 +121,9 @@ def _decode_attention(spec, params, entry, x, pos):
 
 
 def _decode_seq(layers, params, cache, x, pos, prefix=()):
-    """One token through a layer sequence in decode mode; returns
-    ``(y, cache')`` with the attention entries replaced functionally."""
+    """A token block (s = 1 decode step, s = S prompt prefill) through a
+    layer sequence in decode mode; returns ``(y, cache')`` with the
+    attention entries replaced functionally."""
     for spec in layers:
         path = prefix + (spec.name,)
         key = "/".join(path)
@@ -129,7 +139,9 @@ def _decode_seq(layers, params, cache, x, pos, prefix=()):
                 sc = x
             x = y + sc
         elif isinstance(spec, L.PosEmbed):
-            x = x + jnp.take(p["emb"], pos, axis=0)[None, None, :]
+            x = x + jnp.take(
+                p["emb"], pos + jnp.arange(x.shape[1]), axis=0
+            )[None]
         elif isinstance(spec, L.BatchNorm):
             raise NotImplementedError(
                 "BatchNorm in decode mode (LM families use LayerNorm/RMSNorm)"
@@ -173,9 +185,10 @@ def generate(
     Greedy at ``temperature=0`` (default), else softmax sampling at the
     given temperature (``rng`` required), optionally truncated to the
     ``top_k`` highest-probability tokens and/or the ``top_p`` nucleus
-    (smallest probability mass >= top_p).  Prefill and generation are two
-    ``lax.scan``s of the single-token step inside one jit per
-    (shape, n_new) — the decode loop never leaves the device.
+    (smallest probability mass >= top_p).  Prefill is one whole-prompt
+    forward and generation a ``lax.scan`` of the single-token step,
+    inside one jit per (shape, n_new) — the decode loop never leaves the
+    device.
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     B, S = prompt.shape
@@ -240,24 +253,15 @@ def _generate_fn(model: SegmentedModel, S: int, n_new: int,
 
     @jax.jit
     def run(params, cache, prompt, rng):
-        B = prompt.shape[0]
-        vocab = _vocab_size(model)
-
         def step_body(cache, tok, pos):
             x, cache = _decode_seq(model.layers, params, cache, tok, pos)
             return x[:, 0], cache
 
-        def prefill(carry, inp):
-            cache, _ = carry
-            tok, pos = inp
-            logits, cache = step_body(cache, tok[:, None], pos)
-            return (cache, logits), None
-
-        (cache_f, logits), _ = lax.scan(
-            prefill,
-            (cache, jnp.zeros((B, vocab), jnp.float32)),
-            (jnp.moveaxis(prompt, 1, 0), jnp.arange(S)),
-        )
+        # one-shot prefill: the whole prompt in ONE forward (S-long
+        # matmuls feed the MXU) with causal-within-block cache attention,
+        # instead of S sequential single-token steps
+        x, cache_f = _decode_seq(model.layers, params, cache, prompt, 0)
+        logits = x[:, -1]
 
         def sample(logits, r):
             if temperature == 0.0:
@@ -282,6 +286,3 @@ def _generate_fn(model: SegmentedModel, S: int, n_new: int,
     return run
 
 
-def _vocab_size(model: SegmentedModel) -> int:
-    out_shape = model.shapes[-1][1]
-    return int(out_shape[-1])
