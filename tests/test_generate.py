@@ -165,3 +165,23 @@ def test_truncated_sampling_respects_top_k_and_top_p():
     assert kept(_truncate_logits(logits, None, 0.8)) == {0, 1}
     # top_k=3 keeps exactly the three largest (0.6, 0.22, 0.08)
     assert kept(_truncate_logits(logits, 3, None)) == {0, 1, 2}
+
+
+def test_generate_with_bf16_cache_first_token_and_shape():
+    """bf16 KV cache (the serving config: half the cache bytes): the
+    FIRST greedy token must match the f32 cache — a single-step argmax
+    flip needs a logit margin below cache rounding error.  Later tokens
+    can legitimately diverge (one flip re-conditions the whole suffix),
+    so only shape/dtype is asserted for the rest."""
+    import jax.numpy as jnp
+
+    model = llama_tiny()
+    params, _ = init_model(model, seed=0)
+    toks = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(3), (2, 8), 0, 64), np.int32
+    )
+    f32 = np.asarray(generate(model, params, toks, 12))
+    b16 = np.asarray(
+        generate(model, params, toks, 12, cache_dtype=jnp.bfloat16))
+    np.testing.assert_array_equal(f32[:, 0], b16[:, 0])
+    assert b16.shape == f32.shape and b16.dtype == f32.dtype
