@@ -1,0 +1,96 @@
+"""Example 4 — beyond the reference: prune a causal LM and serve it.
+
+The reference is vision-only; this framework extends the same
+attribution→prune loop to the LM families (BASELINE configs 3-5) and adds
+the serving path the reference never had.  This script:
+
+1. trains a miniature Llama (GQA + RoPE + SwiGLU) briefly on token data,
+2. scores one block's FFN channels with Taylor attribution on the LM loss,
+3. prunes the lowest-scoring fraction (optimizer state sliced too),
+4. fine-tunes a few steps at the new shapes (one recompile), and
+5. generates from BOTH models with the KV-cache decoder — same prompt,
+   pruned model decoding at its pruned shapes.
+
+Runs in about a minute on CPU.
+
+Run::
+
+    python examples/04_prune_llm_and_generate.py [--cpu]
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+if "--cpu" in sys.argv:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import optax
+
+import torchpruner_tpu as tp
+from torchpruner_tpu.data import load_dataset
+from torchpruner_tpu.models import llama_tiny
+from torchpruner_tpu.train.loop import Trainer
+from torchpruner_tpu.utils.flops import param_count
+from torchpruner_tpu.utils.losses import lm_cross_entropy_loss
+
+
+def main():
+    model = llama_tiny()
+    data = load_dataset("lm_tiny", "train", n=512)
+    batches = data.batches(64)
+
+    trainer = Trainer.create(
+        model, optax.adam(1e-3), lm_cross_entropy_loss, seed=0
+    )
+    for epoch in range(3):
+        for x, _ in batches:
+            loss = trainer.step(x, x)
+    print(f"trained: loss {float(loss):.4f}, "
+          f"params {param_count(trainer.params):,}")
+
+    # score one block's FFN gate channels on the LM loss (per-example
+    # rows first, mean reduction — the reference's attribution contract)
+    target = "block1_ffn/gate"
+    metric = tp.TaylorAttributionMetric(
+        trainer.model, trainer.params, [(x, x) for x, _ in batches[:4]],
+        lm_cross_entropy_loss, state=trainer.state,
+    )
+    scores = metric.run(target)
+    dense_model, dense_params = trainer.model, trainer.params
+    res = tp.prune_by_scores(
+        trainer.model, trainer.params, target, scores,
+        policy="fraction", fraction=0.25,
+        state=trainer.state, opt_state=trainer.opt_state,
+    )
+    print(f"pruned {target}: {len(scores)} -> "
+          f"{res.model.widths()[target]} channels, "
+          f"params {param_count(res.params):,}")
+
+    # fine-tune at the new shapes (ONE recompile — the XLA-honest
+    # equivalent of the reference's in-place surgery)
+    trainer = trainer.rebuild(res.model, res.params, res.state,
+                              res.opt_state)
+    for x, _ in batches:
+        loss = trainer.step(x, x)
+    print(f"fine-tuned: loss {float(loss):.4f}")
+
+    # serve both: one-shot prefill + KV-cache decode; the pruned model
+    # decodes at its pruned shapes, next to the trained dense model it
+    # was cut from
+    prompt = np.asarray(data.x[:2, :8], np.int32)
+    out_pruned = tp.generate(trainer.model, trainer.params, prompt, 16)
+    out_dense = tp.generate(dense_model, dense_params, prompt, 16)
+    print(f"prompt:       {prompt[0].tolist()}")
+    print(f"pruned model: {np.asarray(out_pruned)[0].tolist()}")
+    print(f"dense model:  {np.asarray(out_dense)[0].tolist()}")
+
+
+if __name__ == "__main__":
+    main()
