@@ -63,7 +63,14 @@ def main():
         lm_cross_entropy_loss, state=trainer.state,
     )
     scores = metric.run(target)
-    dense_model, dense_params = trainer.model, trainer.params
+    # COPY the trained dense params: pruning shares buffers for untouched
+    # layers, and the fine-tune step donates its inputs — generating from
+    # a plain reference after fine-tuning would hit deleted arrays
+    import jax
+    import jax.numpy as jnp
+
+    dense_model = trainer.model
+    dense_params = jax.tree.map(jnp.copy, trainer.params)
     res = tp.prune_by_scores(
         trainer.model, trainer.params, target, scores,
         policy="fraction", fraction=0.25,
