@@ -153,6 +153,13 @@ def prune(
     Equivalent of ``Pruner.prune_model`` (reference pruner.py:21-57) with the
     cascade resolved statically instead of via NaN propagation, and optimizer
     state sliced for *any* optax optimizer rather than SGD only.
+
+    Aliasing note: leaves the plan does not touch are returned *unchanged*
+    (shared buffers, not copies).  Training the pruned result with a
+    donating step (``Trainer.step`` donates params/opt_state) therefore
+    invalidates those leaves in the SOURCE pytree too — hold
+    ``jax.tree.map(jnp.copy, params)`` if you need the pre-prune model
+    alive afterwards (examples/04 demonstrates this).
     """
     group = layer if isinstance(layer, PruneGroup) else G.group_for(model, layer)
     drop = np.unique(np.asarray(drop, dtype=np.int64).reshape(-1))
