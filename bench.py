@@ -59,6 +59,12 @@ import time
 TPU_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "bench_tpu_last.json")
 
+#: partial results, rewritten by the measurement child after EVERY leg —
+#: if the child is killed mid-run (orchestrator or driver timeout), the
+#: legs that did finish are salvaged from here instead of being lost.
+PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "bench_partial_last.json")
+
 #: per-attempt budget for the measurement child.  A cold full TPU run
 #: (every leg compiling from scratch on the 1-core host through the axon
 #: tunnel) can exceed 900 s; the persistent compilation cache brings warm
@@ -526,6 +532,7 @@ def main() -> dict:
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
     legs: dict = {}
+    commit = _git_commit()  # once — it cannot change mid-run
 
     def run_leg(name, fn):
         # fault isolation: one leg's failure must not destroy the other
@@ -549,6 +556,24 @@ def main() -> dict:
             f"[bench] {name} done in {time.perf_counter() - t0:.1f}s",
             file=sys.stderr, flush=True,
         )
+        if not smoke:
+            try:  # salvageable partial record after every leg; atomic
+                # replace so a kill mid-write can't tear the last good
+                # one.  Never let this bookkeeping abort remaining legs
+                # (a non-serializable leg value must not end the run).
+                blob = json.dumps({
+                    "platform": platform,
+                    "git_commit": commit,
+                    "written_at": time.strftime(
+                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                    "legs": legs,
+                }, indent=1)
+                tmp = PARTIAL_PATH + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(blob)
+                os.replace(tmp, PARTIAL_PATH)
+            except Exception:  # noqa: BLE001
+                pass
 
     run_leg("mnist_prune", _leg_mnist)
     if on_tpu or smoke or "--all-legs" in sys.argv:
@@ -573,8 +598,9 @@ def main() -> dict:
         head_name, head = "mnist_fc_shapley_prune_wall_clock", \
             legs["mnist_prune"]
     else:
-        head_name = "mnist_fc_shapley_prune_wall_clock"
-        head = {"value": None, "unit": "s", "vs_baseline": None}
+        null = _null_result()
+        head_name = null.pop("metric")
+        head = null
     out = {
         "metric": head_name,
         "value": head["value"],
@@ -608,6 +634,7 @@ def orchestrate() -> dict:
     cmd = [sys.executable, os.path.abspath(__file__), "--run", *passthrough]
     attempts: list[dict] = []
     best_partial: dict | None = None  # parseable result with a null headline
+    t_start = time.time()
     plans = [(0.0, False), (15.0, False), (0.0, True)]
     if "--cpu" not in sys.argv:
         # pre-flight: a hung TPU tunnel parks backend init in retry-sleep
@@ -674,9 +701,41 @@ def orchestrate() -> dict:
             if isinstance(cand, dict) and "metric" in cand:
                 result = cand
                 break
+        if result is None and rc != 0:
+            # a killed child (orchestrator timeout OR external signal)
+            # wrote a partial record after each finished leg — salvage it
+            # (only if written by THIS run)
+            try:
+                if os.path.getmtime(PARTIAL_PATH) > t_start:
+                    with open(PARTIAL_PATH) as f:
+                        part = json.load(f)
+                    result = _null_result(
+                        platform=part.get("platform"),
+                        salvaged_partial=True,
+                        git_commit=part.get("git_commit"),
+                        written_at=part.get("written_at"),
+                        legs=part.get("legs", {}),
+                    )
+                    # a finished headline leg is a real measurement even
+                    # if a later leg hung — don't throw it away
+                    mn = part.get("legs", {}).get("mnist_prune")
+                    if isinstance(mn, dict) and "error" not in mn \
+                            and mn.get("value") is not None:
+                        result["value"] = mn["value"]
+                        result["vs_baseline"] = mn.get("vs_baseline")
+            except (OSError, json.JSONDecodeError):
+                pass
         if rc == 0 and result is not None and result.get("value") is not None:
             if attempts:
                 result["attempts"] = attempts
+            if (
+                best_partial is not None
+                and best_partial.get("platform") == "tpu"
+                and result.get("platform") != "tpu"
+            ):
+                # a timed-out TPU attempt's finished legs outrank a CPU
+                # fallback — carry them alongside, clearly labelled
+                result["tpu_partial"] = best_partial
             if result.get("platform") == "tpu" and "--smoke" not in sys.argv:
                 try:
                     with open(TPU_CACHE, "w") as f:
@@ -714,20 +773,33 @@ def orchestrate() -> dict:
         # to the CPU fallback instead of burning another timeout window
         i = len(plans) - 1 if (rc == -1 and not force_cpu) else i + 1
     if best_partial is not None:
-        best_partial["error"] = "headline leg failed (see legs/attempts)"
+        best_partial["error"] = (
+            "partial run — child killed before finishing (see "
+            "legs/attempts)" if best_partial.get("value") is not None
+            else "headline leg failed (see legs/attempts)"
+        )
         best_partial["attempts"] = attempts
         _attach_last_tpu(best_partial)
         return best_partial
-    out = {
+    out = _null_result(
+        error="all bench attempts failed (see attempts)",
+        attempts=attempts,
+    )
+    _attach_last_tpu(out)
+    return out
+
+
+def _null_result(**extra) -> dict:
+    """The parseable no-measurement skeleton (one definition — the
+    salvage path, the all-failed path, and main()'s empty-legs headline
+    share the metric-name contract)."""
+    return {
         "metric": "mnist_fc_shapley_prune_wall_clock",
         "value": None,
         "unit": "s",
         "vs_baseline": None,
-        "error": "all bench attempts failed (see attempts)",
-        "attempts": attempts,
+        **extra,
     }
-    _attach_last_tpu(out)
-    return out
 
 
 def _git_commit() -> str:

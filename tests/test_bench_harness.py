@@ -1,0 +1,73 @@
+"""Bench harness mechanics (no real measurement): the per-leg partial
+record that makes a killed child salvageable, and the shared null-result
+skeleton."""
+
+import json
+import os
+import sys
+
+import pytest
+
+
+@pytest.fixture()
+def bench(monkeypatch, tmp_path):
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench as mod
+
+    monkeypatch.setattr(mod, "PARTIAL_PATH",
+                        str(tmp_path / "partial.json"))
+    return mod
+
+
+def test_partial_record_written_after_every_leg(bench, monkeypatch):
+    """main() must persist finished legs as it goes (atomic replace), so
+    a child killed mid-run leaves the completed measurements on disk."""
+    calls, disk_at_call = [], []
+
+    def stub(name, value):
+        def leg(smoke):
+            # snapshot what the salvage file held when this leg STARTED
+            # (assertions must happen outside: run_leg catches exceptions)
+            disk_at_call.append(
+                list(json.load(open(bench.PARTIAL_PATH))["legs"])
+                if os.path.exists(bench.PARTIAL_PATH) else None
+            )
+            calls.append(name)
+            return {"value": value, "unit": "s", "vs_baseline": 1.0}
+        return leg
+
+    monkeypatch.setattr(bench, "_leg_mnist", stub("mnist_prune", 1.0))
+    monkeypatch.setattr(bench, "_leg_llama_decode",
+                        stub("llama_decode", 2.0))
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--run", "--cpu", "--no-cache"])
+    out = bench.main()
+    assert calls == ["mnist_prune", "llama_decode"]
+    # the second leg saw the first leg's record already persisted
+    assert disk_at_call == [None, ["mnist_prune"]]
+    part = json.load(open(bench.PARTIAL_PATH))
+    assert list(part["legs"]) == calls
+    assert part["platform"] == "cpu"
+    assert out["legs"]["mnist_prune"]["value"] == 1.0
+    assert not os.path.exists(bench.PARTIAL_PATH + ".tmp")
+
+
+def test_partial_record_skipped_in_smoke_mode(bench, monkeypatch):
+    leg = lambda smoke: {"value": 1, "unit": "s", "vs_baseline": 1.0,
+                         "mfu": 0.1, "img_per_s_per_chip": 1.0}
+    monkeypatch.setattr(bench, "_leg_mnist", leg)
+    for name in ("_leg_vgg_robustness", "_leg_vgg_train",
+                 "_leg_flash_attention", "_leg_llama_decode",
+                 "_leg_mfu_llama"):
+        monkeypatch.setattr(bench, name, leg)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--run", "--cpu",
+                                      "--smoke", "--no-cache"])
+    bench.main()
+    assert not os.path.exists(bench.PARTIAL_PATH)
+
+
+def test_null_result_skeleton(bench):
+    r = bench._null_result(error="x", attempts=[1])
+    assert r["metric"] == "mnist_fc_shapley_prune_wall_clock"
+    assert r["value"] is None and r["vs_baseline"] is None
+    assert r["error"] == "x" and r["attempts"] == [1]
