@@ -577,11 +577,14 @@ def main() -> dict:
 
     run_leg("mnist_prune", _leg_mnist)
     if on_tpu or smoke or "--all-legs" in sys.argv:
-        run_leg("vgg16_robustness", _leg_vgg_robustness)
+        # cheap legs first, the long full-sweep leg last: if the child is
+        # killed mid-run, the salvaged partial holds the most
+        # measurements per minute spent
         run_leg("vgg16_train", _leg_vgg_train)
-        run_leg("flash_attention", _leg_flash_attention)
-        run_leg("llama_decode", _leg_llama_decode)
         run_leg("mfu_llama", _leg_mfu_llama)
+        run_leg("llama_decode", _leg_llama_decode)
+        run_leg("flash_attention", _leg_flash_attention)
+        run_leg("vgg16_robustness", _leg_vgg_robustness)
     else:
         # CPU fallback: the VGG legs are TPU-sized, but decode on
         # llama_tiny is CPU-sized — keep it so every round's artifact has
