@@ -74,9 +74,16 @@ void tp_gather_rows(const uint8_t* src, const int64_t* idx, int64_t batch,
 }
 
 // Random horizontal flip + pad-and-crop augmentation on a float32 NHWC
-// batch (the reference's RandomHorizontalFlip + RandomCrop(32, padding=4),
-// its cifar10.py:105-110) — fused: the padded intermediate is never
-// materialized, out-of-window pixels write zeros directly.
+// batch (after the reference's RandomHorizontalFlip + RandomCrop(32,
+// padding=4), its cifar10.py:105-110) — fused: the padded intermediate is
+// never materialized, out-of-window pixels write zeros directly.
+//
+// Fill-value deviation from the reference: this kernel runs on
+// ALREADY-NORMALIZED data, so a 0 fill lands at the per-channel mean,
+// whereas the reference pads the RAW image with 0 before Normalize, so
+// its border pixels land at -mean/std (~ -2 sigma).  Distributionally
+// close, not bit-identical; callers needing the reference's exact border
+// statistics should augment before normalizing.
 //
 // Determinism contract (mirrored bit-for-bit by the Python fallback):
 // example i draws from its own splitmix64 stream seeded

@@ -1,0 +1,67 @@
+"""Worker for the two-process distributed test (not collected by pytest).
+
+Run as ``python _mp_worker.py <process_id> <num_processes> <port>``.
+Joins the multi-host runtime through the framework's own
+``initialize_distributed``, builds a global data mesh, feeds this host's
+``Dataset.host_shard`` slice through ``ShardedTrainer`` (whose
+``shard_batch`` assembles global batches from per-host locals), and
+prints one JSON line with the loss trajectory and a parameter checksum.
+"""
+
+import json
+import sys
+
+import jax
+
+# in-process platform selection: with the experimental TPU plugin
+# installed the JAX_PLATFORMS env var alone does not defeat plugin
+# discovery (see tests/conftest.py)
+jax.config.update("jax_platforms", "cpu")
+
+from torchpruner_tpu.parallel.mesh import initialize_distributed, make_mesh
+
+
+def main() -> None:
+    pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    assert initialize_distributed(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=n,
+        process_id=pid,
+    ), "initialize_distributed must report distributed mode"
+
+    import numpy as np
+    import optax
+
+    from torchpruner_tpu.data import synthetic_dataset
+    from torchpruner_tpu.models.mlp import fc_net
+    from torchpruner_tpu.parallel.train import ShardedTrainer
+    from torchpruner_tpu.utils.losses import cross_entropy_loss
+
+    mesh = make_mesh({"data": jax.device_count()})
+    trainer = ShardedTrainer.create(
+        fc_net(16, hidden=(32, 32)), optax.sgd(0.05), cross_entropy_loss,
+        mesh, seed=0, min_shard_size=0,
+    )
+    local = synthetic_dataset((16,), 4, 64, seed=0).host_shard()
+    losses = [
+        float(trainer.step(x, y))
+        for x, y in local.iter_batches(16, drop_remainder=True)
+    ]
+    # ragged local batches (15,15,2): the padded+masked multiprocess
+    # evaluation path must count exactly the real examples
+    eval_loss, eval_acc = trainer.evaluate(local.batches(15))
+    w = np.asarray(jax.device_get(trainer.params["fc1"]["w"]))
+    print(json.dumps({
+        "pid": pid,
+        "process_count": jax.process_count(),
+        "global_devices": jax.device_count(),
+        "local_devices": jax.local_device_count(),
+        "losses": losses,
+        "eval_loss": eval_loss,
+        "eval_acc": eval_acc,
+        "w_abs_sum": float(np.abs(w).sum()),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,10 +1,12 @@
 """Bench harness mechanics (no real measurement): the per-leg partial
-record that makes a killed child salvageable, and the shared null-result
-skeleton."""
+record and streamed snapshots that make a killed child salvageable, the
+budget guard, the TPU-cache merge, and the shared null-result skeleton."""
 
 import json
 import os
+import subprocess
 import sys
+import time
 
 import pytest
 
@@ -71,3 +73,154 @@ def test_null_result_skeleton(bench):
     assert r["metric"] == "mnist_fc_shapley_prune_wall_clock"
     assert r["value"] is None and r["vs_baseline"] is None
     assert r["error"] == "x" and r["attempts"] == [1]
+
+
+def test_snapshot_streamed_after_every_leg(bench, monkeypatch, capsys):
+    """Round-3 fix: main() must PRINT a complete, driver-parseable result
+    snapshot after each leg (the orchestrator forwards them live, so a
+    driver kill keeps everything already finished)."""
+    leg = lambda smoke: {"value": 1.5, "unit": "s", "vs_baseline": 2.0}
+    monkeypatch.setattr(bench, "_leg_mnist", leg)
+    monkeypatch.setattr(bench, "_leg_llama_decode", leg)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--run", "--cpu",
+                                      "--no-cache"])
+    monkeypatch.delenv("BENCH_DEADLINE_TS", raising=False)
+    out = bench.main()
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    snaps = [json.loads(ln) for ln in lines]
+    assert len(snaps) == 2  # one per leg
+    for snap in snaps:
+        assert snap["stream"] == "in_progress"
+        assert {"metric", "value", "unit", "vs_baseline", "legs"} <= set(snap)
+    # the first snapshot already carries the finished headline leg
+    assert snaps[0]["metric"] == "mnist_fc_shapley_prune_wall_clock"
+    assert snaps[0]["value"] == 1.5
+    assert list(snaps[1]["legs"]) == ["mnist_prune", "llama_decode"]
+    assert out["value"] == 1.5 and "stream" not in out
+
+
+def test_budget_guard_skips_unfinishable_legs(bench, monkeypatch, capsys):
+    """With an orchestrator deadline too close, legs are SKIPPED with a
+    reason instead of being started and killed mid-measurement."""
+    ran = []
+    leg = lambda smoke: ran.append(1) or {"value": 1, "unit": "s"}
+    monkeypatch.setattr(bench, "_leg_mnist", leg)
+    monkeypatch.setattr(bench, "_leg_llama_decode", leg)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--run", "--cpu",
+                                      "--no-cache"])
+    monkeypatch.setenv("BENCH_DEADLINE_TS", str(time.time() + 5.0))
+    out = bench.main()
+    assert ran == []
+    assert "budget" in out["legs"]["mnist_prune"]["skipped"]
+    assert "budget" in out["legs"]["llama_decode"]["skipped"]
+    assert out["value"] is None  # skipped legs never fake a headline
+    # ...but the skip decisions themselves were streamed
+    snaps = [json.loads(ln)
+             for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert len(snaps) == 2
+
+
+def test_assemble_headline_prefers_sweep_and_names_dataset(bench):
+    """The sweep headline metric carries the digits32 caveat in its NAME
+    (advisor round-3: cross-dataset vs_baseline must not be quotable
+    without the caveat)."""
+    legs = {
+        "mnist_prune": {"value": 10.0, "unit": "s", "vs_baseline": 2.8},
+        "vgg16_robustness": {"value": 900.0, "unit": "s",
+                             "vs_baseline": 12.0},
+    }
+    out = bench._assemble(legs, "tpu", "TPU v5 lite", None, smoke=False)
+    assert out["metric"] == "vgg16_layerwise_sweep_digits32_wall_clock"
+    assert out["value"] == 900.0
+    # an errored sweep leg falls back to the MNIST headline
+    legs["vgg16_robustness"] = {"error": "boom"}
+    out = bench._assemble(legs, "tpu", "TPU v5 lite", None, smoke=False)
+    assert out["metric"] == "mnist_fc_shapley_prune_wall_clock"
+
+
+def test_stream_child_forwards_snapshots_live(bench, capsys):
+    """_stream_child re-prints each child JSON line as it appears and
+    returns the last one; non-JSON noise lines are passed over."""
+    prog = ("import json,sys\n"
+            "print('noise')\n"
+            "print(json.dumps({'metric':'m','value':1}))\n"
+            "print(json.dumps({'metric':'m','value':2}))\n")
+    seen = []
+
+    def enrich(c):
+        seen.append(c["value"])
+        c["enriched"] = True
+        return c
+
+    rc, last, _err = bench._stream_child([sys.executable, "-c", prog], 60.0,
+                                         enrich)
+    assert rc == 0 and last["value"] == 2 and last["enriched"]
+    assert seen == [1, 2]
+    lines = [json.loads(ln)
+             for ln in capsys.readouterr().out.splitlines() if ln.strip()
+             and ln.startswith("{")]
+    assert [ln["value"] for ln in lines] == [1, 2]
+
+
+def test_stream_child_kills_on_timeout(bench):
+    prog = ("import json,sys,time\n"
+            "print('progress line', file=sys.stderr, flush=True)\n"
+            "print(json.dumps({'metric':'m','value':1}), flush=True)\n"
+            "time.sleep(60)\n")
+    t0 = time.time()
+    rc, last, err = bench._stream_child([sys.executable, "-c", prog], 2.0,
+                                        lambda c: c)
+    assert time.time() - t0 < 30
+    assert rc == -1
+    assert last["value"] == 1  # the pre-kill snapshot survives
+    assert "progress line" in err  # stderr tail captured for attempts[]
+
+
+def test_write_tpu_cache_carries_forward_missing_legs(bench, monkeypatch,
+                                                      tmp_path):
+    """A budget-capped TPU run that skipped the expensive sweep must not
+    erase a previously-cached sweep measurement — it is carried forward
+    with the commit/timestamp it was measured at."""
+    cache = tmp_path / "tpu_cache.json"
+    monkeypatch.setattr(bench, "TPU_CACHE", str(cache))
+    old = {"measured_at": "2026-07-29T00:00:00Z", "git_commit": "oldc",
+           "result": {"legs": {
+               "vgg16_robustness": {"value": 1558.1, "unit": "s"},
+               "mnist_prune": {"value": 15.2, "unit": "s"},
+           }}}
+    cache.write_text(json.dumps(old))
+    new = {"metric": "mnist_fc_shapley_prune_wall_clock", "value": 12.0,
+           "unit": "s", "platform": "tpu",
+           "legs": {"mnist_prune": {"value": 12.0, "unit": "s"},
+                    "vgg16_robustness": {"skipped": "budget"}}}
+    bench._write_tpu_cache(new)
+    written = json.loads(cache.read_text())
+    legs = written["result"]["legs"]
+    # fresh leg wins; skipped leg replaced by the carried measurement
+    assert legs["mnist_prune"]["value"] == 12.0
+    assert "carried_from" not in legs["mnist_prune"]
+    assert legs["vgg16_robustness"]["value"] == 1558.1
+    assert legs["vgg16_robustness"]["carried_from"]["git_commit"] == "oldc"
+
+
+def test_orchestrate_prints_boot_line_first(bench, monkeypatch, capsys):
+    """The orchestrator's FIRST act is printing a parseable skeleton, so
+    a driver kill during preflight still leaves `parsed != null`."""
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--cpu", "--no-cache"])
+    monkeypatch.delenv("BENCH_DEADLINE_TS", raising=False)
+    final = {"metric": "mnist_fc_shapley_prune_wall_clock", "value": 3.0,
+             "unit": "s", "vs_baseline": 9.3, "platform": "cpu", "legs": {}}
+
+    def fake_stream(cmd, timeout_s, enrich):
+        print(json.dumps(enrich(dict(final, stream="in_progress"))),
+              flush=True)
+        return 0, dict(final), ""
+
+    monkeypatch.setattr(bench, "_stream_child", fake_stream)
+    out = bench.orchestrate()
+    lines = [json.loads(ln)
+             for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert lines[0]["stream"] == "starting"
+    assert lines[0]["metric"] == "mnist_fc_shapley_prune_wall_clock"
+    assert lines[0]["value"] is None
+    assert out["value"] == 3.0 and "stream" not in out

@@ -155,3 +155,27 @@ def test_bf16_gradients_run():
     assert dq.dtype == jnp.bfloat16
     assert all(bool(jnp.all(jnp.isfinite(t.astype(jnp.float32))))
                for t in (dq, dk, dv))
+
+
+def test_auto_dispatch_is_seq_length_aware(monkeypatch):
+    """impl="auto" keeps the XLA path on CPU always, and on TPU below
+    FLASH_AUTO_MIN_S (the measured S=2048 point has XLA faster with
+    affordable memory); flash engages only where its linear-in-S backward
+    memory matters."""
+    from torchpruner_tpu.core import layers as L
+
+    calls = []
+    monkeypatch.setattr(
+        "torchpruner_tpu.ops.flash_attention.flash_attention",
+        lambda q, k, v, causal: calls.append(q.shape) or _xla_attention(
+            q, k, v, causal=causal),
+    )
+    q, k, v = qkv(B=1, S=16, H=2, Dh=8)
+    L.attention_core(q, k, v, causal=True, impl="auto")
+    assert calls == []  # cpu backend -> xla
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    L.attention_core(q, k, v, causal=True, impl="auto")
+    assert calls == []  # tpu but S=16 < FLASH_AUTO_MIN_S -> xla
+    monkeypatch.setattr(L, "FLASH_AUTO_MIN_S", 16)
+    L.attention_core(q, k, v, causal=True, impl="auto")
+    assert len(calls) == 1  # tpu and S >= threshold -> flash kernel

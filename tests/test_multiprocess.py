@@ -1,0 +1,105 @@
+"""Cross-host (multi-PROCESS) execution of the distributed path.
+
+``dryrun_multichip`` and the mesh tests prove multi-device SPMD inside one
+process; this proves the wiring a pod actually needs (SURVEY.md §2.11):
+two OS processes join one JAX runtime through
+``initialize_distributed`` (Gloo collectives on CPU), build one global
+mesh, feed disjoint ``Dataset.host_shard`` slices, and produce the exact
+single-process DP trajectory.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import optax
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_dp_matches_single_process():
+    worker = os.path.join(REPO, "tests", "_mp_worker.py")
+    env = os.environ.copy()
+    # each worker gets 2 virtual CPU devices -> a 4-device global mesh
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    # python puts the SCRIPT's dir on sys.path, not the cwd — the worker
+    # needs the repo root to import torchpruner_tpu
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), "2", str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=REPO, env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=420)
+            assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+            outs.append((out, err))
+    finally:
+        for p in procs:
+            p.kill()
+    results = []
+    for out, err in outs:
+        lines = [ln for ln in out.splitlines() if ln.startswith("{")]
+        assert lines, f"no JSON from worker:\n{out}\n{err[-1000:]}"
+        results.append(json.loads(lines[-1]))
+    results.sort(key=lambda r: r["pid"])
+
+    # one runtime: every process sees all 4 devices but addresses only 2
+    for r in results:
+        assert r["process_count"] == 2
+        assert r["global_devices"] == 4
+        assert r["local_devices"] == 2
+    # both processes ran the same SPMD program: identical trajectories
+    np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(results[0]["w_abs_sum"],
+                               results[1]["w_abs_sum"], rtol=1e-6)
+
+    # ...and the distributed trajectory equals single-process DP on the
+    # same global batches (host i contributes examples i::2, so a global
+    # batch is the concatenation of the per-host slices)
+    from torchpruner_tpu.data import synthetic_dataset
+    from torchpruner_tpu.models.mlp import fc_net
+    from torchpruner_tpu.train.loop import Trainer
+    from torchpruner_tpu.utils.losses import cross_entropy_loss
+
+    data = synthetic_dataset((16,), 4, 64, seed=0)
+    shards = [data.host_shard(i, 2) for i in range(2)]
+    trainer = Trainer.create(fc_net(16, hidden=(32, 32)), optax.sgd(0.05),
+                             cross_entropy_loss, seed=0)
+    ref = []
+    for (x0, y0), (x1, y1) in zip(
+        shards[0].iter_batches(16, drop_remainder=True),
+        shards[1].iter_batches(16, drop_remainder=True),
+    ):
+        ref.append(float(trainer.step(np.concatenate([x0, x1]),
+                                      np.concatenate([y0, y1]))))
+    assert len(ref) == len(results[0]["losses"]) == 2
+    np.testing.assert_allclose(results[0]["losses"], ref, rtol=1e-4)
+
+    # the multiprocess padded+masked evaluation counts exactly the real
+    # examples: compare against single-process eval on the same batches
+    ref_eval = trainer.evaluate([
+        (np.concatenate([x0, x1]), np.concatenate([y0, y1]))
+        for (x0, y0), (x1, y1) in zip(shards[0].batches(15),
+                                      shards[1].batches(15))
+    ])
+    np.testing.assert_allclose(results[0]["eval_loss"], ref_eval[0],
+                               rtol=1e-4)
+    np.testing.assert_allclose(results[0]["eval_acc"], ref_eval[1],
+                               rtol=1e-6)

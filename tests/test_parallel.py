@@ -166,18 +166,25 @@ def test_sharded_trainer_matches_single_device():
 
 
 def test_sharded_trainer_evaluate_matches_single_device():
-    """Data-sharded evaluation (including a non-dividing final batch that
-    stays replicated) must equal the single-device evaluation exactly."""
+    """Data-sharded evaluation — including batches that do NOT divide the
+    data axis, which are padded to the next multiple and masked — must
+    equal the single-device evaluation exactly."""
     mesh = make_mesh({"data": 2, "model": 4})
     tx = optax.sgd(0.05)
     t1 = Trainer.create(model_8(), tx, cross_entropy_loss, seed=0)
     t8 = ShardedTrainer.create(model_8(), tx, cross_entropy_loss, mesh,
                                seed=0, min_shard_size=0)
-    data = synthetic_dataset((16,), 4, 50, seed=3).batches(16)  # 16,16,16,2
+    # 15 % 2 != 0: every batch is ragged wrt the 2-way data axis, and the
+    # final batch (5) is ragged wrt the batch size too
+    data = synthetic_dataset((16,), 4, 50, seed=3).batches(15)
     l1, a1 = t1.evaluate(data)
     l8, a8 = t8.evaluate(data)
     np.testing.assert_allclose(l1, l8, rtol=1e-5)
     assert a1 == a8
+    # dividing batches agree with ragged batches over the same examples
+    l8b, a8b = t8.evaluate(synthetic_dataset((16,), 4, 50, seed=3).batches(25))
+    np.testing.assert_allclose(l8, l8b, rtol=1e-5)
+    assert a8 == a8b
 
 
 def test_sharded_trainer_gradient_accumulation_matches():

@@ -783,13 +783,26 @@ def _rope(x, theta: float, offset=0):
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 
 
+#: ``impl="auto"`` crossover: below this sequence length the XLA einsum
+#: path wins on TPU — measured at S=2048 (bench ``flash_attention`` leg,
+#: TPU v5 lite: flash 73.7 ms vs XLA 72.1 ms grad step, speedup 0.979) —
+#: and its O(S²) temp memory is still affordable (768 MB at S=2048).
+#: Above it the flash kernel's O(S·Dh) backward memory is the point:
+#: 48.6 MB vs the quadratic XLA buffer that grows 16× per 4× S and OOMs
+#: long-context training.  Revisit with experiments/flash_sweep.py when
+#: longer-S on-chip numbers land.
+FLASH_AUTO_MIN_S = 4096
+
+
 def attention_core(q, k, v, *, causal: bool, impl: str = "auto"):
     """Scaled-dot-product attention core on ``(B, S, H, Dh)`` tensors
-    (K/V already expanded to H heads).  ``impl="auto"`` uses the Pallas
-    flash kernel on TPU (torchpruner_tpu/ops/flash_attention.py) and the
-    XLA einsum path elsewhere."""
+    (K/V already expanded to H heads).  ``impl="auto"`` picks the XLA
+    einsum path except on TPU at ``S >= FLASH_AUTO_MIN_S``, where the
+    Pallas flash kernel's linear-in-S memory earns its keep
+    (torchpruner_tpu/ops/flash_attention.py)."""
     if impl == "auto":
-        impl = "flash" if jax.default_backend() == "tpu" else "xla"
+        impl = ("flash" if jax.default_backend() == "tpu"
+                and q.shape[1] >= FLASH_AUTO_MIN_S else "xla")
     if impl == "flash":
         from torchpruner_tpu.ops.flash_attention import flash_attention
 

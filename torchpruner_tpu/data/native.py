@@ -178,11 +178,17 @@ def _augment_numpy(x: np.ndarray, seed: int, pad: int) -> np.ndarray:
 def augment_batch(x: np.ndarray, seed: int, pad: int = 4,
                   n_threads: int = 4) -> np.ndarray:
     """Random horizontal flip + ``pad``-pixel shift-and-crop on a
-    channels-last float32 image batch (the reference's
+    channels-last float32 image batch (after the reference's
     RandomHorizontalFlip + RandomCrop(32, padding=4), its
     cifar10.py:105-110).  Native kernel when built (fused, threaded, no
     padded intermediate), identical-output numpy fallback otherwise;
-    non-image (non-4D) inputs pass through unchanged."""
+    non-image (non-4D) inputs pass through unchanged.
+
+    Out-of-window pixels are filled with 0.  On the normalized tensors
+    this pipeline feeds, that is the per-channel mean — the reference
+    instead pads the RAW image before Normalize, putting its borders at
+    ``-mean/std``.  Distributionally close, not bit-identical (see
+    cpp/data_pipeline.cc)."""
     if x.ndim != 4:
         return x
     x = np.ascontiguousarray(x, dtype=np.float32)

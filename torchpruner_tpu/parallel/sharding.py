@@ -218,12 +218,27 @@ def tp_sharding(model, params, mesh: Mesh, axis: str = "model",
 
 
 def shard_batch(batch, mesh: Mesh, axis: str = "data"):
-    """Place ``(x, y)`` with batch dim sharded over the data axis.  The
-    leading dim must divide the axis size (callers pad or drop the
-    remainder — ``Dataset.iter_batches(drop_remainder=True)``)."""
+    """Place ``(x, y)`` with batch dim sharded over the data axis.
+
+    Single-process: a plain sharded ``device_put``; the leading dim must
+    divide the axis size (callers pad or drop the remainder —
+    ``Dataset.iter_batches(drop_remainder=True)``).
+
+    Multi-process (a mesh spanning hosts after
+    ``initialize_distributed``): each host passes its LOCAL shard — the
+    slice ``Dataset.host_shard()`` feeds it — and the global array
+    assembles from every host's addressable pieces without any
+    cross-host copy, the standard per-host input pipeline on pods.  The
+    local leading dim must then divide the axis's addressable share.
+    """
     sh = batch_sharding(mesh, axis)
+    multiprocess = any(
+        d.process_index != jax.process_index() for d in mesh.devices.flat
+    )
 
     def put(a):
+        if multiprocess:
+            return jax.make_array_from_process_local_data(sh, np.asarray(a))
         if a.shape[0] % mesh.shape[axis]:
             raise ValueError(
                 f"batch dim {a.shape[0]} not divisible by mesh axis "

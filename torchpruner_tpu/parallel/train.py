@@ -196,22 +196,39 @@ class ShardedTrainer:
         return t
 
     def evaluate(self, data):
-        """Evaluation with batches sharded over the data axis (XLA
-        all-reduces the loss/count sums); batches that don't divide the
-        axis stay replicated rather than being dropped — evaluation must
-        count every example."""
-        from torchpruner_tpu.train.loop import evaluate
+        """Evaluation with every batch sharded over the data axis (XLA
+        all-reduces the loss/count sums).  A batch that doesn't divide the
+        axis is PADDED to the next multiple (repeating its last example)
+        and evaluated under a validity mask, so the ragged final batch of
+        a dataset keeps all devices busy instead of silently replicating —
+        while still counting exactly the real examples."""
+        from torchpruner_tpu.train.loop import make_masked_eval_step
 
-        bs = batch_sharding(self.mesh, self.data_axis)
-        n = self.mesh.shape[self.data_axis]
-
-        def sharded_stream():
-            for x, y in (data() if callable(data) else data):
-                x, y = jnp.asarray(x), jnp.asarray(y)
-                if x.shape[0] % n == 0:
-                    x = jax.device_put(x, bs)
-                    y = jax.device_put(y, bs)
-                yield x, y
-
-        return evaluate(self.model, self.params, self.state,
-                        sharded_stream, self.loss_fn)
+        # multi-process mesh: each host feeds its LOCAL shard (the same
+        # contract as step()/shard_batch), pads to its addressable share
+        # of the data axis, and the mask keeps global counts exact
+        multiprocess = any(d.process_index != jax.process_index()
+                           for d in self.mesh.devices.flat)
+        n = (sum(d.process_index == jax.process_index()
+                 for d in self.mesh.devices.flat) if multiprocess
+             else self.mesh.shape[self.data_axis])
+        step = make_masked_eval_step(self.model, self.loss_fn)
+        tot_l, tot_c, tot_n, tot_p = 0.0, 0, 0, 0
+        for x, y in (data() if callable(data) else data):
+            x, y = jnp.asarray(x), jnp.asarray(y)
+            b = x.shape[0]
+            pad = (-b) % n
+            if pad:
+                x = jnp.concatenate([x, jnp.repeat(x[-1:], pad, axis=0)])
+                y = jnp.concatenate([y, jnp.repeat(y[-1:], pad, axis=0)])
+            valid = jnp.arange(b + pad) < b
+            x, y, valid = shard_batch((x, y, valid), self.mesh,
+                                      self.data_axis)
+            l, c, nn, n_pred = step(self.params, self.state, x, y, valid)
+            tot_l += float(l)
+            tot_c += int(c)
+            tot_n += int(nn)
+            tot_p += int(n_pred)
+        if tot_n == 0:
+            raise ValueError("evaluate() got an empty dataset")
+        return tot_l / tot_n, tot_c / tot_p

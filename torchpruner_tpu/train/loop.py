@@ -151,6 +151,34 @@ def make_eval_step(model: SegmentedModel, loss_fn):
     return jax.jit(step)
 
 
+def make_masked_eval_step(model: SegmentedModel, loss_fn):
+    """(params, state, x, y, valid) ->
+    (masked loss sum, masked #correct, #valid examples, #predictions).
+
+    ``valid`` is a per-example boolean over the batch dim: padded rows
+    (added so a ragged final batch still divides a mesh's data axis)
+    contribute nothing to any statistic.  Counts come back as traced
+    scalars — unlike :func:`make_eval_step`, where ``n_predictions`` is
+    static — because the valid count varies with the mask, not the shape.
+    """
+
+    def step(params, state, x, y, valid):
+        out, _ = model.apply(params, x, state=state, train=False)
+        losses = loss_fn(out, y)
+        vf = valid.astype(losses.dtype)
+        if out.ndim == y.ndim + 1 and y.ndim >= 2:
+            # LM: position t predicts token t+1 (matches prediction_counts)
+            pred = jnp.argmax(out[:, :-1], axis=-1)
+            correct = jnp.sum((pred == y[:, 1:]) * valid[:, None])
+            n_pred = jnp.sum(valid) * (y.shape[1] - 1)
+        else:
+            correct = jnp.sum((jnp.argmax(out, axis=-1) == y) * valid)
+            n_pred = jnp.sum(valid)
+        return jnp.sum(losses * vf), correct, jnp.sum(valid), n_pred
+
+    return jax.jit(step)
+
+
 def evaluate(model, params, state, data, loss_fn):
     """Average loss and accuracy over ``data`` (reference train.py:51-72).
     Loss averages per example; accuracy per prediction (== per example for
