@@ -59,6 +59,7 @@ spawns).
 
 from __future__ import annotations
 
+import inspect
 import json
 import os
 import subprocess
@@ -184,10 +185,12 @@ def _leg_mnist(smoke: bool) -> dict:
     }
 
 
-def _leg_vgg_robustness(smoke: bool) -> dict:
+def _leg_vgg_robustness(smoke: bool, progress=None) -> dict:
     """Leg 2: the FULL layerwise-robustness sweep — every prunable layer
     × the 8-method panel (3 runs for stochastic methods), measured end to
     end with no projection (reference: 6.5 h for 15 layers × 8 methods).
+    ``progress`` (from run_leg) checkpoints after every layer so a kill
+    mid-sweep still reports the finished layers' AUCs.
 
     The net is TRAINED first, in-leg, on digits32 (real sklearn digit
     scans at CIFAR-10 geometry — the only real image data guaranteed in
@@ -267,9 +270,28 @@ def _leg_vgg_robustness(smoke: bool) -> dict:
                                 sv_samples=5),
     }
     t0 = time.perf_counter()
+    partial_results: dict = {}
+
+    def on_layer(layer, layer_res):
+        if progress is None:
+            return
+        partial_results[layer] = layer_res
+        stats = auc_summary_std(partial_results)
+        progress({
+            "value": None,
+            "unit": "s",
+            "layers_done": len(partial_results),
+            "elapsed_s": round(time.perf_counter() - t0, 1),
+            "eval_examples": len(test),
+            "auc_so_far": {k: round(v["mean"], 4)
+                           for k, v in stats.items()},
+            "trained_test_acc": round(float(test_acc), 4),
+        })
+
     results = layerwise_robustness(
         model, params, state, batches, methods, cross_entropy_loss,
         layers=layers, compute_dtype=jnp.bfloat16, verbose=False,
+        on_layer=on_layer,
     )
     sweep_s = time.perf_counter() - t0
     per_layer_s = {
@@ -413,7 +435,7 @@ def _leg_mfu_llama(smoke: bool) -> dict:
     import numpy as np
     import optax
 
-    from torchpruner_tpu.models import llama, llama_tiny
+    from torchpruner_tpu.models import llama_tiny, mfu_llama
     from torchpruner_tpu.train.loop import Trainer
     from torchpruner_tpu.utils.flops import model_cost, param_count
     from torchpruner_tpu.utils.losses import lm_cross_entropy_loss
@@ -422,10 +444,9 @@ def _leg_mfu_llama(smoke: bool) -> dict:
     if smoke:
         model, B = llama_tiny(), 2
     else:
-        model = llama(vocab_size=32000, dim=1024, depth=8, num_heads=8,
-                      num_kv_heads=8, head_dim=128, ffn_dim=4096,
-                      seq_len=1024)
-        B = 8
+        # one factory shared with experiments.step_trace --model
+        # mfu_llama, so the stopwatch and the trace profile the same net
+        model, B = mfu_llama(), 8
     S = model.input_shape[0]
     rng = np.random.default_rng(0)
     toks = jax.numpy.asarray(
@@ -545,7 +566,8 @@ def _leg_llama_decode(smoke: bool) -> dict:
 
 def _leg_ok(legs: dict, name: str) -> bool:
     return (name in legs and "error" not in legs[name]
-            and "skipped" not in legs[name])
+            and "skipped" not in legs[name]
+            and "in_progress" not in legs[name])
 
 
 def _assemble(legs: dict, platform: str, device_kind, cache_dir,
@@ -662,15 +684,30 @@ def main() -> dict:
         # flash leg crashed the whole TPU attempt and forced CPU fallback)
         print(f"[bench] {name} starting", file=sys.stderr, flush=True)
         t0 = time.perf_counter()
+        kw = {}
+        if "progress" in inspect.signature(fn).parameters:
+            # a long leg checkpoints itself: each call replaces the leg's
+            # entry with an in_progress partial and streams a snapshot,
+            # so a kill mid-sweep keeps the finished layers
+            def _progress(partial: dict, _name=name):
+                legs[_name] = dict(partial, in_progress=True)
+                snapshot()
+            kw["progress"] = _progress
         try:
-            legs[name] = fn(smoke)
+            legs[name] = fn(smoke, **kw)
         except Exception as e:  # noqa: BLE001 - diagnostic, re-raised as data
             import traceback
 
-            legs[name] = {
+            err = {
                 "error": f"{type(e).__name__}: {e}"[:500],
                 "traceback_tail": traceback.format_exc()[-500:],
             }
+            prev = legs.get(name)
+            if isinstance(prev, dict) and prev.get("in_progress"):
+                # a crash late in a checkpointing leg must not discard the
+                # finished layers' data — merge the error into the partial
+                err = {**prev, **err}
+            legs[name] = err
         # stderr progress so an orchestrator timeout still documents which
         # legs completed and where the time went (round-2 postmortem: a
         # 900 s TPU timeout left zero evidence of the slow leg)

@@ -1,0 +1,67 @@
+#!/bin/sh
+# On-chip evidence capture — run the moment the axon tunnel answers.
+#
+# Probes first (a hung tunnel must not park the whole capture), then runs
+# every measurement the repo's perf story cites, writing committed-quality
+# artifacts into results/.  Each step is independently fault-isolated:
+# a failure (or a tunnel drop mid-capture) leaves the earlier artifacts.
+#
+# Usage: sh scripts/capture_tpu.sh   (from the repo root; ~60-90 min warm)
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p results logs
+
+echo "[capture] probing tunnel..."
+if ! timeout 75 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu', d; print(d)"; then
+    echo "[capture] tunnel down — aborting (re-run when it answers)"
+    exit 1
+fi
+
+stamp=$(date -u +%Y-%m-%d_%H%M)
+commit=$(git rev-parse --short HEAD)
+echo "[capture] tunnel up; commit $commit"
+
+# 1. the full six-leg bench (incl. the non-projected trained sweep,
+#    mfu_llama, decode): the headline artifact + refreshed TPU cache.
+#    The outer timeout must EXCEED the bench's internal budget (TPU
+#    attempt + CPU-reserve wind-down) or the final result line and the
+#    bench_tpu_last.json refresh are lost to the external kill.
+BENCH_TOTAL_BUDGET_S=10800 timeout 11400 python bench.py \
+    > "logs/bench_tpu_${stamp}.jsonl" 2> "logs/bench_tpu_${stamp}.err"
+# only a finished on-chip result may be committed under the bench_tpu_
+# name; a CPU fallback / boot line / in_progress snapshot is not one
+python - "logs/bench_tpu_${stamp}.jsonl" \
+    "results/bench_tpu_${stamp}_${commit}.json" <<'EOF' \
+    && echo "[capture] bench done (on-chip result committed)" \
+    || echo "[capture] bench produced NO finished on-chip result — see logs/"
+import json, sys
+lines = [l for l in open(sys.argv[1]) if l.strip().startswith("{")]
+last = json.loads(lines[-1]) if lines else {}
+ok = (last.get("platform") == "tpu" and "stream" not in last
+      and last.get("value") is not None)
+if ok:
+    open(sys.argv[2], "w").write(lines[-1])
+sys.exit(0 if ok else 1)
+EOF
+
+# 2. flash-attention S-sweep (+ block tuning): the time-crossover table
+timeout 3600 python -m torchpruner_tpu.experiments.flash_sweep --tune \
+    --out "results/flash_sweep_tpu_${stamp}_${commit}.json" \
+    2> "logs/flash_sweep_${stamp}.err" && echo "[capture] flash sweep done"
+
+# 3. compile economics (bucketing x persistent cache) on the real backend
+timeout 3600 python -m torchpruner_tpu.experiments.compile_economics \
+    --steps 5 --out "results/compile_economics_tpu_${stamp}_${commit}.json" \
+    2> "logs/compile_econ_${stamp}.err" && echo "[capture] compile economics done"
+
+# 4. step anatomy: where the milliseconds go, conv-bound vs matmul-bound
+timeout 1800 python -m torchpruner_tpu.experiments.step_trace \
+    --model vgg16_bn --batch 256 \
+    --out "results/steptrace_vgg16_tpu_${stamp}_${commit}.json" \
+    2> "logs/steptrace_vgg_${stamp}.err" && echo "[capture] vgg16 trace done"
+timeout 1800 python -m torchpruner_tpu.experiments.step_trace \
+    --model mfu_llama --batch 8 \
+    --out "results/steptrace_mfullama_tpu_${stamp}_${commit}.json" \
+    2> "logs/steptrace_llama_${stamp}.err" && echo "[capture] mfu_llama trace done"
+
+echo "[capture] done — review results/, update PERF.md, commit"

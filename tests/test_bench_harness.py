@@ -120,6 +120,56 @@ def test_budget_guard_skips_unfinishable_legs(bench, monkeypatch, capsys):
     assert len(snaps) == 2
 
 
+def test_leg_progress_checkpoints_are_streamed(bench, monkeypatch, capsys):
+    """A leg that accepts ``progress`` (the multi-hour sweep) checkpoints
+    itself: each call streams an in_progress snapshot, the in_progress
+    entry never becomes the headline, and the final return replaces it."""
+
+    def sweep_leg(smoke, progress=None):
+        progress({"value": None, "unit": "s", "layers_done": 1})
+        progress({"value": None, "unit": "s", "layers_done": 2})
+        return {"value": 1.5, "unit": "s", "vs_baseline": 18.7}
+
+    monkeypatch.setattr(bench, "_leg_mnist", sweep_leg)
+    monkeypatch.setattr(bench, "_leg_llama_decode",
+                        lambda smoke: {"value": 2.0, "unit": "s"})
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--run", "--cpu",
+                                      "--no-cache"])
+    monkeypatch.delenv("BENCH_DEADLINE_TS", raising=False)
+    out = bench.main()
+    snaps = [json.loads(ln)
+             for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    prog = [s for s in snaps
+            if s["legs"].get("mnist_prune", {}).get("in_progress")]
+    assert [p["legs"]["mnist_prune"]["layers_done"] for p in prog] == [1, 2]
+    # an unfinished headline leg must not fake a headline measurement
+    for p in prog:
+        assert p["value"] is None
+    assert out["value"] == 1.5
+    assert "in_progress" not in out["legs"]["mnist_prune"]
+
+
+def test_leg_crash_keeps_checkpointed_progress(bench, monkeypatch, capsys):
+    """A crash late in a checkpointing leg merges the error INTO the
+    in_progress partial instead of discarding the finished layers."""
+
+    def crashing_sweep(smoke, progress=None):
+        progress({"value": None, "unit": "s", "layers_done": 12,
+                  "auc_so_far": {"sv": 0.3}})
+        raise RuntimeError("oom at layer 13")
+
+    monkeypatch.setattr(bench, "_leg_mnist", crashing_sweep)
+    monkeypatch.setattr(bench, "_leg_llama_decode",
+                        lambda smoke: {"value": 2.0, "unit": "s"})
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--run", "--cpu",
+                                      "--no-cache"])
+    monkeypatch.delenv("BENCH_DEADLINE_TS", raising=False)
+    out = bench.main()
+    leg = out["legs"]["mnist_prune"]
+    assert "oom at layer 13" in leg["error"]
+    assert leg["layers_done"] == 12 and leg["auc_so_far"] == {"sv": 0.3}
+
+
 def test_assemble_headline_prefers_sweep_and_names_dataset(bench):
     """The sweep headline metric carries the digits32 caveat in its NAME
     (advisor round-3: cross-dataset vs_baseline must not be quotable

@@ -41,6 +41,7 @@ TOKEN_DATASET_SHAPES = {
     "glue_sst2": (128, 30522, 2),
     "glue_tiny": (16, 128, 2),
     "lm_corpus": (2048, 128256, None),
+    "lm_mfu": (1024, 32000, None),  # matches models.mfu_llama
     "lm_tiny": (16, 256, None),
 }
 

@@ -34,6 +34,7 @@ from torchpruner_tpu.models import (
     fmnist_convnet,
     llama3_8b,
     llama_tiny,
+    mfu_llama,
     mnist_fc,
     resnet20_cifar,
     resnet50,
@@ -83,6 +84,7 @@ MODEL_REGISTRY = {
     "bert_tiny": (bert_tiny, "glue_tiny"),
     "llama3_8b": (llama3_8b, "lm_corpus"),
     "llama_tiny": (llama_tiny, "lm_tiny"),
+    "mfu_llama": (mfu_llama, "lm_mfu"),
 }
 
 LOSS_REGISTRY = {

@@ -204,6 +204,7 @@ def layerwise_robustness(
     data_axis: str = "data",
     compute_dtype=None,
     verbose: bool = True,
+    on_layer: Optional[Callable[[str, Dict[str, List[Dict]]], None]] = None,
 ) -> Dict[str, Dict[str, List[Dict]]]:
     """The full sweep: every prunable layer × every method (×
     ``runs_stochastic`` repeats for stochastic methods).
@@ -213,6 +214,11 @@ def layerwise_robustness(
     randomness — seed the metric with ``base_seed + run`` (zero-arg
     factories are accepted but make the repeats identical).  Returns
     ``results[layer][method] = [ {scores, loss, acc, auc, seconds}, ... ]``.
+
+    ``on_layer(layer, results[layer])`` fires after each layer's panel
+    completes — callers use it to checkpoint the multi-hour sweep so a
+    kill mid-run keeps the finished layers (bench.py's streamed
+    snapshots).  Callback errors are the caller's problem; keep it cheap.
     """
     import inspect
 
@@ -294,6 +300,8 @@ def layerwise_robustness(
                     f"({runs[0]['seconds']:.1f}s/run)",
                     flush=True,
                 )
+        if on_layer is not None:
+            on_layer(layer, results[layer])
     return results
 
 

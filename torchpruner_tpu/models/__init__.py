@@ -18,6 +18,7 @@ from torchpruner_tpu.models.llama import (
     llama_moe,
     llama_moe_tiny,
     llama_tiny,
+    mfu_llama,
 )
 
 __all__ = [
@@ -28,4 +29,5 @@ __all__ = [
     "vit", "vit_b16", "vit_tiny",
     "bert", "bert_base", "bert_tiny",
     "llama", "llama3_8b", "llama_moe", "llama_moe_tiny", "llama_tiny",
+    "mfu_llama",
 ]

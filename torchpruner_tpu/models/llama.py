@@ -131,6 +131,18 @@ def llama3_8b(seq_len: int = 2048) -> SegmentedModel:
     return llama(seq_len=seq_len)
 
 
+def mfu_llama(seq_len: int = 1024) -> SegmentedModel:
+    """~200M-param Llama (dim 1024 × depth 8, 32k vocab) whose FLOPs are
+    large MXU-shaped matmuls — the MFU-ceiling probe shared by bench.py's
+    ``mfu_llama`` leg and ``experiments.step_trace --model mfu_llama``
+    (one definition so the stopwatch and the trace profile the same
+    program)."""
+    return llama(
+        vocab_size=32000, dim=1024, depth=8, num_heads=8, num_kv_heads=8,
+        head_dim=128, ffn_dim=4096, seq_len=seq_len,
+    )
+
+
 def llama_tiny(
     *,
     vocab_size: int = 256,
