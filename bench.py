@@ -100,7 +100,7 @@ CPU_RESERVE_S = float(os.environ.get("BENCH_CPU_RESERVE_S", "600"))
 _LEG_EST_S = {
     "mnist_prune": (90, 520),
     "vgg16_train": (300, 3600),
-    "mfu_llama": (240, 3600),
+    "mfu_llama": (420, 3600),
     "llama_decode": (120, 220),
     "flash_attention": (240, 3600),
     "vgg16_robustness": (2400, 100000),
@@ -449,28 +449,63 @@ def _leg_mfu_llama(smoke: bool) -> dict:
         model, B = mfu_llama(), 8
     S = model.input_shape[0]
     rng = np.random.default_rng(0)
-    toks = jax.numpy.asarray(
-        rng.integers(0, 1000, size=(B, S)).astype("int32"))
+    peak = _peak_flops(jax.devices()[0])
+
+    # one Trainer for the whole sweep: params/opt-state are
+    # batch-independent (re-initializing ~200M params per batch size
+    # would waste a third of the leg's budget); jit recompiles the step
+    # per token shape either way
     trainer = Trainer.create(model, optax.adam(3e-4),
                              lm_cross_entropy_loss, seed=0,
                              compute_dtype=jax.numpy.bfloat16)
-    stats = time_fn(trainer.step, toks, toks, iters=10, warmup=3)
-    step_s = stats["p50_s"]
+
+    def measure(b):
+        toks = jax.numpy.asarray(
+            rng.integers(0, 1000, size=(b, S)).astype("int32"))
+        stats = time_fn(trainer.step, toks, toks, iters=10, warmup=3)
+        step_s = stats["p50_s"]
+        r = {
+            "ms": round(step_s * 1e3, 3),
+            "tokens_per_s_per_chip": round(b * S / step_s, 1),
+            "compile_s": round(stats["compile_s"], 2),
+        }
+        _, fwd_flops = model_cost(model, trainer.params, trainer.state,
+                                  batch_size=b)
+        r["mfu"] = (round((3.0 * fwd_flops / step_s) / peak, 4)
+                    if fwd_flops and peak else None)
+        r["_params"] = param_count(trainer.params)
+        return r
+
+    first = measure(B)
     out = {
-        "ms": round(step_s * 1e3, 3),
-        "tokens_per_s_per_chip": round(B * S / step_s, 1),
-        "params": param_count(trainer.params),
+        "ms": first["ms"],
+        "tokens_per_s_per_chip": first["tokens_per_s_per_chip"],
+        "params": first.pop("_params"),
         "shape": f"B{B} S{S}",
-        "compile_s": round(stats["compile_s"], 2),
+        "compile_s": first["compile_s"],
         "compute_dtype": "bfloat16",
+        "mfu": first["mfu"],
     }
-    peak = _peak_flops(jax.devices()[0])
-    _, fwd_flops = model_cost(model, trainer.params, trainer.state,
-                              batch_size=B)
-    if fwd_flops and peak:
-        out["mfu"] = round((3.0 * fwd_flops / step_s) / peak, 4)
-    else:
-        out["mfu"] = None
+    if not smoke and jax.devices()[0].platform == "tpu":
+        # MFU rises with arithmetic intensity until HBM runs out — sweep
+        # batch and surface the best configuration (the number the ≥35%
+        # target is judged on)
+        sweep = {str(B): {k: v for k, v in first.items()
+                          if not k.startswith("_")}}
+        for b in (16, 32):
+            try:
+                r = measure(b)
+            except Exception as e:  # noqa: BLE001 - OOM ends the sweep
+                sweep[str(b)] = {"error": f"{type(e).__name__}: {e}"[:200]}
+                break
+            r.pop("_params", None)
+            sweep[str(b)] = r
+        out["batch_sweep"] = sweep
+        best = max((v for v in sweep.values() if v.get("mfu")),
+                   key=lambda v: v["mfu"], default=None)
+        if best:
+            out["best_mfu"] = best["mfu"]
+            out["best_tokens_per_s_per_chip"] = best["tokens_per_s_per_chip"]
     return out
 
 
@@ -706,7 +741,9 @@ def main() -> dict:
             if isinstance(prev, dict) and prev.get("in_progress"):
                 # a crash late in a checkpointing leg must not discard the
                 # finished layers' data — merge the error into the partial
+                # (and drop the still-running flag: this entry is final)
                 err = {**prev, **err}
+                err.pop("in_progress", None)
             legs[name] = err
         # stderr progress so an orchestrator timeout still documents which
         # legs completed and where the time went (round-2 postmortem: a
@@ -944,7 +981,7 @@ def orchestrate() -> dict:
                 return sum(
                     1 for leg in r.get("legs", {}).values()
                     if isinstance(leg, dict) and "error" not in leg
-                    and "skipped" not in leg
+                    and "skipped" not in leg and "in_progress" not in leg
                 )
 
             if best_partial is None or n_ok(result) > n_ok(best_partial):

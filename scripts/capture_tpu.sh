@@ -38,7 +38,7 @@ import json, sys
 lines = [l for l in open(sys.argv[1]) if l.strip().startswith("{")]
 last = json.loads(lines[-1]) if lines else {}
 ok = (last.get("platform") == "tpu" and "stream" not in last
-      and last.get("value") is not None)
+      and "error" not in last and last.get("value") is not None)
 if ok:
     open(sys.argv[2], "w").write(lines[-1])
 sys.exit(0 if ok else 1)

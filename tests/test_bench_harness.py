@@ -168,6 +168,7 @@ def test_leg_crash_keeps_checkpointed_progress(bench, monkeypatch, capsys):
     leg = out["legs"]["mnist_prune"]
     assert "oom at layer 13" in leg["error"]
     assert leg["layers_done"] == 12 and leg["auc_so_far"] == {"sv": 0.3}
+    assert "in_progress" not in leg  # the entry is final, not running
 
 
 def test_assemble_headline_prefers_sweep_and_names_dataset(bench):
