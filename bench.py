@@ -399,23 +399,21 @@ def _leg_vgg_train(smoke: bool) -> dict:
     if not smoke and jax.devices()[0].platform == "tpu":
         # batch scaling: small 32x32 convs underfill the MXU at b256, so
         # sweep larger batches and surface the best-MFU configuration
-        sweep = {str(batch): {"ms": bf16["ms"], "mfu": bf16["mfu"],
-                              "img_per_s_per_chip":
-                                  bf16["img_per_s_per_chip"]}}
-        for b in (512, 1024):
+        def measure_at(b):
+            nonlocal x, y, batch
             x = jax.numpy.asarray(
                 rng.normal(size=(b, 32, 32, 3)).astype("float32"))
             y = jax.numpy.asarray(
                 rng.integers(0, 10, size=(b,)).astype("int32"))
             batch = b  # measure() closes over batch for img/s + MFU
-            try:
-                r = measure(jax.numpy.bfloat16)
-            except Exception as e:  # noqa: BLE001 - OOM ends the sweep
-                sweep[str(b)] = {"error": f"{type(e).__name__}: {e}"[:200]}
-                break
-            sweep[str(b)] = {"ms": r["ms"], "mfu": r["mfu"],
-                             "img_per_s_per_chip": r["img_per_s_per_chip"]}
-        out["batch_sweep"] = sweep
+            r = measure(jax.numpy.bfloat16)
+            return {"ms": r["ms"], "mfu": r["mfu"],
+                    "img_per_s_per_chip": r["img_per_s_per_chip"]}
+
+        seeded = {batch: {"ms": bf16["ms"], "mfu": bf16["mfu"],
+                          "img_per_s_per_chip": bf16["img_per_s_per_chip"]}}
+        sweep = _batch_sweep(measure_at, seeded, (512, 1024))
+        out["batch_sweep"] = {str(b): v for b, v in sweep.items()}
         best = max(
             (v for v in sweep.values() if v.get("mfu")),
             key=lambda v: v["mfu"], default=None,
@@ -423,6 +421,21 @@ def _leg_vgg_train(smoke: bool) -> dict:
         if best:
             out["best_mfu"] = best["mfu"]
     return out
+
+
+def _batch_sweep(measure, seeded: dict, batches) -> dict:
+    """Extend ``{batch: result}`` with ``measure(b)`` per extra batch
+    size (shared by the VGG and mfu_llama MFU sweeps).  A failure —
+    typically HBM OOM — records an error cell and ENDS the sweep: larger
+    batches would only fail harder."""
+    sweep = dict(seeded)
+    for b in batches:
+        try:
+            sweep[b] = measure(b)
+        except Exception as e:  # noqa: BLE001 - OOM ends the sweep
+            sweep[b] = {"error": f"{type(e).__name__}: {e}"[:200]}
+            break
+    return sweep
 
 
 def _leg_mfu_llama(smoke: bool) -> dict:
@@ -458,6 +471,7 @@ def _leg_mfu_llama(smoke: bool) -> dict:
     trainer = Trainer.create(model, optax.adam(3e-4),
                              lm_cross_entropy_loss, seed=0,
                              compute_dtype=jax.numpy.bfloat16)
+    params = param_count(trainer.params)
 
     def measure(b):
         toks = jax.numpy.asarray(
@@ -473,34 +487,21 @@ def _leg_mfu_llama(smoke: bool) -> dict:
                                   batch_size=b)
         r["mfu"] = (round((3.0 * fwd_flops / step_s) / peak, 4)
                     if fwd_flops and peak else None)
-        r["_params"] = param_count(trainer.params)
         return r
 
     first = measure(B)
     out = {
-        "ms": first["ms"],
-        "tokens_per_s_per_chip": first["tokens_per_s_per_chip"],
-        "params": first.pop("_params"),
+        **first,
+        "params": params,
         "shape": f"B{B} S{S}",
-        "compile_s": first["compile_s"],
         "compute_dtype": "bfloat16",
-        "mfu": first["mfu"],
     }
     if not smoke and jax.devices()[0].platform == "tpu":
         # MFU rises with arithmetic intensity until HBM runs out — sweep
         # batch and surface the best configuration (the number the ≥35%
         # target is judged on)
-        sweep = {str(B): {k: v for k, v in first.items()
-                          if not k.startswith("_")}}
-        for b in (16, 32):
-            try:
-                r = measure(b)
-            except Exception as e:  # noqa: BLE001 - OOM ends the sweep
-                sweep[str(b)] = {"error": f"{type(e).__name__}: {e}"[:200]}
-                break
-            r.pop("_params", None)
-            sweep[str(b)] = r
-        out["batch_sweep"] = sweep
+        sweep = _batch_sweep(measure, {B: first}, (16, 32))
+        out["batch_sweep"] = {str(b): v for b, v in sweep.items()}
         best = max((v for v in sweep.values() if v.get("mfu")),
                    key=lambda v: v["mfu"], default=None)
         if best:

@@ -219,7 +219,9 @@ def test_stream_child_kills_on_timeout(bench):
             "print(json.dumps({'metric':'m','value':1}), flush=True)\n"
             "time.sleep(60)\n")
     t0 = time.time()
-    rc, last, err = bench._stream_child([sys.executable, "-c", prog], 2.0,
+    # 8 s pre-kill budget: interpreter startup on the loaded 1-core box
+    # can exceed 2 s, and the snapshot must get out before the kill
+    rc, last, err = bench._stream_child([sys.executable, "-c", prog], 8.0,
                                         lambda c: c)
     assert time.time() - t0 < 30
     assert rc == -1

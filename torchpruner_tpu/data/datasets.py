@@ -30,6 +30,7 @@ DATASET_SHAPES = {
     # experiments (training + the layerwise-robustness sweep) can run on
     # a genuinely trained net in environments without the CIFAR files
     "digits32": ((32, 32, 3), 10),
+    "digits32_flat": ((3072,), 10),
 }
 
 #: fixed deterministic split of the 1,797 digits examples
@@ -250,12 +251,16 @@ def load_dataset(
     ds = _load_from_disk(name, split, dtype=np.float32)
     if ds is None and name in ("digits", "digits_flat"):
         ds = _load_digits(name, split)
-    if ds is None and name == "digits32":
+    if ds is None and name in ("digits32", "digits32_flat"):
         base = _load_digits("digits", split)
         if base is not None:
             x = np.kron(base.x, np.ones((1, 4, 4, 1), np.float32))
-            ds = Dataset(np.repeat(x, 3, axis=3), base.y,
-                         f"digits32:{split}")
+            x = np.repeat(x, 3, axis=3)
+            if name == "digits32_flat":
+                # CIFAR-10-FC geometry (3072 = 32*32*3,) on real scans —
+                # the vehicle for the reference's untrained CIFAR10-FC row
+                x = x.reshape(len(x), -1)
+            ds = Dataset(x, base.y, f"{name}:{split}")
     if ds is None:
         defaults = {"train": 50000, "val": 1000, "test": 10000}
         count = n or defaults.get(split, 1000)

@@ -421,7 +421,13 @@ def main(argv=None):
 
         jax.config.update("jax_platforms", "cpu")
 
-    runs = args.untrained or ["digits_fc:digits_flat"]
+    # default rows: the MNIST-FC analog on 64-d real scans AND the
+    # CIFAR10-FC analog (the reference's second untrained row) on the
+    # same scans at CIFAR-10 geometry (3072-d) — the exact architecture,
+    # params exactly the reference's 10,338,602
+    runs = args.untrained or [
+        "digits_fc:digits_flat", "cifar10_fc:digits32_flat"
+    ]
     if not args.untrained:
         for m, d in (("mnist_fc", "mnist_flat"), ("cifar10_fc", "cifar10_flat")):
             if _have_real(d):
@@ -432,7 +438,7 @@ def main(argv=None):
         if not _have_real(d):
             print(f"[parity] skipping {spec}: no real data", flush=True)
             continue
-        untrained[d] = run_untrained_prune_parity(m, d)
+        untrained[f"{m} on {d}"] = run_untrained_prune_parity(m, d)
 
     robustness = []
     if not args.skip_robustness:
