@@ -20,6 +20,11 @@
 
 extern "C" {
 
+// Bumped whenever an exported signature changes; the ctypes loader
+// refuses binaries whose version doesn't match (a stale build/ .so bound
+// with new argtypes would corrupt memory, not error).
+int32_t tp_abi_version() { return 2; }
+
 // splitmix64 (Steele et al.) — tiny, high-quality, trivially portable.
 static inline uint64_t splitmix64(uint64_t* s) {
   uint64_t z = (*s += 0x9E3779B97F4A7C15ULL);
@@ -76,14 +81,14 @@ void tp_gather_rows(const uint8_t* src, const int64_t* idx, int64_t batch,
 // Random horizontal flip + pad-and-crop augmentation on a float32 NHWC
 // batch (after the reference's RandomHorizontalFlip + RandomCrop(32,
 // padding=4), its cifar10.py:105-110) — fused: the padded intermediate is
-// never materialized, out-of-window pixels write zeros directly.
+// never materialized, out-of-window pixels write the fill value directly.
 //
-// Fill-value deviation from the reference: this kernel runs on
-// ALREADY-NORMALIZED data, so a 0 fill lands at the per-channel mean,
-// whereas the reference pads the RAW image with 0 before Normalize, so
-// its border pixels land at -mean/std (~ -2 sigma).  Distributionally
-// close, not bit-identical; callers needing the reference's exact border
-// statistics should augment before normalizing.
+// fill: per-channel border value, c floats, or nullptr for 0.  The kernel
+// runs on ALREADY-NORMALIZED data, where the reference pads the RAW image
+// with 0 BEFORE Normalize — its border pixels land at -mean/std.  Passing
+// fill = -mean/std therefore reproduces the reference's border statistics
+// exactly; a nullptr fill (0 = the per-channel mean) is the right value
+// for data that was scaled, not standardized (e.g. digits in [0, 1]).
 //
 // Determinism contract (mirrored bit-for-bit by the Python fallback):
 // example i draws from its own splitmix64 stream seeded
@@ -92,11 +97,19 @@ void tp_gather_rows(const uint8_t* src, const int64_t* idx, int64_t batch,
 // (y, x) reads the flipped source at (y + dy - pad, x + dx - pad).
 // Per-example streams make the result independent of thread count.
 void tp_augment_images(const float* src, int64_t n, int64_t h, int64_t w,
-                       int64_t c, int64_t pad, uint64_t seed, float* out,
-                       int32_t n_threads) {
+                       int64_t c, int64_t pad, uint64_t seed,
+                       const float* fill, float* out, int32_t n_threads) {
   const int64_t span = 2 * pad + 1;
   const int64_t row_elems = w * c;
   const int64_t img_elems = h * row_elems;
+  auto fill_row = [=](float* dst, int64_t n_px) {
+    if (!fill) {
+      std::memset(dst, 0, n_px * c * sizeof(float));
+      return;
+    }
+    for (int64_t p = 0; p < n_px; ++p)
+      for (int64_t ch = 0; ch < c; ++ch) dst[p * c + ch] = fill[ch];
+  };
   auto one = [=](int64_t i) {
     uint64_t s = seed ^ (0xD1B54A32D192ED03ULL * static_cast<uint64_t>(i + 1));
     const uint64_t flip = splitmix64(&s) & 1ULL;
@@ -108,14 +121,14 @@ void tp_augment_images(const float* src, int64_t n, int64_t h, int64_t w,
       float* orow = ot + y * row_elems;
       const int64_t sy = y + dy - pad;
       if (sy < 0 || sy >= h) {
-        std::memset(orow, 0, row_elems * sizeof(float));
+        fill_row(orow, w);
         continue;
       }
       const float* irow = im + sy * row_elems;
       for (int64_t x = 0; x < w; ++x) {
         int64_t sx = x + dx - pad;
         if (sx < 0 || sx >= w) {
-          std::memset(orow + x * c, 0, c * sizeof(float));
+          fill_row(orow + x * c, 1);
           continue;
         }
         if (flip) sx = w - 1 - sx;

@@ -107,6 +107,38 @@ def test_augment_native_matches_python_bitwise(lib):
     )
 
 
+def test_augment_fill_native_matches_python_and_reference_border(lib):
+    """fill=-mean/std reproduces the reference's pad-raw-then-Normalize
+    border pixels (its cifar10.py:105-110: RandomCrop(padding=4) runs on
+    the raw image, Normalize after — borders land at -mean/std)."""
+    from torchpruner_tpu.data.datasets import norm_zero
+
+    fill = norm_zero("cifar10")
+    np.testing.assert_allclose(
+        fill, -np.array([0.485, 0.456, 0.406]) / [0.229, 0.224, 0.225],
+        rtol=1e-6)
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(40, 12, 12, 3)).astype(np.float32)
+    for seed in (0, 77):
+        got = native.augment_batch(x, seed, fill=fill)
+        np.testing.assert_array_equal(
+            got, native._augment_numpy(x, seed, pad=4, fill=fill))
+    # pad-then-normalize commutes with normalize-then-pad-with(-mean/std):
+    # augmenting raw data then normalizing == normalizing then augmenting
+    # with the norm_zero fill, for the same seed (bit-exact draws)
+    mean = np.array([0.485, 0.456, 0.406], np.float32)
+    std = np.array([0.229, 0.224, 0.225], np.float32)
+    raw = rng.random(size=(16, 8, 8, 3)).astype(np.float32)
+    a = (native.augment_batch(raw, seed=3) - mean) / std
+    b = native.augment_batch((raw - mean) / std, seed=3, fill=fill)
+    np.testing.assert_allclose(a, b, atol=1e-5)
+    # scalar fill broadcasts; wrong channel count raises
+    one = native.augment_batch(x, 1, fill=0.5)
+    assert one.shape == x.shape
+    with pytest.raises(ValueError):
+        native.augment_batch(x, 1, fill=[1.0, 2.0])
+
+
 def test_augment_semantics():
     rng = np.random.default_rng(3)
     x = rng.normal(size=(64, 8, 8, 1)).astype(np.float32)

@@ -14,6 +14,7 @@ _x.npy`` / ``_y.npy`` files) — the loader interface is identical either way.
 from torchpruner_tpu.data.datasets import (
     Dataset,
     load_dataset,
+    norm_zero,
     synthetic_dataset,
     synthetic_token_dataset,
 )
@@ -28,6 +29,7 @@ from torchpruner_tpu.data.native import (
 __all__ = [
     "Dataset",
     "load_dataset",
+    "norm_zero",
     "synthetic_dataset",
     "synthetic_token_dataset",
     "native_available",

@@ -36,6 +36,32 @@ DATASET_SHAPES = {
 #: fixed deterministic split of the 1,797 digits examples
 _DIGITS_SPLIT = {"train": (0, 1297), "val": (1297, 1497), "test": (1497, 1797)}
 
+def norm_zero(name: str) -> Optional[np.ndarray]:
+    """Where a raw-zero pixel lands after ``name``'s normalization:
+    ``-mean/std`` per channel, or None when the dataset is not
+    standardized (0 is already the raw-zero value).
+
+    Stats come from the one place that defines the on-disk normalization
+    (data/prepare.py — reference experiments/models/mnist.py:56-60,
+    cifar10.py:104-110).  Only image datasets prepare.py standardizes
+    appear; flat variants are omitted (augmentation passes non-4D data
+    through untouched), and so are scaled-only sets like digits.
+
+    This is the border fill that makes post-normalization augmentation
+    (:func:`~torchpruner_tpu.data.native.augment_batch`) bit-match the
+    reference's pad-raw-then-Normalize order (its cifar10.py:105-110
+    RandomCrop runs before Normalize)."""
+    from torchpruner_tpu.data import prepare
+
+    stats = {
+        "mnist": ((prepare.MNIST_MEAN,), (prepare.MNIST_STD,)),
+        "cifar10": (prepare.IMAGENET_MEAN, prepare.IMAGENET_STD),
+    }.get(name)
+    if stats is None:
+        return None
+    mean, std = (np.asarray(v, np.float32) for v in stats)
+    return -mean / std
+
 #: (seq_len, vocab_size, n_classes) — token datasets; ``n_classes=None``
 #: marks language-modeling data (targets = inputs, next-token loss).
 TOKEN_DATASET_SHAPES = {

@@ -33,12 +33,27 @@ def _load_library(build: bool = True):
         return _lib
     _lib_tried = True
     try:
-        if not os.path.exists(_LIB_PATH) and build:
+        if build:
+            # unconditional: make's dependency check makes this a no-op
+            # when build/ is fresh, and REBUILDS a .so left behind by an
+            # older source (a stale binary bound with current argtypes
+            # would corrupt memory, not error)
             subprocess.run(
                 ["make", "-C", os.path.abspath(_CPP_DIR)],
-                check=True, capture_output=True, timeout=120,
+                check=not os.path.exists(_LIB_PATH),
+                capture_output=True, timeout=120,
             )
         lib = ctypes.CDLL(_LIB_PATH)
+        # belt and braces for make-less environments: refuse any binary
+        # whose exported ABI version doesn't match these bindings
+        try:
+            lib.tp_abi_version.restype = ctypes.c_int32
+            abi = int(lib.tp_abi_version())
+        except AttributeError:
+            abi = 1
+        if abi != 2:
+            _lib = None
+            return None
         lib.tp_shuffle_indices.argtypes = [
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_uint64,
         ]
@@ -49,7 +64,8 @@ def _load_library(build: bool = True):
         lib.tp_augment_images.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_uint64, ctypes.c_void_p, ctypes.c_int32,
+            ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int32,
         ]
         _lib = lib
     except (OSError, subprocess.SubprocessError):
@@ -159,16 +175,22 @@ def _augment_draws(n: int, seed: int, pad: int):
     )
 
 
-def _augment_numpy(x: np.ndarray, seed: int, pad: int) -> np.ndarray:
+def _augment_numpy(x: np.ndarray, seed: int, pad: int,
+                   fill=None) -> np.ndarray:
     """The pure-numpy augmentation path — same draws, flip-then-pad-crop
     semantics as the native kernel (the bitwise-parity test compares the
     kernel against exactly this function)."""
-    n, h, w, _ = x.shape
+    n, h, w, c = x.shape
     flip, dy, dx = _augment_draws(n, seed, pad)
     x = np.where(flip[:, None, None, None], x[:, :, ::-1, :], x)
-    padded = np.pad(
-        x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="constant"
-    )
+    if fill is None:
+        padded = np.pad(
+            x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="constant"
+        )
+    else:
+        padded = np.empty((n, h + 2 * pad, w + 2 * pad, c), np.float32)
+        padded[:] = np.asarray(fill, np.float32)
+        padded[:, pad:pad + h, pad:pad + w, :] = x
     rows = dy[:, None] + np.arange(h)[None, :]
     cols = dx[:, None] + np.arange(w)[None, :]
     return padded[np.arange(n)[:, None, None], rows[:, :, None],
@@ -176,7 +198,7 @@ def _augment_numpy(x: np.ndarray, seed: int, pad: int) -> np.ndarray:
 
 
 def augment_batch(x: np.ndarray, seed: int, pad: int = 4,
-                  n_threads: int = 4) -> np.ndarray:
+                  n_threads: int = 4, fill=None) -> np.ndarray:
     """Random horizontal flip + ``pad``-pixel shift-and-crop on a
     channels-last float32 image batch (after the reference's
     RandomHorizontalFlip + RandomCrop(32, padding=4), its
@@ -184,15 +206,26 @@ def augment_batch(x: np.ndarray, seed: int, pad: int = 4,
     padded intermediate), identical-output numpy fallback otherwise;
     non-image (non-4D) inputs pass through unchanged.
 
-    Out-of-window pixels are filled with 0.  On the normalized tensors
-    this pipeline feeds, that is the per-channel mean — the reference
-    instead pads the RAW image before Normalize, putting its borders at
-    ``-mean/std``.  Distributionally close, not bit-identical (see
-    cpp/data_pipeline.cc)."""
+    ``fill`` sets the per-channel border value (length-``c`` vector, or
+    None for 0).  This function runs AFTER normalization, whereas the
+    reference pads the raw image with 0 BEFORE Normalize — so its border
+    pixels sit at ``-mean/std``.  Pass ``fill=-mean/std``
+    (:func:`~torchpruner_tpu.data.datasets.norm_zero` knows the standard
+    datasets' values) to reproduce the reference's border statistics
+    exactly; leave None for data that was scaled, not standardized
+    (digits in [0, 1]), where 0 IS the raw-zero image value."""
     if x.ndim != 4:
         return x
     x = np.ascontiguousarray(x, dtype=np.float32)
     n, h, w, c = x.shape
+    if fill is not None:
+        fill = np.ascontiguousarray(fill, dtype=np.float32).reshape(-1)
+        if fill.size == 1:
+            fill = np.repeat(fill, c)
+        if fill.size != c:
+            raise ValueError(
+                f"fill has {fill.size} channels, images have {c}"
+            )
     lib = _load_library()
     if lib is not None:
         out = np.empty_like(x)
@@ -200,10 +233,11 @@ def augment_batch(x: np.ndarray, seed: int, pad: int = 4,
             ctypes.c_void_p(x.ctypes.data), ctypes.c_int64(n),
             ctypes.c_int64(h), ctypes.c_int64(w), ctypes.c_int64(c),
             ctypes.c_int64(pad), ctypes.c_uint64(seed & _M),
+            ctypes.c_void_p(0 if fill is None else fill.ctypes.data),
             ctypes.c_void_p(out.ctypes.data), ctypes.c_int32(n_threads),
         )
         return out
-    return _augment_numpy(x, seed, pad)
+    return _augment_numpy(x, seed, pad, fill)
 
 
 def prefetch_batches(

@@ -54,11 +54,16 @@ def epoch_batches(dataset, cfg: ExperimentConfig, epoch: int):
     if not cfg.augment:
         yield from stream
         return
+    # border fill -mean/std where the dataset is standardized: matches the
+    # reference's pad-raw-then-Normalize border statistics exactly
+    from torchpruner_tpu.data.datasets import norm_zero
+
+    fill = norm_zero(cfg.dataset)
     for b, (x, y) in enumerate(stream):
         # per-batch seed, same splitmix64 contract on both the native and
         # numpy augmentation paths — epoch streams are bit-reproducible
         # regardless of which one is in play
-        yield augment_batch(x, seed=seed * 1_000_003 + b), y
+        yield augment_batch(x, seed=seed * 1_000_003 + b, fill=fill), y
 
 
 def run_train(
