@@ -1,0 +1,199 @@
+"""Per-leg on-chip bench capture with hard timeouts and a tunnel watcher.
+
+Why this exists: ``bench.py``'s orchestrator runs all six legs inside ONE
+child process.  When the axon tunnel dies MID-LEG, the in-flight RPC never
+returns — the child can't be interrupted from inside (the hang is in
+device code, not Python), so one wedged leg burns the whole budget
+(round-4 postmortem: ``vgg16_train`` sat 33 min at 0 CPU with the tunnel
+dead under it; the round-3 run produced nothing the same way).
+
+This runner gives each leg its OWN process and a hard kill timeout,
+probes the tunnel between legs (a dead tunnel skips the rest instead of
+wedging), and merges every finished leg into ``bench_tpu_last.json``
+(via :func:`bench._write_tpu_cache`'s carry-forward semantics) plus a
+``results/``-quality artifact — so evidence lands leg by leg, not
+all-or-nothing.
+
+Usage::
+
+    python scripts/run_tpu_legs.py                  # capture now (probe first)
+    python scripts/run_tpu_legs.py --watch 8        # probe every 2 min for
+                                                    # up to 8 h, capture when
+                                                    # the tunnel answers
+    python scripts/run_tpu_legs.py --legs mfu_llama,llama_decode
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402  (the leg functions + cache merge live there)
+
+#: capture order: cheap, high-information legs first so a tunnel drop
+#: mid-capture keeps the most evidence per minute; hard per-leg kill
+#: timeouts sized ~4x the round-2 cold-run observations.
+LEGS = [
+    ("mnist_prune", 600),
+    ("mfu_llama", 2400),
+    ("llama_decode", 1200),
+    ("flash_attention", 1800),
+    ("vgg16_train", 2400),
+    ("vgg16_robustness", 14400),
+]
+
+_CHILD_SRC = r"""
+import json, sys
+sys.path.insert(0, {repo!r})
+import bench
+from torchpruner_tpu.utils.compilation_cache import enable_persistent_cache
+enable_persistent_cache()
+import inspect
+fn = getattr(bench, "_leg_" + {fn_suffix!r})
+kw = {{}}
+if "progress" in inspect.signature(fn).parameters:
+    def _progress(partial):
+        print("LEGPART " + json.dumps(partial), flush=True)
+    kw["progress"] = _progress
+print("LEGJSON " + json.dumps(fn(False, **kw)), flush=True)
+"""
+
+#: leg name -> the bench module's function suffix
+_FN = {
+    "mnist_prune": "mnist",
+    "vgg16_robustness": "vgg_robustness",
+    "vgg16_train": "vgg_train",
+    "mfu_llama": "mfu_llama",
+    "flash_attention": "flash_attention",
+    "llama_decode": "llama_decode",
+}
+
+
+def probe(timeout_s: float = 75) -> str | None:
+    """Device kind if the tunnel answers within ``timeout_s``, else None."""
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices()[0]; "
+             "assert d.platform == 'tpu', d; "
+             "print(getattr(d, 'device_kind', 'tpu'))"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        return p.stdout.strip() if p.returncode == 0 else None
+    except subprocess.TimeoutExpired:
+        return None
+
+
+def run_leg(name: str, timeout_s: float) -> dict:
+    """One leg in its own process; returns the leg dict (an ``error``
+    entry on kill/crash, with the last checkpointed partial if any)."""
+    src = _CHILD_SRC.format(repo=REPO, fn_suffix=_FN[name])
+    t0 = time.time()
+    proc = subprocess.Popen([sys.executable, "-u", "-c", src],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True)
+    final, partial = None, None
+    killed = False
+    import threading
+
+    def _kill():
+        nonlocal killed
+        killed = True
+        proc.kill()
+
+    timer = threading.Timer(timeout_s, _kill)
+    timer.start()
+    try:
+        for line in proc.stdout:
+            # a line truncated by the hard kill must not crash the
+            # capture loop — the whole point is salvaging earlier legs
+            try:
+                if line.startswith("LEGJSON "):
+                    final = json.loads(line[8:])
+                elif line.startswith("LEGPART "):
+                    partial = json.loads(line[8:])
+            except json.JSONDecodeError:
+                pass
+    finally:
+        timer.cancel()
+    rc = proc.wait()
+    if final is not None:
+        return final
+    err = {"error": (f"leg killed after {timeout_s:.0f}s (tunnel wedge?)"
+                     if killed else f"leg child died rc={rc}"),
+           "elapsed_s": round(time.time() - t0, 1)}
+    if isinstance(partial, dict):  # keep checkpointed layers from a kill
+        err = {**partial, **err}
+        err.pop("in_progress", None)
+    return err
+
+
+def capture(leg_names, device_kind: str) -> dict:
+    stamp = time.strftime("%Y-%m-%d_%H%M", time.gmtime())
+    commit = bench._git_commit()
+    out_path = os.path.join(
+        REPO, "results", f"bench_tpu_{stamp}_{commit}.json")
+    legs: dict = {}
+    for name, timeout_s in leg_names:
+        if probe() is None:
+            legs[name] = {"skipped": "tunnel down at leg start"}
+            print(f"[legs] {name}: tunnel down, skipping", flush=True)
+            continue
+        print(f"[legs] {name} starting (timeout {timeout_s}s)", flush=True)
+        t0 = time.time()
+        legs[name] = run_leg(name, timeout_s)
+        status = "error" if "error" in legs[name] else "ok"
+        print(f"[legs] {name} {status} in {time.time() - t0:.0f}s",
+              flush=True)
+        # merge + persist after EVERY leg: a later wedge keeps earlier wins
+        result = bench._assemble(legs, "tpu", device_kind, None, False)
+        result["capture"] = "per-leg (scripts/run_tpu_legs.py)"
+        bench._write_tpu_cache(result)
+        with open(out_path, "w") as f:
+            json.dump({
+                "measured_at": time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                "git_commit": commit,
+                "device_kind": device_kind,
+                "result": result,
+            }, f, indent=1)
+    return legs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--legs", default=None,
+                    help="comma-separated subset (default: all six)")
+    ap.add_argument("--watch", type=float, default=0, metavar="HOURS",
+                    help="probe every --interval until the tunnel answers, "
+                         "for up to HOURS; 0 = probe once and exit if down")
+    ap.add_argument("--interval", type=float, default=120)
+    args = ap.parse_args(argv)
+    wanted = ([(n, t) for n, t in LEGS
+               if n in set(args.legs.split(","))] if args.legs else LEGS)
+    deadline = time.time() + args.watch * 3600
+    while True:
+        kind = probe()
+        if kind:
+            print(f"[legs] tunnel up ({kind})", flush=True)
+            legs = capture(wanted, kind)
+            ok = sum(1 for v in legs.values()
+                     if "error" not in v and "skipped" not in v)
+            print(f"[legs] done: {ok}/{len(wanted)} legs ok", flush=True)
+            return 0 if ok else 1
+        if time.time() >= deadline:
+            print("[legs] tunnel down, watch window over", flush=True)
+            return 2
+        print("[legs] tunnel down, waiting...", flush=True)
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,11 +38,17 @@ def _load_library(build: bool = True):
             # when build/ is fresh, and REBUILDS a .so left behind by an
             # older source (a stale binary bound with current argtypes
             # would corrupt memory, not error)
-            subprocess.run(
-                ["make", "-C", os.path.abspath(_CPP_DIR)],
-                check=not os.path.exists(_LIB_PATH),
-                capture_output=True, timeout=120,
-            )
+            try:
+                subprocess.run(
+                    ["make", "-C", os.path.abspath(_CPP_DIR)],
+                    check=not os.path.exists(_LIB_PATH),
+                    capture_output=True, timeout=120,
+                )
+            except FileNotFoundError:
+                # make-less environment: a prebuilt .so may still be
+                # loadable — the ABI check below refuses a stale one
+                if not os.path.exists(_LIB_PATH):
+                    raise
         lib = ctypes.CDLL(_LIB_PATH)
         # belt and braces for make-less environments: refuse any binary
         # whose exported ABI version doesn't match these bindings
