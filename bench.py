@@ -23,8 +23,11 @@ Six legs (baselines from BASELINE.md where the reference has one):
    path: grad-step time and compiled temp memory at S=2048 (the O(S·Dh)
    vs O(S²) backward-memory claim, measured).
 5. ``llama_decode`` — KV-cache decode throughput (tokens/s) through
-   ``generate`` (no reference baseline; the reference has no inference
-   loop).  Also runs on the CPU fallback (it is CPU-sized).
+   ``generate``, dense vs after a 25% FFN-channel structured prune
+   (example 04's serving flow; no reference baseline — the reference has
+   no inference loop).  On TPU the model is the ~200M ``mfu_llama``
+   (decode reads every param per token: an HBM-bound, serving-shaped
+   number); the CPU fallback keeps the CPU-sized ``llama_tiny``.
 6. ``mfu_llama`` — train-step MFU on a ~200M-param Llama whose FLOPs are
    large MXU-shaped matmuls: the machinery's MFU ceiling, next to the
    conv-bound VGG16 number.
@@ -101,7 +104,7 @@ _LEG_EST_S = {
     "mnist_prune": (90, 520),
     "vgg16_train": (300, 3600),
     "mfu_llama": (420, 3600),
-    "llama_decode": (120, 220),
+    "llama_decode": (600, 300),
     "flash_attention": (240, 3600),
     "vgg16_robustness": (2400, 100000),
 }
@@ -551,19 +554,28 @@ def _leg_flash_attention(smoke: bool) -> dict:
 
 
 def _leg_llama_decode(smoke: bool) -> dict:
-    """KV-cache decode throughput (tokens/s) on the llama family — the
-    serving-path number for pruned LMs (no reference baseline; the
-    reference has no inference loop)."""
+    """KV-cache decode throughput (tokens/s) on the llama family, dense
+    AND after a 25% FFN-channel prune (example 04's serving flow) — the
+    speedup structured pruning actually buys at decode time (no
+    reference baseline; the reference has no inference loop)."""
     import jax
     import numpy as np
 
     from torchpruner_tpu.core.segment import init_model
     from torchpruner_tpu.generate import generate
-    from torchpruner_tpu.models import llama_tiny
+    from torchpruner_tpu.models import llama_tiny, mfu_llama
 
-    model = llama_tiny()
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if smoke:
+        model, B, S, n_new = llama_tiny(), 2, 8, 16
+    elif on_tpu:
+        # serving-scale: a ~200M-param model's decode is HBM-bound (reads
+        # all params per token) — the number that means something; the
+        # 35k-param tiny model only measures per-step launch overhead
+        model, B, S, n_new = mfu_llama(), 8, 64, 128
+    else:
+        model, B, S, n_new = llama_tiny(), 8, 64, 128
     params, _ = init_model(model, seed=0)
-    B, S, n_new = (2, 8, 16) if smoke else (8, 64, 128)
     prompt = np.asarray(
         jax.random.randint(jax.random.PRNGKey(0), (B, S), 0, 256), np.int32
     )
@@ -581,9 +593,11 @@ def _leg_llama_decode(smoke: bool) -> dict:
         "gen_tokens_per_s": round(B * n_new / steady, 1),
         "steady_s": round(steady, 3),
         "first_call_s": round(compile_and_first, 2),
+        "model": ("mfu_llama (~200M)" if not smoke and on_tpu
+                  else "llama_tiny"),
         "shape": f"B{B} prompt{S} new{n_new}",
     }
-    if not smoke and jax.devices()[0].platform == "tpu":
+    if not smoke and on_tpu:
         # bf16 KV cache: the serving configuration (half the cache bytes;
         # decode is HBM-bandwidth-bound so it reads half as much).  TPU
         # only — the extra compile buys nothing on the CPU fallback.
@@ -597,6 +611,34 @@ def _leg_llama_decode(smoke: bool) -> dict:
         steady16 = time.perf_counter() - t0
         result["gen_tokens_per_s_bf16_cache"] = round(
             B * n_new / steady16, 1)
+    # post-prune serving (example 04's flow, scoring cost excluded):
+    # weight_norm-score every block's FFN channels, prune the lowest 25%,
+    # decode at the pruned shapes — the structured-prune decode payoff
+    from torchpruner_tpu.attributions import WeightNormAttributionMetric
+    from torchpruner_tpu.core.graph import pruning_graph
+    from torchpruner_tpu.core.pruner import prune_by_scores
+    from torchpruner_tpu.utils.flops import param_count
+    from torchpruner_tpu.utils.losses import lm_cross_entropy_loss
+
+    params_before = param_count(params)
+    pm, pp, ps = model, params, None
+    for g in pruning_graph(model):
+        if not g.target.endswith("/gate"):  # FFN hidden channels only
+            continue
+        scores = WeightNormAttributionMetric(
+            pm, pp, [], lm_cross_entropy_loss).run(g.target)
+        res = prune_by_scores(pm, pp, g.target, scores,
+                              policy="fraction", fraction=0.25, state=ps)
+        pm, pp, ps = res.model, res.params, res.state
+    jax.block_until_ready(generate(pm, pp, prompt, n_new))  # compile
+    t0 = time.perf_counter()
+    jax.block_until_ready(generate(pm, pp, prompt, n_new))
+    steady_pruned = time.perf_counter() - t0
+    result["pruned_ffn_fraction"] = 0.25
+    result["params_before"] = params_before
+    result["params_after"] = param_count(pp)
+    result["gen_tokens_per_s_pruned"] = round(B * n_new / steady_pruned, 1)
+    result["prune_decode_speedup"] = round(steady / steady_pruned, 3)
     return result
 
 
@@ -1013,32 +1055,38 @@ def orchestrate() -> dict:
     return out
 
 
-def _write_tpu_cache(result: dict) -> None:
-    """Refresh the last-known-TPU cache, CARRYING forward cached legs this
-    run skipped or didn't reach (a budget-capped driver run that skips the
-    2400 s sweep must not erase a previously-captured sweep — each carried
-    leg is labelled with the commit/timestamp it was measured at)."""
-    merged = dict(result)
+def _merge_cached_legs(legs: dict) -> dict:
+    """``legs`` extended with previously-cached TPU legs this run skipped
+    or didn't reach (a budget-capped run that skips the 2400 s sweep must
+    not erase a previously-captured sweep) — each carried leg labelled
+    with the commit/timestamp it was measured at.  Shared by the cache
+    writer below and the per-leg capture runner, so a SUBSET capture's
+    headline is assembled from the merged set, not just this run's legs."""
+    merged = dict(legs)
     try:
         with open(TPU_CACHE) as f:
             old = json.load(f)
-        old_legs = old.get("result", {}).get("legs", {})
-        legs = dict(merged.get("legs", {}))
-        for name, leg in old_legs.items():
-            cur = legs.get(name)
+        for name, leg in old.get("result", {}).get("legs", {}).items():
+            cur = merged.get(name)
             cur_ok = isinstance(cur, dict) and "error" not in cur \
                 and "skipped" not in cur
             if cur_ok or not isinstance(leg, dict) or "error" in leg \
                     or "skipped" in leg:
                 continue
-            legs[name] = dict(leg)
-            legs[name].setdefault("carried_from", {
+            merged[name] = dict(leg)
+            merged[name].setdefault("carried_from", {
                 "git_commit": old.get("git_commit"),
                 "measured_at": old.get("measured_at"),
             })
-        merged["legs"] = legs
     except (OSError, json.JSONDecodeError):
         pass
+    return merged
+
+
+def _write_tpu_cache(result: dict) -> None:
+    """Refresh the last-known-TPU cache with carried-forward legs."""
+    merged = dict(result)
+    merged["legs"] = _merge_cached_legs(merged.get("legs", {}))
     try:
         with open(TPU_CACHE, "w") as f:
             json.dump({

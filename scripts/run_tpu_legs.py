@@ -43,7 +43,7 @@ import bench  # noqa: E402  (the leg functions + cache merge live there)
 LEGS = [
     ("mnist_prune", 600),
     ("mfu_llama", 2400),
-    ("llama_decode", 1200),
+    ("llama_decode", 1800),
     ("flash_attention", 1800),
     ("vgg16_train", 2400),
     ("vgg16_robustness", 14400),
@@ -93,15 +93,18 @@ def probe(timeout_s: float = 75) -> str | None:
 
 def run_leg(name: str, timeout_s: float) -> dict:
     """One leg in its own process; returns the leg dict (an ``error``
-    entry on kill/crash, with the last checkpointed partial if any)."""
+    entry on kill/crash, with the last checkpointed partial and a stderr
+    tail for the postmortem)."""
+    import threading
+    from collections import deque
+
     src = _CHILD_SRC.format(repo=REPO, fn_suffix=_FN[name])
     t0 = time.time()
     proc = subprocess.Popen([sys.executable, "-u", "-c", src],
                             stdout=subprocess.PIPE,
-                            stderr=subprocess.DEVNULL, text=True)
+                            stderr=subprocess.PIPE, text=True)
     final, partial = None, None
     killed = False
-    import threading
 
     def _kill():
         nonlocal killed
@@ -110,6 +113,14 @@ def run_leg(name: str, timeout_s: float) -> dict:
 
     timer = threading.Timer(timeout_s, _kill)
     timer.start()
+    err_tail: deque = deque(maxlen=8)
+
+    def _pump_stderr():
+        for line in proc.stderr:
+            err_tail.append(line[:400])
+
+    pump = threading.Thread(target=_pump_stderr, daemon=True)
+    pump.start()
     try:
         for line in proc.stdout:
             # a line truncated by the hard kill must not crash the
@@ -117,32 +128,41 @@ def run_leg(name: str, timeout_s: float) -> dict:
             try:
                 if line.startswith("LEGJSON "):
                     final = json.loads(line[8:])
+                    # result in hand: don't wait out a child that wedges
+                    # during teardown over a dead tunnel
+                    break
                 elif line.startswith("LEGPART "):
                     partial = json.loads(line[8:])
             except json.JSONDecodeError:
                 pass
     finally:
         timer.cancel()
+    if proc.poll() is None:
+        proc.kill()
     rc = proc.wait()
+    pump.join(timeout=5)
     if final is not None:
         return final
     err = {"error": (f"leg killed after {timeout_s:.0f}s (tunnel wedge?)"
                      if killed else f"leg child died rc={rc}"),
-           "elapsed_s": round(time.time() - t0, 1)}
+           "elapsed_s": round(time.time() - t0, 1),
+           "stderr_tail": "".join(err_tail)[-1200:]}
     if isinstance(partial, dict):  # keep checkpointed layers from a kill
         err = {**partial, **err}
         err.pop("in_progress", None)
     return err
 
 
-def capture(leg_names, device_kind: str) -> dict:
+def capture(leg_names, device_kind: str, just_probed: bool = False) -> dict:
     stamp = time.strftime("%Y-%m-%d_%H%M", time.gmtime())
     commit = bench._git_commit()
     out_path = os.path.join(
         REPO, "results", f"bench_tpu_{stamp}_{commit}.json")
     legs: dict = {}
-    for name, timeout_s in leg_names:
-        if probe() is None:
+    for i, (name, timeout_s) in enumerate(leg_names):
+        # the caller's successful probe covers the first leg — don't pay
+        # (or flakily fail) a second back-to-back probe round trip
+        if not (i == 0 and just_probed) and probe() is None:
             legs[name] = {"skipped": "tunnel down at leg start"}
             print(f"[legs] {name}: tunnel down, skipping", flush=True)
             continue
@@ -152,8 +172,11 @@ def capture(leg_names, device_kind: str) -> dict:
         status = "error" if "error" in legs[name] else "ok"
         print(f"[legs] {name} {status} in {time.time() - t0:.0f}s",
               flush=True)
-        # merge + persist after EVERY leg: a later wedge keeps earlier wins
-        result = bench._assemble(legs, "tpu", device_kind, None, False)
+        # merge + persist after EVERY leg: a later wedge keeps earlier
+        # wins, and the headline assembles from current + carried legs so
+        # a subset capture never nulls out a previously-captured headline
+        merged = bench._merge_cached_legs(legs)
+        result = bench._assemble(merged, "tpu", device_kind, None, False)
         result["capture"] = "per-leg (scripts/run_tpu_legs.py)"
         bench._write_tpu_cache(result)
         with open(out_path, "w") as f:
@@ -162,6 +185,7 @@ def capture(leg_names, device_kind: str) -> dict:
                     "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
                 "git_commit": commit,
                 "device_kind": device_kind,
+                "legs_this_run": sorted(legs),
                 "result": result,
             }, f, indent=1)
     return legs
@@ -176,14 +200,21 @@ def main(argv=None) -> int:
                          "for up to HOURS; 0 = probe once and exit if down")
     ap.add_argument("--interval", type=float, default=120)
     args = ap.parse_args(argv)
-    wanted = ([(n, t) for n, t in LEGS
-               if n in set(args.legs.split(","))] if args.legs else LEGS)
+    if args.legs:
+        known = {n for n, _ in LEGS}
+        requested = args.legs.split(",")
+        bad = [n for n in requested if n not in known]
+        if bad:  # fail FAST — not after an hours-long watch window
+            ap.error(f"unknown legs {bad}; choose from {sorted(known)}")
+        wanted = [(n, t) for n, t in LEGS if n in set(requested)]
+    else:
+        wanted = LEGS
     deadline = time.time() + args.watch * 3600
     while True:
         kind = probe()
         if kind:
             print(f"[legs] tunnel up ({kind})", flush=True)
-            legs = capture(wanted, kind)
+            legs = capture(wanted, kind, just_probed=True)
             ok = sum(1 for v in legs.values()
                      if "error" not in v and "skipped" not in v)
             print(f"[legs] done: {ok}/{len(wanted)} legs ok", flush=True)
