@@ -613,7 +613,11 @@ def _leg_llama_decode(smoke: bool) -> dict:
             B * n_new / steady16, 1)
     # post-prune serving (example 04's flow, scoring cost excluded):
     # weight_norm-score every block's FFN channels, prune the lowest 25%,
-    # decode at the pruned shapes — the structured-prune decode payoff
+    # decode at the pruned shapes — the structured-prune decode payoff.
+    # Not in smoke: the extra prune + generate compiles buy no validation
+    # the quant/pruner test files don't already provide.
+    if smoke:
+        return result
     from torchpruner_tpu.attributions import WeightNormAttributionMetric
     from torchpruner_tpu.core.graph import pruning_graph
     from torchpruner_tpu.core.pruner import prune_by_scores
@@ -639,6 +643,23 @@ def _leg_llama_decode(smoke: bool) -> dict:
     result["params_after"] = param_count(pp)
     result["gen_tokens_per_s_pruned"] = round(B * n_new / steady_pruned, 1)
     result["prune_decode_speedup"] = round(steady / steady_pruned, 3)
+    if not smoke and on_tpu:
+        # int8 weight-only serving (ops/quant.py): decode reads every
+        # param per token, so halving weight bytes vs bf16 is the lever —
+        # measured on the dense model AND the full prune->quantize deploy
+        from torchpruner_tpu.ops.quant import quantize_params
+
+        steady_q = {}
+        for tag, (m_, p_) in (("int8", (model, params)),
+                              ("pruned_int8", (pm, pp))):
+            qp = quantize_params(m_, p_)
+            jax.block_until_ready(generate(m_, qp, prompt, n_new))
+            t0 = time.perf_counter()
+            jax.block_until_ready(generate(m_, qp, prompt, n_new))
+            steady_q[tag] = time.perf_counter() - t0
+            result[f"gen_tokens_per_s_{tag}"] = round(
+                B * n_new / steady_q[tag], 1)
+        result["int8_decode_speedup"] = round(steady / steady_q["int8"], 3)
     return result
 
 

@@ -98,6 +98,14 @@ def main():
     print(f"pruned model: {np.asarray(out_pruned)[0].tolist()}")
     print(f"dense model:  {np.asarray(out_dense)[0].tolist()}")
 
+    # deploy step: int8 weight-only quantization (decode reads every
+    # param per generated token — on TPU the weight bytes are the
+    # bottleneck, and int8 halves them vs bf16).  Quantize AFTER
+    # pruning; generation runs directly on the QTensor params.
+    qparams = tp.quantize_params(trainer.model, trainer.params)
+    out_q = tp.generate(trainer.model, qparams, prompt, 16)
+    print(f"pruned+int8:  {np.asarray(out_q)[0].tolist()}")
+
 
 if __name__ == "__main__":
     main()

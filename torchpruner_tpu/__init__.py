@@ -44,6 +44,11 @@ from torchpruner_tpu.generate import (
     init_cache,
     make_decode_step,
 )
+from torchpruner_tpu.ops.quant import (
+    QTensor,
+    dequantize_params,
+    quantize_params,
+)
 from torchpruner_tpu.utils.torch_import import (
     import_hf_llama,
     import_torch_vgg16_bn,
@@ -81,6 +86,9 @@ __all__ = [
     "generate",
     "init_cache",
     "make_decode_step",
+    "QTensor",
+    "quantize_params",
+    "dequantize_params",
     "Pruner",
     "RandomAttributionMetric",
     "WeightNormAttributionMetric",

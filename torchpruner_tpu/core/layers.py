@@ -59,6 +59,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from torchpruner_tpu.ops.quant import oscale, wval
+
 # ---------------------------------------------------------------------------
 # Layer specs
 # ---------------------------------------------------------------------------
@@ -829,9 +831,17 @@ def apply_layer(
     taps: Optional[Taps] = None,
     path: Tuple[str, ...] = (),
 ):
-    """Apply one layer. Pure; returns ``(y, new_state)``."""
+    """Apply one layer. Pure; returns ``(y, new_state)``.
+
+    Matmul weights may be int8 :class:`~torchpruner_tpu.ops.quant.QTensor`
+    leaves (weight-only serving quantization): the dot consumes the int8
+    payload converted to the activation dtype, and the per-output-channel
+    scale is applied to the OUTPUT — exact for symmetric per-out-channel
+    quantization, and the convert-only producer keeps the weight int8 in
+    HBM (ops/quant.py).
+    """
     if isinstance(spec, Dense):
-        y = x @ params["w"]
+        y = oscale(x @ wval(params["w"], x.dtype), params["w"])
         if "b" in params:
             y = y + params["b"]
         return y, state
@@ -985,9 +995,12 @@ def apply_layer(
                     f"sp_model(model, 'auto') for single-device "
                     f"apply/scoring/generation"
                 ) from e
-        q = jnp.einsum("bsd,dhk->bshk", x, params["wq"])
-        k = jnp.einsum("bsd,dhk->bshk", x, params["wk"])
-        v = jnp.einsum("bsd,dhk->bshk", x, params["wv"])
+        q = oscale(jnp.einsum("bsd,dhk->bshk", x,
+                              wval(params["wq"], x.dtype)), params["wq"])
+        k = oscale(jnp.einsum("bsd,dhk->bshk", x,
+                              wval(params["wk"], x.dtype)), params["wk"])
+        v = oscale(jnp.einsum("bsd,dhk->bshk", x,
+                              wval(params["wv"], x.dtype)), params["wv"])
         if "bq" in params:
             q = q + params["bq"]
             k = k + params["bk"]
@@ -1016,14 +1029,15 @@ def apply_layer(
             zh = jnp.moveaxis(ctx, 2, 3)
             zh = taps.at_site(path, zh)
             ctx = jnp.moveaxis(zh, 3, 2)
-        y = jnp.einsum("bshk,hkd->bsd", ctx, params["wo"])
+        y = oscale(jnp.einsum("bshk,hkd->bsd", ctx,
+                              wval(params["wo"], ctx.dtype)), params["wo"])
         if "bo" in params:
             y = y + params["bo"]
         return y, state
 
     if isinstance(spec, GatedDense):
-        g = x @ params["wg"]
-        u = x @ params["wu"]
+        g = oscale(x @ wval(params["wg"], x.dtype), params["wg"])
+        u = oscale(x @ wval(params["wu"], x.dtype), params["wu"])
         if "bg" in params:
             g = g + params["bg"]
             u = u + params["bu"]

@@ -14,6 +14,7 @@ import dataclasses
 from dataclasses import dataclass
 from typing import Any, Callable, Optional, Sequence, Tuple, Union
 
+import jax
 import numpy as np
 
 from torchpruner_tpu.core import layers as L
@@ -161,6 +162,17 @@ def prune(
     ``jax.tree.map(jnp.copy, params)`` if you need the pre-prune model
     alive afterwards (examples/04 demonstrates this).
     """
+    from torchpruner_tpu.ops.quant import QTensor
+
+    if any(isinstance(leaf, QTensor)
+           for leaf in jax.tree.leaves(
+               params, is_leaf=lambda x: isinstance(x, QTensor))):
+        raise ValueError(
+            "params contain int8 QTensor weights — prune BEFORE "
+            "quantizing (the deploy order is prune → fine-tune → "
+            "quantize; slicing q/scale along mismatched axes would "
+            "corrupt the weights silently)"
+        )
     group = layer if isinstance(layer, PruneGroup) else G.group_for(model, layer)
     drop = np.unique(np.asarray(drop, dtype=np.int64).reshape(-1))
     plan = plan_for_group(model, group)

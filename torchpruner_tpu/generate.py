@@ -38,6 +38,7 @@ from jax import lax
 
 from torchpruner_tpu.core import layers as L
 from torchpruner_tpu.core.segment import SegmentedModel
+from torchpruner_tpu.ops.quant import oscale, wval
 
 _NEG_INF = -1e30
 
@@ -81,9 +82,12 @@ def _decode_attention(spec, params, entry, x, pos):
     block's K/V are written at ``pos..pos+s-1`` and attention is causal
     within the block.  Returns (y, entry').
     """
-    q = jnp.einsum("bsd,dhk->bshk", x, params["wq"])
-    k = jnp.einsum("bsd,dhk->bshk", x, params["wk"])
-    v = jnp.einsum("bsd,dhk->bshk", x, params["wv"])
+    q = oscale(jnp.einsum("bsd,dhk->bshk", x,
+                          wval(params["wq"], x.dtype)), params["wq"])
+    k = oscale(jnp.einsum("bsd,dhk->bshk", x,
+                          wval(params["wk"], x.dtype)), params["wk"])
+    v = oscale(jnp.einsum("bsd,dhk->bshk", x,
+                          wval(params["wv"], x.dtype)), params["wv"])
     if "bq" in params:
         q = q + params["bq"]
         k = k + params["bk"]
@@ -114,7 +118,8 @@ def _decode_attention(spec, params, entry, x, pos):
     )
     w = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
     ctx = jnp.einsum("bhqt,bthk->bqhk", w, v_cache)
-    y = jnp.einsum("bshk,hkd->bsd", ctx, params["wo"])
+    y = oscale(jnp.einsum("bshk,hkd->bsd", ctx,
+                          wval(params["wo"], ctx.dtype)), params["wo"])
     if "bo" in params:
         y = y + params["bo"]
     return y, {"k": k_cache, "v": v_cache}

@@ -1,0 +1,183 @@
+"""Weight-only int8 quantization for the serving path.
+
+Decode reads every parameter once per generated token, so KV-cache
+generation is HBM-bandwidth-bound (PERF.md, ``llama_decode`` leg) — the
+reference framework has no inference path at all, and on TPU the lever
+that matters is BYTES READ, not FLOPs.  Symmetric per-output-channel
+int8 weights halve the weight traffic vs bf16 (4× vs f32); compute
+stays in the activation dtype.
+
+The dequantization is formulated so XLA keeps the int8 tensor in HBM:
+
+    y = (x @ convert(q, x.dtype)) * scale        # NOT  x @ (q * scale)
+
+Per-OUTPUT-channel scales commute with the contraction (only input axes
+are contracted), so scaling the matmul's output is exact — and the
+weight's only producer is a unary ``convert``, which XLA fuses into the
+dot's operand read (a ``q * scale`` weight would materialize a full
+dequantized copy when fusion declines the multiply).
+
+Composition with pruning: quantize AFTER structural pruning (the
+serving order — prune, fine-tune, quantize, deploy).  ``prune()``
+refuses pytrees containing :class:`QTensor` leaves rather than silently
+slicing ``q`` and ``scale`` along mismatched axes.
+
+No reference equivalent (the reference is training-side only); the
+technique is standard weight-only PTQ (Dettmers et al., 2022, at the
+per-channel granularity TPU serving stacks use).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["QTensor", "quantize_tensor", "quantize_params",
+           "dequantize_params", "wval", "oscale"]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class QTensor:
+    """Symmetric per-output-channel int8 weight: ``w ≈ q * scale``.
+
+    ``q`` has the original weight's shape (int8); ``scale`` has the
+    shape of the OUTPUT axes (float32) — the axes a matmul/einsum does
+    NOT contract — so output-side rescaling is exact.
+    """
+
+    q: jnp.ndarray        # int8, original weight shape
+    scale: jnp.ndarray    # f32, shape = output-axes suffix of q.shape
+
+    # pytree protocol: arrays are children (device_put / jit-arg friendly)
+    def tree_flatten(self) -> Tuple[tuple, None]:
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, _aux, children) -> "QTensor":
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):  # the STORAGE dtype; compute happens in x.dtype
+        return self.q.dtype
+
+    def dequantize(self, dtype=jnp.float32) -> jnp.ndarray:
+        """Materialized ``q * scale`` (tests / export — NOT the serving
+        path, which scales matmul outputs instead)."""
+        n_in = self.q.ndim - self.scale.ndim
+        return (self.q.astype(dtype)
+                * self.scale.reshape((1,) * n_in + self.scale.shape)
+                .astype(dtype))
+
+
+def quantize_tensor(w, n_in_axes: int = 1) -> QTensor:
+    """Symmetric int8 over the leading ``n_in_axes`` input axes: one
+    scale per output channel (max-abs / 127), zero-channels get scale 1
+    so ``q = 0`` round-trips exactly."""
+    w = jnp.asarray(w)
+    in_axes = tuple(range(n_in_axes))
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=in_axes)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    n_in = w.ndim - scale.ndim
+    q = jnp.round(w.astype(jnp.float32)
+                  / scale.reshape((1,) * n_in + scale.shape))
+    return QTensor(q.astype(jnp.int8), scale.astype(jnp.float32))
+
+
+def wval(w, dtype):
+    """The tensor a matmul/einsum should consume: the int8 payload
+    converted to the activation dtype (a unary producer XLA fuses into
+    the dot) for :class:`QTensor`, the weight itself otherwise."""
+    return w.q.astype(dtype) if isinstance(w, QTensor) else w
+
+
+def oscale(y, w):
+    """Apply ``w``'s output-channel scale to a matmul output ``y`` (the
+    exact dequantization for per-output-channel symmetric quantization);
+    identity for unquantized weights."""
+    if not isinstance(w, QTensor):
+        return y
+    return y * w.scale.astype(y.dtype)
+
+
+#: layer-type -> {param key: number of INPUT axes} for the weights worth
+#: quantizing.  Norm scales/biases and conv kernels stay in float (convs
+#: are compute-bound at serving batch sizes; the win is the big matmuls).
+_QUANT_KEYS = {
+    "Dense": {"w": 1},
+    "GatedDense": {"wg": 1, "wu": 1},
+    "MultiHeadAttention": {"wq": 1, "wk": 1, "wv": 1, "wo": 2},
+}
+
+
+def quantize_params(model, params, *, layers: Optional[Sequence[str]] = None):
+    """Int8-quantize the matmul weights of ``model``'s Dense /
+    GatedDense / attention layers (biases, norms, embeddings, convs and
+    MoE stay float).  Returns a NEW params pytree with
+    :class:`QTensor` leaves, servable by ``model.apply`` / ``generate``
+    directly.  ``layers`` restricts to the named layer paths
+    (``"block1_ffn/gate"`` style for nested layers).
+
+    Quantize AFTER pruning: this is the deploy step of the
+    prune → fine-tune → quantize pipeline (examples/04).
+    """
+    wanted = set(layers) if layers is not None else None
+    matched: set = set()
+    out = _quantize_walk(model.layers, params, (), wanted, matched)
+    if wanted is not None and wanted - matched:
+        # a typo'd layer name must not silently deploy unquantized
+        raise KeyError(
+            f"quantize_params: no quantizable layer matched "
+            f"{sorted(wanted - matched)} (quantizable: Dense, GatedDense, "
+            f"attention; nested paths spell as 'block/child')"
+        )
+    return out
+
+
+def _quantize_walk(specs, params, prefix: Tuple[str, ...], wanted, matched):
+    from torchpruner_tpu.core import layers as L
+
+    out = dict(params)
+    for spec in specs:
+        name = spec.name
+        if isinstance(spec, L.COMPOSITE_TYPES):
+            if name in out:
+                out[name] = _quantize_walk(
+                    spec.body + spec.shortcut, out[name],
+                    prefix + (name,), wanted, matched)
+            continue
+        keys = _QUANT_KEYS.get(type(spec).__name__)
+        full = "/".join(prefix + (name,))
+        if keys is None or (wanted is not None and full not in wanted) \
+                or name not in out:
+            continue
+        matched.add(full)
+        p = dict(out[name])
+        for key, n_in in keys.items():
+            if key in p and not isinstance(p[key], QTensor):
+                p[key] = quantize_tensor(p[key], n_in_axes=n_in)
+        out[name] = p
+    return out
+
+
+def dequantize_params(params):
+    """Materialize every :class:`QTensor` back to float (round-trip
+    testing / exporting to an unquantized consumer)."""
+    return _dequant_tree(params)
+
+
+def _dequant_tree(t):
+    if isinstance(t, QTensor):
+        return t.dequantize()
+    if isinstance(t, dict):
+        return {k: _dequant_tree(v) for k, v in t.items()}
+    if isinstance(t, (list, tuple)):
+        return type(t)(_dequant_tree(v) for v in t)
+    return t
