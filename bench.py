@@ -643,7 +643,7 @@ def _leg_llama_decode(smoke: bool) -> dict:
     result["params_after"] = param_count(pp)
     result["gen_tokens_per_s_pruned"] = round(B * n_new / steady_pruned, 1)
     result["prune_decode_speedup"] = round(steady / steady_pruned, 3)
-    if not smoke and on_tpu:
+    if on_tpu:  # smoke already returned above
         # int8 weight-only serving (ops/quant.py): decode reads every
         # param per token, so halving weight bytes vs bf16 is the lever —
         # measured on the dense model AND the full prune->quantize deploy

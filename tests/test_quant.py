@@ -28,7 +28,8 @@ def test_quantize_tensor_roundtrip_error_bounded():
     rng = np.random.default_rng(0)
     w = rng.normal(size=(64, 32)).astype(np.float32)
     qt = quantize_tensor(w)
-    assert qt.q.dtype == jnp.int8 and qt.scale.shape == (32,)
+    assert qt.q.dtype == jnp.int8 and qt.scale.shape == (1, 32)
+    assert qt.out_scale().shape == (32,)
     # symmetric max-abs/127: per-channel error <= scale/2
     err = np.abs(qt.dequantize() - w)
     assert (err <= np.asarray(qt.scale) / 2 + 1e-7).all()
@@ -47,12 +48,18 @@ def test_quantize_tensor_zero_channel_and_3d():
     # attention-projection shape (d, h, k): one scale per (h, k) output
     rng = np.random.default_rng(1)
     w3 = rng.normal(size=(16, 2, 8)).astype(np.float32)
-    q3 = quantize_tensor(w3, n_in_axes=1)
-    assert q3.scale.shape == (2, 8)
+    q3 = quantize_tensor(w3, in_axes=1)
+    assert q3.scale.shape == (1, 2, 8) and q3.out_scale().shape == (2, 8)
     # wo shape (h, k, d), two contracted input axes -> per-d scale
     wo = rng.normal(size=(2, 8, 16)).astype(np.float32)
-    qo = quantize_tensor(wo, n_in_axes=2)
-    assert qo.scale.shape == (16,)
+    qo = quantize_tensor(wo, in_axes=2)
+    assert qo.scale.shape == (1, 1, 16) and qo.out_scale().shape == (16,)
+    # MoE expert planes contract the MIDDLE axis: per-(expert, out) scale
+    we = rng.normal(size=(4, 16, 8)).astype(np.float32)  # (E, D, F)
+    qe = quantize_tensor(we, in_axes=(1,))
+    assert qe.scale.shape == (4, 1, 8) and qe.out_scale().shape == (4, 8)
+    err = np.abs(np.asarray(qe.dequantize()) - we)
+    assert (err <= np.asarray(qe.scale) / 2 + 1e-7).all()
 
 
 def test_qtensor_is_a_pytree():
@@ -128,6 +135,30 @@ def test_prune_then_quantize_composes_and_reverse_refuses():
         tp.prune_by_scores(model, tp.quantize_params(model, params),
                            "block1_ffn/gate", scores,
                            policy="fraction", fraction=0.25)
+
+
+@pytest.mark.parametrize("dispatch", ["dense", "sparse"])
+def test_quantized_moe_close_to_dequantized(dispatch):
+    """Expert-plane int8: the output-side rescaling (trailing-broadcast
+    in the dense formulation, positional keepdims in the sparse dispatch
+    buffers) equals applying the dequantized weights, both dispatches."""
+    from torchpruner_tpu.models import llama_moe_tiny
+
+    model = llama_moe_tiny(dispatch=dispatch)
+    params, _ = init_model(model, seed=0)
+    qparams = tp.quantize_params(model, params)
+    x = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0, 256),
+        np.int32)
+    quant, _ = model.apply(qparams, x)
+    deq, _ = model.apply(tp.dequantize_params(qparams), x)
+    # same weights, two evaluation orders -> tight tolerance
+    np.testing.assert_allclose(np.asarray(quant), np.asarray(deq),
+                               rtol=2e-4, atol=2e-4)
+    dense_out, _ = model.apply(params, x)
+    dense_out = np.asarray(dense_out)
+    assert np.abs(dense_out - np.asarray(quant)).max() \
+        < 0.15 * np.abs(dense_out).max()
 
 
 def test_quantize_layers_subset_and_dequantize_roundtrip():

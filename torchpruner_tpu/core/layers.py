@@ -59,7 +59,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from torchpruner_tpu.ops.quant import oscale, wval
+from torchpruner_tpu.ops.quant import QTensor, oscale, wval
 
 # ---------------------------------------------------------------------------
 # Layer specs
@@ -1078,12 +1078,14 @@ def apply_layer(
             # semantics) without letting zero-gate filler pairs leak into
             # other experts' capacity
             return _moe_sparse(spec, params, x, routing, gates), state
-        g = jnp.einsum("bsd,edf->bsef", x, params["wg"])
-        u = jnp.einsum("bsd,edf->bsef", x, params["wu"])
+        g = oscale(jnp.einsum("bsd,edf->bsef", x,
+                              wval(params["wg"], x.dtype)), params["wg"])
+        u = oscale(jnp.einsum("bsd,edf->bsef", x,
+                              wval(params["wu"], x.dtype)), params["wu"])
         h = ACTIVATION_FNS[spec.fn](g) * u  # (B, S, E, F)
-        y = jnp.einsum(
-            "bsef,efd->bsd", h * gates[..., None], params["wo"]
-        )
+        y = oscale(jnp.einsum(
+            "bsef,efd->bsd", h * gates[..., None],
+            wval(params["wo"], h.dtype)), params["wo"])
         return y, state
 
     if isinstance(spec, Residual):
@@ -1153,10 +1155,21 @@ def _moe_sparse(spec: MoE, params, x, routing, gates):
     buf = (
         jnp.zeros((E, C + 1, d), xf.dtype).at[e_s, slot].set(xf[t_s])[:, :C]
     )
-    g = jnp.einsum("ecd,edf->ecf", buf, params["wg"])
-    u = jnp.einsum("ecd,edf->ecf", buf, params["wu"])
+
+    # int8 expert planes: the (E, C, ·) buffers have the WEIGHT's rank,
+    # so the keepdims scale multiplies positionally (oscale's trailing-
+    # broadcast form would misalign E against C) — exact because each
+    # scale element is constant across its expert's contraction
+    def _scaled(y, w):
+        return y * w.scale.astype(y.dtype) if isinstance(w, QTensor) else y
+
+    g = _scaled(jnp.einsum("ecd,edf->ecf", buf,
+                           wval(params["wg"], buf.dtype)), params["wg"])
+    u = _scaled(jnp.einsum("ecd,edf->ecf", buf,
+                           wval(params["wu"], buf.dtype)), params["wu"])
     h = ACTIVATION_FNS[spec.fn](g) * u  # (E, C, F)
-    out = jnp.einsum("ecf,efd->ecd", h, params["wo"])
+    out = _scaled(jnp.einsum("ecf,efd->ecd", h,
+                             wval(params["wo"], h.dtype)), params["wo"])
     contrib = out[e_s, jnp.minimum(slot, C - 1)] * jnp.where(
         keep, g_s, 0.0
     )[:, None]

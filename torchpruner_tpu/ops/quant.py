@@ -20,7 +20,9 @@ dequantized copy when fusion declines the multiply).
 Composition with pruning: quantize AFTER structural pruning (the
 serving order — prune, fine-tune, quantize, deploy).  ``prune()``
 refuses pytrees containing :class:`QTensor` leaves rather than silently
-slicing ``q`` and ``scale`` along mismatched axes.
+slicing ``q`` and ``scale`` along mismatched axes.  Tensor-parallel
+sharding rules likewise predate quantization — quantize the unsharded
+serving replica (sharded params fall back to replicated placement).
 
 No reference equivalent (the reference is training-side only); the
 technique is standard weight-only PTQ (Dettmers et al., 2022, at the
@@ -30,7 +32,7 @@ per-channel granularity TPU serving stacks use).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -44,21 +46,23 @@ __all__ = ["QTensor", "quantize_tensor", "quantize_params",
 class QTensor:
     """Symmetric per-output-channel int8 weight: ``w ≈ q * scale``.
 
-    ``q`` has the original weight's shape (int8); ``scale`` has the
-    shape of the OUTPUT axes (float32) — the axes a matmul/einsum does
-    NOT contract — so output-side rescaling is exact.
+    ``q`` has the original weight's shape (int8).  ``scale`` (float32)
+    has the same rank with the contracted INPUT axes (``in_axes``,
+    static) reduced to size 1 — so ``q * scale`` broadcasts exactly, for
+    any input-axis position (Dense's leading input, MoE's middle one).
     """
 
-    q: jnp.ndarray        # int8, original weight shape
-    scale: jnp.ndarray    # f32, shape = output-axes suffix of q.shape
+    q: jnp.ndarray             # int8, original weight shape
+    scale: jnp.ndarray         # f32, w.shape with in_axes -> 1
+    in_axes: Tuple[int, ...]   # static: which axes a matmul contracts
 
-    # pytree protocol: arrays are children (device_put / jit-arg friendly)
-    def tree_flatten(self) -> Tuple[tuple, None]:
-        return (self.q, self.scale), None
+    # pytree protocol: arrays are children, in_axes static aux data
+    def tree_flatten(self) -> Tuple[tuple, tuple]:
+        return (self.q, self.scale), tuple(self.in_axes)
 
     @classmethod
-    def tree_unflatten(cls, _aux, children) -> "QTensor":
-        return cls(*children)
+    def tree_unflatten(cls, aux, children) -> "QTensor":
+        return cls(children[0], children[1], tuple(aux))
 
     @property
     def shape(self):
@@ -71,24 +75,28 @@ class QTensor:
     def dequantize(self, dtype=jnp.float32) -> jnp.ndarray:
         """Materialized ``q * scale`` (tests / export — NOT the serving
         path, which scales matmul outputs instead)."""
-        n_in = self.q.ndim - self.scale.ndim
-        return (self.q.astype(dtype)
-                * self.scale.reshape((1,) * n_in + self.scale.shape)
-                .astype(dtype))
+        return self.q.astype(dtype) * self.scale.astype(dtype)
+
+    def out_scale(self) -> jnp.ndarray:
+        """The scale with input axes squeezed out: the shape of the
+        OUTPUT axes, for trailing-broadcast multiplication onto a
+        matmul/einsum result (:func:`oscale`)."""
+        return jnp.squeeze(self.scale, axis=tuple(self.in_axes))
 
 
-def quantize_tensor(w, n_in_axes: int = 1) -> QTensor:
-    """Symmetric int8 over the leading ``n_in_axes`` input axes: one
-    scale per output channel (max-abs / 127), zero-channels get scale 1
-    so ``q = 0`` round-trips exactly."""
+def quantize_tensor(w, in_axes: Union[int, Tuple[int, ...]] = 1) -> QTensor:
+    """Symmetric int8 with one scale per output channel (max-abs / 127)
+    over the contracted ``in_axes`` (an int means that many LEADING
+    axes); zero-channels get scale 1 so ``q = 0`` round-trips exactly."""
     w = jnp.asarray(w)
-    in_axes = tuple(range(n_in_axes))
-    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=in_axes)
+    if isinstance(in_axes, int):
+        in_axes = tuple(range(in_axes))
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=in_axes,
+                   keepdims=True)
     scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-    n_in = w.ndim - scale.ndim
-    q = jnp.round(w.astype(jnp.float32)
-                  / scale.reshape((1,) * n_in + scale.shape))
-    return QTensor(q.astype(jnp.int8), scale.astype(jnp.float32))
+    q = jnp.round(w.astype(jnp.float32) / scale)
+    return QTensor(q.astype(jnp.int8), scale.astype(jnp.float32),
+                   tuple(in_axes))
 
 
 def wval(w, dtype):
@@ -99,28 +107,40 @@ def wval(w, dtype):
 
 
 def oscale(y, w):
-    """Apply ``w``'s output-channel scale to a matmul output ``y`` (the
-    exact dequantization for per-output-channel symmetric quantization);
-    identity for unquantized weights."""
+    """Apply ``w``'s output-channel scale to a matmul output ``y``
+    whose TRAILING axes are ``w``'s output axes (every standard apply
+    site) — the exact dequantization for per-output-channel symmetric
+    quantization; identity for unquantized weights.  Sites where the
+    output axes are not trailing (the MoE sparse-dispatch buffers, same
+    rank as the weight) multiply by ``w.scale`` directly instead."""
     if not isinstance(w, QTensor):
         return y
-    return y * w.scale.astype(y.dtype)
+    return y * w.out_scale().astype(y.dtype)
 
 
-#: layer-type -> {param key: number of INPUT axes} for the weights worth
-#: quantizing.  Norm scales/biases and conv kernels stay in float (convs
-#: are compute-bound at serving batch sizes; the win is the big matmuls).
+#: layer-type -> {param key: contracted input axes}.  Norm scales/biases
+#: and conv kernels stay in float (convs are compute-bound at serving
+#: batch sizes; the win is the big matmuls); the MoE router too (tiny,
+#: and its softmax is precision-sensitive).
 _QUANT_KEYS = {
-    "Dense": {"w": 1},
-    "GatedDense": {"wg": 1, "wu": 1},
-    "MultiHeadAttention": {"wq": 1, "wk": 1, "wv": 1, "wo": 2},
+    "Dense": {"w": (0,)},
+    "GatedDense": {"wg": (0,), "wu": (0,)},
+    "MultiHeadAttention": {"wq": (0,), "wk": (0,), "wv": (0,),
+                           "wo": (0, 1)},
+    # wg/wu (E, D, F) contract D -> per-(expert, channel) scales.  wo
+    # (E, F, D) must use ONE scale per output d SHARED across experts:
+    # the dense formulation's bsef,efd->bsd einsum contracts e, so a
+    # per-expert wo scale could not be factored out of the output (the
+    # price is a coarser wo quantization when experts' magnitudes
+    # diverge; wg/wu keep per-expert granularity)
+    "MoE": {"wg": (1,), "wu": (1,), "wo": (0, 1)},
 }
 
 
 def quantize_params(model, params, *, layers: Optional[Sequence[str]] = None):
     """Int8-quantize the matmul weights of ``model``'s Dense /
-    GatedDense / attention layers (biases, norms, embeddings, convs and
-    MoE stay float).  Returns a NEW params pytree with
+    GatedDense / attention / MoE layers (biases, norms, embeddings,
+    convs and routers stay float).  Returns a NEW params pytree with
     :class:`QTensor` leaves, servable by ``model.apply`` / ``generate``
     directly.  ``layers`` restricts to the named layer paths
     (``"block1_ffn/gate"`` style for nested layers).
@@ -136,7 +156,7 @@ def quantize_params(model, params, *, layers: Optional[Sequence[str]] = None):
         raise KeyError(
             f"quantize_params: no quantizable layer matched "
             f"{sorted(wanted - matched)} (quantizable: Dense, GatedDense, "
-            f"attention; nested paths spell as 'block/child')"
+            f"attention, MoE; nested paths spell as 'block/child')"
         )
     return out
 
@@ -160,9 +180,9 @@ def _quantize_walk(specs, params, prefix: Tuple[str, ...], wanted, matched):
             continue
         matched.add(full)
         p = dict(out[name])
-        for key, n_in in keys.items():
+        for key, in_axes in keys.items():
             if key in p and not isinstance(p[key], QTensor):
-                p[key] = quantize_tensor(p[key], n_in_axes=n_in)
+                p[key] = quantize_tensor(p[key], in_axes=in_axes)
         out[name] = p
     return out
 
