@@ -191,6 +191,63 @@ def capture(leg_names, device_kind: str, just_probed: bool = False) -> dict:
     return legs
 
 
+#: auxiliary captures after the legs (the rest of scripts/capture_tpu.sh,
+#: PERF.md's evidence beyond bench numbers): (tag, timeout_s, argv-maker).
+#: Each runs in its own subprocess with a hard timeout, like the legs.
+AUX = [
+    ("flash_sweep", 3600, lambda out:
+        [sys.executable, "-u", "-m",
+         "torchpruner_tpu.experiments.flash_sweep", "--tune", "--out", out]),
+    ("compile_economics", 3600, lambda out:
+        [sys.executable, "-u", "-m",
+         "torchpruner_tpu.experiments.compile_economics", "--steps", "5",
+         "--out", out]),
+    ("steptrace_vgg16", 1800, lambda out:
+        [sys.executable, "-u", "-m",
+         "torchpruner_tpu.experiments.step_trace", "--model", "vgg16_bn",
+         "--batch", "256", "--out", out]),
+    ("steptrace_mfullama", 1800, lambda out:
+        [sys.executable, "-u", "-m",
+         "torchpruner_tpu.experiments.step_trace", "--model", "mfu_llama",
+         "--batch", "8", "--out", out]),
+]
+
+
+def run_aux(device_kind: str) -> int:
+    """The non-bench captures, tunnel-probed and fault-isolated per item;
+    artifacts land in results/ named {tag}_tpu_{stamp}_{commit}.json,
+    stderr in logs/aux_{tag}_{stamp}.err for postmortems.  Returns the
+    number of FAILED captures."""
+    stamp = time.strftime("%Y-%m-%d_%H%M", time.gmtime())
+    commit = bench._git_commit()
+    failed = 0
+    print(f"[legs] aux captures on {device_kind}", flush=True)
+    for tag, timeout_s, mk in AUX:
+        if probe() is None:
+            print(f"[legs] aux {tag}: tunnel down, skipping", flush=True)
+            failed += 1
+            continue
+        out = os.path.join(REPO, "results",
+                           f"{tag}_tpu_{stamp}_{commit}.json")
+        err_path = os.path.join(REPO, "logs", f"aux_{tag}_{stamp}.err")
+        print(f"[legs] aux {tag} starting (timeout {timeout_s}s)",
+              flush=True)
+        t0 = time.time()
+        with open(err_path, "w") as err_f:
+            try:
+                rc = subprocess.run(mk(out), timeout=timeout_s,
+                                    stdout=subprocess.DEVNULL,
+                                    stderr=err_f, cwd=REPO).returncode
+            except subprocess.TimeoutExpired:
+                rc = -1
+        ok = rc == 0 and os.path.exists(out)
+        failed += 0 if ok else 1
+        print(f"[legs] aux {tag} {'ok' if ok else f'rc={rc}'} in "
+              f"{time.time() - t0:.0f}s"
+              + ("" if ok else f" (stderr: {err_path})"), flush=True)
+    return failed
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--legs", default=None,
@@ -199,6 +256,9 @@ def main(argv=None) -> int:
                     help="probe every --interval until the tunnel answers, "
                          "for up to HOURS; 0 = probe once and exit if down")
     ap.add_argument("--interval", type=float, default=120)
+    ap.add_argument("--aux", action="store_true",
+                    help="after the legs, also capture flash sweep / "
+                         "compile economics / step traces into results/")
     args = ap.parse_args(argv)
     if args.legs:
         known = {n for n, _ in LEGS}
@@ -218,7 +278,8 @@ def main(argv=None) -> int:
             ok = sum(1 for v in legs.values()
                      if "error" not in v and "skipped" not in v)
             print(f"[legs] done: {ok}/{len(wanted)} legs ok", flush=True)
-            return 0 if ok else 1
+            aux_failed = run_aux(kind) if args.aux else 0
+            return 0 if ok and not aux_failed else 1
         if time.time() >= deadline:
             print("[legs] tunnel down, watch window over", flush=True)
             return 2
