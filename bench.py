@@ -347,7 +347,7 @@ def _leg_vgg_train(smoke: bool) -> dict:
     from torchpruner_tpu.train.loop import Trainer
     from torchpruner_tpu.utils.flops import model_cost
     from torchpruner_tpu.utils.losses import cross_entropy_loss
-    from torchpruner_tpu.utils.profiling import time_fn
+    from torchpruner_tpu.utils.profiling import time_train_step
 
     if smoke:
         model = vgg16_bn(width_multiplier=0.125, classifier_width=64)
@@ -366,7 +366,7 @@ def _leg_vgg_train(smoke: bool) -> dict:
         trainer = Trainer.create(model, optax.sgd(0.05, momentum=0.9),
                                  cross_entropy_loss, seed=0,
                                  compute_dtype=compute_dtype)
-        stats = time_fn(trainer.step, x, y, iters=10, warmup=3)
+        stats = time_train_step(trainer, x, y, iters=10, warmup=3)
         step_s = stats["p50_s"]
         out = {
             "ms": round(step_s * 1e3, 3),
@@ -455,7 +455,7 @@ def _leg_mfu_llama(smoke: bool) -> dict:
     from torchpruner_tpu.train.loop import Trainer
     from torchpruner_tpu.utils.flops import model_cost, param_count
     from torchpruner_tpu.utils.losses import lm_cross_entropy_loss
-    from torchpruner_tpu.utils.profiling import time_fn
+    from torchpruner_tpu.utils.profiling import time_train_step
 
     if smoke:
         model, B = llama_tiny(), 2
@@ -479,7 +479,7 @@ def _leg_mfu_llama(smoke: bool) -> dict:
     def measure(b):
         toks = jax.numpy.asarray(
             rng.integers(0, 1000, size=(b, S)).astype("int32"))
-        stats = time_fn(trainer.step, toks, toks, iters=10, warmup=3)
+        stats = time_train_step(trainer, toks, toks, iters=10, warmup=3)
         step_s = stats["p50_s"]
         r = {
             "ms": round(step_s * 1e3, 3),

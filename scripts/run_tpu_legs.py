@@ -176,7 +176,11 @@ def capture(leg_names, device_kind: str, just_probed: bool = False) -> dict:
         # wins, and the headline assembles from current + carried legs so
         # a subset capture never nulls out a previously-captured headline
         merged = bench._merge_cached_legs(legs)
-        result = bench._assemble(merged, "tpu", device_kind, None, False)
+        # the leg children enable the persistent cache (_CHILD_SRC); record
+        # the same dir here so the artifact doesn't claim cache-less runs
+        from torchpruner_tpu.utils.compilation_cache import ENV_VAR, _DEFAULT
+        cache_dir = os.environ.get(ENV_VAR) or _DEFAULT
+        result = bench._assemble(merged, "tpu", device_kind, cache_dir, False)
         result["capture"] = "per-leg (scripts/run_tpu_legs.py)"
         bench._write_tpu_cache(result)
         with open(out_path, "w") as f:

@@ -60,8 +60,8 @@ def run(model_name: str, batch: int, dtype: str, steps: int,
                 0, model.out_shape()[-1], size=(batch,)
             ).astype("int32"))
 
-    stats = profiling.time_fn(trainer.step, x, y, iters=max(3, steps),
-                              warmup=3)
+    stats = profiling.time_train_step(trainer, x, y, iters=max(3, steps),
+                                      warmup=3)
     with profiling.trace(trace_dir):
         for _ in range(steps):
             trainer.step(x, y)
