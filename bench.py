@@ -82,6 +82,13 @@ TPU_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "bench_partial_last.json")
 
+#: the multi-hour sweep's cross-window resume scratch: trained weights +
+#: finished layers, so the sweep accumulates across tunnel uptime windows
+#: shorter than itself (gitignored; deleted when a sweep completes).
+ROBUSTNESS_RESUME = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "logs",
+    "vgg_robustness_resume.pkl")
+
 #: total wall-clock budget for the WHOLE orchestration (preflight +
 #: attempts).  The round-2 driver accepted an ~11 min run; the round-3
 #: driver killed the run somewhere past ~23 min — so the default (20 min)
@@ -204,6 +211,7 @@ def _leg_vgg_robustness(smoke: bool, progress=None) -> dict:
     """
     import jax
     import jax.numpy as jnp
+    import numpy as np
     import optax
 
     from torchpruner_tpu.data import load_dataset
@@ -231,24 +239,70 @@ def _leg_vgg_robustness(smoke: bool, progress=None) -> dict:
         epochs, train_bs = 12, 128
 
     # -- train to non-degenerate accuracy (bf16 steps, real digit data;
+    # -- cross-window resume (non-smoke): the full sweep outlasts the
+    # tunnel's observed uptime windows, so trained weights + finished
+    # layers persist under logs/ and a rerun continues where the last
+    # attempt was killed instead of starting the multi-hour sweep over --
+    import pickle
+
+    resume_path = None if smoke else ROBUSTNESS_RESUME
+    weights_path = (resume_path + ".weights") if resume_path else None
+    # the scratch is only valid for the exact protocol that produced it:
+    # geometry/examples/epochs AND the method panel (panel string bumped
+    # whenever the methods dict / sv_samples / runs change)
+    cfg_key = {"n_examples": n_examples, "epochs": epochs,
+               "platform": jax.devices()[0].platform,
+               "panel": "8m-sv5-runs3-adam1e3-bf16-v1"}
+
+    def _atomic_pickle(path, obj):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(obj, f)
+        os.replace(tmp, path)  # a kill mid-write can't tear the scratch
+
+    resume = resume_weights = None
+    if resume_path and os.path.exists(resume_path) \
+            and os.path.exists(weights_path):
+        try:
+            with open(resume_path, "rb") as f:
+                resume = pickle.load(f)
+            with open(weights_path, "rb") as f:
+                resume_weights = pickle.load(f)
+            if resume.get("config") != cfg_key or \
+                    resume_weights.get("config") != cfg_key:
+                resume = resume_weights = None
+        except Exception:
+            resume = resume_weights = None
+
     # adam reaches >95% digits32 test acc by epoch ~4 where the
     # reference's SGD recipe, tuned for 150-epoch CIFAR, barely moves) --
-    train = load_dataset("digits32", "train", seed=0)
-    trainer = Trainer.create(model, optax.adam(1e-3),
-                             cross_entropy_loss, seed=0,
-                             compute_dtype=jnp.bfloat16)
-    t0 = time.perf_counter()
-    for epoch in range(epochs):
-        for x, y in train.iter_batches(train_bs, shuffle=True, seed=epoch,
-                                       drop_remainder=True):
-            trainer.step(jnp.asarray(x), jnp.asarray(y))
-    jax.block_until_ready(trainer.params)
-    train_s = time.perf_counter() - t0
-    params, state = trainer.params, trainer.state
+    if resume_weights is not None:
+        params = jax.tree_util.tree_map(jnp.asarray,
+                                        resume_weights["params"])
+        state = jax.tree_util.tree_map(jnp.asarray,
+                                       resume_weights["state"])
+        train_s = resume["train_s"]
+    else:
+        train = load_dataset("digits32", "train", seed=0)
+        trainer = Trainer.create(model, optax.adam(1e-3),
+                                 cross_entropy_loss, seed=0,
+                                 compute_dtype=jnp.bfloat16)
+        t0 = time.perf_counter()
+        for epoch in range(epochs):
+            for x, y in train.iter_batches(train_bs, shuffle=True,
+                                           seed=epoch,
+                                           drop_remainder=True):
+                trainer.step(jnp.asarray(x), jnp.asarray(y))
+        jax.block_until_ready(trainer.params)
+        train_s = time.perf_counter() - t0
+        params, state = trainer.params, trainer.state
 
     test = load_dataset("digits32", "test", n=n_examples, seed=0)
     batches = test.batches(bs)
-    test_loss, test_acc = trainer.evaluate(batches)
+    from torchpruner_tpu.train.loop import evaluate as eval_model
+    test_loss, test_acc = eval_model(model, params, state, batches,
+                                     cross_entropy_loss)
 
     def factory(method, reduction="mean", **kw):
         def make(run=0):
@@ -272,31 +326,79 @@ def _leg_vgg_robustness(smoke: bool, progress=None) -> dict:
         "sv_mean+2std": factory("shapley", reduction="mean+2std",
                                 sv_samples=5),
     }
+    from torchpruner_tpu.core.graph import pruning_graph
+
+    all_layers = (list(layers) if layers is not None
+                  else [g.target for g in pruning_graph(model)])
+    done: dict = dict(resume["results"]) if resume else {}
+    prior_wall_s = resume.get("wall_s", 0.0) if resume else 0.0
+    remaining = [l for l in all_layers if l not in done]
+
+    # the weights never change after training — write them ONCE (outside
+    # the timed sweep), then checkpoint only the small per-layer results
+    if weights_path and resume_weights is None:
+        try:
+            _atomic_pickle(weights_path, {
+                "config": cfg_key,
+                "params": jax.tree_util.tree_map(np.asarray, params),
+                "state": jax.tree_util.tree_map(np.asarray, state),
+            })
+        except OSError:
+            pass
+
     t0 = time.perf_counter()
-    partial_results: dict = {}
+    partial_results: dict = dict(done)
+
+    def save_resume():
+        if resume_path is None:
+            return
+        try:
+            _atomic_pickle(resume_path, {
+                "config": cfg_key,
+                "train_s": train_s,
+                "results": partial_results,
+                "wall_s": prior_wall_s + time.perf_counter() - t0,
+            })
+        except OSError:
+            pass
+
+    save_resume()  # trained weights persist even if layer 1 is killed
 
     def on_layer(layer, layer_res):
+        partial_results[layer] = layer_res
+        save_resume()
         if progress is None:
             return
-        partial_results[layer] = layer_res
         stats = auc_summary_std(partial_results)
         progress({
             "value": None,
             "unit": "s",
             "layers_done": len(partial_results),
-            "elapsed_s": round(time.perf_counter() - t0, 1),
+            "elapsed_s": round(
+                prior_wall_s + time.perf_counter() - t0, 1),
             "eval_examples": len(test),
             "auc_so_far": {k: round(v["mean"], 4)
                            for k, v in stats.items()},
             "trained_test_acc": round(float(test_acc), 4),
         })
 
-    results = layerwise_robustness(
+    new_results = layerwise_robustness(
         model, params, state, batches, methods, cross_entropy_loss,
-        layers=layers, compute_dtype=jnp.bfloat16, verbose=False,
+        layers=remaining, compute_dtype=jnp.bfloat16, verbose=False,
         on_layer=on_layer,
-    )
-    sweep_s = time.perf_counter() - t0
+    ) if remaining else {}
+    merged = {**done, **new_results}
+    results = {l: merged[l] for l in all_layers if l in merged}
+    # wall clock accumulated over every attempt's sweep loop (training
+    # time excluded, as before; repeated per-attempt compiles included —
+    # that is the real cost of measuring over a flaky tunnel)
+    sweep_s = prior_wall_s + time.perf_counter() - t0
+    for p in (resume_path, weights_path):
+        if p and os.path.exists(p):
+            try:  # complete: a later run should measure fresh, not replay
+                os.remove(p)
+            except OSError:
+                pass
     per_layer_s = {
         layer: round(sum(r["seconds"] for runs in by_method.values()
                          for r in runs), 2)
@@ -316,6 +418,7 @@ def _leg_vgg_robustness(smoke: bool, progress=None) -> dict:
         "panel_runs": SWEEP_PANEL_RUNS,
         "per_layer_seconds": per_layer_s,
         "eval_examples": len(test),
+        "resumed_layers": len(done),
         "examples_adjusted_s": round(adjusted_s, 1),
         "compute_dtype": "bfloat16",
         "trained": {
@@ -553,11 +656,15 @@ def _leg_flash_attention(smoke: bool) -> dict:
     return out
 
 
-def _leg_llama_decode(smoke: bool) -> dict:
+def _leg_llama_decode(smoke: bool, progress=None) -> dict:
     """KV-cache decode throughput (tokens/s) on the llama family, dense
     AND after a 25% FFN-channel prune (example 04's serving flow) — the
     speedup structured pruning actually buys at decode time (no
-    reference baseline; the reference has no inference loop)."""
+    reference baseline; the reference has no inference loop).
+
+    ``progress`` checkpoints after every sub-measurement (dense, bf16-KV,
+    pruned, int8) — this leg wedged a full tunnel window once, losing the
+    dense number it had already measured."""
     import jax
     import numpy as np
 
@@ -597,6 +704,8 @@ def _leg_llama_decode(smoke: bool) -> dict:
                   else "llama_tiny"),
         "shape": f"B{B} prompt{S} new{n_new}",
     }
+    if progress is not None:
+        progress(dict(result))
     if not smoke and on_tpu:
         # bf16 KV cache: the serving configuration (half the cache bytes;
         # decode is HBM-bandwidth-bound so it reads half as much).  TPU
@@ -611,6 +720,8 @@ def _leg_llama_decode(smoke: bool) -> dict:
         steady16 = time.perf_counter() - t0
         result["gen_tokens_per_s_bf16_cache"] = round(
             B * n_new / steady16, 1)
+        if progress is not None:
+            progress(dict(result))
     # post-prune serving (example 04's flow, scoring cost excluded):
     # weight_norm-score every block's FFN channels, prune the lowest 25%,
     # decode at the pruned shapes — the structured-prune decode payoff.
@@ -643,6 +754,8 @@ def _leg_llama_decode(smoke: bool) -> dict:
     result["params_after"] = param_count(pp)
     result["gen_tokens_per_s_pruned"] = round(B * n_new / steady_pruned, 1)
     result["prune_decode_speedup"] = round(steady / steady_pruned, 3)
+    if progress is not None:
+        progress(dict(result))
     if on_tpu:  # smoke already returned above
         # int8 weight-only serving (ops/quant.py): decode reads every
         # param per token, so halving weight bytes vs bf16 is the lever —
@@ -659,6 +772,8 @@ def _leg_llama_decode(smoke: bool) -> dict:
             steady_q[tag] = time.perf_counter() - t0
             result[f"gen_tokens_per_s_{tag}"] = round(
                 B * n_new / steady_q[tag], 1)
+            if progress is not None:
+                progress(dict(result))
         result["int8_decode_speedup"] = round(steady / steady_q["int8"], 3)
     return result
 
@@ -823,10 +938,10 @@ def main() -> dict:
         # cheap legs first, the long full-sweep leg last: if the child is
         # killed mid-run, the streamed snapshots hold the most
         # measurements per minute spent
-        run_leg("vgg16_train", _leg_vgg_train)
         run_leg("mfu_llama", _leg_mfu_llama)
-        run_leg("llama_decode", _leg_llama_decode)
+        run_leg("vgg16_train", _leg_vgg_train)
         run_leg("flash_attention", _leg_flash_attention)
+        run_leg("llama_decode", _leg_llama_decode)
         run_leg("vgg16_robustness", _leg_vgg_robustness)
     else:
         # CPU fallback: the VGG legs are TPU-sized, but decode on

@@ -43,9 +43,9 @@ import bench  # noqa: E402  (the leg functions + cache merge live there)
 LEGS = [
     ("mnist_prune", 600),
     ("mfu_llama", 2400),
-    ("llama_decode", 1800),
-    ("flash_attention", 1800),
     ("vgg16_train", 2400),
+    ("flash_attention", 1800),
+    ("llama_decode", 1800),
     ("vgg16_robustness", 14400),
 ]
 
@@ -217,19 +217,23 @@ AUX = [
 ]
 
 
-def run_aux(device_kind: str) -> int:
+def run_aux(device_kind: str, tags=None) -> dict:
     """The non-bench captures, tunnel-probed and fault-isolated per item;
     artifacts land in results/ named {tag}_tpu_{stamp}_{commit}.json,
     stderr in logs/aux_{tag}_{stamp}.err for postmortems.  Returns the
-    number of FAILED captures."""
+    unfinished tags mapped to why — ``"down"`` (tunnel skip: retry freely)
+    or ``"failed"`` (real attempt died: counts against the attempt cap).
+    ``tags=None`` runs all of ``AUX``."""
     stamp = time.strftime("%Y-%m-%d_%H%M", time.gmtime())
     commit = bench._git_commit()
-    failed = 0
+    failed: dict = {}
     print(f"[legs] aux captures on {device_kind}", flush=True)
     for tag, timeout_s, mk in AUX:
+        if tags is not None and tag not in tags:
+            continue
         if probe() is None:
             print(f"[legs] aux {tag}: tunnel down, skipping", flush=True)
-            failed += 1
+            failed[tag] = "down"
             continue
         out = os.path.join(REPO, "results",
                            f"{tag}_tpu_{stamp}_{commit}.json")
@@ -245,7 +249,8 @@ def run_aux(device_kind: str) -> int:
             except subprocess.TimeoutExpired:
                 rc = -1
         ok = rc == 0 and os.path.exists(out)
-        failed += 0 if ok else 1
+        if not ok:
+            failed[tag] = "failed"
         print(f"[legs] aux {tag} {'ok' if ok else f'rc={rc}'} in "
               f"{time.time() - t0:.0f}s"
               + ("" if ok else f" (stderr: {err_path})"), flush=True)
@@ -263,6 +268,17 @@ def main(argv=None) -> int:
     ap.add_argument("--aux", action="store_true",
                     help="after the legs, also capture flash sweep / "
                          "compile economics / step traces into results/")
+    ap.add_argument("--until-complete", action="store_true",
+                    help="keep watching + recapturing across tunnel "
+                         "windows until every requested leg (and aux "
+                         "item) has captured ok or the --watch window "
+                         "ends; short legs first, then aux, then the "
+                         "resumable multi-hour sweep")
+    ap.add_argument("--max-attempts", type=int, default=3,
+                    help="per-leg attempt cap in --until-complete mode "
+                         "(a persistently wedging leg must not starve "
+                         "the rest; the sweep leg is exempt — it "
+                         "resumes from its checkpoint)")
     args = ap.parse_args(argv)
     if args.legs:
         known = {n for n, _ in LEGS}
@@ -274,6 +290,8 @@ def main(argv=None) -> int:
     else:
         wanted = LEGS
     deadline = time.time() + args.watch * 3600
+    if args.until_complete:
+        return run_until_complete(wanted, deadline, args)
     while True:
         kind = probe()
         if kind:
@@ -282,13 +300,83 @@ def main(argv=None) -> int:
             ok = sum(1 for v in legs.values()
                      if "error" not in v and "skipped" not in v)
             print(f"[legs] done: {ok}/{len(wanted)} legs ok", flush=True)
-            aux_failed = run_aux(kind) if args.aux else 0
+            aux_failed = run_aux(kind) if args.aux else {}
             return 0 if ok and not aux_failed else 1
         if time.time() >= deadline:
             print("[legs] tunnel down, watch window over", flush=True)
             return 2
         print("[legs] tunnel down, waiting...", flush=True)
         time.sleep(args.interval)
+
+
+def run_until_complete(wanted, deadline, args) -> int:
+    """Loop watch→capture across tunnel windows until everything has
+    landed (or the window ends): short legs first (highest evidence per
+    tunnel minute), aux artifacts second, the cross-window-resumable
+    robustness sweep last.  A leg that errors ``--max-attempts`` times is
+    dropped with a notice so one wedger can't starve the rest."""
+    short = {n: t for n, t in wanted if n != "vgg16_robustness"}
+    sweep = {n: t for n, t in wanted if n == "vgg16_robustness"}
+    aux_left = ([t for t, _, _ in AUX] if args.aux else [])
+    attempts: dict = {}
+    aux_passes = 0
+    gave_up: list = []
+
+    def capture_phase(pool, kind) -> None:
+        legs = capture([(n, pool[n]) for n in pool], kind,
+                       just_probed=True)
+        for n, v in legs.items():
+            if "error" not in v and "skipped" not in v:
+                pool.pop(n, None)
+            elif "error" in v:
+                attempts[n] = attempts.get(n, 0) + 1
+                if n not in sweep and attempts[n] >= args.max_attempts:
+                    print(f"[legs] {n}: giving up after "
+                          f"{attempts[n]} attempts", flush=True)
+                    pool.pop(n, None)
+                    gave_up.append(n)
+
+    while True:
+        if not (short or aux_left or sweep):
+            if gave_up:
+                print(f"[legs] until-complete: done, but gave up on "
+                      f"{gave_up}", flush=True)
+                return 1
+            print("[legs] until-complete: everything captured", flush=True)
+            return 0
+        kind = probe()
+        if kind is None:
+            if time.time() >= deadline:
+                left = sorted(short) + aux_left + sorted(sweep)
+                print(f"[legs] watch window over; uncaptured: {left}",
+                      flush=True)
+                return 2
+            time.sleep(args.interval)
+            continue
+        print(f"[legs] tunnel up ({kind})", flush=True)
+        if short:
+            capture_phase(short, kind)
+        elif aux_left:
+            outcome = run_aux(kind, aux_left)
+            aux_left = sorted(outcome)
+            # tunnel-down skips retry freely; only real failed attempts
+            # count against the cap
+            if any(why == "failed" for why in outcome.values()):
+                aux_passes += 1
+            if aux_left and aux_passes >= args.max_attempts:
+                print(f"[legs] aux: giving up on {aux_left} after "
+                      f"{aux_passes} failed passes", flush=True)
+                gave_up.extend(aux_left)
+                aux_left = []
+            elif aux_left:
+                time.sleep(min(args.interval, 60))
+        elif sweep:
+            capture_phase(sweep, kind)
+        if time.time() >= deadline and (short or aux_left or sweep):
+            left = sorted(short) + aux_left + sorted(sweep)
+            print(f"[legs] watch window over; uncaptured: {left}",
+                  flush=True)
+            return 2
 
 
 if __name__ == "__main__":

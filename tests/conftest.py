@@ -55,6 +55,7 @@ SLOW_TESTS = {
     "test_models.py::test_vit_tiny_forward_and_prune_groups",
     "test_moe.py::test_expert_parallel_sharding_and_step",
     "test_multiprocess.py::test_two_process_dp_matches_single_process",
+    "test_bench_harness.py::test_robustness_leg_resumes_across_kills",
     "test_moe.py::test_moe_aux_weight_in_training_loss",
     "test_moe.py::test_moe_forward_and_gate_sparsity",
     "test_moe.py::test_sparse_dispatch_matches_dense_when_nothing_dropped",

@@ -277,3 +277,43 @@ def test_orchestrate_prints_boot_line_first(bench, monkeypatch, capsys):
     assert lines[0]["metric"] == "mnist_fc_shapley_prune_wall_clock"
     assert lines[0]["value"] is None
     assert out["value"] == 3.0 and "stream" not in out
+
+
+def test_robustness_leg_resumes_across_kills(bench, monkeypatch, tmp_path):
+    """The multi-hour sweep leg must survive tunnel windows shorter than
+    itself: a kill after layer 1 leaves trained weights + that layer on
+    disk, and the rerun continues from layer 2 instead of starting over,
+    deleting the scratch once the sweep completes."""
+    import torchpruner_tpu.core.graph as G
+    import torchpruner_tpu.models as M
+
+    real_vgg, real_graph = M.vgg16_bn, G.pruning_graph
+    monkeypatch.setattr(
+        M, "vgg16_bn",
+        lambda **kw: real_vgg(width_multiplier=0.125, classifier_width=64))
+    # 3 layers keep the test's sweep minutes-scale, exercising the same
+    # resume arithmetic as the 15-layer run
+    monkeypatch.setattr(G, "pruning_graph", lambda m: real_graph(m)[:3])
+    monkeypatch.setenv("BENCH_ROBUSTNESS_EXAMPLES", "16")
+    resume = tmp_path / "resume.pkl"
+    monkeypatch.setattr(bench, "ROBUSTNESS_RESUME", str(resume))
+
+    class Wedge(Exception):
+        pass
+
+    seen = []
+
+    def killer(partial):
+        seen.append(partial)
+        raise Wedge()  # simulate the tunnel dying right after layer 1
+
+    with pytest.raises(Wedge):
+        bench._leg_vgg_robustness(False, progress=killer)
+    assert resume.exists()  # trained weights + layer 1 checkpointed
+    assert seen[0]["layers_done"] == 1
+
+    r = bench._leg_vgg_robustness(False, progress=lambda p: None)
+    assert r["resumed_layers"] == 1
+    assert r["n_layers"] == 3
+    assert r["projection"] is None
+    assert not resume.exists()  # complete: scratch cleared

@@ -9,7 +9,11 @@ from torchpruner_tpu.experiments.max_comparison import (
     GROUND_TRUTH,
     run_max_comparison,
 )
-from torchpruner_tpu.utils.profiling import StepTimer, time_fn
+from torchpruner_tpu.utils.profiling import (
+    StepTimer,
+    time_fn,
+    time_train_step,
+)
 
 
 def test_max_comparison_matches_analytic_values():
@@ -34,6 +38,31 @@ def test_time_fn_reports_steady_state():
     stats = time_fn(f, jnp.ones((64, 64)), iters=3, warmup=1)
     assert 0 < stats["min_s"] <= stats["mean_s"]
     assert stats["compile_s"] > 0
+
+
+def test_time_train_step_fences_updated_params():
+    """The trainer-step stopwatch must advance real training (the fence
+    covers the params update, not just the loss scalar)."""
+    import jax
+    import optax
+
+    from torchpruner_tpu.models import digits_fc
+    from torchpruner_tpu.train.loop import Trainer
+    from torchpruner_tpu.utils.losses import cross_entropy_loss
+
+    model = digits_fc()
+    trainer = Trainer.create(model, optax.sgd(0.1), cross_entropy_loss,
+                             seed=0)
+    # host copy: the step donates the param buffers
+    before = np.asarray(jax.tree_util.tree_leaves(trainer.params)[0]).copy()
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(4,) + model.input_shape).astype("float32"))
+    y = jnp.zeros((4,), jnp.int32)
+    stats = time_train_step(trainer, x, y, iters=2, warmup=1)
+    assert stats["min_s"] > 0
+    assert trainer.step_count == 3  # warmup + iters all executed
+    after = np.asarray(jax.tree_util.tree_leaves(trainer.params)[0])
+    assert not np.allclose(before, after)
 
 
 def test_step_timer_phases():
