@@ -1,6 +1,12 @@
 #!/bin/sh
 # On-chip evidence capture — run the moment the axon tunnel answers.
 #
+# NOTE: on a flaky tunnel prefer the per-leg runner, which survives
+# mid-leg wedges, retries across uptime windows, and resumes the
+# multi-hour sweep from its checkpoint:
+#   python scripts/run_tpu_legs.py --until-complete --watch 8 --aux
+# This script is the simple one-shot variant for a HEALTHY tunnel.
+#
 # Probes first (a hung tunnel must not park the whole capture), then runs
 # every measurement the repo's perf story cites, writing committed-quality
 # artifacts into results/.  Each step is independently fault-isolated:
@@ -60,7 +66,7 @@ timeout 1800 python -m torchpruner_tpu.experiments.step_trace \
     --out "results/steptrace_vgg16_tpu_${stamp}_${commit}.json" \
     2> "logs/steptrace_vgg_${stamp}.err" && echo "[capture] vgg16 trace done"
 timeout 1800 python -m torchpruner_tpu.experiments.step_trace \
-    --model mfu_llama --batch 8 \
+    --model mfu_llama --batch 32 \
     --out "results/steptrace_mfullama_tpu_${stamp}_${commit}.json" \
     2> "logs/steptrace_llama_${stamp}.err" && echo "[capture] mfu_llama trace done"
 
