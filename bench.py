@@ -148,6 +148,7 @@ def _leg_mnist(smoke: bool) -> dict:
     import jax
 
     from torchpruner_tpu.attributions import ShapleyAttributionMetric
+    from torchpruner_tpu.utils.profiling import hard_fence
     from torchpruner_tpu.core.graph import pruning_graph
     from torchpruner_tpu.core.pruner import prune_by_scores
     from torchpruner_tpu.core.segment import init_model
@@ -169,7 +170,7 @@ def _leg_mnist(smoke: bool) -> dict:
     batches = val.batches(bs)
     # stage data on device once (input pipeline, not the measured prune loop)
     batches = [(jax.numpy.asarray(x), jax.numpy.asarray(y)) for x, y in batches]
-    jax.block_until_ready(batches)
+    hard_fence(batches)
 
     params_before = param_count(params)
     t0 = time.perf_counter()
@@ -184,7 +185,7 @@ def _leg_mnist(smoke: bool) -> dict:
         res = prune_by_scores(model, params, target, scores,
                               policy="negative", state=state)
         model, params, state = res.model, res.params, res.state
-    jax.block_until_ready(params)
+    hard_fence(params)
     elapsed = time.perf_counter() - t0
     return {
         "value": round(elapsed, 3),
@@ -223,6 +224,7 @@ def _leg_vgg_robustness(smoke: bool, progress=None) -> dict:
     from torchpruner_tpu.models import vgg16_bn
     from torchpruner_tpu.train.loop import Trainer
     from torchpruner_tpu.utils.losses import cross_entropy_loss
+    from torchpruner_tpu.utils.profiling import hard_fence
 
     if smoke:
         model = vgg16_bn(width_multiplier=0.125, classifier_width=64)
@@ -294,7 +296,7 @@ def _leg_vgg_robustness(smoke: bool, progress=None) -> dict:
                                            seed=epoch,
                                            drop_remainder=True):
                 trainer.step(jnp.asarray(x), jnp.asarray(y))
-        jax.block_until_ready(trainer.params)
+        hard_fence(trainer.params)
         train_s = time.perf_counter() - t0
         params, state = trainer.params, trainer.state
 
@@ -483,6 +485,7 @@ def _leg_vgg_train(smoke: bool) -> dict:
                 # fwd+bwd ≈ 3× forward FLOPs (standard approximation);
                 # the peak table is bf16, so MFU only applies to that leg
                 out["mfu"] = round((3.0 * fwd_flops / step_s) / peak, 4)
+                _flag_implausible_mfu(out)
             else:
                 out["mfu"] = None
         return out
@@ -513,20 +516,35 @@ def _leg_vgg_train(smoke: bool) -> dict:
                 rng.integers(0, 10, size=(b,)).astype("int32"))
             batch = b  # measure() closes over batch for img/s + MFU
             r = measure(jax.numpy.bfloat16)
-            return {"ms": r["ms"], "mfu": r["mfu"],
+            keep = {"ms": r["ms"], "mfu": r["mfu"],
                     "img_per_s_per_chip": r["img_per_s_per_chip"]}
+            if "implausible" in r:
+                keep["implausible"] = r["implausible"]
+            return keep
 
         seeded = {batch: {"ms": bf16["ms"], "mfu": bf16["mfu"],
-                          "img_per_s_per_chip": bf16["img_per_s_per_chip"]}}
+                          "img_per_s_per_chip": bf16["img_per_s_per_chip"],
+                          **({"implausible": bf16["implausible"]}
+                             if "implausible" in bf16 else {})}}
         sweep = _batch_sweep(measure_at, seeded, (512, 1024))
         out["batch_sweep"] = {str(b): v for b, v in sweep.items()}
         best = max(
-            (v for v in sweep.values() if v.get("mfu")),
+            (v for v in sweep.values()
+             if v.get("mfu") and "implausible" not in v),
             key=lambda v: v["mfu"], default=None,
         )
         if best:
             out["best_mfu"] = best["mfu"]
     return out
+
+
+def _flag_implausible_mfu(r: dict) -> dict:
+    """A physically impossible reading means the stopwatch failed, not
+    that the chip beat its own peak — flag it so no sweep/headline path
+    can quote it as clean."""
+    if r.get("mfu") is not None and r["mfu"] > 1.0:
+        r["implausible"] = "mfu > 1.0: timing fence failed"
+    return r
 
 
 def _batch_sweep(measure, seeded: dict, batches) -> dict:
@@ -593,7 +611,7 @@ def _leg_mfu_llama(smoke: bool) -> dict:
                                   batch_size=b)
         r["mfu"] = (round((3.0 * fwd_flops / step_s) / peak, 4)
                     if fwd_flops and peak else None)
-        return r
+        return _flag_implausible_mfu(r)
 
     first = measure(B)
     out = {
@@ -608,7 +626,8 @@ def _leg_mfu_llama(smoke: bool) -> dict:
         # target is judged on)
         sweep = _batch_sweep(measure, {B: first}, (16, 32))
         out["batch_sweep"] = {str(b): v for b, v in sweep.items()}
-        best = max((v for v in sweep.values() if v.get("mfu")),
+        best = max((v for v in sweep.values()
+                    if v.get("mfu") and "implausible" not in v),
                    key=lambda v: v["mfu"], default=None)
         if best:
             out["best_mfu"] = best["mfu"]
@@ -671,6 +690,7 @@ def _leg_llama_decode(smoke: bool, progress=None) -> dict:
     from torchpruner_tpu.core.segment import init_model
     from torchpruner_tpu.generate import generate
     from torchpruner_tpu.models import llama_tiny, mfu_llama
+    from torchpruner_tpu.utils.profiling import hard_fence
 
     on_tpu = jax.devices()[0].platform == "tpu"
     if smoke:
@@ -688,10 +708,10 @@ def _leg_llama_decode(smoke: bool, progress=None) -> dict:
     )
     t0 = time.perf_counter()
     out = generate(model, params, prompt, n_new)
-    jax.block_until_ready(out)
+    hard_fence(out)
     compile_and_first = time.perf_counter() - t0
     t0 = time.perf_counter()
-    jax.block_until_ready(generate(model, params, prompt, n_new))
+    hard_fence(generate(model, params, prompt, n_new))
     steady = time.perf_counter() - t0
     # end-to-end generation throughput: GENERATED tokens over the whole
     # call (the one-shot prefill's cost sits in the denominator, not the
@@ -712,11 +732,11 @@ def _leg_llama_decode(smoke: bool, progress=None) -> dict:
         # only — the extra compile buys nothing on the CPU fallback.
         import jax.numpy as jnp
 
-        jax.block_until_ready(generate(model, params, prompt, n_new,
-                                       cache_dtype=jnp.bfloat16))
+        hard_fence(generate(model, params, prompt, n_new,
+                              cache_dtype=jnp.bfloat16))
         t0 = time.perf_counter()
-        jax.block_until_ready(generate(model, params, prompt, n_new,
-                                       cache_dtype=jnp.bfloat16))
+        hard_fence(generate(model, params, prompt, n_new,
+                              cache_dtype=jnp.bfloat16))
         steady16 = time.perf_counter() - t0
         result["gen_tokens_per_s_bf16_cache"] = round(
             B * n_new / steady16, 1)
@@ -745,9 +765,9 @@ def _leg_llama_decode(smoke: bool, progress=None) -> dict:
         res = prune_by_scores(pm, pp, g.target, scores,
                               policy="fraction", fraction=0.25, state=ps)
         pm, pp, ps = res.model, res.params, res.state
-    jax.block_until_ready(generate(pm, pp, prompt, n_new))  # compile
+    hard_fence(generate(pm, pp, prompt, n_new))  # compile
     t0 = time.perf_counter()
-    jax.block_until_ready(generate(pm, pp, prompt, n_new))
+    hard_fence(generate(pm, pp, prompt, n_new))
     steady_pruned = time.perf_counter() - t0
     result["pruned_ffn_fraction"] = 0.25
     result["params_before"] = params_before
@@ -766,9 +786,9 @@ def _leg_llama_decode(smoke: bool, progress=None) -> dict:
         for tag, (m_, p_) in (("int8", (model, params)),
                               ("pruned_int8", (pm, pp))):
             qp = quantize_params(m_, p_)
-            jax.block_until_ready(generate(m_, qp, prompt, n_new))
+            hard_fence(generate(m_, qp, prompt, n_new))
             t0 = time.perf_counter()
-            jax.block_until_ready(generate(m_, qp, prompt, n_new))
+            hard_fence(generate(m_, qp, prompt, n_new))
             steady_q[tag] = time.perf_counter() - t0
             result[f"gen_tokens_per_s_{tag}"] = round(
                 B * n_new / steady_q[tag], 1)

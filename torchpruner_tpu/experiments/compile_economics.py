@@ -48,6 +48,7 @@ def run_schedule(bucket: int, steps: int, smoke: bool) -> dict:
     from torchpruner_tpu.models import vgg16_bn
     from torchpruner_tpu.train.loop import Trainer
     from torchpruner_tpu.utils.losses import cross_entropy_loss
+    from torchpruner_tpu.utils.profiling import hard_fence
 
     if smoke:
         model = vgg16_bn(width_multiplier=0.125, classifier_width=64)
@@ -72,13 +73,13 @@ def run_schedule(bucket: int, steps: int, smoke: bool) -> dict:
         target = targets[i % len(targets)]
         t0 = time.perf_counter()
         trainer.step(x, y)
-        jax.block_until_ready(trainer.params)
+        hard_fence(trainer.params)
         first_s = time.perf_counter() - t0
         steady = []
         for _ in range(3):
             t0 = time.perf_counter()
             trainer.step(x, y)
-            jax.block_until_ready(trainer.params)
+            hard_fence(trainer.params)
             steady.append(time.perf_counter() - t0)
         steady_s = min(steady)
 

@@ -65,7 +65,7 @@ def run(model_name: str, batch: int, dtype: str, steps: int,
     with profiling.trace(trace_dir):
         for _ in range(steps):
             trainer.step(x, y)
-        jax.block_until_ready(trainer.params)
+        profiling.hard_fence(trainer.params)
     summary = summarize_trace(trace_dir)
     summary["steps_traced"] = steps
     summary["p50_step_ms"] = round(stats["p50_s"] * 1e3, 3)

@@ -34,6 +34,32 @@ def trace(log_dir: str = "/tmp/torchpruner_tpu_trace"):
         jax.profiler.stop_trace()
 
 
+def hard_fence(out) -> None:
+    """Block until ``out`` has ACTUALLY been computed, on every backend.
+
+    ``jax.block_until_ready`` waits on buffer readiness *events*.  On the
+    tunnelled axon TPU backend those events can signal before the program
+    retires, so a readiness fence undercounts wildly (observed: 1.6 ms
+    "train steps" on a ~200M-param model — an implied 24 PFLOP/s on a
+    ~0.4 PFLOP/s chip).  A device→host copy has no such loophole: the
+    bytes of an output cannot arrive on the host before the program that
+    writes them finishes executing on the device stream.
+
+    To keep the fence cheap even when the outputs are large (e.g. timed
+    attention gradients — MBs per leaf), fetch a one-element *canary*:
+    eagerly index the smallest leaf (a tiny dependent program that the
+    device cannot run before the producer retires) and ``device_get`` its
+    4-byte result.  Host-side event signalling may lie; the device-stream
+    ordering and the D2H bytes cannot.
+    """
+    jax.block_until_ready(out)
+    leaves = [l for l in jax.tree_util.tree_leaves(out)
+              if hasattr(l, "ravel") and getattr(l, "size", 0)]
+    if leaves:
+        smallest = min(leaves, key=lambda l: l.size)
+        jax.device_get(smallest.ravel()[0])
+
+
 def time_fn(
     fn: Callable,
     *args,
@@ -44,21 +70,25 @@ def time_fn(
     """Steady-state wall-clock of ``fn(*args, **kwargs)``.
 
     Warms up (compile + cache), then times ``iters`` calls with a
-    ``block_until_ready`` fence on each result.  Returns
-    ``{"mean_s", "min_s", "p50_s", "compile_s"}``.
+    :func:`hard_fence` on each result — a device→host fetch of the
+    smallest output leaf, because event-based readiness fences lie on the
+    tunnelled backend (see :func:`hard_fence`).  The scalar fetch adds one
+    tunnel round trip per iteration, which *over*counts small steps by
+    the RTT — the conservative direction.  Returns ``{"mean_s", "min_s",
+    "p50_s", "compile_s"}``.
     """
     t0 = time.perf_counter()
     out = None
     for _ in range(max(1, warmup)):
         out = fn(*args, **kwargs)
-    jax.block_until_ready(out)
+    hard_fence(out)
     compile_s = time.perf_counter() - t0
 
     times: List[float] = []
     for _ in range(iters):
         t0 = time.perf_counter()
         out = fn(*args, **kwargs)
-        jax.block_until_ready(out)
+        hard_fence(out)
         times.append(time.perf_counter() - t0)
     times.sort()
     return {
@@ -84,7 +114,15 @@ def time_train_step(trainer, *args, iters: int = 10, warmup: int = 2):
 
     def step_fenced(*a):
         loss = trainer.step(*a)
-        return loss, trainer.params
+        # scalar probe that data-depends on the UPDATED params: fetching
+        # it (time_fn's hard_fence device_gets the smallest leaf) cannot
+        # complete before the step program has written params'.  The
+        # probe is its own tiny eager dispatch — nanoseconds next to the
+        # step, and it keeps the D2H payload at 4 bytes instead of
+        # round-tripping a params leaf over the tunnel.
+        leaf = jax.tree_util.tree_leaves(trainer.params)[0]
+        return loss.astype(jax.numpy.float32) + 0.0 * leaf.ravel()[0].astype(
+            jax.numpy.float32)
 
     return time_fn(step_fenced, *args, iters=iters, warmup=warmup)
 
