@@ -713,13 +713,24 @@ def _leg_llama_decode(smoke: bool, progress=None) -> dict:
     prompt = np.asarray(
         jax.random.randint(jax.random.PRNGKey(0), (B, S), 0, 256), np.int32
     )
+    reps = 1 if smoke else 10
+
+    def timed_decode(m_, p_, **kw):
+        # chained like time_fn(chained=True): the per-call canary fence
+        # pays a tunnel RTT comparable to a whole 128-token decode, which
+        # would mask the pruned/int8 deltas this leg exists to measure
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(reps):
+            out = generate(m_, p_, prompt, n_new, **kw)
+        hard_fence(out)
+        return (time.perf_counter() - t0) / reps
+
     t0 = time.perf_counter()
     out = generate(model, params, prompt, n_new)
     hard_fence(out)
     compile_and_first = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    hard_fence(generate(model, params, prompt, n_new))
-    steady = time.perf_counter() - t0
+    steady = timed_decode(model, params)
     # end-to-end generation throughput: GENERATED tokens over the whole
     # call (the one-shot prefill's cost sits in the denominator, not the
     # numerator — counting prompt positions would inflate the rate)
@@ -740,11 +751,8 @@ def _leg_llama_decode(smoke: bool, progress=None) -> dict:
         import jax.numpy as jnp
 
         hard_fence(generate(model, params, prompt, n_new,
-                              cache_dtype=jnp.bfloat16))
-        t0 = time.perf_counter()
-        hard_fence(generate(model, params, prompt, n_new,
-                              cache_dtype=jnp.bfloat16))
-        steady16 = time.perf_counter() - t0
+                            cache_dtype=jnp.bfloat16))  # compile
+        steady16 = timed_decode(model, params, cache_dtype=jnp.bfloat16)
         result["gen_tokens_per_s_bf16_cache"] = round(
             B * n_new / steady16, 1)
         if progress is not None:
@@ -773,9 +781,7 @@ def _leg_llama_decode(smoke: bool, progress=None) -> dict:
                               policy="fraction", fraction=0.25, state=ps)
         pm, pp, ps = res.model, res.params, res.state
     hard_fence(generate(pm, pp, prompt, n_new))  # compile
-    t0 = time.perf_counter()
-    hard_fence(generate(pm, pp, prompt, n_new))
-    steady_pruned = time.perf_counter() - t0
+    steady_pruned = timed_decode(pm, pp)
     result["pruned_ffn_fraction"] = 0.25
     result["params_before"] = params_before
     result["params_after"] = param_count(pp)
@@ -793,10 +799,8 @@ def _leg_llama_decode(smoke: bool, progress=None) -> dict:
         for tag, (m_, p_) in (("int8", (model, params)),
                               ("pruned_int8", (pm, pp))):
             qp = quantize_params(m_, p_)
-            hard_fence(generate(m_, qp, prompt, n_new))
-            t0 = time.perf_counter()
-            hard_fence(generate(m_, qp, prompt, n_new))
-            steady_q[tag] = time.perf_counter() - t0
+            hard_fence(generate(m_, qp, prompt, n_new))  # compile
+            steady_q[tag] = timed_decode(m_, qp)
             result[f"gen_tokens_per_s_{tag}"] = round(
                 B * n_new / steady_q[tag], 1)
             if progress is not None:
