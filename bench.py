@@ -121,26 +121,11 @@ SWEEP_BASELINE_S = 6.5 * 3600.0  # reference 15-layer × 8-method sweep
 SWEEP_PANEL_RUNS = 14  # 5 deterministic + 3 stochastic × 3 runs per layer
 SWEEP_N_LAYERS = 15
 
-# bf16 peak FLOP/s per chip by device_kind prefix (public spec sheets)
-_PEAK_FLOPS = {
-    "TPU v3": 123e12,
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v5": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-    "TPU7": 2307e12,
-}
-
 
 def _peak_flops(device) -> float | None:
-    kind = getattr(device, "device_kind", "") or ""
-    for prefix in sorted(_PEAK_FLOPS, key=len, reverse=True):
-        if kind.startswith(prefix):
-            return _PEAK_FLOPS[prefix]
-    return None
+    from torchpruner_tpu.utils.flops import peak_bf16_flops
+
+    return peak_bf16_flops(device)
 
 
 def _leg_mnist(smoke: bool) -> dict:
@@ -541,12 +526,9 @@ def _leg_vgg_train(smoke: bool) -> dict:
 
 
 def _flag_implausible_mfu(r: dict) -> dict:
-    """A physically impossible reading means the stopwatch failed, not
-    that the chip beat its own peak — flag it so no sweep/headline path
-    can quote it as clean."""
-    if r.get("mfu") is not None and r["mfu"] > 1.0:
-        r["implausible"] = "mfu > 1.0: timing fence failed"
-    return r
+    from torchpruner_tpu.utils.flops import flag_implausible_mfu
+
+    return flag_implausible_mfu(r)
 
 
 def _batch_sweep(measure, seeded: dict, batches) -> dict:

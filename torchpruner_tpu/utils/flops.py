@@ -44,3 +44,38 @@ def model_cost(
     except Exception:  # cost analysis is best-effort on some backends
         flops = None
     return param_count(params), flops
+
+
+#: bf16 peak FLOP/s per chip by ``device_kind`` prefix (public spec
+#: sheets) — longest prefix wins.  Shared by bench.py's MFU legs and the
+#: step-trace device-MFU computation so the denominators agree.
+PEAK_BF16_FLOPS = {
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+    "TPU7": 2307e12,
+}
+
+
+def peak_bf16_flops(device) -> float | None:
+    """Spec-sheet bf16 peak for ``device`` (None when unknown)."""
+    kind = getattr(device, "device_kind", "") or ""
+    for prefix in sorted(PEAK_BF16_FLOPS, key=len, reverse=True):
+        if kind.startswith(prefix):
+            return PEAK_BF16_FLOPS[prefix]
+    return None
+
+
+def flag_implausible_mfu(r: dict, *keys) -> dict:
+    """An MFU reading above 1.0 means the stopwatch or the trace failed,
+    not that the chip beat its spec — mark the record so no downstream
+    table can quote it as clean.  ``keys`` defaults to ("mfu",)."""
+    for k in keys or ("mfu",):
+        if r.get(k) is not None and r[k] > 1.0:
+            r["implausible"] = f"{k} > 1.0: timing fence or trace failed"
+    return r

@@ -103,10 +103,27 @@ def summarize_trace(log_dir: str, top: int = 25,
             pid for pid, name in proc_names.items()
             if "device:" in name.lower() or "tpu" in name.lower()
         }
+        # a device process carries several stacked tracks: "Steps" (one
+        # span per step number — these dominated early summaries as huge
+        # numerically-named 'other' ops), "XLA Modules" (one span per
+        # program execution, duplicating its ops' time), and "XLA Ops"
+        # (the per-op events this table is about).  Counting all three
+        # triple-counts; restrict to the op tracks when they exist.
+        op_tids = {
+            (e["pid"], e["tid"])
+            for e in events
+            if e.get("ph") == "M" and e.get("name") == "thread_name"
+            and e["pid"] in device_pids
+            and e.get("args", {}).get("name", "") in (
+                "XLA Ops", "Async XLA Ops")
+        }
         for e in events:
             if e.get("ph") != "X" or "dur" not in e:
                 continue
-            if device_pids and e.get("pid") not in device_pids:
+            if op_tids:
+                if (e.get("pid"), e.get("tid")) not in op_tids:
+                    continue
+            elif device_pids and e.get("pid") not in device_pids:
                 continue
             name = e.get("name", "")
             # '$...' = Python frames; 'end: <op>' = nested completion
