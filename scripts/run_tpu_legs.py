@@ -213,7 +213,7 @@ AUX = [
     ("steptrace_mfullama", 1800, lambda out:
         [sys.executable, "-u", "-m",
          "torchpruner_tpu.experiments.step_trace", "--model", "mfu_llama",
-         "--batch", "8", "--out", out]),
+         "--batch", "32", "--out", out]),
 ]
 
 
