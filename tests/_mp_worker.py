@@ -23,11 +23,15 @@ from torchpruner_tpu.parallel.mesh import initialize_distributed, make_mesh
 
 def main() -> None:
     pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    mode = sys.argv[4] if len(sys.argv) > 4 else "dp"
     assert initialize_distributed(
         coordinator_address=f"127.0.0.1:{port}",
         num_processes=n,
         process_id=pid,
     ), "initialize_distributed must report distributed mode"
+    if mode == "pp":
+        run_pp(pid)
+        return
 
     import numpy as np
     import optax
@@ -60,6 +64,50 @@ def main() -> None:
         "eval_loss": eval_loss,
         "eval_acc": eval_acc,
         "w_abs_sum": float(np.abs(w).sum()),
+    }), flush=True)
+
+
+def run_pp(pid: int) -> None:
+    """SPMD pipeline parallelism across processes: the pp mesh axis spans
+    both hosts' devices, so the stage-to-stage ``ppermute`` crosses the
+    process boundary — the collective-based PP path a pod runs (the
+    device-pinned ``parallel.pipeline`` cannot do this)."""
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torchpruner_tpu.core.segment import init_model
+    from torchpruner_tpu.models import llama_tiny
+    from torchpruner_tpu.parallel.pp_spmd import pp_spmd_train_step
+    from torchpruner_tpu.utils.losses import lm_cross_entropy_loss
+
+    mesh = Mesh(np.asarray(jax.devices()), ("pp",))
+    rep = NamedSharding(mesh, P())
+
+    def glob(a):
+        return jax.make_array_from_process_local_data(rep, np.asarray(a))
+
+    model = llama_tiny(depth=4)
+    params, _ = init_model(model, seed=0)
+    tokens = np.asarray(model.example_input(8, seed=0))
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(jax.tree_util.tree_map(np.asarray, params))
+    params = jax.tree_util.tree_map(glob, params)
+    opt_state = jax.tree_util.tree_map(glob, opt_state)
+    toks = glob(tokens)
+
+    step = pp_spmd_train_step(model, opt, lm_cross_entropy_loss,
+                              mesh=mesh, n_microbatches=4)
+    losses = []
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, toks)
+        losses.append(float(loss))
+    print(json.dumps({
+        "pid": pid,
+        "process_count": jax.process_count(),
+        "global_devices": jax.device_count(),
+        "local_devices": jax.local_device_count(),
+        "losses": losses,
     }), flush=True)
 
 

@@ -89,6 +89,11 @@ SLOW_TESTS = {
     "test_pipeline.py::test_pipelined_bn_model_threads_state_through_microbatches",
     "test_torch_import.py::test_hf_llama_import_matches_transformers_forward",
     "test_train.py::test_mixed_precision_training_keeps_f32_master_state",
+    "test_pp_spmd.py::test_pp_spmd_forward_matches_sequential",
+    "test_pp_spmd.py::test_pp_spmd_grads_match_sequential",
+    "test_pp_spmd.py::test_pp_spmd_train_step_matches_single_device",
+    "test_pp_spmd.py::test_pp_spmd_remat_matches",
+    "test_multiprocess.py::test_two_process_spmd_pipeline_matches_single_process",
 }
 
 

@@ -103,3 +103,71 @@ def test_two_process_dp_matches_single_process():
                                rtol=1e-4)
     np.testing.assert_allclose(results[0]["eval_acc"], ref_eval[1],
                                rtol=1e-6)
+
+
+def test_two_process_spmd_pipeline_matches_single_process():
+    """The collective-based PP path (parallel/pp_spmd.py) across two
+    processes: a 4-stage pp mesh axis spanning 2 hosts x 2 devices, so
+    the stage-to-stage ppermute crosses the process boundary.  The loss
+    trajectory must equal the plain single-device gradient step."""
+    worker = os.path.join(REPO, "tests", "_mp_worker.py")
+    env = os.environ.copy()
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), "2", str(port), "pp"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=REPO, env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=420)
+            assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+            outs.append((out, err))
+    finally:
+        for p in procs:
+            p.kill()
+    results = []
+    for out, err in outs:
+        lines = [ln for ln in out.splitlines() if ln.startswith("{")]
+        assert lines, f"no JSON from worker:\n{out}\n{err[-1000:]}"
+        results.append(json.loads(lines[-1]))
+    results.sort(key=lambda r: r["pid"])
+    for r in results:
+        assert r["process_count"] == 2
+        assert r["global_devices"] == 4
+        assert r["local_devices"] == 2
+    np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
+                               rtol=1e-6)
+
+    # single-device reference trajectory (same seeds, same data)
+    import jax
+    import optax
+
+    from torchpruner_tpu.core.segment import init_model
+    from torchpruner_tpu.models import llama_tiny
+    from torchpruner_tpu.utils.losses import lm_cross_entropy_loss
+
+    model = llama_tiny(depth=4)
+    params, _ = init_model(model, seed=0)
+    tokens = model.example_input(8, seed=0)
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    def loss_fn(p):
+        logits, _ = model.apply(p, tokens)
+        return lm_cross_entropy_loss(logits, tokens).mean()
+
+    ref = []
+    for _ in range(3):
+        l, g = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(g, opt_state, params)
+        params = jax.tree_util.tree_map(lambda a, u: a + u, params, updates)
+        ref.append(float(l))
+    np.testing.assert_allclose(results[0]["losses"], ref,
+                               rtol=1e-4, atol=1e-6)

@@ -41,6 +41,12 @@ from torchpruner_tpu.parallel.ulysses import (
     ulysses_attention_local,
 )
 from torchpruner_tpu.parallel.pipeline import PipelineParallel, balance_stages
+from torchpruner_tpu.parallel.pp_spmd import (
+    pp_spmd_apply,
+    pp_spmd_train_step,
+    split_pipeline,
+    stack_block_params,
+)
 from torchpruner_tpu.parallel.sp import SPTrainer, sp_model
 
 __all__ = [
@@ -67,6 +73,10 @@ __all__ = [
     "ulysses_attention",
     "ulysses_attention_local",
     "PipelineParallel",
+    "pp_spmd_apply",
+    "pp_spmd_train_step",
+    "split_pipeline",
+    "stack_block_params",
     "balance_stages",
     "SPTrainer",
     "sp_model",

@@ -35,10 +35,13 @@ device (parameter updates still use pre-step params for every microbatch,
 as in GPipe).
 
 This is the honest JAX formulation of pipeline parallelism for one process
-with several local devices (a TPU host's chips).  Cross-host pipelining
-composes with the mesh layers (DP/FSDP/TP shard *within* a stage via
-``ShardedTrainer``); a fused schedule inside one XLA program is the later
-optimization.
+with several local devices (a TPU host's chips) and HETEROGENEOUS stages
+(conv stacks, pruned-per-block models).  For uniform-block transformer
+stacks, :mod:`~torchpruner_tpu.parallel.pp_spmd` is the cross-host
+formulation: the schedule fused into one ``shard_map``-ed XLA program,
+activations shifting stage-to-stage over ``lax.ppermute`` — the
+collective rides ICI/DCN, so it pipelines across processes where this
+module's device pinning cannot.
 """
 
 from __future__ import annotations

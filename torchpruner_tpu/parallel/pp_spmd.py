@@ -1,0 +1,250 @@
+"""SPMD pipeline parallelism — collective-based, cross-host capable.
+
+:mod:`~torchpruner_tpu.parallel.pipeline` pipelines *heterogeneous*
+stages by pinning each stage's params to a local device and letting
+async dispatch overlap them — which is single-process by construction
+(a process cannot ``device_put`` onto another host's chips).  This
+module is the pods formulation for uniform-block transformer stacks
+(the llama family): ONE program runs on every device of a ``pp`` mesh
+axis under ``shard_map``; the depth axis of the *stacked* block params
+is sharded over ``pp`` (each device holds ``depth // n_stages``
+consecutive blocks), microbatches stream through the stages, and
+``lax.ppermute`` shifts activations stage→stage.  The permute is an XLA
+collective like any other — it rides ICI within a host and DCN across
+hosts — so the same compiled step pipelines across processes
+(SURVEY.md §2.11's pods north star), with no NCCL-analog code.
+
+Schedule: GPipe forward fill/drain (Huang et al., 2019) over
+``T = n_micro + n_stages - 1`` ticks, expressed as ONE ``lax.scan``:
+at tick ``t`` stage 0 injects microbatch ``t``, every stage applies its
+blocks to whatever the permute delivered, the last stage banks outputs
+for microbatch ``t - (n_stages - 1)``.  The bubble fraction is the
+standard ``(S - 1) / (M + S - 1)``.  Gradients need nothing special:
+the transpose of ``ppermute`` is the reverse permutation, so
+``jax.grad`` of the whole step is pipeline-parallel automatically —
+activation gradients hop backwards over the same collective.
+
+Composability: params enter in the model's ordinary pytree layout and
+are stacked inside the traced function, so gradient pytrees, optax
+states, checkpoints, and the pruner all keep the unstacked layout;
+other mesh axes (data, tensor) compose through GSPMD exactly as in
+``ShardedTrainer``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.segment import SegmentedModel
+
+
+def split_pipeline(model: SegmentedModel):
+    """``(pre, pairs, post)``: the top-level layers before the first
+    uniform block, the per-block ``(attn, ffn)`` :class:`Residual`
+    pairs, and the layers after the last block.
+
+    Raises if the blocks are not uniform (stage stacking needs every
+    block's param shapes identical — true for the dense llama family;
+    pruned-per-block or MoE models should pipeline with
+    :mod:`~torchpruner_tpu.parallel.pipeline` instead).
+    """
+    pre: List[L.LayerSpec] = []
+    pairs: List[Tuple[L.LayerSpec, L.LayerSpec]] = []
+    post: List[L.LayerSpec] = []
+    specs = list(model.layers)
+    i = 0
+    while i < len(specs):
+        a = specs[i]
+        b = specs[i + 1] if i + 1 < len(specs) else None
+        if (isinstance(a, L.Residual) and isinstance(b, L.Residual)
+                and a.name.endswith("_attn") and b.name.endswith("_ffn")):
+            if post:
+                # a pair after non-block layers would be silently
+                # reordered around them by the stage stacking — refuse
+                raise ValueError(
+                    f"block pair {a.name}/{b.name} appears after "
+                    f"non-block layer {post[0].name}: the block stack "
+                    "must be contiguous for SPMD pipelining")
+            pairs.append((a, b))
+            i += 2
+        elif not pairs:
+            pre.append(a)
+            i += 1
+        else:
+            post.append(a)
+            i += 1
+    if not pairs:
+        raise ValueError("no uniform (attn, ffn) Residual pairs found — "
+                         "pp_spmd needs a llama-style block stack")
+    def _reject_unsupported(spec):
+        if isinstance(spec, L.BatchNorm):
+            raise ValueError(
+                f"BatchNorm ({spec.name}) carries running state; "
+                "cross-microbatch state threading belongs to "
+                "parallel.pipeline, not the SPMD formulation")
+        if isinstance(spec, L.Dropout) and getattr(spec, "rate", 0):
+            raise ValueError(
+                f"Dropout ({spec.name}) needs per-microbatch rng "
+                "plumbing the SPMD schedule does not provide yet")
+        for child in (getattr(spec, "body", ()) or ()) + tuple(
+                getattr(spec, "shortcut", ()) or ()):
+            _reject_unsupported(child)
+
+    for spec in list(pre) + [s for p in pairs for s in p] + list(post):
+        _reject_unsupported(spec)
+    canon = tuple(dataclasses.replace(s, name=n)
+                  for s, n in zip(pairs[0], ("pp_attn", "pp_ffn")))
+    for a, b in pairs[1:]:
+        got = (dataclasses.replace(a, name="pp_attn"),
+               dataclasses.replace(b, name="pp_ffn"))
+        if got != canon:
+            raise ValueError(
+                f"non-uniform blocks ({a.name}/{b.name} differ from "
+                f"{pairs[0][0].name}/{pairs[0][1].name}) — stage stacking "
+                "requires identical block shapes")
+    return tuple(pre), tuple(pairs), tuple(post)
+
+
+def stack_block_params(params, pairs):
+    """Per-leaf ``jnp.stack`` of the blocks' param subtrees along a new
+    leading depth axis: ``{"attn": tree, "ffn": tree}`` with every leaf
+    shaped ``(depth, ...)``.  Runs under jit (the stack fuses; under a
+    sharded entry the result is resharded by GSPMD per the shard_map
+    in_specs)."""
+    attn = [params[a.name] for a, _ in pairs]
+    ffn = [params[f.name] for _, f in pairs]
+    return {
+        "attn": jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *attn),
+        "ffn": jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *ffn),
+    }
+
+
+def pp_spmd_apply(
+    model: SegmentedModel,
+    params,
+    tokens,
+    *,
+    mesh: Mesh,
+    n_microbatches: int,
+    axis: str = "pp",
+    remat: bool = False,
+    compute_dtype=None,
+    train: bool = False,
+):
+    """Forward pass with the block stack pipelined over ``mesh[axis]``.
+
+    ``tokens``: ``(B, S)`` int32, ``B % n_microbatches == 0``.  Embedding
+    and head (the ``pre``/``post`` layers) run replicated outside the
+    pipelined region — they are a sliver of the FLOPs; sharding them
+    belongs to the data/tensor axes.  Returns ``(B, S, vocab)`` logits.
+
+    State-carrying layers (BatchNorm) are rejected: the llama family is
+    stateless, and cross-microbatch state threading belongs to
+    :mod:`~torchpruner_tpu.parallel.pipeline`.
+    """
+    pre, pairs, post = split_pipeline(model)
+    n_stages = mesh.shape[axis]
+    depth = len(pairs)
+    if depth % n_stages != 0:
+        raise ValueError(f"depth {depth} not divisible by {n_stages} stages")
+    M = n_microbatches
+    B = tokens.shape[0]
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    attn_spec, ffn_spec = (dataclasses.replace(s, name=n)
+                           for s, n in zip(pairs[0], ("pp_attn", "pp_ffn")))
+
+    if compute_dtype is not None:
+        params = jax.tree_util.tree_map(
+            lambda a: a.astype(compute_dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
+
+    h, _ = L.apply_seq(pre, params, {}, tokens, train=train)
+    x_micro = h.reshape((M, B // M) + h.shape[1:])
+    stacked = stack_block_params(params, pairs)
+
+    def stage_program(blocks_local, x_all):
+        idx = jax.lax.axis_index(axis)
+
+        def apply_blocks(act):
+            def body(a, p_one):
+                a2, _ = L.apply_seq(
+                    (attn_spec, ffn_spec),
+                    {"pp_attn": p_one["attn"], "pp_ffn": p_one["ffn"]},
+                    {}, a, train=train, remat=remat,
+                )
+                return a2, None
+            out, _ = jax.lax.scan(body, act, blocks_local)
+            return out
+
+        def tick(carry, t):
+            act_in, out_buf = carry
+            inject = x_all[jnp.clip(t, 0, M - 1)]
+            cur = jnp.where(idx == 0, inject, act_in)
+            y = apply_blocks(cur)
+            m = t - (n_stages - 1)
+            banked = out_buf.at[jnp.clip(m, 0, M - 1)].set(y)
+            write = (idx == n_stages - 1) & (m >= 0) & (m < M)
+            out_buf = jnp.where(write, banked, out_buf)
+            act_next = jax.lax.ppermute(
+                y, axis, [(s, s + 1) for s in range(n_stages - 1)])
+            return (act_next, out_buf), None
+
+        # the tick carry is device-varying from the first ppermute on;
+        # seed it as varying so the loop-invariant checker types the
+        # scan consistently (new shard_map VMA semantics)
+        carry0 = (jnp.zeros_like(x_all[0]), jnp.zeros_like(x_all))
+        if hasattr(jax.lax, "pcast"):
+            carry0 = jax.lax.pcast(carry0, axis, to="varying")
+        else:  # pragma: no cover - older jax
+            carry0 = jax.lax.pvary(carry0, axis)
+        (_, out_buf), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(M + n_stages - 1))
+        # only the last stage ever banks outputs; the psum both collects
+        # them and re-replicates the result for the post layers
+        return jax.lax.psum(out_buf, axis)
+
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    spec_blocks = jax.tree_util.tree_map(lambda _: P(axis), stacked)
+    y_micro = shard_map(
+        stage_program, mesh=mesh,
+        in_specs=(spec_blocks, P()), out_specs=P(),
+    )(stacked, x_micro)
+    y = y_micro.reshape((B,) + y_micro.shape[2:])
+    logits, _ = L.apply_seq(post, params, {}, y, train=train)
+    return logits
+
+
+def pp_spmd_train_step(model, optimizer, loss_fn, *, mesh, n_microbatches,
+                       axis: str = "pp", remat: bool = False,
+                       compute_dtype=None):
+    """A jitted ``(params, opt_state, tokens) -> (params', opt_state',
+    loss)`` whose forward/backward is pipelined over ``mesh[axis]``.
+    ``loss_fn(logits, tokens) -> (B,)`` per-example losses (e.g.
+    :func:`~torchpruner_tpu.utils.losses.lm_cross_entropy_loss`)."""
+
+    def loss(params, tokens):
+        logits = pp_spmd_apply(
+            model, params, tokens, mesh=mesh,
+            n_microbatches=n_microbatches, axis=axis, remat=remat,
+            compute_dtype=compute_dtype, train=True)
+        return loss_fn(logits, tokens).mean()
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        l, grads = jax.value_and_grad(loss)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, opt_state, l
+
+    return step
