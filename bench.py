@@ -108,12 +108,15 @@ CPU_RESERVE_S = float(os.environ.get("BENCH_CPU_RESERVE_S", "600"))
 #: (cold compiles through the tunnel); CPU numbers from the round-2/3
 #: fallback runs on the 1-core host.
 _LEG_EST_S = {
-    "mnist_prune": (90, 520),
-    "vgg16_train": (300, 3600),
-    "mfu_llama": (420, 3600),
-    "llama_decode": (600, 300),
-    "flash_attention": (240, 3600),
-    "vgg16_robustness": (2400, 100000),
+    # TPU numbers re-based on the round-4 captures, warm persistent
+    # cache (observed: mnist 60 s, vgg_train 32 s, mfu_llama 51 s,
+    # decode 63 s, flash 10 s, sweep 928 s), with 2-6x cold margin
+    "mnist_prune": (150, 520),
+    "vgg16_train": (120, 3600),
+    "mfu_llama": (180, 3600),
+    "llama_decode": (180, 300),
+    "flash_attention": (60, 3600),
+    "vgg16_robustness": (1500, 100000),
 }
 
 MNIST_BASELINE_S = 28.0  # reference MNIST FC prune wall-clock (BASELINE.md)
@@ -1154,6 +1157,19 @@ def orchestrate() -> dict:
                 pass
         if rc == 0 and result is not None and result.get("value") is not None:
             result.pop("stream", None)
+            if result.get("platform") == "tpu" and "--smoke" not in sys.argv:
+                # the PRINTED result must carry previously-cached legs a
+                # budget-capped child skipped (e.g. the 15-layer sweep),
+                # and its headline must be re-assembled from the merged
+                # set — otherwise a fast subset run demotes the recorded
+                # headline to the MNIST metric even though a measured
+                # sweep sits in the cache (round-4 rehearsal bug)
+                merged = _merge_cached_legs(result.get("legs", {}),
+                                            replace_errors=False)
+                result.update(_assemble(
+                    merged, result.get("platform"),
+                    result.get("device_kind"),
+                    result.get("compilation_cache"), False))
             if attempts:
                 result["attempts"] = attempts
             if (best_partial is not None
@@ -1204,13 +1220,18 @@ def orchestrate() -> dict:
     return out
 
 
-def _merge_cached_legs(legs: dict) -> dict:
+def _merge_cached_legs(legs: dict, *, replace_errors: bool = True) -> dict:
     """``legs`` extended with previously-cached TPU legs this run skipped
     or didn't reach (a budget-capped run that skips the 2400 s sweep must
     not erase a previously-captured sweep) — each carried leg labelled
     with the commit/timestamp it was measured at.  Shared by the cache
     writer below and the per-leg capture runner, so a SUBSET capture's
-    headline is assembled from the merged set, not just this run's legs."""
+    headline is assembled from the merged set, not just this run's legs.
+
+    ``replace_errors=False`` (the PRINTED-result path) keeps a leg that
+    errored THIS run visible instead of papering over the regression
+    with a stale cached success; the cache file itself stays
+    last-known-good per leg (``True``)."""
     merged = dict(legs)
     try:
         with open(TPU_CACHE) as f:
@@ -1219,7 +1240,9 @@ def _merge_cached_legs(legs: dict) -> dict:
             cur = merged.get(name)
             cur_ok = isinstance(cur, dict) and "error" not in cur \
                 and "skipped" not in cur
-            if cur_ok or not isinstance(leg, dict) or "error" in leg \
+            cur_errored = isinstance(cur, dict) and "error" in cur
+            if cur_ok or (cur_errored and not replace_errors) \
+                    or not isinstance(leg, dict) or "error" in leg \
                     or "skipped" in leg:
                 continue
             merged[name] = dict(leg)

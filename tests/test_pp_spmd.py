@@ -132,3 +132,29 @@ def test_pp_spmd_remat_matches():
                         n_microbatches=2, remat=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_pp_spmd_composes_with_uniform_prune():
+    """Pruning every block's FFN to the SAME width keeps the stack
+    uniform (per-block indices may differ — only shapes must match), so
+    structured pruning composes with SPMD pipelining: the pipelined
+    forward of the pruned model equals its sequential forward."""
+    from torchpruner_tpu.core.graph import pruning_graph
+    from torchpruner_tpu.core.pruner import prune_by_scores
+
+    model, params, tokens = _model_and_data(depth=2)
+    rng = np.random.default_rng(0)
+    pm, pp_, ps = model, params, None
+    for g in pruning_graph(model):
+        if not g.target.endswith("/gate"):
+            continue
+        scores = rng.normal(size=pm.layer(g.target).features)
+        res = prune_by_scores(pm, pp_, g.target, scores,
+                              policy="fraction", fraction=0.25, state=ps)
+        pm, pp_, ps = res.model, res.params, res.state
+    assert pm is not model, "prune must have fired"
+    mesh = _mesh(2)
+    want, _ = pm.apply(pp_, tokens)
+    got = pp_spmd_apply(pm, pp_, tokens, mesh=mesh, n_microbatches=2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
