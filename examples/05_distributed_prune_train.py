@@ -1,0 +1,128 @@
+"""Example 5 — beyond the reference: the distributed prune-train loop.
+
+The reference runs everything on one GPU in one process; this framework's
+north star (SURVEY.md §2.11, BASELINE.json) is the same loop on TPU pods.
+This script demonstrates the full scale path on a virtual 8-device CPU
+mesh — the exact code that runs on real chips, exercised the same way
+``__graft_entry__.dryrun_multichip`` validates it every round:
+
+1. a ``{data: 2, model: 2}`` mesh: Llama decoder trained with the batch
+   sharded over ``data`` and params column/row-split over ``model``
+   (tensor parallelism derived from the pruning graph),
+2. distributed attribution scoring (per-example score rows psum-reduced
+   across the mesh), followed by a structured FFN prune + reshard +
+   continued training at the new shapes,
+3. the same architecture (fresh params) pipelined over a ``{pp: 4}``
+   axis with the collective-based SPMD formulation
+   (``parallel/pp_spmd.py``) — stacked blocks, ``lax.ppermute`` between
+   stages,
+4. a ``{pp: 2, data: 2}`` 2-D mesh: pipeline and data parallelism
+   composed in one program — the first-step loss must equal step 3's
+   (same params, same batch, different mesh layout), asserted.
+
+Runs in a couple of minutes on CPU.  On a pod, replace the virtual
+devices with ``initialize_distributed()`` + the real mesh — nothing else
+changes (tests/test_multiprocess.py proves the 2-process wiring).
+
+Run::
+
+    python examples/05_distributed_prune_train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main() -> None:
+    argparse.ArgumentParser(description=__doc__.splitlines()[0]).parse_args()
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import optax
+
+    import torchpruner_tpu as tp
+    from torchpruner_tpu.core.pruner import prune_by_scores
+    from torchpruner_tpu.models import llama_tiny
+    from torchpruner_tpu.parallel import (
+        DistributedScorer,
+        ShardedTrainer,
+        make_mesh,
+        pp_spmd_train_step,
+    )
+    from torchpruner_tpu.utils.losses import lm_cross_entropy_loss
+
+    devices = jax.devices()
+    print(f"devices: {len(devices)} × {devices[0].platform}")
+
+    # -- 1) DP×TP training ------------------------------------------------
+    model = llama_tiny(depth=4)
+    mesh = make_mesh({"data": 2, "model": 2}, devices=devices[:4])
+    trainer = ShardedTrainer.create(
+        model, optax.adam(1e-3), lm_cross_entropy_loss, mesh,
+        seed=0, min_shard_size=0, partition="tp",
+    )
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 256, size=(8, 16)).astype(np.int32)
+    for step in range(3):
+        loss = float(trainer.step(toks, toks))
+    print(f"1) DP×TP train ok (loss {loss:.4f} on mesh "
+          f"{dict(zip(mesh.axis_names, mesh.devices.shape))})")
+
+    # -- 2) score → prune → reshard → keep training -----------------------
+    metric = tp.TaylorAttributionMetric(
+        trainer.model, trainer.params, [(toks, toks)],
+        lm_cross_entropy_loss, state=trainer.state,
+    )
+    # score rows computed SPMD over the mesh's data axis (psum-reduced)
+    scores = DistributedScorer(metric, mesh).run("block1_ffn/gate")
+    res = prune_by_scores(
+        trainer.model, trainer.params, "block1_ffn/gate", scores,
+        policy="fraction", fraction=0.25,
+        state=trainer.state, opt_state=trainer.opt_state,
+    )
+    trainer = trainer.rebuild(res.model, res.params, res.state,
+                              res.opt_state)
+    loss_pruned = float(trainer.step(toks, toks))
+    print(f"2) scored + pruned 25% of block1 FFN, resharded, trained "
+          f"(loss {loss_pruned:.4f}, widths {res.model.layer('block1_ffn/gate').features})")
+
+    # -- 3) SPMD pipeline over 4 stages -----------------------------------
+    pp_mesh = make_mesh({"pp": 4}, devices=devices[:4])
+    step_pp = pp_spmd_train_step(
+        model, optax.adam(1e-3), lm_cross_entropy_loss,
+        mesh=pp_mesh, n_microbatches=4,
+    )
+    params, _ = tp.init_model(model, seed=0)
+    opt_state = optax.adam(1e-3).init(params)
+    params, opt_state, loss_spmd = step_pp(params, opt_state, toks)
+    print(f"3) SPMD pipeline (4 stages, ppermute streaming) train ok "
+          f"(loss {float(loss_spmd):.4f})")
+
+    # -- 4) PP × DP on a 2-D mesh -----------------------------------------
+    mesh2d = make_mesh({"pp": 2, "data": 2}, devices=devices[:4])
+    step_2d = pp_spmd_train_step(
+        model, optax.adam(1e-3), lm_cross_entropy_loss,
+        mesh=mesh2d, n_microbatches=2, data_axis="data",
+    )
+    params, _ = tp.init_model(model, seed=0)
+    params, _, loss_2d = step_2d(params, optax.adam(1e-3).init(params), toks)
+    assert abs(float(loss_2d) - float(loss_spmd)) < 1e-4, (loss_2d, loss_spmd)
+    print(f"4) PP×DP composed on a 2-D mesh ok (loss {float(loss_2d):.4f} "
+          f"== step 3's, asserted)")
+
+
+if __name__ == "__main__":
+    main()

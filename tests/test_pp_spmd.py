@@ -158,3 +158,17 @@ def test_pp_spmd_composes_with_uniform_prune():
     got = pp_spmd_apply(pm, pp_, tokens, mesh=mesh, n_microbatches=2)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_pp_spmd_composes_with_data_axis():
+    """PP x DP on a 2-D mesh: batch sharded over `data` while the block
+    stack pipelines over `pp` — the pod layout.  Output must equal the
+    single-device forward."""
+    model, params, tokens = _model_and_data(depth=2)
+    mesh = jax.sharding.Mesh(
+        np.asarray(jax.devices()[:4]).reshape(2, 2), ("pp", "data"))
+    want, _ = model.apply(params, tokens)
+    got = pp_spmd_apply(model, params, tokens, mesh=mesh,
+                        n_microbatches=2, data_axis="data")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)

@@ -133,6 +133,7 @@ def pp_spmd_apply(
     mesh: Mesh,
     n_microbatches: int,
     axis: str = "pp",
+    data_axis: str | None = None,
     remat: bool = False,
     compute_dtype=None,
     train: bool = False,
@@ -143,6 +144,13 @@ def pp_spmd_apply(
     and head (the ``pre``/``post`` layers) run replicated outside the
     pipelined region — they are a sliver of the FLOPs; sharding them
     belongs to the data/tensor axes.  Returns ``(B, S, vocab)`` logits.
+
+    ``data_axis`` composes PP with DP on a 2-D mesh (e.g.
+    ``{"pp": 4, "data": 2}``): each microbatch's batch dim is sharded
+    over ``data_axis``, so every pp stage runs the pipeline schedule on
+    its data shard — the standard pod layout.  Block params stay
+    replicated over ``data_axis`` (shard them over an fsdp axis via the
+    caller's param shardings if needed; GSPMD composes).
 
     State-carrying layers (BatchNorm) are rejected: the llama family is
     stateless, and cross-microbatch state threading belongs to
@@ -157,6 +165,14 @@ def pp_spmd_apply(
     B = tokens.shape[0]
     if B % M != 0:
         raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    if data_axis is not None:
+        if data_axis not in mesh.shape:
+            raise ValueError(f"data_axis {data_axis!r} not in mesh axes "
+                             f"{tuple(mesh.shape)}")
+        if (B // M) % mesh.shape[data_axis] != 0:
+            raise ValueError(
+                f"microbatch size {B // M} not divisible by mesh axis "
+                f"{data_axis}={mesh.shape[data_axis]}")
     attn_spec, ffn_spec = (dataclasses.replace(s, name=n)
                            for s, n in zip(pairs[0], ("pp_attn", "pp_ffn")))
 
@@ -216,9 +232,12 @@ def pp_spmd_apply(
         from jax.experimental.shard_map import shard_map
 
     spec_blocks = jax.tree_util.tree_map(lambda _: P(axis), stacked)
+    # (M, mb, seq, d): microbatch dim stays whole on every stage; the
+    # per-microbatch batch dim shards over the optional data axis
+    spec_x = P(None, data_axis) if data_axis else P()
     y_micro = shard_map(
         stage_program, mesh=mesh,
-        in_specs=(spec_blocks, P()), out_specs=P(),
+        in_specs=(spec_blocks, spec_x), out_specs=spec_x,
     )(stacked, x_micro)
     y = y_micro.reshape((B,) + y_micro.shape[2:])
     logits, _ = L.apply_seq(post, params, {}, y, train=train)
@@ -226,8 +245,8 @@ def pp_spmd_apply(
 
 
 def pp_spmd_train_step(model, optimizer, loss_fn, *, mesh, n_microbatches,
-                       axis: str = "pp", remat: bool = False,
-                       compute_dtype=None):
+                       axis: str = "pp", data_axis: str | None = None,
+                       remat: bool = False, compute_dtype=None):
     """A jitted ``(params, opt_state, tokens) -> (params', opt_state',
     loss)`` whose forward/backward is pipelined over ``mesh[axis]``.
     ``loss_fn(logits, tokens) -> (B,)`` per-example losses (e.g.
@@ -236,7 +255,8 @@ def pp_spmd_train_step(model, optimizer, loss_fn, *, mesh, n_microbatches,
     def loss(params, tokens):
         logits = pp_spmd_apply(
             model, params, tokens, mesh=mesh,
-            n_microbatches=n_microbatches, axis=axis, remat=remat,
+            n_microbatches=n_microbatches, axis=axis,
+            data_axis=data_axis, remat=remat,
             compute_dtype=compute_dtype, train=True)
         return loss_fn(logits, tokens).mean()
 
