@@ -205,10 +205,10 @@ def _leg_vgg_robustness(smoke: bool, progress=None) -> dict:
 
     from torchpruner_tpu.data import load_dataset
     from torchpruner_tpu.experiments.robustness import (
+        PANEL_VERSION,
         auc_summary_std,
         layerwise_robustness,
     )
-    from torchpruner_tpu.experiments.prune_retrain import build_metric
     from torchpruner_tpu.models import vgg16_bn
     from torchpruner_tpu.train.loop import Trainer
     from torchpruner_tpu.utils.losses import cross_entropy_loss
@@ -242,7 +242,7 @@ def _leg_vgg_robustness(smoke: bool, progress=None) -> dict:
     # whenever the methods dict / sv_samples / runs change)
     cfg_key = {"n_examples": n_examples, "epochs": epochs,
                "platform": jax.devices()[0].platform,
-               "panel": "8m-sv5-runs3-adam1e3-bf16-v1"}
+               "panel": PANEL_VERSION}
 
     def _atomic_pickle(path, obj):
         os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -294,28 +294,14 @@ def _leg_vgg_robustness(smoke: bool, progress=None) -> dict:
     test_loss, test_acc = eval_model(model, params, state, batches,
                                      cross_entropy_loss)
 
-    def factory(method, reduction="mean", **kw):
-        def make(run=0):
-            # bf16 scoring forwards (MXU rate), f32 loss accumulation —
-            # the TPU-native sweep configuration
-            return build_metric(
-                method, model, params, batches, cross_entropy_loss,
-                state=state, reduction=reduction, seed=run,
-                compute_dtype=jnp.bfloat16, **kw,
-            )
-        return make
+    # bf16 scoring forwards (MXU rate), f32 loss accumulation — the
+    # TPU-native sweep configuration; ONE panel definition shared with
+    # experiments.sweep_scaling (which calibrates this leg's
+    # example-count adjustment)
+    from torchpruner_tpu.experiments.robustness import method_panel
 
-    methods = {
-        "random": factory("random"),
-        "weight_norm": factory("weight_norm"),
-        "apoz": factory("apoz"),
-        "sensitivity": factory("sensitivity"),
-        "taylor": factory("taylor"),
-        "taylor_signed": factory("taylor", signed=True),
-        "sv": factory("shapley", sv_samples=5),
-        "sv_mean+2std": factory("shapley", reduction="mean+2std",
-                                sv_samples=5),
-    }
+    methods = method_panel(model, params, batches, cross_entropy_loss,
+                           state=state, compute_dtype=jnp.bfloat16)
     from torchpruner_tpu.core.graph import pruning_graph
 
     all_layers = (list(layers) if layers is not None

@@ -188,6 +188,43 @@ def loss_increase_auc(curve: Dict[str, np.ndarray]) -> float:
     return float(np.mean(curve["loss"] - curve["base_loss"]))
 
 
+PANEL_VERSION = "8m-sv5-runs3-adam1e3-bf16-v1"
+
+
+def method_panel(model, params, batches, loss_fn, *, state=None,
+                 compute_dtype=None, sv_samples: int = 5):
+    """The reference's 8-method scoring panel (VGG notebook cell 8 —
+    random / weight_norm / apoz / sensitivity / taylor / taylor_signed /
+    sv / sv_mean+2std) as metric factories for
+    :func:`layerwise_robustness`.  ONE definition shared by the bench
+    sweep leg and :mod:`~.sweep_scaling`, so the scaling measurement
+    always calibrates the exact panel the headline runs; bump
+    ``PANEL_VERSION`` whenever the dict, ``sv_samples``, or the
+    stochastic-run policy changes (it keys the sweep's resume scratch).
+    """
+    from torchpruner_tpu.experiments.prune_retrain import build_metric
+
+    def factory(method, reduction="mean", **kw):
+        def make(run=0):
+            return build_metric(
+                method, model, params, batches, loss_fn,
+                state=state, reduction=reduction, seed=run,
+                compute_dtype=compute_dtype, **kw)
+        return make
+
+    return {
+        "random": factory("random"),
+        "weight_norm": factory("weight_norm"),
+        "apoz": factory("apoz"),
+        "sensitivity": factory("sensitivity"),
+        "taylor": factory("taylor"),
+        "taylor_signed": factory("taylor", signed=True),
+        "sv": factory("shapley", sv_samples=sv_samples),
+        "sv_mean+2std": factory("shapley", reduction="mean+2std",
+                                sv_samples=sv_samples),
+    }
+
+
 def layerwise_robustness(
     model: SegmentedModel,
     params,
