@@ -208,6 +208,7 @@ def _leg_vgg_robustness(smoke: bool, progress=None) -> dict:
         PANEL_VERSION,
         auc_summary_std,
         layerwise_robustness,
+        method_panel,
     )
     from torchpruner_tpu.models import vgg16_bn
     from torchpruner_tpu.train.loop import Trainer
@@ -298,8 +299,6 @@ def _leg_vgg_robustness(smoke: bool, progress=None) -> dict:
     # TPU-native sweep configuration; ONE panel definition shared with
     # experiments.sweep_scaling (which calibrates this leg's
     # example-count adjustment)
-    from torchpruner_tpu.experiments.robustness import method_panel
-
     methods = method_panel(model, params, batches, cross_entropy_loss,
                            state=state, compute_dtype=jnp.bfloat16)
     from torchpruner_tpu.core.graph import pruning_graph
