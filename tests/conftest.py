@@ -94,6 +94,7 @@ SLOW_TESTS = {
     "test_pp_spmd.py::test_pp_spmd_train_step_matches_single_device",
     "test_pp_spmd.py::test_pp_spmd_remat_matches",
     "test_pp_spmd.py::test_pp_spmd_composes_with_data_axis",
+    "test_pp_spmd.py::test_pp_spmd_vit_forward_matches",
     "test_pp_spmd.py::test_pp_spmd_composes_with_uniform_prune",
     "test_multiprocess.py::test_two_process_spmd_pipeline_matches_single_process",
 }

@@ -172,3 +172,29 @@ def test_pp_spmd_composes_with_data_axis():
                         n_microbatches=2, data_axis="data")
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_pp_spmd_vit_forward_matches():
+    """ViT's `_attn`/`_mlp` Residual pairs pipeline exactly like llama's
+    `_attn`/`_ffn` — vision transformer forward parity over 2 stages."""
+    from torchpruner_tpu.models import vit_tiny
+
+    model = vit_tiny(depth=2)
+    params, state = init_model(model, seed=0)
+    assert not state
+    x = jnp.asarray(np.asarray(model.example_input(4, seed=0)))
+    mesh = _mesh(2)
+    want, _ = model.apply(params, x)
+    got = pp_spmd_apply(model, params, x, mesh=mesh, n_microbatches=2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pp_spmd_bert_rejected_cleanly():
+    """BERT's interleaved post-LayerNorms break block contiguity; the
+    split must refuse rather than silently reorder (parallel.pipeline
+    handles heterogeneous stacks)."""
+    from torchpruner_tpu.models import bert_tiny
+
+    with pytest.raises(ValueError):
+        split_pipeline(bert_tiny())

@@ -54,6 +54,11 @@ def split_pipeline(model: SegmentedModel):
     pruned-per-block or MoE models should pipeline with
     :mod:`~torchpruner_tpu.parallel.pipeline` instead).
     """
+    # llama blocks pair `_attn` with `_ffn`; ViT pairs `_attn` with
+    # `_mlp` — both are uniform adjacent Residual pairs and pipeline
+    # identically.  BERT interleaves post-LayerNorms between the
+    # residuals, so it correctly fails the pairing (use
+    # parallel.pipeline for it).
     pre: List[L.LayerSpec] = []
     pairs: List[Tuple[L.LayerSpec, L.LayerSpec]] = []
     post: List[L.LayerSpec] = []
@@ -63,7 +68,8 @@ def split_pipeline(model: SegmentedModel):
         a = specs[i]
         b = specs[i + 1] if i + 1 < len(specs) else None
         if (isinstance(a, L.Residual) and isinstance(b, L.Residual)
-                and a.name.endswith("_attn") and b.name.endswith("_ffn")):
+                and a.name.endswith("_attn")
+                and b.name.endswith(("_ffn", "_mlp"))):
             if post:
                 # a pair after non-block layers would be silently
                 # reordered around them by the stage stacking — refuse
@@ -80,8 +86,9 @@ def split_pipeline(model: SegmentedModel):
             post.append(a)
             i += 1
     if not pairs:
-        raise ValueError("no uniform (attn, ffn) Residual pairs found — "
-                         "pp_spmd needs a llama-style block stack")
+        raise ValueError(
+            "no uniform (attn, ffn/mlp) Residual pairs found — pp_spmd "
+            "needs a llama- or ViT-style block stack")
     def _reject_unsupported(spec):
         if isinstance(spec, L.BatchNorm):
             raise ValueError(
