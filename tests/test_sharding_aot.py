@@ -108,6 +108,30 @@ def test_llama3_8b_would_catch_an_unsharded_tensor():
         _assert_no_large_replicated(params, shardings)
 
 
+def _abstract_sharded_inputs(params, opt_shapes, p_sh, mesh):
+    """(p_s, o_s): ShapeDtypeStruct trees carrying the given param
+    shardings and FSDP-over-data adam-state shardings (scalar counts
+    replicate) — the shared recipe for every AOT lowering test."""
+    opt_sh = jax.tree_util.tree_map(
+        # adam m/v mirror the param tree; scalar counts replicate
+        lambda leaf: (
+            NamedSharding(mesh, P())
+            if np.ndim(leaf) == 0
+            else fsdp_sharding(leaf, mesh, axis="data")
+        ),
+        opt_shapes,
+        is_leaf=lambda x: hasattr(x, "shape"),
+    )
+    p_s = jax.tree_util.tree_map(
+        lambda l, sh: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=sh),
+        params, p_sh)
+    o_s = jax.tree_util.tree_map(
+        lambda l, sh: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=sh),
+        opt_shapes, opt_sh,
+        is_leaf=lambda x: hasattr(x, "shape"))
+    return p_s, o_s
+
+
 @pytest.mark.parametrize("partition", ["fsdp", "tp"])
 def test_llama3_8b_train_step_lowers_on_abstract_pod_mesh(partition):
     """Trace + lower the full sharded train step (fwd, bwd, adam update)
@@ -120,16 +144,6 @@ def test_llama3_8b_train_step_lowers_on_abstract_pod_mesh(partition):
         p_sh = fsdp_sharding(params, MESH)
     else:
         p_sh = tp_sharding(model, params, MESH)
-    opt_sh = jax.tree_util.tree_map(
-        # adam m/v mirror the param tree; scalar counts replicate
-        lambda leaf: (
-            NamedSharding(MESH, P())
-            if np.ndim(leaf) == 0
-            else fsdp_sharding(leaf, MESH)
-        ),
-        opt_shapes,
-        is_leaf=lambda x: hasattr(x, "shape"),
-    )
     batch_sh = NamedSharding(MESH, P("data"))
     B, S = 16, 2048
     x_s = jax.ShapeDtypeStruct((B, S), jnp.int32, sharding=batch_sh)
@@ -150,15 +164,7 @@ def test_llama3_8b_train_step_lowers_on_abstract_pod_mesh(partition):
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    p_s = jax.tree_util.tree_map(
-        lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
-        params, p_sh,
-    )
-    o_s = jax.tree_util.tree_map(
-        lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
-        opt_shapes, opt_sh,
-        is_leaf=lambda x: hasattr(x, "shape") and not isinstance(x, tuple),
-    )
+    p_s, o_s = _abstract_sharded_inputs(params, opt_shapes, p_sh, MESH)
     lowered = jax.jit(step).trace(p_s, o_s, x_s).lower(
         lowering_platforms=("tpu",)
     )
@@ -260,3 +266,31 @@ def test_llama3_8b_training_memory_budget_fits_v5p():
     b1 = training_memory(model, rep, dict(MESH.shape), tx=optax.adam(1e-4))
     assert not b1.fits(HBM_BYTES["TPU v5e"])
     assert b1.largest_replicated[1] > 1 * gib  # the embedding
+
+
+def test_llama3_8b_pp_spmd_step_lowers_on_abstract_pod_mesh():
+    """The collective-based pipeline step (parallel/pp_spmd.py) traces
+    and lowers for TPU at 8B scale on an abstract {pp: 8, data: 8}
+    64-chip mesh — 4 blocks per stage, batch sharded over data, remat
+    per block — proving the cross-host PP program constructs without a
+    pod."""
+    from torchpruner_tpu.parallel.pp_spmd import pp_spmd_train_step
+
+    mesh = AbstractMesh((8, 8), ("pp", "data"))
+    model, params, _ = _shapes()
+    tx = optax.adam(1e-4)
+    opt_shapes = jax.eval_shape(tx.init, params)
+    # params/opt enter in the model's ordinary layout, FSDP-sharded over
+    # the data axis; the step stacks blocks and reshards them over pp
+    # internally (GSPMD inserts the collectives)
+    p_sh = fsdp_sharding(params, mesh, axis="data")
+    p_s, o_s = _abstract_sharded_inputs(params, opt_shapes, p_sh, mesh)
+    B, S = 64, 2048  # microbatch 16 divides data=8
+    x_s = jax.ShapeDtypeStruct(
+        (B, S), jnp.int32, sharding=NamedSharding(mesh, P("data")))
+
+    step = pp_spmd_train_step(
+        model, tx, lm_cross_entropy_loss, mesh=mesh, n_microbatches=4,
+        data_axis="data", remat=True, compute_dtype=jnp.bfloat16)
+    lowered = step.trace(p_s, o_s, x_s).lower(lowering_platforms=("tpu",))
+    assert "sharding" in lowered.as_text()
