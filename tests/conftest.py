@@ -95,6 +95,8 @@ SLOW_TESTS = {
     "test_pp_spmd.py::test_pp_spmd_remat_matches",
     "test_pp_spmd.py::test_pp_spmd_composes_with_data_axis",
     "test_pp_spmd.py::test_pp_spmd_vit_forward_matches",
+    "test_pp_spmd.py::test_pp_spmd_dropout_trains_with_rng",
+    "test_pp_spmd.py::test_pp_spmd_train_step_dropout_with_per_step_rng",
     "test_sharding_aot.py::test_llama3_8b_pp_spmd_step_lowers_on_abstract_pod_mesh",
     "test_pp_spmd.py::test_pp_spmd_composes_with_uniform_prune",
     "test_multiprocess.py::test_two_process_spmd_pipeline_matches_single_process",

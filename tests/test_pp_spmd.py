@@ -198,3 +198,64 @@ def test_pp_spmd_bert_rejected_cleanly():
 
     with pytest.raises(ValueError):
         split_pipeline(bert_tiny())
+
+
+def test_pp_spmd_dropout_trains_with_rng():
+    """Dropout-bearing ViT pipelines in train mode when an rng is
+    provided: deterministic under the same key, actually stochastic
+    (train != eval), and eval mode still equals the sequential apply."""
+    from torchpruner_tpu.models import vit
+
+    model = vit(image_size=16, patch_size=4, dim=32, depth=2,
+                num_heads=4, mlp_dim=64, n_classes=10, dropout=0.2)
+    params, state = init_model(model, seed=0)
+    assert not state
+    x = jnp.asarray(np.asarray(model.example_input(4, seed=0)))
+    mesh = _mesh(2)
+    key = jax.random.PRNGKey(7)
+
+    with pytest.raises(ValueError, match="needs an rng"):
+        pp_spmd_apply(model, params, x, mesh=mesh, n_microbatches=2,
+                      train=True)
+
+    t1 = pp_spmd_apply(model, params, x, mesh=mesh, n_microbatches=2,
+                       train=True, rng=key)
+    t2 = pp_spmd_apply(model, params, x, mesh=mesh, n_microbatches=2,
+                       train=True, rng=key)
+    np.testing.assert_allclose(np.asarray(t1), np.asarray(t2))
+
+    ev = pp_spmd_apply(model, params, x, mesh=mesh, n_microbatches=2)
+    assert np.abs(np.asarray(t1) - np.asarray(ev)).max() > 1e-4
+    want, _ = model.apply(params, x)
+    np.testing.assert_allclose(np.asarray(ev), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pp_spmd_train_step_dropout_with_per_step_rng():
+    """The training-step API trains a dropout-bearing ViT when given a
+    per-step rng, and raises the Dropout layer's own error without."""
+    from torchpruner_tpu.models import vit
+
+    model = vit(image_size=16, patch_size=4, dim=32, depth=2,
+                num_heads=4, mlp_dim=64, n_classes=10, dropout=0.2)
+    params, _ = init_model(model, seed=0)
+    x = jnp.asarray(np.asarray(model.example_input(4, seed=0)))
+    mesh = _mesh(2)
+    opt = optax.adam(1e-3)
+
+    # classification loss shaped like loss_fn(logits, y): reuse tokens
+    # slot for labels via a closure
+    y = jnp.zeros((4,), jnp.int32)
+
+    def loss_fn(logits, _tokens):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -logp[jnp.arange(4), y]
+
+    step = pp_spmd_train_step(model, opt, loss_fn, mesh=mesh,
+                              n_microbatches=2)
+    s = opt.init(params)
+    with pytest.raises(ValueError, match="needs an rng"):
+        step(params, s, x)
+    p2, s2, l1 = step(params, s, x, jax.random.PRNGKey(0))
+    _, _, l2 = step(p2, s2, x, jax.random.PRNGKey(1))
+    assert np.isfinite(float(l1)) and np.isfinite(float(l2))

@@ -95,10 +95,6 @@ def split_pipeline(model: SegmentedModel):
                 f"BatchNorm ({spec.name}) carries running state; "
                 "cross-microbatch state threading belongs to "
                 "parallel.pipeline, not the SPMD formulation")
-        if isinstance(spec, L.Dropout) and getattr(spec, "rate", 0):
-            raise ValueError(
-                f"Dropout ({spec.name}) needs per-microbatch rng "
-                "plumbing the SPMD schedule does not provide yet")
         for child in (getattr(spec, "body", ()) or ()) + tuple(
                 getattr(spec, "shortcut", ()) or ()):
             _reject_unsupported(child)
@@ -144,6 +140,7 @@ def pp_spmd_apply(
     remat: bool = False,
     compute_dtype=None,
     train: bool = False,
+    rng=None,
 ):
     """Forward pass with the block stack pipelined over ``mesh[axis]``.
 
@@ -151,6 +148,11 @@ def pp_spmd_apply(
     and head (the ``pre``/``post`` layers) run replicated outside the
     pipelined region — they are a sliver of the FLOPs; sharding them
     belongs to the data/tensor axes.  Returns ``(B, S, vocab)`` logits.
+
+    ``rng`` enables stochastic layers (Dropout) in ``train`` mode: keys
+    are folded per (tick, stage, block) so every microbatch at every
+    block draws an independent mask — the masks need not (and do not)
+    match the single-device execution order.
 
     ``data_axis`` composes PP with DP on a 2-D mesh (e.g.
     ``{"pp": 4, "data": 2}``): each microbatch's batch dim is sharded
@@ -183,34 +185,54 @@ def pp_spmd_apply(
     attn_spec, ffn_spec = (dataclasses.replace(s, name=n)
                            for s, n in zip(pairs[0], ("pp_attn", "pp_ffn")))
 
+
     if compute_dtype is not None:
         params = jax.tree_util.tree_map(
             lambda a: a.astype(compute_dtype)
             if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
 
-    h, _ = L.apply_seq(pre, params, {}, tokens, train=train)
+    rng_pre = rng_blocks = rng_post = None
+    if rng is not None:
+        rng_pre, rng_blocks, rng_post = jax.random.split(rng, 3)
+    h, _ = L.apply_seq(pre, params, {}, tokens, train=train, rng=rng_pre)
     x_micro = h.reshape((M, B // M) + h.shape[1:])
     stacked = stack_block_params(params, pairs)
 
-    def stage_program(blocks_local, x_all):
+    def stage_program(blocks_local, x_all, key):
         idx = jax.lax.axis_index(axis)
 
-        def apply_blocks(act):
-            def body(a, p_one):
+        def apply_blocks(act, key_t):
+            def body(a, xs):
+                p_one, bidx = xs
+                sub = (None if key_t is None
+                       else jax.random.fold_in(key_t, bidx))
                 a2, _ = L.apply_seq(
                     (attn_spec, ffn_spec),
                     {"pp_attn": p_one["attn"], "pp_ffn": p_one["ffn"]},
-                    {}, a, train=train, remat=remat,
+                    {}, a, train=train, remat=remat, rng=sub,
                 )
                 return a2, None
-            out, _ = jax.lax.scan(body, act, blocks_local)
+            bps = depth // n_stages
+            out, _ = jax.lax.scan(
+                body, act, (blocks_local, jnp.arange(bps)))
             return out
 
         def tick(carry, t):
             act_in, out_buf = carry
             inject = x_all[jnp.clip(t, 0, M - 1)]
             cur = jnp.where(idx == 0, inject, act_in)
-            y = apply_blocks(cur)
+            # independent masks per (tick, stage, data-shard, block):
+            # tick + stage + data coordinate fold here, block inside
+            # apply_blocks — without the data fold, replicated keys give
+            # every data shard identical masks
+            if key is None:
+                key_t = None
+            else:
+                key_t = jax.random.fold_in(jax.random.fold_in(key, t), idx)
+                if data_axis is not None:
+                    key_t = jax.random.fold_in(
+                        key_t, jax.lax.axis_index(data_axis))
+            y = apply_blocks(cur, key_t)
             m = t - (n_stages - 1)
             banked = out_buf.at[jnp.clip(m, 0, M - 1)].set(y)
             write = (idx == n_stages - 1) & (m >= 0) & (m < M)
@@ -242,34 +264,45 @@ def pp_spmd_apply(
     # (M, mb, seq, d): microbatch dim stays whole on every stage; the
     # per-microbatch batch dim shards over the optional data axis
     spec_x = P(None, data_axis) if data_axis else P()
-    y_micro = shard_map(
-        stage_program, mesh=mesh,
-        in_specs=(spec_blocks, spec_x), out_specs=spec_x,
-    )(stacked, x_micro)
+    if rng_blocks is None:
+        def program(blocks_local, x_all):
+            return stage_program(blocks_local, x_all, None)
+        y_micro = shard_map(
+            program, mesh=mesh,
+            in_specs=(spec_blocks, spec_x), out_specs=spec_x,
+        )(stacked, x_micro)
+    else:
+        y_micro = shard_map(
+            stage_program, mesh=mesh,
+            in_specs=(spec_blocks, spec_x, P()), out_specs=spec_x,
+        )(stacked, x_micro, rng_blocks)
     y = y_micro.reshape((B,) + y_micro.shape[2:])
-    logits, _ = L.apply_seq(post, params, {}, y, train=train)
+    logits, _ = L.apply_seq(post, params, {}, y, train=train,
+                            rng=rng_post)
     return logits
 
 
 def pp_spmd_train_step(model, optimizer, loss_fn, *, mesh, n_microbatches,
                        axis: str = "pp", data_axis: str | None = None,
                        remat: bool = False, compute_dtype=None):
-    """A jitted ``(params, opt_state, tokens) -> (params', opt_state',
-    loss)`` whose forward/backward is pipelined over ``mesh[axis]``.
-    ``loss_fn(logits, tokens) -> (B,)`` per-example losses (e.g.
-    :func:`~torchpruner_tpu.utils.losses.lm_cross_entropy_loss`)."""
+    """A jitted ``(params, opt_state, tokens, rng=None) -> (params',
+    opt_state', loss)`` whose forward/backward is pipelined over
+    ``mesh[axis]``.  ``loss_fn(logits, tokens) -> (B,)`` per-example
+    losses (e.g. :func:`~torchpruner_tpu.utils.losses.lm_cross_entropy_loss`).
+    Dropout-bearing models pass a fresh ``rng`` per step (omitting it
+    raises the Dropout layer's needs-an-rng error at trace time)."""
 
-    def loss(params, tokens):
+    def loss(params, tokens, rng):
         logits = pp_spmd_apply(
             model, params, tokens, mesh=mesh,
             n_microbatches=n_microbatches, axis=axis,
             data_axis=data_axis, remat=remat,
-            compute_dtype=compute_dtype, train=True)
+            compute_dtype=compute_dtype, train=True, rng=rng)
         return loss_fn(logits, tokens).mean()
 
     @jax.jit
-    def step(params, opt_state, tokens):
-        l, grads = jax.value_and_grad(loss)(params, tokens)
+    def step(params, opt_state, tokens, rng=None):
+        l, grads = jax.value_and_grad(loss)(params, tokens, rng)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
         return params, opt_state, l
