@@ -190,14 +190,23 @@ def test_pp_spmd_vit_forward_matches():
                                rtol=2e-5, atol=2e-5)
 
 
-def test_pp_spmd_bert_rejected_cleanly():
-    """BERT's interleaved post-LayerNorms break block contiguity; the
-    split must refuse rather than silently reorder (parallel.pipeline
-    handles heterogeneous stacks)."""
+def test_pp_spmd_bert_forward_matches():
+    """BERT's repeating unit is (attn Residual, post-LN, mlp Residual,
+    post-LN) — the block-index grouping stacks the whole 4-spec unit, so
+    the encoder pipelines too.  Forward parity over 2 stages."""
     from torchpruner_tpu.models import bert_tiny
 
-    with pytest.raises(ValueError):
-        split_pipeline(bert_tiny())
+    model = bert_tiny()
+    pre, groups, post = split_pipeline(model)
+    assert len(groups[0]) >= 3  # the interleaved-LN unit, not a pair
+    params, state = init_model(model, seed=0)
+    assert not state
+    x = jnp.asarray(np.asarray(model.example_input(4, seed=0)))
+    mesh = _mesh(2)
+    want, _ = model.apply(params, x)
+    got = pp_spmd_apply(model, params, x, mesh=mesh, n_microbatches=2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
 
 
 def test_pp_spmd_dropout_trains_with_rng():
@@ -259,3 +268,13 @@ def test_pp_spmd_train_step_dropout_with_per_step_rng():
     p2, s2, l1 = step(params, s, x, jax.random.PRNGKey(0))
     _, _, l2 = step(p2, s2, x, jax.random.PRNGKey(1))
     assert np.isfinite(float(l1)) and np.isfinite(float(l2))
+
+
+def test_pp_spmd_moe_rejected():
+    """MoE blocks emit a load-balancing aux loss the SPMD schedule does
+    not collect — silently dropping it would let experts collapse, so
+    the split refuses (EP via ShardedTrainer handles MoE)."""
+    from torchpruner_tpu.models import llama_moe_tiny
+
+    with pytest.raises(ValueError, match="aux loss"):
+        split_pipeline(llama_moe_tiny())

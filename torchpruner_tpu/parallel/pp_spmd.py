@@ -5,7 +5,7 @@ stages by pinning each stage's params to a local device and letting
 async dispatch overlap them — which is single-process by construction
 (a process cannot ``device_put`` onto another host's chips).  This
 module is the pods formulation for uniform-block transformer stacks
-(the llama family): ONE program runs on every device of a ``pp`` mesh
+(the llama, ViT, and BERT families): ONE program runs on every device of a ``pp`` mesh
 axis under ``shard_map``; the depth axis of the *stacked* block params
 is sharded over ``pp`` (each device holds ``depth // n_stages``
 consecutive blocks), microbatches stream through the stages, and
@@ -34,7 +34,8 @@ other mesh axes (data, tensor) compose through GSPMD exactly as in
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Tuple
+import re
+from typing import List
 
 import jax
 import jax.numpy as jnp
@@ -45,87 +46,108 @@ from torchpruner_tpu.core.segment import SegmentedModel
 
 
 def split_pipeline(model: SegmentedModel):
-    """``(pre, pairs, post)``: the top-level layers before the first
-    uniform block, the per-block ``(attn, ffn)`` :class:`Residual`
-    pairs, and the layers after the last block.
+    """``(pre, groups, post)``: the top-level layers before the first
+    transformer block, one spec-tuple per block (the repeating unit),
+    and the layers after the last block.
 
-    Raises if the blocks are not uniform (stage stacking needs every
-    block's param shapes identical — true for the dense llama family;
-    pruned-per-block or MoE models should pipeline with
-    :mod:`~torchpruner_tpu.parallel.pipeline` instead).
+    Blocks are recognized by the zoo's ``block{i}_*`` naming: all
+    consecutive top-level specs sharing a block index form one group, so
+    the repeating unit can be any shape — llama's (attn, ffn) Residual
+    pair, ViT's (attn, mlp), BERT's (attn, attn_ln, mlp, mlp_ln) with
+    interleaved post-LayerNorms.  Raises if the groups are not uniform
+    (stage stacking needs identical param shapes in every block — a
+    per-block-pruned or MoE-uneven stack should pipeline with
+    :mod:`~torchpruner_tpu.parallel.pipeline` instead), if block indices
+    are not contiguous, or if non-block layers interleave the stack.
     """
-    # llama blocks pair `_attn` with `_ffn`; ViT pairs `_attn` with
-    # `_mlp` — both are uniform adjacent Residual pairs and pipeline
-    # identically.  BERT interleaves post-LayerNorms between the
-    # residuals, so it correctly fails the pairing (use
-    # parallel.pipeline for it).
+    pat = re.compile(r"^block(\d+)_(.+)$")
     pre: List[L.LayerSpec] = []
-    pairs: List[Tuple[L.LayerSpec, L.LayerSpec]] = []
+    groups: List[List[L.LayerSpec]] = []
     post: List[L.LayerSpec] = []
-    specs = list(model.layers)
-    i = 0
-    while i < len(specs):
-        a = specs[i]
-        b = specs[i + 1] if i + 1 < len(specs) else None
-        if (isinstance(a, L.Residual) and isinstance(b, L.Residual)
-                and a.name.endswith("_attn")
-                and b.name.endswith(("_ffn", "_mlp"))):
-            if post:
-                # a pair after non-block layers would be silently
-                # reordered around them by the stage stacking — refuse
-                raise ValueError(
-                    f"block pair {a.name}/{b.name} appears after "
-                    f"non-block layer {post[0].name}: the block stack "
-                    "must be contiguous for SPMD pipelining")
-            pairs.append((a, b))
-            i += 2
-        elif not pairs:
-            pre.append(a)
-            i += 1
+    cur_idx = None
+    for spec in model.layers:
+        m = pat.match(spec.name)
+        if m is None:
+            if groups:
+                post.append(spec)
+            else:
+                pre.append(spec)
+            continue
+        if post:
+            raise ValueError(
+                f"block layer {spec.name} appears after non-block layer "
+                f"{post[0].name}: the block stack must be contiguous "
+                "for SPMD pipelining")
+        idx = int(m.group(1))
+        if cur_idx is None or idx == cur_idx + 1:
+            groups.append([spec])
+            cur_idx = idx
+        elif idx == cur_idx:
+            groups[-1].append(spec)
         else:
-            post.append(a)
-            i += 1
-    if not pairs:
+            raise ValueError(
+                f"block indices jump at {spec.name} (previous block "
+                f"{cur_idx}): the stack must be contiguous")
+    if not groups:
         raise ValueError(
-            "no uniform (attn, ffn/mlp) Residual pairs found — pp_spmd "
-            "needs a llama- or ViT-style block stack")
+            "no block{i}_* layers found — pp_spmd needs a uniform "
+            "transformer block stack (llama / ViT / BERT families)")
+
     def _reject_unsupported(spec):
         if isinstance(spec, L.BatchNorm):
             raise ValueError(
                 f"BatchNorm ({spec.name}) carries running state; "
                 "cross-microbatch state threading belongs to "
                 "parallel.pipeline, not the SPMD formulation")
+        if isinstance(spec, L.MoE):
+            raise ValueError(
+                f"MoE ({spec.name}) emits a load-balancing aux loss this "
+                "schedule does not collect — train MoE stacks with "
+                "ShardedTrainer (EP) or parallel.pipeline instead")
         for child in (getattr(spec, "body", ()) or ()) + tuple(
                 getattr(spec, "shortcut", ()) or ()):
             _reject_unsupported(child)
 
-    for spec in list(pre) + [s for p in pairs for s in p] + list(post):
+    for spec in list(pre) + [s for g in groups for s in g] + list(post):
         _reject_unsupported(spec)
-    canon = tuple(dataclasses.replace(s, name=n)
-                  for s, n in zip(pairs[0], ("pp_attn", "pp_ffn")))
-    for a, b in pairs[1:]:
-        got = (dataclasses.replace(a, name="pp_attn"),
-               dataclasses.replace(b, name="pp_ffn"))
-        if got != canon:
+
+    canon = canonical_group(groups[0])
+    for g in groups[1:]:
+        if canonical_group(g) != canon:
             raise ValueError(
-                f"non-uniform blocks ({a.name}/{b.name} differ from "
-                f"{pairs[0][0].name}/{pairs[0][1].name}) — stage stacking "
-                "requires identical block shapes")
-    return tuple(pre), tuple(pairs), tuple(post)
+                f"non-uniform blocks ({g[0].name}... differs from "
+                f"{groups[0][0].name}...) — stage stacking requires "
+                "identical block shapes")
+    return tuple(pre), tuple(tuple(g) for g in groups), tuple(post)
 
 
-def stack_block_params(params, pairs):
+def canonical_group(group) -> tuple:
+    """The group's specs with block-index-free names (``pp{j}``) — the
+    uniformity comparand and the spec set the pipelined stage applies."""
+    return tuple(dataclasses.replace(s, name=f"pp{j}")
+                 for j, s in enumerate(group))
+
+
+def stack_block_params(params, groups):
     """Per-leaf ``jnp.stack`` of the blocks' param subtrees along a new
-    leading depth axis: ``{"attn": tree, "ffn": tree}`` with every leaf
-    shaped ``(depth, ...)``.  Runs under jit (the stack fuses; under a
-    sharded entry the result is resharded by GSPMD per the shard_map
-    in_specs)."""
-    attn = [params[a.name] for a, _ in pairs]
-    ffn = [params[f.name] for _, f in pairs]
-    return {
-        "attn": jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *attn),
-        "ffn": jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *ffn),
-    }
+    leading depth axis, keyed by canonical position name (``pp{j}``);
+    positions without params (e.g. Activation) are absent, like they are
+    in ``params``.  Runs under jit (the stack fuses; under a sharded
+    entry the result is resharded by GSPMD per the shard_map in_specs).
+    """
+    out = {}
+    for j, spec in enumerate(groups[0]):
+        present = [g[j].name in params for g in groups]
+        if not any(present):
+            continue
+        if not all(present):
+            raise ValueError(
+                f"block position {j} ({spec.name}) has params in some "
+                "blocks but not others")
+        trees = [params[g[j].name] for g in groups]
+        out[f"pp{j}"] = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *trees)
+    return out
 
 
 def pp_spmd_apply(
@@ -165,9 +187,9 @@ def pp_spmd_apply(
     stateless, and cross-microbatch state threading belongs to
     :mod:`~torchpruner_tpu.parallel.pipeline`.
     """
-    pre, pairs, post = split_pipeline(model)
+    pre, groups, post = split_pipeline(model)
     n_stages = mesh.shape[axis]
-    depth = len(pairs)
+    depth = len(groups)
     if depth % n_stages != 0:
         raise ValueError(f"depth {depth} not divisible by {n_stages} stages")
     M = n_microbatches
@@ -182,9 +204,7 @@ def pp_spmd_apply(
             raise ValueError(
                 f"microbatch size {B // M} not divisible by mesh axis "
                 f"{data_axis}={mesh.shape[data_axis]}")
-    attn_spec, ffn_spec = (dataclasses.replace(s, name=n)
-                           for s, n in zip(pairs[0], ("pp_attn", "pp_ffn")))
-
+    canon_specs = canonical_group(groups[0])
 
     if compute_dtype is not None:
         params = jax.tree_util.tree_map(
@@ -196,7 +216,7 @@ def pp_spmd_apply(
         rng_pre, rng_blocks, rng_post = jax.random.split(rng, 3)
     h, _ = L.apply_seq(pre, params, {}, tokens, train=train, rng=rng_pre)
     x_micro = h.reshape((M, B // M) + h.shape[1:])
-    stacked = stack_block_params(params, pairs)
+    stacked = stack_block_params(params, groups)
 
     def stage_program(blocks_local, x_all, key):
         idx = jax.lax.axis_index(axis)
@@ -207,8 +227,7 @@ def pp_spmd_apply(
                 sub = (None if key_t is None
                        else jax.random.fold_in(key_t, bidx))
                 a2, _ = L.apply_seq(
-                    (attn_spec, ffn_spec),
-                    {"pp_attn": p_one["attn"], "pp_ffn": p_one["ffn"]},
+                    canon_specs, p_one,
                     {}, a, train=train, remat=remat, rng=sub,
                 )
                 return a2, None
