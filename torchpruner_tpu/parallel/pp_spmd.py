@@ -24,6 +24,12 @@ the transpose of ``ppermute`` is the reverse permutation, so
 ``jax.grad`` of the whole step is pipeline-parallel automatically —
 activation gradients hop backwards over the same collective.
 
+Future work: the Megatron interleaved schedule (V virtual stages per
+device) would cut the bubble from ``(S-1)/(M+S-1)`` toward
+``(S-1)/(V·M+S-1)``; the GPipe fill/drain here plus per-block remat is
+the simplest correct pods formulation, and the interleaving is a
+schedule-only change on top of the same stacked-ppermute machinery.
+
 Composability: params enter in the model's ordinary pytree layout and
 are stacked inside the traced function, so gradient pytrees, optax
 states, checkpoints, and the pruner all keep the unstacked layout;
