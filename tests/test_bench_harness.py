@@ -256,6 +256,28 @@ def test_write_tpu_cache_carries_forward_missing_legs(bench, monkeypatch,
     assert legs["vgg16_robustness"]["carried_from"]["git_commit"] == "oldc"
 
 
+def test_merge_keeps_current_errors_on_the_print_path(bench, monkeypatch,
+                                                      tmp_path):
+    """replace_errors=False (the PRINTED-result path) must keep a leg
+    that errored THIS run visible instead of papering over the
+    regression with a stale cached success; the default (cache-file)
+    path stays last-known-good."""
+    cache = tmp_path / "tpu_cache.json"
+    monkeypatch.setattr(bench, "TPU_CACHE", str(cache))
+    cache.write_text(json.dumps({
+        "measured_at": "2026-07-29T00:00:00Z", "git_commit": "oldc",
+        "result": {"legs": {
+            "flash_attention": {"flash_ms": 73.7, "xla_ms": 72.1},
+        }}}))
+    current = {"flash_attention": {"error": "Pallas lowering failed"},
+               "mnist_prune": {"value": 3.3, "unit": "s"}}
+    printed = bench._merge_cached_legs(dict(current), replace_errors=False)
+    assert printed["flash_attention"] == {"error": "Pallas lowering failed"}
+    cached = bench._merge_cached_legs(dict(current))
+    assert cached["flash_attention"]["flash_ms"] == 73.7
+    assert cached["flash_attention"]["carried_from"]["git_commit"] == "oldc"
+
+
 def test_orchestrate_prints_boot_line_first(bench, monkeypatch, capsys):
     """The orchestrator's FIRST act is printing a parseable skeleton, so
     a driver kill during preflight still leaves `parsed != null`."""
