@@ -1,0 +1,83 @@
+"""Fused int4 weight-only matmul — ops/int4_matmul.py.
+
+CPU runs the Pallas kernel in interpreter mode (like the flash tests),
+so correctness is exercised everywhere; the bandwidth claim is measured
+on chip (PERF.md serving section).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchpruner_tpu.ops.int4_matmul import (
+    int4_matmul,
+    pack_int4,
+    quantize_int4,
+    unpack_int4,
+)
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.integers(-8, 8, size=(64, 16)).astype(np.int8))
+    p = pack_int4(q)
+    assert p.shape == (32, 16) and p.dtype == jnp.int8
+    np.testing.assert_array_equal(np.asarray(unpack_int4(p)), np.asarray(q))
+
+
+def test_pack_rejects_odd_rows():
+    with pytest.raises(ValueError, match="even"):
+        pack_int4(jnp.zeros((3, 4), jnp.int8))
+
+
+@pytest.mark.parametrize("D,F,blocks", [
+    (1024, 512, {}),                                # kernel, default tiles
+    (1024, 512, {"block_d": 256, "block_f": 256}),  # kernel, small tiles
+    (96, 48, {}),                                   # XLA fallback path
+])
+def test_int4_matmul_matches_unpacked_reference(D, F, blocks):
+    """Kernel path (tiling shapes) and XLA fallback (non-tiling) both
+    equal the explicit unpack-then-matmul in f32."""
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.integers(-8, 8, size=(D, F)).astype(np.int8))
+    x = jnp.asarray(rng.normal(size=(8, D)).astype(np.float32))
+    p = pack_int4(q)
+    # the kernel computes in bf16 operands / f32 accumulation — compare
+    # against the same-precision XLA matmul, where agreement is tight
+    want = jnp.dot(x.astype(jnp.bfloat16), q.astype(jnp.bfloat16),
+                   preferred_element_type=jnp.float32)
+    got = int4_matmul(x, p, **blocks)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_quantize_int4_bounds_error_and_applies_scale():
+    rng = np.random.default_rng(2)
+    w = jnp.asarray(rng.normal(size=(256, 128)).astype(np.float32))
+    packed, scale = quantize_int4(w)
+    deq = np.asarray(unpack_int4(packed), np.float32) * np.asarray(scale)
+    # int4 grid: |err| <= scale/2 per element
+    assert np.max(np.abs(deq - np.asarray(w)) / np.asarray(scale)) <= 0.5 + 1e-6
+
+    x = jnp.asarray(rng.normal(size=(4, 256)).astype(np.float32))
+    got = int4_matmul(x, packed, scale)
+    # same arithmetic as the kernel: bf16 int matmul, f32 post-scale
+    want = (jnp.dot(x.astype(jnp.bfloat16),
+                    unpack_int4(packed).astype(jnp.bfloat16),
+                    preferred_element_type=jnp.float32)
+            * scale[None, :])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_zero_channel_roundtrips_exactly():
+    rng = np.random.default_rng(3)
+    w = jnp.asarray(rng.normal(size=(64, 32)).astype(np.float32))
+    w = w.at[:, 3].set(0.0)  # one dead channel among live ones
+    packed, scale = quantize_int4(w)
+    assert float(scale[3]) == 1.0  # the zero-channel fallback scale
+    x = jnp.ones((2, 64), jnp.float32)
+    y = np.asarray(int4_matmul(x, packed, scale))
+    np.testing.assert_array_equal(y[:, 3], np.zeros(2))
+    assert np.abs(y[:, :3]).max() > 0  # live channels stay live

@@ -1,0 +1,113 @@
+"""Weight-precision decode-matmul bandwidth: bf16 vs int8 vs fused int4.
+
+The serving lever is BYTES READ per decoded token (PERF.md); this
+experiment measures the three weight formats' per-iteration DEVICE time
+for the decode-shaped matmul ``(B, D) @ (D, F)`` — an on-device
+``fori_loop`` with a data dependency between iterations, timed off the
+profiler's XLA-Ops track, because on the tunnelled single chip both
+per-call stopwatches (≥ one RTT per call) and loop wall-clock (one RTT
+per fence, ~500 µs/iter at N=200) drown microsecond kernels.
+
+Writes ``{"paths": {bf16|int8|int4_kernel: {device_us, eff_GB_s}}}``;
+``eff_GB_s`` = weight bytes that format reads per iteration / device
+time — the bandwidth actually saved, if the int4 kernel's fused unpack
+works as designed (ops/int4_matmul.py).
+
+Run: ``python -m torchpruner_tpu.experiments.int4_bench
+[--out results/...json] [--cpu --smoke]``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def run(smoke: bool = False) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchpruner_tpu.ops.int4_matmul import int4_matmul, quantize_int4
+    from torchpruner_tpu.ops.quant import quantize_tensor
+    from torchpruner_tpu.utils import profiling
+    from torchpruner_tpu.utils.trace_analysis import summarize_trace
+
+    B, D, F = (4, 256, 256) if smoke else (8, 4096, 4096)
+    N = 4 if smoke else 100
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(D, F)).astype(np.float32))
+    x = jnp.asarray(rng.normal(size=(B, D)).astype(np.float32))
+    wb = w.astype(jnp.bfloat16)
+    qt = quantize_tensor(w, in_axes=1)  # the serving int8 formulation
+    q8, s8 = qt.q, qt.out_scale().astype(jnp.float32)
+    p4, s4 = quantize_int4(w)
+
+    def looped(matmul, *wargs):
+        def body(i, c):
+            y = matmul(c, *wargs)
+            # feed the output back (D == F here) with magnitude pinned,
+            # so no iteration can be dead-code-eliminated or reordered
+            return (y / (jnp.sqrt(jnp.mean(y * y)) + 1e-6)).astype(x.dtype)
+
+        return jax.jit(lambda x0: jax.lax.fori_loop(0, N, body, x0))
+
+    paths = {
+        "bf16": (looped(lambda c, w_: jnp.dot(
+            c.astype(jnp.bfloat16), w_,
+            preferred_element_type=jnp.float32), wb), D * F * 2),
+        "int8": (looped(lambda c, q, s: jnp.dot(
+            c.astype(jnp.bfloat16), q.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32) * s[None], q8, s8), D * F),
+        "int4_kernel": (looped(
+            lambda c, p, s: int4_matmul(c, p, s), p4, s4), D * F // 2),
+    }
+
+    out: dict = {"B": B, "D": D, "F": F, "iters": N,
+                 "platform": jax.devices()[0].platform,
+                 "device": getattr(jax.devices()[0], "device_kind", ""),
+                 "paths": {}}
+    for name, (fn, nbytes) in paths.items():
+        profiling.hard_fence(fn(x))  # compile + warm outside the trace
+        trace_dir = f"logs/int4_bench/{name}"
+        with profiling.trace(trace_dir):
+            profiling.hard_fence(fn(x))
+        dev_s = summarize_trace(trace_dir)["total_ms"] / 1e3 / N
+        out["paths"][name] = {
+            "device_us": round(dev_s * 1e6, 2),
+            "eff_GB_s": round(nbytes / dev_s / 1e9, 1) if dev_s else None,
+        }
+        print(f"[int4_bench] {name}: {out['paths'][name]}",
+              file=sys.stderr, flush=True)
+    b16 = out["paths"]["bf16"]["device_us"]
+    i4 = out["paths"]["int4_kernel"]["device_us"]
+    if b16 and i4:
+        out["int4_vs_bf16_speedup"] = round(b16 / i4, 3)
+    return out
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="")
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args(argv)
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    result = run(smoke=args.smoke)
+    print(json.dumps(result, indent=1))
+    if args.out:
+        import os
+
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+        print(f"wrote {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,130 @@
+"""Fused int4 weight-only matmul — a Pallas kernel that unpacks in VMEM.
+
+Why a kernel: decode is HBM-bound on weight bytes (PERF.md serving
+table — int8 already buys 1.33×), and int4 halves the bytes again, but
+ONLY if the unpack never round-trips through HBM.  XLA cannot fuse a
+nibble-unpack (shift/mask + interleave-reshape) into a dot's operand
+read, so an XLA-level int4 path materializes the full-size weight and
+spends MORE bandwidth than it saves; storing ``jnp.int4`` arrays is no
+better (unpacked in HBM — measured 1 byte/element — and int4 jit
+arguments crash the tunnelled backend outright).  The kernel reads the
+PACKED (two values per byte) block into VMEM, sign-extends the nibbles
+in-register, and feeds the MXU — HBM sees half the int8 bytes.
+
+Layout: values pair along the contracted (input) axis — byte ``k`` of
+column ``f`` holds ``w[2k, f]`` in its low nibble and ``w[2k+1, f]`` in
+the high one — so a ``(bd//2, bf)`` packed block unpacks to a
+``(bd, bf)`` operand with the lane (minor) axis untouched.
+
+Scales follow ops/quant.py's convention: symmetric per-OUTPUT-channel,
+applied to the matmul result (exact, since only input axes contract).
+On CPU the kernel runs in interpreter mode (tests); shapes that don't
+tile fall back to an unpack-then-matmul XLA path that is numerically
+identical (just not bandwidth-saving).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["pack_int4", "unpack_int4", "quantize_int4", "int4_matmul"]
+
+#: default tile sizes: bd rows of the contracted axis (bd//2 packed
+#: bytes), bf output lanes.  512×512 unpacked bf16 = 512 KB of VMEM.
+DEFAULT_BLOCK_D = 512
+DEFAULT_BLOCK_F = 512
+
+
+def pack_int4(q):
+    """Pack int8 values in [-8, 7] pairwise along axis 0: ``(D, F)`` →
+    ``(D//2, F)`` with ``out[k] = (q[2k] & 0xF) | (q[2k+1] << 4)``."""
+    if q.shape[0] % 2:
+        raise ValueError(f"input axis {q.shape[0]} must be even to pack")
+    lo = q[0::2] & 0x0F
+    hi = (q[1::2] & 0x0F) << 4
+    return (lo | hi).astype(jnp.int8)
+
+
+def unpack_int4(p):
+    """Inverse of :func:`pack_int4`: ``(D//2, F)`` int8 → ``(D, F)``
+    sign-extended int8 in [-8, 7]."""
+    lo = ((p << 4).astype(jnp.int8)) >> 4   # low nibble, sign-extended
+    hi = p >> 4                             # arithmetic shift sign-extends
+    return jnp.stack([lo, hi], axis=1).reshape(-1, p.shape[-1])
+
+
+def quantize_int4(w, *, sym_max: int = 7):
+    """Symmetric per-output-channel int4: ``(packed, scale)`` with
+    ``w ≈ unpack(packed) * scale`` — ``w`` is ``(D, F)`` (input axis
+    leading, like Dense kernels), ``scale`` is ``(F,)`` float32.
+    Zero-channels get scale 1 so ``q = 0`` round-trips exactly."""
+    absmax = jnp.max(jnp.abs(w), axis=0)
+    scale = jnp.where(absmax > 0, absmax / sym_max, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(w / scale), -sym_max, sym_max).astype(jnp.int8)
+    return pack_int4(q), scale
+
+
+def _kernel(x_ref, w_ref, o_ref):
+    j = pl.program_id(1)
+    wp = w_ref[...]                                   # (bd//2, bf) int8
+    # Mosaic has no int8 vector shifts — widen to i32 in-register (VMEM
+    # already paid the packed bytes; this costs no HBM traffic) and
+    # sign-extend the nibbles with i32 shifts
+    wi = wp.astype(jnp.int32)
+    lo = (wi << 28) >> 28
+    hi = wi >> 4
+    w = (jnp.stack([lo, hi], axis=1)
+         .reshape(wp.shape[0] * 2, wp.shape[1])
+         .astype(jnp.bfloat16))
+    part = jnp.dot(x_ref[...].astype(jnp.bfloat16), w,
+                   preferred_element_type=jnp.float32)
+
+    @pl.when(j == 0)
+    def _init():
+        o_ref[...] = part
+
+    @pl.when(j != 0)
+    def _acc():
+        o_ref[...] += part
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.jit, static_argnames=("block_d", "block_f"))
+def int4_matmul(x, packed, scale=None, *, block_d: int = DEFAULT_BLOCK_D,
+                block_f: int = DEFAULT_BLOCK_F):
+    """``x (B, D) @ (unpack(packed) (D, F) * scale (F,)) -> (B, F)`` f32.
+
+    ``packed`` is :func:`pack_int4`'s ``(D//2, F)`` int8.  Falls back to
+    the XLA unpack-then-matmul path when the shapes don't tile (numerics
+    identical; no bandwidth win)."""
+    B, D = x.shape
+    F = packed.shape[1]
+    if packed.shape[0] * 2 != D:
+        raise ValueError(f"packed rows {packed.shape[0]} != D/2 = {D // 2}")
+    ok = (D % block_d == 0 and F % block_f == 0 and block_d % 2 == 0)
+    if not ok:
+        y = jnp.dot(x.astype(jnp.bfloat16),
+                    unpack_int4(packed).astype(jnp.bfloat16),
+                    preferred_element_type=jnp.float32)
+    else:
+        y = pl.pallas_call(
+            _kernel,
+            grid=(F // block_f, D // block_d),
+            in_specs=[
+                pl.BlockSpec((B, block_d), lambda i, j: (0, j)),
+                pl.BlockSpec((block_d // 2, block_f), lambda i, j: (j, i)),
+            ],
+            out_specs=pl.BlockSpec((B, block_f), lambda i, j: (0, i)),
+            out_shape=jax.ShapeDtypeStruct((B, F), jnp.float32),
+            interpret=_interpret(),
+        )(x, packed)
+    if scale is not None:
+        y = y * scale[None, :]
+    return y
