@@ -48,12 +48,10 @@ def test_split_pipeline_structure():
 
 
 def test_split_pipeline_rejects_nonuniform():
-    from torchpruner_tpu.core.pruner import prune
-    from torchpruner_tpu.core.plan import PrunePlan  # noqa: F401
+    from torchpruner_tpu.core.pruner import prune_by_scores
 
     model, params, _ = _model_and_data(depth=4)
     # prune one block's FFN: its shapes now differ from the others
-    from torchpruner_tpu.core.pruner import prune_by_scores
 
     res = prune_by_scores(model, params, "block2_ffn/gate",
                           np.arange(64.0), policy="fraction", fraction=0.25)
