@@ -59,7 +59,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from torchpruner_tpu.ops.quant import QTensor, oscale, wval
+from torchpruner_tpu.ops.quant import QTensor, oscale, qdot, wval
 
 # ---------------------------------------------------------------------------
 # Layer specs
@@ -841,7 +841,7 @@ def apply_layer(
     HBM (ops/quant.py).
     """
     if isinstance(spec, Dense):
-        y = oscale(x @ wval(params["w"], x.dtype), params["w"])
+        y = oscale(qdot(x, params["w"]), params["w"])
         if "b" in params:
             y = y + params["b"]
         return y, state
@@ -1036,8 +1036,8 @@ def apply_layer(
         return y, state
 
     if isinstance(spec, GatedDense):
-        g = oscale(x @ wval(params["wg"], x.dtype), params["wg"])
-        u = oscale(x @ wval(params["wu"], x.dtype), params["wu"])
+        g = oscale(qdot(x, params["wg"]), params["wg"])
+        u = oscale(qdot(x, params["wu"]), params["wu"])
         if "bg" in params:
             g = g + params["bg"]
             u = u + params["bu"]

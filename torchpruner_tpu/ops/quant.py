@@ -38,44 +38,72 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["QTensor", "quantize_tensor", "quantize_params",
-           "dequantize_params", "wval", "oscale"]
+           "dequantize_params", "wval", "oscale", "qdot"]
 
 
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class QTensor:
-    """Symmetric per-output-channel int8 weight: ``w ≈ q * scale``.
+    """Symmetric per-output-channel integer weight: ``w ≈ q * scale``.
 
-    ``q`` has the original weight's shape (int8).  ``scale`` (float32)
-    has the same rank with the contracted INPUT axes (``in_axes``,
-    static) reduced to size 1 — so ``q * scale`` broadcasts exactly, for
-    any input-axis position (Dense's leading input, MoE's middle one).
+    ``bits=8`` (default): ``q`` has the original weight's shape (int8).
+    ``bits=4``: ``q`` stores two values per int8 byte, packed pairwise
+    along ``pack_axis`` (an even-length contracted axis), so the packed
+    axis has HALF the logical length — half the bytes at rest and half
+    the HBM residency of int8.  ``wval`` unpacks (an elementwise
+    producer; see ops/int4_matmul.py for the fused-unpack kernel that
+    also halves the bytes READ).  ``scale`` (float32) has the logical
+    rank with the contracted INPUT axes (``in_axes``, static) reduced
+    to size 1 — so dequantization broadcasts exactly, for any
+    input-axis position (Dense's leading input, MoE's middle one).
     """
 
-    q: jnp.ndarray             # int8, original weight shape
+    q: jnp.ndarray             # int8 payload (packed when bits=4)
     scale: jnp.ndarray         # f32, w.shape with in_axes -> 1
     in_axes: Tuple[int, ...]   # static: which axes a matmul contracts
+    bits: int = 8              # static: 8 (plain) or 4 (packed pairs)
+    pack_axis: int = 0         # static: the axis pairs pack along
 
-    # pytree protocol: arrays are children, in_axes static aux data
+    # pytree protocol: arrays are children, the rest static aux data
     def tree_flatten(self) -> Tuple[tuple, tuple]:
-        return (self.q, self.scale), tuple(self.in_axes)
+        return ((self.q, self.scale),
+                (tuple(self.in_axes), self.bits, self.pack_axis))
 
     @classmethod
     def tree_unflatten(cls, aux, children) -> "QTensor":
-        return cls(children[0], children[1], tuple(aux))
+        in_axes, bits, pack_axis = aux
+        return cls(children[0], children[1], tuple(in_axes), bits,
+                   pack_axis)
 
     @property
     def shape(self):
+        """The LOGICAL weight shape (unpacked)."""
+        if self.bits == 4:
+            s = list(self.q.shape)
+            s[self.pack_axis] *= 2
+            return tuple(s)
         return self.q.shape
 
     @property
     def dtype(self):  # the STORAGE dtype; compute happens in x.dtype
         return self.q.dtype
 
+    def unpacked(self) -> jnp.ndarray:
+        """The logical int8 payload (identity for bits=8)."""
+        if self.bits != 4:
+            return self.q
+        from torchpruner_tpu.ops.int4_matmul import unpack_int4
+
+        moved = jnp.moveaxis(self.q, self.pack_axis, 0)
+        flat = unpack_int4(moved.reshape(moved.shape[0], -1))
+        return jnp.moveaxis(
+            flat.reshape((moved.shape[0] * 2,) + moved.shape[1:]),
+            0, self.pack_axis)
+
     def dequantize(self, dtype=jnp.float32) -> jnp.ndarray:
         """Materialized ``q * scale`` (tests / export — NOT the serving
         path, which scales matmul outputs instead)."""
-        return self.q.astype(dtype) * self.scale.astype(dtype)
+        return self.unpacked().astype(dtype) * self.scale.astype(dtype)
 
     def out_scale(self) -> jnp.ndarray:
         """The scale with input axes squeezed out: the shape of the
@@ -84,26 +112,64 @@ class QTensor:
         return jnp.squeeze(self.scale, axis=tuple(self.in_axes))
 
 
-def quantize_tensor(w, in_axes: Union[int, Tuple[int, ...]] = 1) -> QTensor:
-    """Symmetric int8 with one scale per output channel (max-abs / 127)
-    over the contracted ``in_axes`` (an int means that many LEADING
-    axes); zero-channels get scale 1 so ``q = 0`` round-trips exactly."""
+def quantize_tensor(w, in_axes: Union[int, Tuple[int, ...]] = 1,
+                    *, bits: int = 8) -> QTensor:
+    """Symmetric integer weight with one scale per output channel
+    (max-abs / ``2**(bits-1) - 1``) over the contracted ``in_axes`` (an
+    int means that many LEADING axes); zero-channels get scale 1 so
+    ``q = 0`` round-trips exactly.  ``bits=4`` packs value pairs along
+    the first even-length contracted axis (raises if none is)."""
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
     w = jnp.asarray(w)
     if isinstance(in_axes, int):
         in_axes = tuple(range(in_axes))
+    sym = float(2 ** (bits - 1) - 1)
     amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=in_axes,
                    keepdims=True)
-    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-    q = jnp.round(w.astype(jnp.float32) / scale)
-    return QTensor(q.astype(jnp.int8), scale.astype(jnp.float32),
-                   tuple(in_axes))
+    scale = jnp.where(amax > 0, amax / sym, 1.0)
+    q = jnp.round(w.astype(jnp.float32) / scale).astype(jnp.int8)
+    if bits == 8:
+        return QTensor(q, scale.astype(jnp.float32), tuple(in_axes))
+    from torchpruner_tpu.ops.int4_matmul import pack_int4
+
+    pack_axis = next((a for a in in_axes if w.shape[a] % 2 == 0), None)
+    if pack_axis is None:
+        raise ValueError(
+            f"int4 needs an even-length contracted axis to pack; "
+            f"shape {w.shape}, in_axes {in_axes}")
+    moved = jnp.moveaxis(q, pack_axis, 0)
+    packed = pack_int4(moved.reshape(moved.shape[0], -1)).reshape(
+        (moved.shape[0] // 2,) + moved.shape[1:])
+    return QTensor(jnp.moveaxis(packed, 0, pack_axis),
+                   scale.astype(jnp.float32), tuple(in_axes), 4,
+                   pack_axis)
 
 
 def wval(w, dtype):
-    """The tensor a matmul/einsum should consume: the int8 payload
-    converted to the activation dtype (a unary producer XLA fuses into
-    the dot) for :class:`QTensor`, the weight itself otherwise."""
-    return w.q.astype(dtype) if isinstance(w, QTensor) else w
+    """The tensor a matmul/einsum should consume: the integer payload
+    (nibble-unpacked for bits=4) converted to the activation dtype for
+    :class:`QTensor` — a unary/elementwise producer chain XLA fuses or
+    materializes per step — and the weight itself otherwise."""
+    return w.unpacked().astype(dtype) if isinstance(w, QTensor) else w
+
+
+def qdot(x, w):
+    """``x @ w`` for a possibly-quantized trailing-contraction weight —
+    the Dense/GatedDense matmul site.  bits=4 weights with bf16
+    activations route through the fused-unpack Pallas kernel
+    (ops/int4_matmul.py) so the packed bytes are what HBM reads; other
+    cases consume :func:`wval` (bits=4 there unpacks through XLA —
+    correct everywhere, capacity-not-bandwidth).  The caller applies
+    :func:`oscale` as usual."""
+    if (isinstance(w, QTensor) and w.bits == 4 and w.in_axes == (0,)
+            and w.pack_axis == 0 and x.dtype == jnp.bfloat16):
+        from torchpruner_tpu.ops.int4_matmul import int4_matmul
+
+        lead = x.shape[:-1]
+        y = int4_matmul(x.reshape((-1, x.shape[-1])), w.q)
+        return y.reshape(lead + (w.shape[-1],)).astype(x.dtype)
+    return x @ wval(w, x.dtype)
 
 
 def oscale(y, w):
@@ -137,20 +203,27 @@ _QUANT_KEYS = {
 }
 
 
-def quantize_params(model, params, *, layers: Optional[Sequence[str]] = None):
-    """Int8-quantize the matmul weights of ``model``'s Dense /
-    GatedDense / attention / MoE layers (biases, norms, embeddings,
-    convs and routers stay float).  Returns a NEW params pytree with
+def quantize_params(model, params, *, layers: Optional[Sequence[str]] = None,
+                    bits: int = 8):
+    """Quantize the matmul weights of ``model``'s Dense / GatedDense /
+    attention / MoE layers (biases, norms, embeddings, convs and
+    routers stay float).  Returns a NEW params pytree with
     :class:`QTensor` leaves, servable by ``model.apply`` / ``generate``
     directly.  ``layers`` restricts to the named layer paths
     (``"block1_ffn/gate"`` style for nested layers).
+
+    ``bits=8`` is the bandwidth configuration (the int8 payload feeds
+    the dot directly).  ``bits=4`` HALVES the weights' bytes at rest —
+    the capacity lever: a 2× bigger model per chip's HBM — at the cost
+    of an unpack per use (the fused bandwidth kernel is
+    ops/int4_matmul.py) and int4 precision.
 
     Quantize AFTER pruning: this is the deploy step of the
     prune → fine-tune → quantize pipeline (examples/04).
     """
     wanted = set(layers) if layers is not None else None
     matched: set = set()
-    out = _quantize_walk(model.layers, params, (), wanted, matched)
+    out = _quantize_walk(model.layers, params, (), wanted, matched, bits)
     if wanted is not None and wanted - matched:
         # a typo'd layer name must not silently deploy unquantized
         raise KeyError(
@@ -161,7 +234,8 @@ def quantize_params(model, params, *, layers: Optional[Sequence[str]] = None):
     return out
 
 
-def _quantize_walk(specs, params, prefix: Tuple[str, ...], wanted, matched):
+def _quantize_walk(specs, params, prefix: Tuple[str, ...], wanted, matched,
+                   bits: int = 8):
     from torchpruner_tpu.core import layers as L
 
     out = dict(params)
@@ -171,7 +245,7 @@ def _quantize_walk(specs, params, prefix: Tuple[str, ...], wanted, matched):
             if name in out:
                 out[name] = _quantize_walk(
                     spec.body + spec.shortcut, out[name],
-                    prefix + (name,), wanted, matched)
+                    prefix + (name,), wanted, matched, bits)
             continue
         keys = _QUANT_KEYS.get(type(spec).__name__)
         full = "/".join(prefix + (name,))
@@ -182,7 +256,8 @@ def _quantize_walk(specs, params, prefix: Tuple[str, ...], wanted, matched):
         p = dict(out[name])
         for key, in_axes in keys.items():
             if key in p and not isinstance(p[key], QTensor):
-                p[key] = quantize_tensor(p[key], in_axes=in_axes)
+                p[key] = quantize_tensor(p[key], in_axes=in_axes,
+                                         bits=bits)
         out[name] = p
     return out
 
