@@ -1,0 +1,20 @@
+#!/bin/bash
+# probe every 3 min until the deadline; on tunnel-up capture the int4
+# microbench artifact, then refresh the decode leg (int4 rows)
+cd /root/repo
+deadline=$(( $(date +%s) + ${1:-14000} ))
+while [ $(date +%s) -lt $deadline ]; do
+  if timeout 70 python -c "import jax; d=jax.devices()[0]; assert d.platform=='tpu'" 2>/dev/null; then
+    echo "[watch] tunnel up at $(date -u +%H:%M)"
+    timeout 1800 python -m torchpruner_tpu.experiments.int4_bench \
+      --out results/int4_bench_tpu_$(date -u +%Y-%m-%d_%H%M)_$(git rev-parse --short HEAD).json \
+      && echo "[watch] int4 bench captured"
+    timeout 2400 python -u scripts/run_tpu_legs.py --legs llama_decode \
+      && echo "[watch] decode leg refreshed"
+    exit 0
+  fi
+  echo "[watch] down at $(date -u +%H:%M)"
+  sleep 180
+done
+echo "[watch] window over"
+exit 2
