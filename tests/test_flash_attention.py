@@ -179,3 +179,23 @@ def test_auto_dispatch_is_seq_length_aware(monkeypatch):
     monkeypatch.setattr(L, "FLASH_AUTO_MIN_S", 16)
     L.attention_core(q, k, v, causal=True, impl="auto")
     assert len(calls) == 1  # tpu and S >= threshold -> flash kernel
+
+
+def test_cross_attention_lengths_route_to_xla_path():
+    """Differing q/k lengths (cross attention) are outside the kernel's
+    grid (built from q's length); they must compute through the XLA
+    path — full key coverage — not silently truncate K/V."""
+    q, _, _ = qkv(S=32)
+    _, k, v = qkv(S=128, seed=1)
+    got = flash_attention(q, k, v, causal=False)
+    want = _xla_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+    # the long-S block_k bump keys on k's length and must not force the
+    # kernel for these shapes either
+    q2, _, _ = qkv(S=64, seed=2)
+    _, k2, v2 = qkv(S=128, seed=3)
+    got2 = flash_attention(q2, k2, v2, causal=False)
+    want2 = _xla_attention(q2, k2, v2, causal=False)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(want2),
+                               rtol=1e-6, atol=1e-6)

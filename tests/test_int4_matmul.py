@@ -52,6 +52,21 @@ def test_int4_matmul_matches_unpacked_reference(D, F, blocks):
                                rtol=1e-5, atol=1e-4)
 
 
+def test_int4_matmul_tiles_prefill_row_counts():
+    """Row counts above MAX_UNTILED_ROWS get their own grid dimension
+    (a prefill through a bits=4 model, e.g. B8 × S2048 = 16384 rows,
+    must not hold the whole row block in VMEM); numerics match."""
+    rng = np.random.default_rng(4)
+    B, D, F = 2048, 512, 512
+    q = jnp.asarray(rng.integers(-8, 8, size=(D, F)).astype(np.int8))
+    x = jnp.asarray(rng.normal(size=(B, D)).astype(np.float32))
+    want = jnp.dot(x.astype(jnp.bfloat16), q.astype(jnp.bfloat16),
+                   preferred_element_type=jnp.float32)
+    got = int4_matmul(x, pack_int4(q))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-4)
+
+
 def test_quantize_int4_bounds_error_and_applies_scale():
     rng = np.random.default_rng(2)
     w = jnp.asarray(rng.normal(size=(256, 128)).astype(np.float32))
@@ -81,3 +96,21 @@ def test_zero_channel_roundtrips_exactly():
     y = np.asarray(int4_matmul(x, packed, scale))
     np.testing.assert_array_equal(y[:, 3], np.zeros(2))
     assert np.abs(y[:, :3]).max() > 0  # live channels stay live
+
+
+def test_pick_row_block_divisor_search():
+    """Row blocks: whole for decode-sized B; the largest divisor
+    <= MAX_UNTILED_ROWS for prefill-sized B (2000 rows -> 1000, not an
+    XLA fallback); degenerate primes route to the fallback (0)."""
+    from torchpruner_tpu.ops.int4_matmul import (
+        MAX_UNTILED_ROWS,
+        _pick_row_block,
+    )
+
+    assert _pick_row_block(8) == 8
+    assert _pick_row_block(MAX_UNTILED_ROWS) == MAX_UNTILED_ROWS
+    assert _pick_row_block(16384) == 1024
+    assert _pick_row_block(2000) == 1000   # B8 x S250 prefill
+    assert _pick_row_block(2048) == 1024
+    assert _pick_row_block(1297 * 2) == 0  # 2x prime: no block in [8, 1024]
+    assert _pick_row_block(104729) == 0    # prime: degenerate, fallback

@@ -266,3 +266,19 @@ def test_int4_dense_kernel_path_matches_unpack_path():
     np.testing.assert_allclose(
         np.asarray(qdot(x, t)), np.asarray(x @ wval(t, x.dtype)),
         rtol=1e-6, atol=1e-6)
+
+
+def test_qtensor_unflattens_legacy_aux_format():
+    """Treedefs serialized before bits/pack_axis existed carried the bare
+    in_axes tuple as aux_data; they must still unflatten (bits=8)."""
+    from torchpruner_tpu.ops.quant import QTensor
+
+    q = jnp.zeros((4, 2), jnp.int8)
+    scale = jnp.ones((1, 2), jnp.float32)
+    t = QTensor.tree_unflatten((0,), (q, scale))
+    assert t.in_axes == (0,) and t.bits == 8 and t.pack_axis == 0
+    # and the current format still round-trips through flatten/unflatten
+    t4 = QTensor(q, scale, (0,), 4, 0)
+    children, aux = t4.tree_flatten()
+    t4b = QTensor.tree_unflatten(aux, children)
+    assert t4b.bits == 4 and t4b.pack_axis == 0 and t4b.in_axes == (0,)

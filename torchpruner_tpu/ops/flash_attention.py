@@ -402,6 +402,13 @@ def flash_attention(q, k, v, *, causal: bool = False,
     (128, 128), except ``block_k`` rises to 256 at S >= 8192 — the
     measured on-chip optimum (results/flash_sweep_tpu_*: S=16384 grad
     step 184.5 ms at 128/128 vs 165.9 ms at 128/256)."""
-    if block_k is None and q.shape[1] >= 8192 and q.shape[1] % 256 == 0:
+    # the kernel's grid is built from q's sequence length, so it only
+    # supports self-attention shapes; differing K/V length (cross
+    # attention) computes through the XLA path instead of silently
+    # truncating keys past q.shape[1]
+    if k.shape[1] != q.shape[1]:
+        return _xla_attention(q, k, v, causal=causal)
+    # block_k tiles the K/V sequence axis (== q's here)
+    if block_k is None and k.shape[1] >= 8192 and k.shape[1] % 256 == 0:
         block_k = 256
     return _flash_attention(q, k, v, causal, block_q, block_k)

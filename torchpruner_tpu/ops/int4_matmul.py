@@ -38,6 +38,13 @@ __all__ = ["pack_int4", "unpack_int4", "quantize_int4", "int4_matmul"]
 DEFAULT_BLOCK_D = 512
 DEFAULT_BLOCK_F = 512
 
+#: leading (row) axis tiling: up to this many rows ride in one block
+#: (decode steps are tiny); above it the rows are tiled too, so a
+#: prefill through a bits=4 model (e.g. B8 × S2048 = 16384 rows) keeps
+#: the x-block + f32 accumulator inside VMEM instead of failing Mosaic.
+MAX_UNTILED_ROWS = 1024
+DEFAULT_BLOCK_B = 256
+
 
 def pack_int4(q):
     """Pack int8 values in [-8, 7] pairwise along axis 0: ``(D, F)`` →
@@ -69,7 +76,7 @@ def quantize_int4(w, *, sym_max: int = 7):
 
 
 def _kernel(x_ref, w_ref, o_ref):
-    j = pl.program_id(1)
+    k = pl.program_id(2)                              # contraction step
     wp = w_ref[...]                                   # (bd//2, bf) int8
     # Mosaic has no int8 vector shifts — widen to i32 in-register (VMEM
     # already paid the packed bytes; this costs no HBM traffic) and
@@ -83,17 +90,30 @@ def _kernel(x_ref, w_ref, o_ref):
     part = jnp.dot(x_ref[...].astype(jnp.bfloat16), w,
                    preferred_element_type=jnp.float32)
 
-    @pl.when(j == 0)
+    @pl.when(k == 0)
     def _init():
         o_ref[...] = part
 
-    @pl.when(j != 0)
+    @pl.when(k != 0)
     def _acc():
         o_ref[...] += part
 
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
+
+
+def _pick_row_block(B: int) -> int:
+    """Largest row-block <= MAX_UNTILED_ROWS that divides ``B`` (the
+    whole count for decode-sized B); 0 when only degenerate tilings
+    exist (< 8 rows per block — prime-ish huge B), routing to the XLA
+    fallback instead of a one-row-per-grid-step kernel."""
+    if B <= MAX_UNTILED_ROWS:
+        return B
+    for bb in range(MAX_UNTILED_ROWS, 7, -1):
+        if B % bb == 0:
+            return bb
+    return 0
 
 
 @functools.partial(jax.jit, static_argnames=("block_d", "block_f"))
@@ -108,7 +128,11 @@ def int4_matmul(x, packed, scale=None, *, block_d: int = DEFAULT_BLOCK_D,
     F = packed.shape[1]
     if packed.shape[0] * 2 != D:
         raise ValueError(f"packed rows {packed.shape[0]} != D/2 = {D // 2}")
-    ok = (D % block_d == 0 and F % block_f == 0 and block_d % 2 == 0)
+    # decode-sized row counts ride whole; prefill-sized ones tile so the
+    # x-block and the f32 accumulator stay inside VMEM
+    block_b = _pick_row_block(B)
+    ok = (block_b > 0 and D % block_d == 0 and F % block_f == 0
+          and block_d % 2 == 0)
     if not ok:
         y = jnp.dot(x.astype(jnp.bfloat16),
                     unpack_int4(packed).astype(jnp.bfloat16),
@@ -116,12 +140,16 @@ def int4_matmul(x, packed, scale=None, *, block_d: int = DEFAULT_BLOCK_D,
     else:
         y = pl.pallas_call(
             _kernel,
-            grid=(F // block_f, D // block_d),
+            # contraction (k) innermost so the (i, j) output block stays
+            # resident across its accumulation steps
+            grid=(B // block_b, F // block_f, D // block_d),
             in_specs=[
-                pl.BlockSpec((B, block_d), lambda i, j: (0, j)),
-                pl.BlockSpec((block_d // 2, block_f), lambda i, j: (j, i)),
+                pl.BlockSpec((block_b, block_d), lambda i, j, k: (i, k)),
+                pl.BlockSpec((block_d // 2, block_f),
+                             lambda i, j, k: (k, j)),
             ],
-            out_specs=pl.BlockSpec((B, block_f), lambda i, j: (0, i)),
+            out_specs=pl.BlockSpec((block_b, block_f),
+                                   lambda i, j, k: (i, j)),
             out_shape=jax.ShapeDtypeStruct((B, F), jnp.float32),
             interpret=_interpret(),
         )(x, packed)

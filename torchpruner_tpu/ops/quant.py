@@ -71,7 +71,12 @@ class QTensor:
 
     @classmethod
     def tree_unflatten(cls, aux, children) -> "QTensor":
-        in_axes, bits, pack_axis = aux
+        if all(isinstance(a, int) for a in aux):
+            # pre-int4 aux format: the bare in_axes tuple (checkpoints /
+            # treedefs serialized before bits/pack_axis existed)
+            in_axes, bits, pack_axis = aux, 8, 0
+        else:
+            in_axes, bits, pack_axis = aux
         return cls(children[0], children[1], tuple(in_axes), bits,
                    pack_axis)
 
