@@ -199,6 +199,12 @@ def capture(leg_names, device_kind: str, just_probed: bool = False) -> dict:
 #: PERF.md's evidence beyond bench numbers): (tag, timeout_s, argv-maker).
 #: Each runs in its own subprocess with a hard timeout, like the legs.
 AUX = [
+    ("int4_bench", 1800, lambda out:
+        [sys.executable, "-u", "-m",
+         "torchpruner_tpu.experiments.int4_bench", "--out", out]),
+    ("llama8b_decode", 5400, lambda out:
+        [sys.executable, "-u", "-m",
+         "torchpruner_tpu.experiments.llama8b_decode", "--out", out]),
     ("flash_sweep", 3600, lambda out:
         [sys.executable, "-u", "-m",
          "torchpruner_tpu.experiments.flash_sweep", "--tune", "--out", out]),

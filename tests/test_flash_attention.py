@@ -199,3 +199,20 @@ def test_cross_attention_lengths_route_to_xla_path():
     want2 = _xla_attention(q2, k2, v2, causal=False)
     np.testing.assert_allclose(np.asarray(got2), np.asarray(want2),
                                rtol=1e-6, atol=1e-6)
+
+
+def test_causal_cross_attention_bottom_right_aligned():
+    """Causal with a query chunk shorter than the KV prefix (chunked
+    prefill): query i sees keys j <= i + (Sk - Sq).  The last query of
+    the chunk sees every key; the mask equals tril when Sq == Sk."""
+    q, _, _ = qkv(S=4, seed=4)
+    _, k, v = qkv(S=8, seed=5)
+    out = flash_attention(q, k, v, causal=True)
+    # row i must equal self-attention over the first (Sk - Sq) + i + 1
+    # keys, computed independently per row
+    for i in range(4):
+        n_vis = 8 - 4 + i + 1
+        want = _xla_attention(q[:, i:i + 1], k[:, :n_vis], v[:, :n_vis],
+                              causal=False)
+        np.testing.assert_allclose(np.asarray(out[:, i:i + 1]),
+                                   np.asarray(want), rtol=1e-5, atol=1e-5)

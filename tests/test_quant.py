@@ -282,3 +282,47 @@ def test_qtensor_unflattens_legacy_aux_format():
     children, aux = t4.tree_flatten()
     t4b = QTensor.tree_unflatten(aux, children)
     assert t4b.bits == 4 and t4b.pack_axis == 0 and t4b.in_axes == (0,)
+
+
+def test_quantized_random_params_build_and_serve():
+    """The 8B serving experiment's direct-at-quantized builder
+    (experiments/llama8b_decode.py): QTensor leaves land exactly where
+    quantize_params puts them, and the tree decodes through generate."""
+    import jax
+
+    from torchpruner_tpu.experiments.llama8b_decode import (
+        logical_params,
+        quantized_random_params,
+        weight_bytes,
+    )
+    from torchpruner_tpu.generate import generate
+    from torchpruner_tpu.models import llama
+
+    model = llama(vocab_size=64, dim=16, depth=2, num_heads=2,
+                  num_kv_heads=1, head_dim=8, ffn_dim=32, seq_len=32)
+    params, state = quantized_random_params(model, bits=4, seed=1)
+    assert state == {}
+
+    from torchpruner_tpu.ops.quant import QTensor
+
+    # every attention/FFN matmul weight is a QTensor; norms/embedding not
+    blk = params["block1_attn"]
+    assert all(isinstance(blk["attn"][k], QTensor)
+               for k in ("wq", "wk", "wv", "wo"))
+    assert not isinstance(blk["norm"]["scale"], QTensor)
+    ffn = params["block1_ffn"]
+    assert all(isinstance(ffn["gate"][k], QTensor) for k in ("wg", "wu"))
+    assert isinstance(ffn["down"]["w"], QTensor)
+    assert isinstance(params["lm_head"]["w"], QTensor)
+    assert not isinstance(params["tok_emb"]["emb"], QTensor)
+
+    # logical count equals the float model's count; bytes roughly halve
+    # the int8 representation (packed axis) for the quantized majority
+    ref_params, _ = model.init(jax.random.PRNGKey(0))
+    from torchpruner_tpu.utils.flops import param_count
+
+    assert logical_params(params) == param_count(ref_params)
+    assert weight_bytes(params) < param_count(ref_params)  # < 1 B/param
+
+    toks = generate(model, params, jnp.zeros((2, 4), jnp.int32), 4)
+    assert toks.shape == (2, 4)

@@ -311,3 +311,47 @@ def test_sharded_trainer_bf16_remat_step():
               state=t.state, opt_state=t.opt_state)
     t = t.rebuild(r.model, r.params, r.state, r.opt_state)
     assert np.isfinite(float(t.step(x, x)))
+
+
+def test_multi_step_matches_sequential_steps():
+    """K steps scanned inside one program (Trainer.multi_step) must
+    produce exactly the params, rng chain, state and losses of K
+    sequential Trainer.step calls on the same batches — the dispatch
+    amortization is free of semantic drift (incl. BN state threading
+    and per-step rng splits)."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchpruner_tpu.core import layers as L
+
+    def bn_model():  # BN exercises mutable-state threading
+        return SegmentedModel(
+            (L.Dense("fc1", 16), L.BatchNorm("bn1"),
+             L.Activation("r1", "relu"), L.Dense("out", 4)),
+            (8,),
+        )
+
+    ds = tiny_data(n=96)
+    batches = list(ds.batches(32))[:3]
+    xs = jnp.stack([b[0] for b in batches])
+    ys = jnp.stack([b[1] for b in batches])
+
+    seq = Trainer.create(bn_model(), optax.adam(1e-2), cross_entropy_loss,
+                         seed=0)
+    seq_losses = [float(seq.step(x, y)) for x, y in batches]
+
+    multi = Trainer.create(bn_model(), optax.adam(1e-2), cross_entropy_loss,
+                           seed=0)
+    losses = multi.multi_step(xs, ys)
+
+    np.testing.assert_allclose(np.asarray(losses), seq_losses, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(seq.params),
+                    jax.tree_util.tree_leaves(multi.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(seq.state),
+                    jax.tree_util.tree_leaves(multi.state)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(seq.rng), np.asarray(multi.rng))
+    assert multi.step_count == 3

@@ -1,0 +1,240 @@
+"""Flagship single-chip serving: Llama-3-8B decode via int4 weights.
+
+The BASELINE.json Llama-3-8B config cannot be SERVED on one 16 GB chip
+in bf16: 8.0B params × 2 bytes ≈ 15 GB of weights before the KV cache
+or a single activation.  int4 weight storage (ops/quant.py bits=4 +
+the fused-unpack kernel in ops/int4_matmul.py) shrinks the matmul
+weights to ~3.8 GB, leaving room for a bf16 embedding, the KV cache
+and activations — the whole 8B model decodes on ONE chip.  Nothing in
+the reference framework (a single-device vision pruning library,
+SURVEY.md §2) has any serving path at all; this experiment measures
+the capability its users would gain by switching.
+
+Measured variants (gen tok/s on the real chip):
+
+- ``int4_dense``: the full 8B config, int4 matmul weights.
+- ``int4_pruned``: 25 % of FFN hidden channels pruned (the BASELINE
+  prune target — ffn_dim 14336 → 10752), then int4 — the
+  prune-then-quantize serving pipeline of examples/04 at 8B scale.
+
+Params are built DIRECTLY at the quantized representation: each float
+leaf is created on device in bf16, quantized, and dropped, so peak
+transient memory is one leaf (+ its f32 quantize copy, ~2.1 GB for
+lm_head) on top of the quantized tree — no 8B master is ever
+materialized on host or device.  Weights are random; decode cost is
+data-independent (same matmuls, same cache writes every step), so
+throughput on random weights equals throughput on trained ones.
+
+Run: ``python -m torchpruner_tpu.experiments.llama8b_decode
+[--out results/...json] [--cpu --smoke]``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def quantized_random_params(model, *, bits: int = 4, seed: int = 0,
+                            dtype=None):
+    """A servable ``(params, state)`` with :class:`QTensor` leaves at
+    every site ``quantize_params`` would quantize, built leaf-by-leaf
+    on device (see module docstring).  Norm scales init to ones and
+    biases to zeros; matmul weights to small normals — values only
+    matter for numerics, not for decode throughput."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchpruner_tpu.core import layers as L
+    from torchpruner_tpu.core.segment import init_model
+    from torchpruner_tpu.ops.quant import _QUANT_KEYS, quantize_tensor
+
+    dtype = dtype or jnp.bfloat16
+    p_shapes, s_shapes = jax.eval_shape(
+        lambda: init_model(model, seed, dtype))
+    key = jax.random.PRNGKey(seed)
+
+    def build(specs, shapes):
+        nonlocal key
+        out = {}
+        for spec in specs:
+            name = spec.name
+            if name not in shapes:
+                continue
+            if isinstance(spec, L.COMPOSITE_TYPES):
+                out[name] = build(spec.body + spec.shortcut, shapes[name])
+                continue
+            qkeys = _QUANT_KEYS.get(type(spec).__name__, {})
+            entry = {}
+            for pname, sd in shapes[name].items():
+                key, sub = jax.random.split(key)
+                if pname in ("scale",):
+                    leaf = jnp.ones(sd.shape, dtype)
+                elif pname.startswith("b"):
+                    leaf = jnp.zeros(sd.shape, dtype)
+                else:
+                    leaf = jax.random.normal(sub, sd.shape, dtype) * 0.02
+                if pname in qkeys:
+                    entry[pname] = quantize_tensor(
+                        leaf, in_axes=qkeys[pname], bits=bits)
+                    del leaf  # one transient float leaf at a time
+                else:
+                    entry[pname] = leaf
+            out[name] = entry
+        return out
+
+    params = build(model.layers, p_shapes)
+    state = jax.tree_util.tree_map(
+        lambda sd: jnp.zeros(sd.shape, sd.dtype), s_shapes)
+    return params, state
+
+
+def logical_params(params) -> int:
+    """Parameter count at the LOGICAL (unpacked, scale-free) shapes —
+    ``param_count`` over a quantized tree would count packed bytes and
+    scales as parameters."""
+    import math
+
+    import jax
+
+    from torchpruner_tpu.ops.quant import QTensor
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(
+            params, is_leaf=lambda x: isinstance(x, QTensor)):
+        total += (math.prod(leaf.shape) if isinstance(leaf, QTensor)
+                  else leaf.size)
+    return int(total)
+
+
+def weight_bytes(params) -> int:
+    """Bytes of weight traffic per decode step: every leaf is read once
+    per token batch, except the embedding table (gathered, B rows)."""
+    import jax
+
+    from torchpruner_tpu.ops.quant import QTensor
+
+    total = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(
+            params, is_leaf=lambda x: isinstance(x, QTensor)):
+        if any(getattr(k, "key", None) == "emb" for k in path):
+            continue
+        if isinstance(leaf, QTensor):
+            total += leaf.q.size + leaf.scale.size * 4
+        else:
+            total += leaf.size * leaf.dtype.itemsize
+    return int(total)
+
+
+def measure_decode(model, params, *, batch: int, prompt_len: int,
+                   n_new: int, runs: int = 2) -> dict:
+    """gen tok/s for one model+params: first call compiles (reported
+    separately), then the best of ``runs`` steady calls."""
+    import jax.numpy as jnp
+
+    from torchpruner_tpu.generate import generate
+    from torchpruner_tpu.utils.profiling import hard_fence
+
+    prompt = jnp.zeros((batch, prompt_len), jnp.int32)
+
+    def once():
+        t0 = time.perf_counter()
+        toks = generate(model, params, prompt, n_new,
+                        cache_dtype=jnp.bfloat16)
+        hard_fence(toks)
+        return time.perf_counter() - t0
+
+    first = once()
+    steady = min(once() for _ in range(runs))
+    return {
+        "gen_tokens_per_s": round(batch * n_new / steady, 1),
+        "ms_per_token_step": round(steady / n_new * 1e3, 3),
+        "steady_s": round(steady, 3),
+        "first_call_s": round(first, 1),
+        "shape": f"B{batch} prompt{prompt_len} new{n_new}",
+    }
+
+
+def run(smoke: bool = False) -> dict:
+    import jax
+
+    from torchpruner_tpu.models import llama
+
+    if smoke:
+        dims = dict(vocab_size=512, dim=64, depth=2, num_heads=4,
+                    num_kv_heads=2, head_dim=16, ffn_dim=128, seq_len=64)
+        pruned_ffn = 96
+        batch, prompt_len, n_new = 2, 8, 8
+    else:
+        # Llama-3-8B (BASELINE.json row: vocab 128256, dim 4096,
+        # depth 32, 32Q/8KV heads, FFN 14336)
+        dims = dict(seq_len=256)
+        pruned_ffn = 10752  # 25% FFN channels pruned
+        batch, prompt_len, n_new = 8, 64, 64
+
+    out: dict = {
+        "platform": jax.devices()[0].platform,
+        "device": getattr(jax.devices()[0], "device_kind", ""),
+        "bits": 4,
+        "variants": {},
+    }
+
+    for tag, ffn in (("int4_dense", None), ("int4_pruned", pruned_ffn)):
+        cfg = dict(dims)
+        if ffn is not None:
+            cfg["ffn_dim"] = ffn
+        model = llama(**cfg)
+        t0 = time.perf_counter()
+        params, _state = quantized_random_params(model, bits=4)
+        build_s = time.perf_counter() - t0
+        wb = weight_bytes(params)
+        r = measure_decode(model, params, batch=batch,
+                           prompt_len=prompt_len, n_new=n_new)
+        r.update({
+            "params": logical_params(params),
+            "weight_bytes_per_step": wb,
+            "weight_gb": round(wb / 1e9, 2),
+            "build_s": round(build_s, 1),
+            # bytes every decode step must stream from HBM / its time
+            "implied_GB_s": round(
+                wb / (r["steady_s"] / n_new) / 1e9, 1),
+        })
+        if ffn is not None:
+            r["pruned_ffn_fraction"] = 0.25
+        out["variants"][tag] = r
+        print(f"[llama8b_decode] {tag}: {r}", file=sys.stderr, flush=True)
+
+    d = out["variants"]
+    if "int4_dense" in d and "int4_pruned" in d:
+        out["prune_decode_speedup"] = round(
+            d["int4_pruned"]["gen_tokens_per_s"]
+            / d["int4_dense"]["gen_tokens_per_s"], 3)
+    return out
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="")
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args(argv)
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    result = run(smoke=args.smoke)
+    print(json.dumps(result, indent=1))
+    if args.out:
+        import os
+
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+        print(f"wrote {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

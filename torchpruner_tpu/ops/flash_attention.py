@@ -49,8 +49,13 @@ def _xla_attention(q, k, v, *, causal: bool):
         "bshk,bthk->bhst", q, k, preferred_element_type=jnp.float32
     ) * scale
     if causal:
-        S = q.shape[1]
-        mask = jnp.tril(jnp.ones((S, S), bool))
+        Sq, Sk = q.shape[1], k.shape[1]
+        # bottom-right-aligned causal mask: query i sees keys
+        # j <= i + (Sk - Sq).  Equals tril for self-attention; for a
+        # query chunk against a longer KV prefix (chunked prefill) the
+        # chunk's last query sees the whole prefix.
+        mask = (jnp.arange(Sk)[None, :]
+                <= jnp.arange(Sq)[:, None] + (Sk - Sq))
         logits = jnp.where(mask[None, None], logits, _NEG_INF)
     w = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     return jnp.einsum("bhst,bthk->bshk", w, v)

@@ -126,6 +126,18 @@ def steady_s(stats: Dict[str, float]) -> float:
     return stats["p50_s"]
 
 
+def _params_probe(trainer, scalar):
+    """A scalar that data-depends on the trainer's UPDATED params:
+    fetching it (time_fn's hard_fence device_gets the smallest leaf)
+    cannot complete before the step program has written params'.  The
+    probe is its own tiny eager dispatch — nanoseconds next to the
+    step, and it keeps the D2H payload at 4 bytes instead of
+    round-tripping a params leaf over the tunnel."""
+    leaf = jax.tree_util.tree_leaves(trainer.params)[0]
+    return scalar.astype(jax.numpy.float32) + 0.0 * leaf.ravel()[0].astype(
+        jax.numpy.float32)
+
+
 def time_train_step(trainer, *args, iters: int = 10, warmup: int = 2,
                     chained: bool = False):
     """:func:`time_fn` over a ``Trainer.step`` call, fenced on the UPDATED
@@ -136,23 +148,29 @@ def time_train_step(trainer, *args, iters: int = 10, warmup: int = 2,
     the tunnelled TPU backend that scalar's buffer can report ready before
     the program retires, so fencing the loss alone undercounts the step —
     observed as 2.4 ms "steps" (implied 12 PFLOP/s) on a ~200M-param model.
-    Fencing the new params pins the measurement to program completion on
-    every backend.
+    Fencing the new params (:func:`_params_probe`) pins the measurement to
+    program completion on every backend.
     """
 
     def step_fenced(*a):
-        loss = trainer.step(*a)
-        # scalar probe that data-depends on the UPDATED params: fetching
-        # it (time_fn's hard_fence device_gets the smallest leaf) cannot
-        # complete before the step program has written params'.  The
-        # probe is its own tiny eager dispatch — nanoseconds next to the
-        # step, and it keeps the D2H payload at 4 bytes instead of
-        # round-tripping a params leaf over the tunnel.
-        leaf = jax.tree_util.tree_leaves(trainer.params)[0]
-        return loss.astype(jax.numpy.float32) + 0.0 * leaf.ravel()[0].astype(
-            jax.numpy.float32)
+        return _params_probe(trainer, trainer.step(*a))
 
     return time_fn(step_fenced, *args, iters=iters, warmup=warmup,
+                   chained=chained)
+
+
+def time_train_multi_step(trainer, xs, ys, iters: int = 5, warmup: int = 2,
+                          chained: bool = True):
+    """:func:`time_fn` over ``Trainer.multi_step`` (K optimizer steps in
+    ONE dispatched program — the per-program dispatch cost amortizes 1/K
+    on top of chaining's 1/iters fence amortization), fenced on the
+    updated params like :func:`time_train_step`.  Divide
+    :func:`steady_s` by ``xs.shape[0]`` for the per-step seconds."""
+
+    def fenced(xs_, ys_):
+        return _params_probe(trainer, trainer.multi_step(xs_, ys_)[-1])
+
+    return time_fn(fenced, xs, ys, iters=iters, warmup=warmup,
                    chained=chained)
 
 
