@@ -670,31 +670,47 @@ def _leg_flash_attention(smoke: bool) -> dict:
     )
     from torchpruner_tpu.utils.profiling import steady_s, time_fn
 
-    B, S, H, Dh = (1, 512, 2, 32) if smoke else (4, 2048, 8, 64)
-    ks = jax.random.split(jax.random.PRNGKey(0), 3)
-    q, k, v = (jax.random.normal(kk, (B, S, H, Dh), jnp.bfloat16)
-               for kk in ks)
+    def measure(B, S, H, Dh):
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.random.normal(kk, (B, S, H, Dh), jnp.bfloat16)
+                   for kk in ks)
 
-    def make(fn):
-        def loss(q_, k_, v_):
-            return jnp.sum(fn(q_, k_, v_, causal=True).astype(jnp.float32))
-        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        def make(fn):
+            def loss(q_, k_, v_):
+                return jnp.sum(
+                    fn(q_, k_, v_, causal=True).astype(jnp.float32))
+            return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
 
-    out = {}
-    for name, fn in (("flash", flash_attention), ("xla", _xla_attention)):
-        g = make(fn)
-        stats = time_fn(g, q, k, v, iters=5, warmup=2, chained=True)
-        out[f"{name}_ms"] = round(steady_s(stats) * 1e3, 3)
-        out[f"{name}_ms_fenced_p50"] = round(stats["p50_s"] * 1e3, 3)
-        try:
-            mem = g.lower(q, k, v).compile().memory_analysis()
-            out[f"{name}_temp_mb"] = round(
-                mem.temp_size_in_bytes / 2**20, 1)
-        except Exception:
-            out[f"{name}_temp_mb"] = None
-    if out.get("xla_ms") and out.get("flash_ms"):
-        out["speedup"] = round(out["xla_ms"] / out["flash_ms"], 3)
-    out["shape"] = f"B{B} S{S} H{H} Dh{Dh} bf16 causal"
+        r = {}
+        for name, fn in (("flash", flash_attention),
+                         ("xla", _xla_attention)):
+            g = make(fn)
+            stats = time_fn(g, q, k, v, iters=5, warmup=2, chained=True)
+            r[f"{name}_ms"] = round(steady_s(stats) * 1e3, 3)
+            r[f"{name}_ms_fenced_p50"] = round(stats["p50_s"] * 1e3, 3)
+            try:
+                mem = g.lower(q, k, v).compile().memory_analysis()
+                r[f"{name}_temp_mb"] = round(
+                    mem.temp_size_in_bytes / 2**20, 1)
+            except Exception:
+                r[f"{name}_temp_mb"] = None
+        if r.get("xla_ms") and r.get("flash_ms"):
+            r["speedup"] = round(r["xla_ms"] / r["flash_ms"], 3)
+        r["shape"] = f"B{B} S{S} H{H} Dh{Dh} bf16 causal"
+        return r
+
+    if smoke:
+        return measure(1, 512, 2, 32)
+    if jax.devices()[0].platform != "tpu":
+        return measure(4, 2048, 8, 64)  # CPU fallback: 8k is minutes/iter
+    # headline at S=8192 — a shape where impl="auto" actually dispatches
+    # the kernel (S >= FLASH_AUTO_MIN_S) and its linear backward memory
+    # matters; the old S=2048 headline showcased the XLA fallback the
+    # auto dispatch deliberately picks there (round-4 verdict).  The
+    # crossover point stays measured as the secondary row; the full S
+    # curve lives in results/flash_sweep_tpu_*.
+    out = measure(4, 8192, 8, 64)
+    out["crossover_s2048"] = measure(4, 2048, 8, 64)
     return out
 
 
@@ -830,7 +846,8 @@ def _leg_llama_decode(smoke: bool, progress=None) -> dict:
         for tag, (m_, p_, kw) in (
                 ("int8", (model, params, {})),
                 ("pruned_int8", (pm, pp, {})),
-                ("int4", (model, params, {"bits": 4}))):
+                ("int4", (model, params, {"bits": 4})),
+                ("pruned_int4", (pm, pp, {"bits": 4}))):
             qp = quantize_params(m_, p_, **kw)
             if kw.get("bits") == 4:
                 qp = cast_floats(qp, jax.numpy.bfloat16)
@@ -843,6 +860,10 @@ def _leg_llama_decode(smoke: bool, progress=None) -> dict:
         result["int8_decode_speedup"] = round(steady / steady_q["int8"], 3)
         result["int4_decode_speedup_vs_bf16_weights"] = round(
             steady_bf16w / steady_q["int4"], 3)
+        # the full deploy pipeline (prune 25% FFN -> int4) vs the plain
+        # bf16-weights dense serving baseline
+        result["pruned_int4_decode_speedup_vs_bf16_weights"] = round(
+            steady_bf16w / steady_q["pruned_int4"], 3)
     return result
 
 

@@ -208,6 +208,9 @@ AUX = [
     ("flash_sweep", 3600, lambda out:
         [sys.executable, "-u", "-m",
          "torchpruner_tpu.experiments.flash_sweep", "--tune", "--out", out]),
+    ("sweep_scaling", 3600, lambda out:
+        [sys.executable, "-u", "-m",
+         "torchpruner_tpu.experiments.sweep_scaling", "--out", out]),
     ("compile_economics", 3600, lambda out:
         [sys.executable, "-u", "-m",
          "torchpruner_tpu.experiments.compile_economics", "--steps", "5",

@@ -138,3 +138,18 @@ def test_disk_datasets_are_memory_mapped(tmp_path, monkeypatch):
         np.testing.assert_array_equal(np.asarray(bx), x[i * 16:(i + 1) * 16])
     sub = ds.subset(10, seed=3)
     assert len(sub) == 10 and np.isfinite(np.asarray(sub.x)).all()
+
+
+def test_resample_grows_split_with_replacement():
+    """Dataset.resample draws n examples with replacement — the cost-curve
+    vehicle that lets sweep_scaling measure n=1000 on a 300-example
+    split (wall-clock depends on array sizes, not label novelty)."""
+    import numpy as np
+
+    from torchpruner_tpu.data import load_dataset
+
+    ds = load_dataset("digits32", "test", seed=0)
+    big = ds.resample(2 * len(ds.x) + 7, seed=0)
+    assert len(big.x) == 2 * len(ds.x) + 7
+    assert big.x.shape[1:] == ds.x.shape[1:]
+    assert set(np.unique(big.y)) <= set(np.unique(ds.y))

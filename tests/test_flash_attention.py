@@ -216,3 +216,28 @@ def test_causal_cross_attention_bottom_right_aligned():
                               causal=False)
         np.testing.assert_allclose(np.asarray(out[:, i:i + 1]),
                                    np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_bwd_xla_fallback_above_threshold(monkeypatch):
+    """At S >= FLASH_BWD_XLA_MIN_S (32k on chip) the vjp recomputes
+    gradients through the XLA path while the forward stays flash; both
+    must match the pure-XLA computation."""
+    from torchpruner_tpu.ops import flash_attention as F
+
+    monkeypatch.setattr(F, "FLASH_BWD_XLA_MIN_S", 32)
+    q, k, v = qkv(S=64)
+
+    def loss_flash(q_, k_, v_):
+        return jnp.sum(F.flash_attention(q_, k_, v_, causal=True) ** 2)
+
+    def loss_xla(q_, k_, v_):
+        return jnp.sum(F._xla_attention(q_, k_, v_, causal=True) ** 2)
+
+    val_f, grads_f = jax.value_and_grad(loss_flash, argnums=(0, 1, 2))(
+        q, k, v)
+    val_x, grads_x = jax.value_and_grad(loss_xla, argnums=(0, 1, 2))(
+        q, k, v)
+    np.testing.assert_allclose(float(val_f), float(val_x), rtol=1e-5)
+    for gf, gx in zip(grads_f, grads_x):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gx),
+                                   rtol=1e-4, atol=1e-5)

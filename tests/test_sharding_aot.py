@@ -294,3 +294,67 @@ def test_llama3_8b_pp_spmd_step_lowers_on_abstract_pod_mesh():
         data_axis="data", remat=True, compute_dtype=jnp.bfloat16)
     lowered = step.trace(p_s, o_s, x_s).lower(lowering_platforms=("tpu",))
     assert "sharding" in lowered.as_text()
+
+
+def test_llama3_8b_distributed_taylor_scoring_lowers():
+    """The scoring third of the north-star loop (attribution -> prune ->
+    retrain on pods): Taylor per-example rows at the BASELINE FFN prune
+    site, batch sharded over data, params TP-sharded over model, reduced
+    as distributed moments (sum / sum-of-squares psum'd by XLA) — traced
+    and lowered at 8B scale on the abstract {data: 8, model: 8} mesh.
+    This is exactly what DistributedScorer dispatches per batch
+    (parallel/scoring.py run(): run_rows + jnp.sum moments)."""
+    from torchpruner_tpu.attributions.activation import grad_rows_fn
+    from torchpruner_tpu.utils.dtypes import cast_floats
+
+    model, params, state = _shapes()
+    assert not jax.tree_util.tree_leaves(state)
+    row_fn = grad_rows_fn(model, "block1_ffn/gate",
+                          lm_cross_entropy_loss, "taylor")
+
+    def moments(p, x, y):
+        rows = row_fn(cast_floats(p, jnp.bfloat16), {}, x, y)
+        rows = rows.astype(jnp.float32)
+        return jnp.sum(rows, axis=0), jnp.sum(rows * rows, axis=0)
+
+    p_sh = tp_sharding(model, params, MESH)
+    p_s = jax.tree_util.tree_map(
+        lambda l, sh: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=sh),
+        params, p_sh)
+    B, S = 16, 2048
+    x_s = jax.ShapeDtypeStruct(
+        (B, S), jnp.int32, sharding=NamedSharding(MESH, P("data")))
+    lowered = jax.jit(moments).trace(p_s, x_s, x_s).lower(
+        lowering_platforms=("tpu",))
+    assert "sharding" in lowered.as_text()
+
+
+def test_llama3_8b_distributed_shapley_rows_lower():
+    """Shapley rows (the scan-over-units marginal chain x vmap over
+    permutations) trace and lower at 8B on the abstract pod mesh with
+    TP-sharded params and data-sharded batch — the most expensive
+    attribution in the loop proven constructible at BASELINE scale."""
+    from torchpruner_tpu.attributions.shapley import shapley_rows_fn
+    from torchpruner_tpu.utils.dtypes import cast_floats
+
+    model, params, _ = _shapes()
+    n_units = model.site_shape("block1_ffn/gate")[-1]
+    assert n_units == 14336
+    row_fn = shapley_rows_fn(model, "block1_ffn/gate",
+                             lm_cross_entropy_loss, False)
+
+    def rows(p, x, y, perms):
+        return row_fn(cast_floats(p, jnp.bfloat16), {}, x, y, perms)
+
+    p_sh = tp_sharding(model, params, MESH)
+    p_s = jax.tree_util.tree_map(
+        lambda l, sh: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=sh),
+        params, p_sh)
+    B, S = 16, 2048
+    x_s = jax.ShapeDtypeStruct(
+        (B, S), jnp.int32, sharding=NamedSharding(MESH, P("data")))
+    perm_s = jax.ShapeDtypeStruct((1, n_units), jnp.int32,
+                                  sharding=NamedSharding(MESH, P()))
+    lowered = jax.jit(rows).trace(p_s, x_s, x_s, perm_s).lower(
+        lowering_platforms=("tpu",))
+    assert "sharding" in lowered.as_text()

@@ -90,6 +90,16 @@ class Dataset:
         idx = rng.permutation(len(self.x))[:n]
         return Dataset(self.x[idx], self.y[idx], self.name)
 
+    def resample(self, n: int, seed: int = 0) -> "Dataset":
+        """``n`` examples drawn WITH replacement — grows a split past its
+        real size for cost-curve measurements (wall-clock depends on
+        array sizes, not label novelty; see experiments/sweep_scaling).
+        Not for accuracy evaluation: repeated examples bias statistics."""
+        rng = np.random.default_rng(seed)
+        idx = rng.integers(0, len(self.x), size=n)
+        return Dataset(self.x[idx], self.y[idx],
+                       f"{self.name}[resampled {n}]")
+
     def host_shard(self, index: Optional[int] = None,
                    count: Optional[int] = None) -> "Dataset":
         """This host's slice for multi-host data parallelism: host ``i``

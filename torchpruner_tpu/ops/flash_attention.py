@@ -40,6 +40,17 @@ DEFAULT_BLOCK_K = 128
 MIN_BLOCK = 8  # below this the kernel degrades to tiny-tile scalar work
 _LANE = 128  # TPU lane width: minor dim of the LSE/delta row layout
 
+#: at/above this sequence length the flash BACKWARD kernel's remote
+#: compilation fails on the tunnelled single-chip backend (HTTP 500 —
+#: PERF.md flash S-sweep; the forward compiles and runs at 32k).  The
+#: vjp then recomputes gradients through the XLA path instead, keeping
+#: 32k-token training WORKING at quadratic temp cost in the backward
+#: only.  Set to None to always use the flash backward (e.g. on a
+#: directly-attached chip); multi-device 32k training should prefer
+#: ring/Ulysses sequence parallelism (parallel/sp.py), which shards S
+#: before attention ever sees the full length.
+FLASH_BWD_XLA_MIN_S: int | None = 32768
+
 
 def _xla_attention(q, k, v, *, causal: bool):
     """Reference einsum path on (B, S, H, Dh); also the non-blocking
@@ -366,6 +377,16 @@ def _flash_vjp_fwd(q, k, v, causal, block_q, block_k):
     blocks = _pick_blocks(q.shape[1], block_q, block_k)
     if blocks is None:
         return _xla_attention(q, k, v, causal=causal), (q, k, v, None, None)
+    if FLASH_BWD_XLA_MIN_S is not None \
+            and q.shape[1] >= FLASH_BWD_XLA_MIN_S:
+        # flash FORWARD (compiles and runs at 32k — 58.4 ms, 0 MB temp,
+        # PERF.md S-sweep), but the backward kernel's remote compilation
+        # 500s on the tunnelled backend at this length; hand the vjp the
+        # lse=None residual so the backward recomputes through the XLA
+        # path — 32k-token training works at XLA's quadratic temp cost
+        # in the backward only (measured viable: 121.7 ms / 13.3 GB).
+        out = _flash_attention(q, k, v, causal, block_q, block_k)
+        return out, (q, k, v, None, None)
     bq, bk = blocks
     # (B, S, H, Dh) -> (B, H, S, Dh) for clean per-(batch, head) blocking
     qt, kt, vt = (jnp.moveaxis(t, 2, 1) for t in (q, k, v))

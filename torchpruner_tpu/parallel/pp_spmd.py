@@ -14,21 +14,22 @@ collective like any other — it rides ICI within a host and DCN across
 hosts — so the same compiled step pipelines across processes
 (SURVEY.md §2.11's pods north star), with no NCCL-analog code.
 
-Schedule: GPipe forward fill/drain (Huang et al., 2019) over
-``T = n_micro + n_stages - 1`` ticks, expressed as ONE ``lax.scan``:
-at tick ``t`` stage 0 injects microbatch ``t``, every stage applies its
-blocks to whatever the permute delivered, the last stage banks outputs
-for microbatch ``t - (n_stages - 1)``.  The bubble fraction is the
-standard ``(S - 1) / (M + S - 1)``.  Gradients need nothing special:
-the transpose of ``ppermute`` is the reverse permutation, so
-``jax.grad`` of the whole step is pipeline-parallel automatically —
-activation gradients hop backwards over the same collective.
-
-Future work: the Megatron interleaved schedule (V virtual stages per
-device) would cut the bubble from ``(S-1)/(M+S-1)`` toward
-``(S-1)/(V·M+S-1)``; the GPipe fill/drain here plus per-block remat is
-the simplest correct pods formulation, and the interleaving is a
-schedule-only change on top of the same stacked-ppermute machinery.
+Schedule: ONE ``lax.scan`` over ticks implementing the Megatron
+interleaved schedule (Narayanan et al., 2021) with ``V = interleave``
+virtual stages per device; ``V = 1`` (the default) reduces exactly to
+GPipe forward fill/drain (Huang et al., 2019).  Each device holds the
+``V`` depth-chunks ``v*S + d`` (``cb = depth/(S·V)`` blocks each) and
+the activation ring gains a wrap edge ``S-1 → 0`` so a microbatch
+passes every device ``V`` times.  The schedule is diagonal: at tick
+``t`` device ``d`` sits on lane ``tt = t - d`` and computes chunk
+``v = (tt // S) mod V`` for microbatch ``m = (tt // (S·V))·S + tt % S``
+— stage 0 injects when ``v = 0``, the last stage banks when
+``v = V-1``.  Bubble fraction: ``(S-1)/(V·M + S-1)`` — interleaving
+cuts the GPipe bubble by ``~V`` at the price of ``V×`` the ppermute
+traffic.  Gradients need nothing special: the transpose of
+``ppermute`` is the reverse permutation, so ``jax.grad`` of the whole
+step is pipeline-parallel automatically — activation gradients hop
+backwards over the same collective.
 
 Composability: params enter in the model's ordinary pytree layout and
 are stacked inside the traced function, so gradient pytrees, optax
@@ -169,8 +170,14 @@ def pp_spmd_apply(
     compute_dtype=None,
     train: bool = False,
     rng=None,
+    interleave: int = 1,
 ):
     """Forward pass with the block stack pipelined over ``mesh[axis]``.
+
+    ``interleave = V > 1`` enables the Megatron interleaved schedule:
+    each device holds V non-contiguous depth chunks and the bubble
+    shrinks ~V× (module docstring).  Requires
+    ``depth % (n_stages * V) == 0``.
 
     ``tokens``: ``(B, S)`` int32, ``B % n_microbatches == 0``.  Embedding
     and head (the ``pre``/``post`` layers) run replicated outside the
@@ -196,8 +203,14 @@ def pp_spmd_apply(
     pre, groups, post = split_pipeline(model)
     n_stages = mesh.shape[axis]
     depth = len(groups)
-    if depth % n_stages != 0:
-        raise ValueError(f"depth {depth} not divisible by {n_stages} stages")
+    V = int(interleave)
+    if V < 1:
+        raise ValueError(f"interleave must be >= 1, got {interleave}")
+    if depth % (n_stages * V) != 0:
+        raise ValueError(
+            f"depth {depth} not divisible by {n_stages} stages × "
+            f"{V} virtual chunks")
+    cb = depth // (n_stages * V)  # blocks per virtual chunk
     M = n_microbatches
     B = tokens.shape[0]
     if B % M != 0:
@@ -223,11 +236,28 @@ def pp_spmd_apply(
     h, _ = L.apply_seq(pre, params, {}, tokens, train=train, rng=rng_pre)
     x_micro = h.reshape((M, B // M) + h.shape[1:])
     stacked = stack_block_params(params, groups)
+    if V > 1:
+        # re-order the depth axis so the contiguous pp shard of device d
+        # holds its V interleaved chunks v*S + d (each cb consecutive
+        # blocks), chunk-major: local block j belongs to chunk j // cb
+        order = jnp.asarray([
+            (v * n_stages + d) * cb + b
+            for d in range(n_stages) for v in range(V) for b in range(cb)
+        ])
+        stacked = jax.tree_util.tree_map(
+            lambda arr: jnp.take(arr, order, axis=0), stacked)
+
+    # one lax.scan over the diagonal-lane schedule (module docstring):
+    # lane tt = t - device; chunk v = (tt // S) mod V; microbatch
+    # m = (tt // (S*V)) * S + tt % S.  V = 1 reduces to GPipe exactly.
+    # Ticks to the last bank of microbatch M-1 (lane algebra, static):
+    T = (((M - 1) // n_stages * V + V - 1) * n_stages
+         + (M - 1) % n_stages + n_stages)
 
     def stage_program(blocks_local, x_all, key):
         idx = jax.lax.axis_index(axis)
 
-        def apply_blocks(act, key_t):
+        def apply_chunk(act, v, key_t):
             def body(a, xs):
                 p_one, bidx = xs
                 sub = (None if key_t is None
@@ -237,18 +267,25 @@ def pp_spmd_apply(
                     {}, a, train=train, remat=remat, rng=sub,
                 )
                 return a2, None
-            bps = depth // n_stages
+            chunk = jax.tree_util.tree_map(
+                lambda arr: jax.lax.dynamic_slice_in_dim(
+                    arr, v * cb, cb, axis=0), blocks_local)
             out, _ = jax.lax.scan(
-                body, act, (blocks_local, jnp.arange(bps)))
+                body, act, (chunk, v * cb + jnp.arange(cb)))
             return out
 
         def tick(carry, t):
             act_in, out_buf = carry
-            inject = x_all[jnp.clip(t, 0, M - 1)]
-            cur = jnp.where(idx == 0, inject, act_in)
+            tt = t - idx
+            p = jnp.mod(tt, n_stages)
+            rnd = jnp.floor_divide(tt, n_stages)
+            v = jnp.mod(rnd, V)
+            m = jnp.floor_divide(rnd, V) * n_stages + p
+            inject = x_all[jnp.clip(m, 0, M - 1)]
+            cur = jnp.where((idx == 0) & (v == 0), inject, act_in)
             # independent masks per (tick, stage, data-shard, block):
             # tick + stage + data coordinate fold here, block inside
-            # apply_blocks — without the data fold, replicated keys give
+            # apply_chunk — without the data fold, replicated keys give
             # every data shard identical masks
             if key is None:
                 key_t = None
@@ -257,13 +294,18 @@ def pp_spmd_apply(
                 if data_axis is not None:
                     key_t = jax.random.fold_in(
                         key_t, jax.lax.axis_index(data_axis))
-            y = apply_blocks(cur, key_t)
-            m = t - (n_stages - 1)
+            y = apply_chunk(cur, v, key_t)
             banked = out_buf.at[jnp.clip(m, 0, M - 1)].set(y)
-            write = (idx == n_stages - 1) & (m >= 0) & (m < M)
+            write = ((idx == n_stages - 1) & (v == V - 1)
+                     & (m >= 0) & (m < M))
             out_buf = jnp.where(write, banked, out_buf)
-            act_next = jax.lax.ppermute(
-                y, axis, [(s, s + 1) for s in range(n_stages - 1)])
+            perm = [(s, s + 1) for s in range(n_stages - 1)]
+            if V > 1:
+                # the wrap edge sends chunk-v outputs back to stage 0
+                # for chunk v+1 (stage 0's v = 0 injection overwrites
+                # the wrapped value after the final chunk)
+                perm = perm + [(n_stages - 1, 0)]
+            act_next = jax.lax.ppermute(y, axis, perm)
             return (act_next, out_buf), None
 
         # the tick carry is device-varying from the first ppermute on;
@@ -274,8 +316,7 @@ def pp_spmd_apply(
             carry0 = jax.lax.pcast(carry0, axis, to="varying")
         else:  # pragma: no cover - older jax
             carry0 = jax.lax.pvary(carry0, axis)
-        (_, out_buf), _ = jax.lax.scan(
-            tick, carry0, jnp.arange(M + n_stages - 1))
+        (_, out_buf), _ = jax.lax.scan(tick, carry0, jnp.arange(T))
         # only the last stage ever banks outputs; the psum both collects
         # them and re-replicates the result for the post layers
         return jax.lax.psum(out_buf, axis)
@@ -309,20 +350,24 @@ def pp_spmd_apply(
 
 def pp_spmd_train_step(model, optimizer, loss_fn, *, mesh, n_microbatches,
                        axis: str = "pp", data_axis: str | None = None,
-                       remat: bool = False, compute_dtype=None):
+                       remat: bool = False, compute_dtype=None,
+                       interleave: int = 1):
     """A jitted ``(params, opt_state, tokens, rng=None) -> (params',
     opt_state', loss)`` whose forward/backward is pipelined over
     ``mesh[axis]``.  ``loss_fn(logits, tokens) -> (B,)`` per-example
     losses (e.g. :func:`~torchpruner_tpu.utils.losses.lm_cross_entropy_loss`).
     Dropout-bearing models pass a fresh ``rng`` per step (omitting it
-    raises the Dropout layer's needs-an-rng error at trace time)."""
+    raises the Dropout layer's needs-an-rng error at trace time).
+    ``interleave`` enables the interleaved schedule (see
+    :func:`pp_spmd_apply`)."""
 
     def loss(params, tokens, rng):
         logits = pp_spmd_apply(
             model, params, tokens, mesh=mesh,
             n_microbatches=n_microbatches, axis=axis,
             data_axis=data_axis, remat=remat,
-            compute_dtype=compute_dtype, train=True, rng=rng)
+            compute_dtype=compute_dtype, train=True, rng=rng,
+            interleave=interleave)
         return loss_fn(logits, tokens).mean()
 
     @jax.jit
