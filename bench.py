@@ -527,7 +527,7 @@ def _leg_vgg_train(smoke: bool) -> dict:
                           "img_per_s_per_chip": bf16["img_per_s_per_chip"],
                           **({"implausible": bf16["implausible"]}
                              if "implausible" in bf16 else {})}}
-        sweep = _batch_sweep(measure_at, seeded, (512, 1024))
+        sweep = _batch_sweep(measure_at, seeded, (512, 1024, 2048))
         out["batch_sweep"] = {str(b): v for b, v in sweep.items()}
         best = max(
             (v for v in sweep.values()

@@ -123,6 +123,17 @@ def main() -> None:
     print(f"4) PP×DP composed on a 2-D mesh ok (loss {float(loss_2d):.4f} "
           f"== step 3's, asserted)")
 
+    # -- 5) interleaved schedule (V=2 chunks per stage) -------------------
+    step_il = pp_spmd_train_step(
+        model, optax.adam(1e-3), lm_cross_entropy_loss,
+        mesh=mesh2d, n_microbatches=2, data_axis="data", interleave=2,
+    )
+    params, _ = tp.init_model(model, seed=0)
+    params, _, loss_il = step_il(params, optax.adam(1e-3).init(params), toks)
+    assert abs(float(loss_il) - float(loss_spmd)) < 1e-4, (loss_il, loss_spmd)
+    print(f"5) Megatron interleaved schedule (V=2, wrap-around ppermute) "
+          f"ok (loss {float(loss_il):.4f} == step 3's, asserted)")
+
 
 if __name__ == "__main__":
     main()

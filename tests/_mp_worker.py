@@ -102,12 +102,28 @@ def run_pp(pid: int) -> None:
     for _ in range(3):
         params, opt_state, loss = step(params, opt_state, toks)
         losses.append(float(loss))
+
+    # the interleaved schedule (V=2 chunks/device) across the same
+    # process boundary: the wrap-around ppermute edge S-1 -> 0 crosses
+    # hosts in BOTH directions (depth 8 so depth % (4 stages * 2) == 0)
+    model8 = llama_tiny(depth=8)
+    params8, _ = init_model(model8, seed=0)
+    opt_state8 = opt.init(jax.tree_util.tree_map(np.asarray, params8))
+    params8 = jax.tree_util.tree_map(glob, params8)
+    opt_state8 = jax.tree_util.tree_map(glob, opt_state8)
+    step_i = pp_spmd_train_step(model8, opt, lm_cross_entropy_loss,
+                                mesh=mesh, n_microbatches=4, interleave=2)
+    losses_i = []
+    for _ in range(2):
+        params8, opt_state8, loss = step_i(params8, opt_state8, toks)
+        losses_i.append(float(loss))
     print(json.dumps({
         "pid": pid,
         "process_count": jax.process_count(),
         "global_devices": jax.device_count(),
         "local_devices": jax.local_device_count(),
         "losses": losses,
+        "losses_interleaved": losses_i,
     }), flush=True)
 
 

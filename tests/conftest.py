@@ -100,6 +100,14 @@ SLOW_TESTS = {
     "test_sharding_aot.py::test_llama3_8b_pp_spmd_step_lowers_on_abstract_pod_mesh",
     "test_pp_spmd.py::test_pp_spmd_composes_with_uniform_prune",
     "test_multiprocess.py::test_two_process_spmd_pipeline_matches_single_process",
+    "test_pp_spmd.py::test_pp_spmd_interleaved_forward_matches_sequential",
+    "test_pp_spmd.py::test_pp_spmd_interleaved_train_step_matches_gpipe",
+    "test_pp_spmd.py::test_pp_spmd_interleaved_ragged_wave_still_matches",
+    "test_flash_attention.py::test_bwd_xla_fallback_above_threshold",
+    "test_quant.py::test_quantized_random_params_build_and_serve",
+    "test_train.py::test_multi_step_matches_sequential_steps",
+    "test_torch_import.py::test_vgg16_bn_import_from_saved_checkpoint_file",
+    "test_int4_matmul.py::test_int4_matmul_tiles_prefill_row_counts",
 }
 
 

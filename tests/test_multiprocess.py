@@ -171,3 +171,25 @@ def test_two_process_spmd_pipeline_matches_single_process():
         ref.append(float(l))
     np.testing.assert_allclose(results[0]["losses"], ref,
                                rtol=1e-4, atol=1e-6)
+
+    # interleaved (V=2) trajectory: cross-process equality + the
+    # single-device depth-8 reference
+    np.testing.assert_allclose(results[0]["losses_interleaved"],
+                               results[1]["losses_interleaved"], rtol=1e-6)
+    model8 = llama_tiny(depth=8)
+    params8, _ = init_model(model8, seed=0)
+    opt_state8 = opt.init(params8)
+
+    def loss8(p):
+        logits, _ = model8.apply(p, tokens)
+        return lm_cross_entropy_loss(logits, tokens).mean()
+
+    ref8 = []
+    for _ in range(2):
+        l, g = jax.value_and_grad(loss8)(params8)
+        updates, opt_state8 = opt.update(g, opt_state8, params8)
+        params8 = jax.tree_util.tree_map(lambda a, u: a + u, params8,
+                                         updates)
+        ref8.append(float(l))
+    np.testing.assert_allclose(results[0]["losses_interleaved"], ref8,
+                               rtol=1e-4, atol=1e-6)

@@ -326,3 +326,30 @@ def test_quantized_random_params_build_and_serve():
 
     toks = generate(model, params, jnp.zeros((2, 4), jnp.int32), 4)
     assert toks.shape == (2, 4)
+
+
+def test_qdot_3d_weight_kernel_path_matches_tensordot():
+    """Attention-shaped (d, H, Dh) int4 weights flatten onto the fused
+    kernel (packing pairs along axis 0 survive a trailing-axes flatten);
+    the result must match the XLA tensordot formulation, and float 3-D
+    weights must take the same contraction."""
+    from torchpruner_tpu.ops.quant import qdot, quantize_tensor, wval
+
+    rng = np.random.default_rng(5)
+    d, H, Dh = 512, 4, 128
+    w = jnp.asarray(rng.normal(size=(d, H, Dh)).astype(np.float32))
+    x = jnp.asarray(rng.normal(size=(2, 3, d)).astype(np.float32))
+    t = quantize_tensor(w, in_axes=(0,), bits=4)
+
+    via_kernel = qdot(x.astype(jnp.bfloat16), t)
+    assert via_kernel.shape == (2, 3, H, Dh)
+    via_unpack = jnp.tensordot(x.astype(jnp.bfloat16),
+                               wval(t, jnp.bfloat16), axes=(2, 0))
+    np.testing.assert_allclose(np.asarray(via_kernel, np.float32),
+                               np.asarray(via_unpack, np.float32),
+                               rtol=3e-2, atol=3e-1)
+    # float 3-D weight: plain tensordot
+    np.testing.assert_allclose(
+        np.asarray(qdot(x, w)),
+        np.asarray(jnp.tensordot(x, w, axes=(2, 0))),
+        rtol=1e-6, atol=1e-5)

@@ -76,6 +76,43 @@ def spec_from_dict(d: dict) -> SegmentedModel:
     )
 
 
+def _pack_qtensors(tree):
+    """Replace :class:`QTensor` leaves with plain ``{"q", "scale"}``
+    dicts (orbax-serializable) and collect their static aux data keyed
+    by path (the same root-relative paths :func:`_unpack_qtensors`
+    walks) — quantized serving trees checkpoint losslessly."""
+    from torchpruner_tpu.ops.quant import QTensor
+
+    aux: Dict[str, list] = {}
+
+    def walk(t, p):
+        if isinstance(t, QTensor):
+            aux[p] = [list(t.in_axes), t.bits, t.pack_axis]
+            return {"q": t.q, "scale": t.scale}
+        if isinstance(t, dict):
+            return {k: walk(v, f"{p}/{k}" if p else k)
+                    for k, v in t.items()}
+        return t
+
+    return walk(tree, ""), aux
+
+
+def _unpack_qtensors(tree, aux: Dict[str, list]):
+    from torchpruner_tpu.ops.quant import QTensor
+
+    def walk(t, p):
+        if p in aux:
+            in_axes, bits, pack_axis = aux[p]
+            return QTensor(t["q"], t["scale"], tuple(in_axes), bits,
+                           pack_axis)
+        if isinstance(t, dict):
+            return {k: walk(v, f"{p}/{k}" if p else k)
+                    for k, v in t.items()}
+        return t
+
+    return walk(tree, "")
+
+
 def save_checkpoint(
     path: str,
     model: SegmentedModel,
@@ -87,11 +124,15 @@ def save_checkpoint(
     prune_history: Optional[list] = None,
     extra: Optional[Dict[str, Any]] = None,
 ):
-    """Write a checkpoint directory: ``spec.json`` + orbax array tree."""
+    """Write a checkpoint directory: ``spec.json`` + orbax array tree.
+    Quantized (:class:`~torchpruner_tpu.ops.quant.QTensor`) params are
+    supported: the int payload + scale save as arrays and the static
+    quantization metadata rides in ``spec.json``."""
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
     os.makedirs(path, exist_ok=True)
+    params, q_aux = _pack_qtensors(params)
     meta = {
         "spec": spec_to_dict(model),
         "widths": model.widths(),
@@ -99,6 +140,8 @@ def save_checkpoint(
         "prune_history": prune_history or [],
         "extra": extra or {},
     }
+    if q_aux:
+        meta["quantized"] = q_aux
     if opt_state is not None:
         # the optax pytree structure (node types included) — restore
         # refuses to rebuild under a *different* optimizer whose state
@@ -136,6 +179,8 @@ def restore_checkpoint(path: str, tx=None, *, check_opt_structure: bool = True):
     ckptr = ocp.PyTreeCheckpointer()
     restored = ckptr.restore(os.path.join(path, "arrays"))
     params = restored["params"]
+    if meta.get("quantized"):
+        params = _unpack_qtensors(params, meta["quantized"])
     state = restored.get("state", {})
     opt_state = None
     if tx is not None and "opt_state" in restored:

@@ -995,12 +995,12 @@ def apply_layer(
                     f"sp_model(model, 'auto') for single-device "
                     f"apply/scoring/generation"
                 ) from e
-        q = oscale(jnp.einsum("bsd,dhk->bshk", x,
-                              wval(params["wq"], x.dtype)), params["wq"])
-        k = oscale(jnp.einsum("bsd,dhk->bshk", x,
-                              wval(params["wk"], x.dtype)), params["wk"])
-        v = oscale(jnp.einsum("bsd,dhk->bshk", x,
-                              wval(params["wv"], x.dtype)), params["wv"])
+        # qdot contracts x's trailing axis with the weight's leading one
+        # (== einsum bsd,dhk->bshk) and routes int4 weights through the
+        # fused-unpack kernel (ops/quant.qdot)
+        q = oscale(qdot(x, params["wq"]), params["wq"])
+        k = oscale(qdot(x, params["wk"]), params["wk"])
+        v = oscale(qdot(x, params["wv"]), params["wv"])
         if "bq" in params:
             q = q + params["bq"]
             k = k + params["bk"]

@@ -38,7 +38,7 @@ from jax import lax
 
 from torchpruner_tpu.core import layers as L
 from torchpruner_tpu.core.segment import SegmentedModel
-from torchpruner_tpu.ops.quant import oscale, wval
+from torchpruner_tpu.ops.quant import oscale, qdot, wval
 
 _NEG_INF = -1e30
 
@@ -82,12 +82,12 @@ def _decode_attention(spec, params, entry, x, pos):
     block's K/V are written at ``pos..pos+s-1`` and attention is causal
     within the block.  Returns (y, entry').
     """
-    q = oscale(jnp.einsum("bsd,dhk->bshk", x,
-                          wval(params["wq"], x.dtype)), params["wq"])
-    k = oscale(jnp.einsum("bsd,dhk->bshk", x,
-                          wval(params["wk"], x.dtype)), params["wk"])
-    v = oscale(jnp.einsum("bsd,dhk->bshk", x,
-                          wval(params["wv"], x.dtype)), params["wv"])
+    # qdot: leading-axis contraction — int4 q/k/v projections ride the
+    # fused-unpack kernel (their (d, H, Dh) weights flatten to the
+    # kernel's 2-D layout); float weights take the same tensordot
+    q = oscale(qdot(x, params["wq"]), params["wq"])
+    k = oscale(qdot(x, params["wk"]), params["wk"])
+    v = oscale(qdot(x, params["wv"]), params["wv"])
     if "bq" in params:
         q = q + params["bq"]
         k = k + params["bk"]

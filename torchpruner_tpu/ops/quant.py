@@ -160,21 +160,30 @@ def wval(w, dtype):
 
 
 def qdot(x, w):
-    """``x @ w`` for a possibly-quantized trailing-contraction weight —
-    the Dense/GatedDense matmul site.  bits=4 weights with bf16
-    activations route through the fused-unpack Pallas kernel
-    (ops/int4_matmul.py) so the packed bytes are what HBM reads; other
-    cases consume :func:`wval` (bits=4 there unpacks through XLA —
-    correct everywhere, capacity-not-bandwidth).  The caller applies
-    :func:`oscale` as usual."""
+    """``x ·₀ w``: contract ``x``'s trailing axis with ``w``'s LEADING
+    axis — the Dense/GatedDense matmul site, and (for 3-D weights like
+    attention's ``wq (d, H, Dh)``) the einsum ``...d,dhk->...hk``.
+    bits=4 weights packed along that leading axis route through the
+    fused-unpack Pallas kernel (ops/int4_matmul.py) with the output
+    axes flattened for the kernel and restored after — packing pairs
+    along axis 0 stay adjacent under a trailing-axes flatten, so the
+    kernel's nibble layout is unchanged.  The packed bytes are what HBM
+    reads; other cases consume :func:`wval` (bits=4 there unpacks
+    through XLA — correct everywhere, capacity-not-bandwidth).  The
+    caller applies :func:`oscale` as usual."""
     if (isinstance(w, QTensor) and w.bits == 4 and w.in_axes == (0,)
             and w.pack_axis == 0 and x.dtype == jnp.bfloat16):
         from torchpruner_tpu.ops.int4_matmul import int4_matmul
 
         lead = x.shape[:-1]
-        y = int4_matmul(x.reshape((-1, x.shape[-1])), w.q)
-        return y.reshape(lead + (w.shape[-1],)).astype(x.dtype)
-    return x @ wval(w, x.dtype)
+        rest = w.shape[1:]  # logical output axes (possibly > 1 of them)
+        y = int4_matmul(x.reshape((-1, x.shape[-1])),
+                        w.q.reshape((w.q.shape[0], -1)))
+        return y.reshape(lead + rest).astype(x.dtype)
+    wv = wval(w, x.dtype)
+    if wv.ndim > 2:
+        return jnp.tensordot(x, wv, axes=(x.ndim - 1, 0))
+    return x @ wv
 
 
 def oscale(y, w):
