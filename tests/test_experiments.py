@@ -435,3 +435,31 @@ def test_prune_retrain_over_configured_mesh(tmp_path):
     for r in records:
         assert np.isfinite(r.post_loss)
         assert r.n_dropped > 0
+
+
+def test_head_to_head_smoke_runs_reference_library():
+    """The same-box reference comparison drives the ACTUAL reference
+    package (torch CPU) and ours through the untrained-prune recipe on
+    shared weights; the protocol must agree (same prunable widths both
+    sides).  Skips when torch or the reference tree is absent."""
+    import os
+
+    import pytest
+
+    pytest.importorskip("torch")
+    from torchpruner_tpu.experiments.head_to_head import REFERENCE, run
+
+    if not os.path.isdir(os.path.join(REFERENCE, "torchpruner")):
+        pytest.skip("reference tree not available")
+    r = run(smoke=True)
+    # both sides start identical and prune a comparable negative set
+    # (exact membership is Monte-Carlo — the reference's permutations
+    # draw from numpy's global state, so run-to-run sets differ)
+    assert r["ours"]["params"][0] == r["reference"]["params"][0]
+    for side in ("ours", "reference"):
+        before, after = r[side]["params"]
+        assert after < before
+    ratio = r["ours"]["params"][1] / r["reference"]["params"][1]
+    assert 0.7 < ratio < 1.4, r
+    assert r["speedup_same_box_cpu"] > 0
+    assert min(r["score_spearman"].values()) > 0.2  # same-weights signal

@@ -96,6 +96,23 @@ def run_sweep(tune: bool = False, smoke: bool = False) -> dict:
         print(f"[flash_sweep] S={S} B={B} H={H} ...", file=sys.stderr,
               flush=True)
         row["flash"] = _measure(flash_attention, q, k, v)
+        from torchpruner_tpu.ops import flash_attention as F
+
+        if (F.FLASH_BWD_XLA_MIN_S is not None
+                and S >= F.FLASH_BWD_XLA_MIN_S):
+            # the default route at this length recomputes the backward
+            # through XLA (the kernel bwd's remote compile 500s on the
+            # tunnel) — record that, then ATTEMPT the pure kernel
+            # backward anyway so a healthier environment measures it
+            row["flash"]["note"] = ("bwd via XLA fallback "
+                                    "(FLASH_BWD_XLA_MIN_S)")
+            old = F.FLASH_BWD_XLA_MIN_S
+            F.FLASH_BWD_XLA_MIN_S = None
+            try:
+                row["flash_kernel_bwd"] = _measure(
+                    flash_attention, q, k, v)
+            finally:
+                F.FLASH_BWD_XLA_MIN_S = old
         row["xla"] = _measure(_xla_attention, q, k, v)
         if row["flash"].get("ms") and row["xla"].get("ms"):
             row["speedup"] = round(row["xla"]["ms"] / row["flash"]["ms"], 3)

@@ -1,0 +1,260 @@
+"""Same-box, same-data, same-weights head-to-head vs the reference.
+
+Every other comparison in this repo is against the reference's COMMITTED
+numbers (an unnamed 2020 CUDA GPU).  This experiment runs the actual
+reference implementation — ``/root/reference/torchpruner``, imported
+as-is on CPU torch — and this framework side by side on identical data
+and identical initial weights, through the reference's own headline
+recipe ("Pruning Untrained Networks", SURVEY.md §3.4): Shapley
+attribution (sv_samples=5) on every prunable layer, outermost first,
+prune the negative-score units with cascade, measure accuracy
+before/after.  Reported per side: scoring+prune wall-clock, params
+before/after, accuracy before/after — plus the per-layer Spearman rank
+agreement between the two implementations' scores (same weights, same
+data; Monte-Carlo permutations differ, so agreement is statistical, not
+exact).
+
+The reference package is executed unmodified as the benchmark target
+(read-only: bytecode writing is disabled so importing never touches the
+reference tree).  The torch-side model is a minimal torch.nn stack
+implementing the reference's ``forward_partial`` protocol at the same
+widths (784-2024-2024-10 LeakyReLU, reference experiments/models/
+mnist.py:14-35) with weights COPIED from this framework's init — the
+same role tests/test_torch_import.py's builders play.
+
+Run: ``python -m torchpruner_tpu.experiments.head_to_head
+[--n 200] [--out results/...json] [--smoke]``  (CPU on both sides —
+the point is same-box protocol parity; TPU numbers live in bench.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REFERENCE = os.environ.get("TORCHPRUNER_REFERENCE", "/root/reference")
+
+
+def _spearman(a, b) -> float:
+    import numpy as np
+
+    ra = np.argsort(np.argsort(a)).astype(np.float64)
+    rb = np.argsort(np.argsort(b)).astype(np.float64)
+    ra -= ra.mean()
+    rb -= rb.mean()
+    denom = np.sqrt((ra * ra).sum() * (rb * rb).sum())
+    return float((ra * rb).sum() / denom) if denom else 0.0
+
+
+def _build_torch_net(widths, torch):
+    """The reference protocol's FC net: ``model.fc`` holds the Linear /
+    LeakyReLU children and ``forward_partial(x, from_module, to_module)``
+    runs the segment — the convention the reference's Shapley fast path
+    consumes (reference attributions.py:70-89)."""
+    import torch.nn as nn
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            layers = []
+            for i in range(len(widths) - 1):
+                layers.append(nn.Linear(widths[i], widths[i + 1]))
+                if i < len(widths) - 2:
+                    layers.append(nn.LeakyReLU())
+            self.fc = nn.Sequential(*layers)
+
+        def forward(self, x):
+            return self.fc(x)
+
+        def forward_partial(self, x, from_module=None, to_module=None):
+            active = from_module is None
+            for child in self.fc.children():
+                if active:
+                    x = child(x)
+                if child is from_module:
+                    active = True
+                if child is to_module:
+                    break
+            return x
+
+    return Net()
+
+
+def run(n: int = 200, smoke: bool = False) -> dict:
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_platforms", "cpu")  # same-box CPU both sides
+    if jax.default_backend() != "cpu":
+        # the config update is a silent no-op once a backend is cached —
+        # a TPU-jax vs CPU-torch comparison must never publish as
+        # "one CPU core each"
+        raise RuntimeError(
+            "head_to_head needs a fresh process (jax backend is "
+            f"{jax.default_backend()!r}, not cpu)")
+
+    import jax.numpy as jnp
+
+    from torchpruner_tpu.attributions import ShapleyAttributionMetric
+    from torchpruner_tpu.core.graph import pruning_graph
+    from torchpruner_tpu.core.pruner import prune_by_scores
+    from torchpruner_tpu.core.segment import init_model
+    from torchpruner_tpu.data import load_dataset
+    from torchpruner_tpu.models.mlp import fc_net
+    from torchpruner_tpu.utils.flops import param_count
+    from torchpruner_tpu.utils.losses import cross_entropy_loss
+
+    if not os.path.isdir(os.path.join(REFERENCE, "torchpruner")):
+        return {"skipped": f"reference package not found at {REFERENCE}"}
+    sys.dont_write_bytecode = True  # never write into the reference tree
+    if REFERENCE not in sys.path:
+        sys.path.insert(0, REFERENCE)
+    import torch
+    import torch.nn.functional as tF
+
+    from torchpruner.attributions import (  # noqa: E402 - the reference
+        ShapleyAttributionMetric as RefShapley,
+    )
+    from torchpruner.pruner import Pruner as RefPruner  # noqa: E402
+
+    hidden = (32, 32) if smoke else (2024, 2024)
+    if smoke:
+        n = 64
+    widths = (784,) + hidden + (10,)
+    model = fc_net(784, hidden=hidden)
+    params, state = init_model(model, seed=0)
+
+    val = load_dataset("mnist_flat", "val", n=n, seed=0)
+    test = load_dataset("mnist_flat", "test", n=max(2 * n, 500), seed=0)
+    bs = max(n // 2, 1)
+    batches = [(jnp.asarray(x), jnp.asarray(y)) for x, y in val.batches(bs)]
+
+    tnet = _build_torch_net(widths, torch).eval()
+    linears = [m for m in tnet.fc if isinstance(m, torch.nn.Linear)]
+    with torch.no_grad():
+        for lin, name in zip(linears, ("fc1", "fc2", "out")):
+            lin.weight.copy_(torch.from_numpy(
+                np.asarray(params[name]["w"]).T))
+            lin.bias.copy_(torch.from_numpy(np.asarray(params[name]["b"])))
+    class _Loader(list):
+        """DataLoader-shaped batch list: the reference's Shapley fast
+        path sizes its row matrix from ``data_gen.dataset``
+        (reference shapley_values.py:34)."""
+
+    t_batches = _Loader(
+        (torch.from_numpy(x.copy()),
+         torch.from_numpy(y.astype(np.int64)))
+        for x, y in val.batches(bs))
+    t_batches.dataset = range(len(val.x))
+
+    def t_loss(output, target, reduction="mean"):
+        return tF.cross_entropy(output, target, reduction=reduction)
+
+    def t_acc(net):
+        with torch.no_grad():
+            correct = total = 0
+            for x, y in test.batches(500):
+                pred = net(torch.from_numpy(x)).argmax(1).numpy()
+                correct += int((pred == y).sum())
+                total += len(y)
+        return correct / total
+
+    def j_acc(m, p, s):
+        correct = total = 0
+        for x, y in test.batches(500):
+            out, _ = m.apply(p, jnp.asarray(x), state=s, train=False)
+            correct += int((np.asarray(out).argmax(1) == y).sum())
+            total += len(y)
+        return correct / total
+
+    out: dict = {"n_examples": n, "widths": list(widths),
+                 "protocol": "Shapley sv_samples=5, prune negative units, "
+                             "outermost layer first (reference 'Pruning "
+                             "Untrained Networks' recipe)"}
+    out["acc_before"] = {"ours": j_acc(model, params, state),
+                         "reference": t_acc(tnet)}
+
+    # ---- ours ----------------------------------------------------------
+    m, p, s = model, params, state
+    params_before = param_count(p)
+    scores_ours: dict = {}
+    t0 = time.perf_counter()
+    for g in pruning_graph(model)[::-1]:  # outermost first
+        # f32 scoring: torch computes f32 on CPU, and bf16 on a CPU
+        # backend is EMULATED (slower) — the TPU-side bf16 numbers live
+        # in bench.py's mnist_prune leg, not here
+        metric = ShapleyAttributionMetric(
+            m, p, batches, cross_entropy_loss, state=s, sv_samples=5,
+            seed=0)
+        scores = metric.run(g.target)
+        scores_ours[g.target] = np.asarray(scores)
+        res = prune_by_scores(m, p, g.target, scores, policy="negative",
+                              state=s)
+        m, p, s = res.model, res.params, res.state
+    ours_s = time.perf_counter() - t0
+    out["ours"] = {
+        "seconds": round(ours_s, 2),
+        "params": [params_before, param_count(p)],
+        "acc_after": j_acc(m, p, s),
+    }
+    print(f"[head_to_head] ours: {out['ours']}", file=sys.stderr,
+          flush=True)
+
+    # ---- reference (unmodified, torch CPU) -----------------------------
+    device = torch.device("cpu")
+    pruner = RefPruner(tnet, input_size=(widths[0],), device=device)
+    tp_before = sum(int(np.prod(q.shape)) for q in tnet.parameters())
+    # (module, cascade): outermost prunable first, mirroring the notebook
+    plan = [(linears[-2], [linears[-1]]), (linears[0], [linears[1]])]
+    scores_ref: dict = {}
+    # the reference's Monte-Carlo permutations draw from numpy's GLOBAL
+    # rng (reference shapley_values.py:45-47) — seed it so the committed
+    # artifact and the smoke test are reproducible
+    np.random.seed(0)
+    torch.manual_seed(0)
+    t0 = time.perf_counter()
+    for target_name, (module, cascade) in zip(("fc2", "fc1"), plan):
+        metric = RefShapley(tnet, t_batches, t_loss, device, sv_samples=5)
+        scores = np.asarray(metric.run(module))
+        scores_ref[target_name] = scores
+        idx = np.argwhere(scores < 0).flatten()
+        pruner.prune_model(module, list(idx), cascading_modules=cascade)
+    ref_s = time.perf_counter() - t0
+    out["reference"] = {
+        "seconds": round(ref_s, 2),
+        "params": [tp_before,
+                   sum(int(np.prod(q.shape)) for q in tnet.parameters())],
+        "acc_after": t_acc(tnet),
+    }
+    print(f"[head_to_head] reference: {out['reference']}", file=sys.stderr,
+          flush=True)
+
+    out["speedup_same_box_cpu"] = round(ref_s / ours_s, 2)
+    out["score_spearman"] = {
+        k: round(_spearman(scores_ours[k], scores_ref[k]), 3)
+        for k in ("fc2", "fc1")
+    }
+    return out
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--n", type=int, default=200)
+    ap.add_argument("--out", default="")
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args(argv)
+    result = run(n=args.n, smoke=args.smoke)
+    print(json.dumps(result, indent=1))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+        print(f"wrote {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -67,22 +67,36 @@ def run(smoke: bool = False) -> dict:
                  "platform": jax.devices()[0].platform,
                  "device": getattr(jax.devices()[0], "device_kind", ""),
                  "paths": {}}
-    for name, (fn, nbytes) in paths.items():
-        profiling.hard_fence(fn(x))  # compile + warm outside the trace
-        trace_dir = f"logs/int4_bench/{name}"
-        with profiling.trace(trace_dir):
-            profiling.hard_fence(fn(x))
-        dev_s = summarize_trace(trace_dir)["total_ms"] / 1e3 / N
-        out["paths"][name] = {
-            "device_us": round(dev_s * 1e6, 2),
-            "eff_GB_s": round(nbytes / dev_s / 1e9, 1) if dev_s else None,
-        }
-        print(f"[int4_bench] {name}: {out['paths'][name]}",
-              file=sys.stderr, flush=True)
+
+    def run_paths(tag_suffix, x0, dest):
+        for name, (fn, nbytes) in paths.items():
+            profiling.hard_fence(fn(x0))  # compile + warm outside trace
+            trace_dir = f"logs/int4_bench/{name}{tag_suffix}"
+            with profiling.trace(trace_dir):
+                profiling.hard_fence(fn(x0))
+            dev_s = summarize_trace(trace_dir)["total_ms"] / 1e3 / N
+            dest[name] = {
+                "device_us": round(dev_s * 1e6, 2),
+                "eff_GB_s": (round(nbytes / dev_s / 1e9, 1)
+                             if dev_s else None),
+            }
+            print(f"[int4_bench] {name}{tag_suffix}: {dest[name]}",
+                  file=sys.stderr, flush=True)
+
+    run_paths("", x, out["paths"])
     b16 = out["paths"]["bf16"]["device_us"]
     i4 = out["paths"]["int4_kernel"]["device_us"]
     if b16 and i4:
         out["int4_vs_bf16_speedup"] = round(b16 / i4, 3)
+
+    # prefill-shaped rows: the row-TILED kernel grid (rows > 1024 get
+    # their own grid dimension — ops/int4_matmul._pick_row_block); here
+    # the matmul is MXU-bound, not weight-bandwidth-bound, so the point
+    # is that the kernel stays competitive, not that it wins
+    Bp = 16 if smoke else 4096
+    xp = jnp.asarray(rng.normal(size=(Bp, D)).astype(np.float32))
+    out["prefill"] = {"B": Bp, "paths": {}}
+    run_paths("_prefill", xp, out["prefill"]["paths"])
     return out
 
 
