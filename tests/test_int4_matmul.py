@@ -114,3 +114,28 @@ def test_pick_row_block_divisor_search():
     assert _pick_row_block(2048) == 1024
     assert _pick_row_block(1297 * 2) == 0  # 2x prime: no block in [8, 1024]
     assert _pick_row_block(104729) == 0    # prime: degenerate, fallback
+
+
+def test_block_sizes_adapt_to_nondefault_axes():
+    """Axes the 512 defaults don't divide shrink to a fitting
+    lane-aligned block instead of losing the kernel: F=768 and the
+    Llama-3 lm_head's F=128256 -> 384; truly unfittable axes (no
+    128-multiple divisor) still fall back."""
+    from torchpruner_tpu.ops.int4_matmul import _fit_block
+
+    assert _fit_block(768, 512) == 384
+    assert _fit_block(128256, 512) == 384  # 384 * 334; 512 doesn't divide
+    assert _fit_block(4096, 512) == 512
+    assert _fit_block(1002, 512) == 0   # 2*3*167: no 128-multiple divides
+    assert _fit_block(128, 512) == 128
+
+    # end-to-end: F=768 takes the kernel path and matches numerics
+    rng = np.random.default_rng(6)
+    D, F = 512, 768
+    q = jnp.asarray(rng.integers(-8, 8, size=(D, F)).astype(np.int8))
+    x = jnp.asarray(rng.normal(size=(4, D)).astype(np.float32))
+    want = jnp.dot(x.astype(jnp.bfloat16), q.astype(jnp.bfloat16),
+                   preferred_element_type=jnp.float32)
+    got = int4_matmul(x, pack_int4(q))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-4)

@@ -116,6 +116,19 @@ def _pick_row_block(B: int) -> int:
     return 0
 
 
+def _fit_block(n: int, preferred: int, *, lane_multiple: int = 128,
+               even: bool = False) -> int:
+    """``preferred`` when it divides ``n``, else the largest
+    lane-aligned block that does (vocab-sized axes are rarely powers of
+    two: Llama-3's lm_head F = 128256 = 256 × 501 needs block 256, not
+    the 512 default); 0 when none divides — XLA fallback."""
+    for b in (preferred, 384, 256, 128):
+        if b <= n and n % b == 0 and b % lane_multiple == 0 \
+                and (not even or b % 2 == 0):
+            return b
+    return 0
+
+
 @functools.partial(jax.jit, static_argnames=("block_d", "block_f"))
 def int4_matmul(x, packed, scale=None, *, block_d: int = DEFAULT_BLOCK_D,
                 block_f: int = DEFAULT_BLOCK_F):
@@ -129,10 +142,12 @@ def int4_matmul(x, packed, scale=None, *, block_d: int = DEFAULT_BLOCK_D,
     if packed.shape[0] * 2 != D:
         raise ValueError(f"packed rows {packed.shape[0]} != D/2 = {D // 2}")
     # decode-sized row counts ride whole; prefill-sized ones tile so the
-    # x-block and the f32 accumulator stay inside VMEM
+    # x-block and the f32 accumulator stay inside VMEM; the D/F blocks
+    # shrink to fit axes the defaults don't divide (vocab-sized F)
     block_b = _pick_row_block(B)
-    ok = (block_b > 0 and D % block_d == 0 and F % block_f == 0
-          and block_d % 2 == 0)
+    block_d = _fit_block(D, block_d, even=True)
+    block_f = _fit_block(F, block_f)
+    ok = block_b > 0 and block_d > 0 and block_f > 0
     if not ok:
         y = jnp.dot(x.astype(jnp.bfloat16),
                     unpack_int4(packed).astype(jnp.bfloat16),
