@@ -358,3 +358,30 @@ def test_llama3_8b_distributed_shapley_rows_lower():
     lowered = jax.jit(rows).trace(p_s, x_s, x_s, perm_s).lower(
         lowering_platforms=("tpu",))
     assert "sharding" in lowered.as_text()
+
+
+def test_llama3_8b_int4_decode_program_lowers():
+    """The flagship serving program — Llama-3-8B, int4 QTensor weights,
+    bf16 KV cache, prefill + 16-token scan — traces and lowers at full
+    scale with no chip and no arrays (eval_shape builds the quantized
+    tree abstractly).  Proves the one-chip 8B decode config composes
+    end-to-end before the on-chip capture runs it."""
+    from torchpruner_tpu.experiments.llama8b_decode import (
+        quantized_random_params,
+    )
+    from torchpruner_tpu.generate import _generate_fn, init_cache
+    from torchpruner_tpu.models import llama
+
+    model = llama(seq_len=256)
+    params_s, _ = jax.eval_shape(
+        lambda: quantized_random_params(model, bits=4))
+    B, S, n_new = 8, 64, 16
+    cache_s = jax.eval_shape(
+        lambda: init_cache(model, B, S + n_new, jnp.bfloat16))
+    prompt_s = jax.ShapeDtypeStruct((B, S), jnp.int32)
+    rng_s = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    run = _generate_fn(model, S, n_new, 0.0)
+    lowered = run.trace(params_s, cache_s, prompt_s, rng_s).lower(
+        lowering_platforms=("tpu",))
+    hlo = lowered.as_text()
+    assert "xi8>" in hlo  # the packed int4 payloads ride as int8
