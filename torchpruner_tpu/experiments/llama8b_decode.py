@@ -16,6 +16,9 @@ Measured variants (gen tok/s on the real chip):
 - ``int4_pruned``: 25 % of FFN hidden channels pruned (the BASELINE
   prune target — ffn_dim 14336 → 10752), then int4 — the
   prune-then-quantize serving pipeline of examples/04 at 8B scale.
+- ``int8_dense``: the full config at int8 (~8.5 GB — also one-chip
+  servable); int4 vs int8 at identical FLOPs is the fused-unpack
+  kernel's bandwidth claim measured at 8B.
 
 Params are built DIRECTLY at the quantized representation: each float
 leaf is created on device in bf16, quantized, and dropped, so peak
@@ -176,17 +179,21 @@ def run(smoke: bool = False) -> dict:
     out: dict = {
         "platform": jax.devices()[0].platform,
         "device": getattr(jax.devices()[0], "device_kind", ""),
-        "bits": 4,
         "variants": {},
     }
 
-    for tag, ffn in (("int4_dense", None), ("int4_pruned", pruned_ffn)):
+    # int8 (~8.5 GB at 8B) also fits one 16 GB chip — measuring it next
+    # to int4 IS the fused-unpack kernel's bandwidth claim at 8B scale
+    # (int4 reads half the weight bytes per decoded token)
+    for tag, bits, ffn in (("int4_dense", 4, None),
+                           ("int4_pruned", 4, pruned_ffn),
+                           ("int8_dense", 8, None)):
         cfg = dict(dims)
         if ffn is not None:
             cfg["ffn_dim"] = ffn
         model = llama(**cfg)
         t0 = time.perf_counter()
-        params, _state = quantized_random_params(model, bits=4)
+        params, _state = quantized_random_params(model, bits=bits)
         build_s = time.perf_counter() - t0
         wb = weight_bytes(params)
         r = measure_decode(model, params, batch=batch,
@@ -200,6 +207,7 @@ def run(smoke: bool = False) -> dict:
             "implied_GB_s": round(
                 wb / (r["steady_s"] / n_new) / 1e9, 1),
         })
+        r["bits"] = bits
         if ffn is not None:
             r["pruned_ffn_fraction"] = 0.25
         out["variants"][tag] = r
@@ -210,6 +218,10 @@ def run(smoke: bool = False) -> dict:
         out["prune_decode_speedup"] = round(
             d["int4_pruned"]["gen_tokens_per_s"]
             / d["int4_dense"]["gen_tokens_per_s"], 3)
+    if "int4_dense" in d and "int8_dense" in d:
+        out["int4_vs_int8_speedup"] = round(
+            d["int4_dense"]["gen_tokens_per_s"]
+            / d["int8_dense"]["gen_tokens_per_s"], 3)
     return out
 
 
