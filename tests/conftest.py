@@ -108,6 +108,7 @@ SLOW_TESTS = {
     "test_train.py::test_multi_step_matches_sequential_steps",
     "test_torch_import.py::test_vgg16_bn_import_from_saved_checkpoint_file",
     "test_int4_matmul.py::test_int4_matmul_tiles_prefill_row_counts",
+    "test_analysis.py::test_lint_sweep_all_presets_full",
 }
 
 

@@ -1,0 +1,419 @@
+"""tpu-lint tests: golden corruptions of known-good plans (each distinct
+failure mode must fire its exact finding, across MLP / conv / llama
+families), sharding hazards on an abstract mesh, jaxpr hazards, the
+``apply_plan`` pre-flight, the ``shard_params`` warning, the CLI exit
+codes, and the all-presets sweep."""
+
+import dataclasses
+import json
+import logging
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torchpruner_tpu.analysis import (
+    abstract_trees,
+    lint_jaxpr,
+    lint_model_plans,
+    lint_plan,
+    lint_preset,
+    lint_sharding,
+    lint_step,
+    severity_config,
+)
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.graph import group_for
+from torchpruner_tpu.core.plan import (
+    PlanError,
+    apply_plan,
+    plan_from_dict,
+    plan_to_dict,
+)
+from torchpruner_tpu.core.pruner import plan_for_group
+from torchpruner_tpu.core.segment import SegmentedModel, init_model
+from torchpruner_tpu.experiments.presets import preset_names
+from torchpruner_tpu.models import digits_convnet, digits_fc, llama_tiny
+
+
+def checks(findings):
+    return [f.check for f in findings]
+
+
+#: (model ctor, a prunable target with a consumer) per family
+FAMILIES = [
+    (digits_fc, "fc1"),                      # MLP
+    (digits_convnet, "conv1"),               # conv (+BN, flatten fan-out)
+    (llama_tiny, "block1_ffn/gate"),         # llama (GLU + down consumer)
+]
+
+
+@pytest.mark.parametrize("ctor,target", FAMILIES, ids=["mlp", "conv", "llama"])
+def test_known_good_plans_lint_clean(ctor, target):
+    model = ctor()
+    assert lint_model_plans(model) == []
+    # and the specific target's plan too
+    params, state = abstract_trees(model)
+    plan = plan_for_group(model, group_for(model, target))
+    assert lint_plan(plan, params, state) == []
+
+
+def _corrupt(plan, i, **changes):
+    """Replace slice ``i`` of a plan with a mutated copy."""
+    slices = list(plan.slices)
+    slices[i] = dataclasses.replace(slices[i], **changes)
+    return dataclasses.replace(plan, slices=tuple(slices))
+
+
+@pytest.mark.parametrize("ctor,target", FAMILIES, ids=["mlp", "conv", "llama"])
+def test_golden_corruptions_fire_exact_findings(ctor, target):
+    """Each distinct corruption of a known-good plan fires exactly its
+    finding — the golden contract of the plan-lint pass."""
+    model = ctor()
+    params, state = abstract_trees(model)
+    plan = plan_for_group(model, group_for(model, target))
+
+    # bad pytree path
+    bad = _corrupt(plan, 0, path=("definitely", "missing"))
+    assert checks(lint_plan(bad, params, state)) == ["plan/missing-path"]
+
+    # axis out of range
+    bad = _corrupt(plan, 0, axis=9)
+    assert checks(lint_plan(bad, params, state)) == ["plan/axis-out-of-range"]
+
+    # fan_out that does not divide the axis
+    bad = _corrupt(plan, 0, fan_out=7)
+    assert checks(lint_plan(bad, params, state)) == ["plan/fanout-indivisible"]
+
+    # consumer unit count disagreeing with the producer's
+    consumer_i = len(plan.slices) - 1  # consumers are appended last
+    bad = dataclasses.replace(plan, n_units=plan.n_units - 1)
+    got = checks(lint_plan(bad, params, state))
+    assert got and set(got) == {"plan/unit-count-mismatch"}
+    assert consumer_i < len(plan.slices)
+
+    # two slices overlapping on the same (path, axis)
+    bad = dataclasses.replace(
+        plan, slices=plan.slices + (plan.slices[0],)
+    )
+    assert checks(lint_plan(bad, params, state)) == [
+        "plan/overlapping-slices"
+    ]
+
+
+def test_missing_state_collection_is_an_error_only_when_required():
+    model = digits_convnet()
+    params, state = abstract_trees(model)
+    plan = plan_for_group(model, group_for(model, "conv1"))
+    # conv1's group drags BatchNorm running stats along -> state required
+    got = checks(lint_plan(plan, params, None))
+    assert got and set(got) == {"plan/missing-collection"}
+    assert lint_plan(plan, params, state) == []
+
+
+# ---------------------------------------------------------------------------
+# sharding lint
+# ---------------------------------------------------------------------------
+
+
+def test_gqa_breaking_head_prune_is_an_error():
+    """llama_tiny: 4 query heads on 2 KV heads.  Dropping both heads of
+    KV group 1 leaves KV head 1 with zero query heads — head-axis TP
+    sharding would misalign; the analyzer must say so."""
+    model = llama_tiny()
+    fs = lint_sharding(
+        model, {"data": 1, "model": 2}, partition="tp",
+        targets=["block1_attn/attn"],
+        drops={"block1_attn/attn": [2, 3]}, min_size=4,
+    )
+    assert "sharding/gqa-indivisible" in checks(fs)
+    [f] = [x for x in fs if x.check == "sharding/gqa-indivisible"]
+    assert f.severity == "error" and f.path == "block1_attn/attn"
+
+
+def test_even_gqa_head_prune_is_clean():
+    """Dropping one head per KV group keeps the grouping even — no
+    error."""
+    model = llama_tiny()
+    fs = lint_sharding(
+        model, {"data": 1, "model": 2}, partition="tp",
+        targets=["block1_attn/attn"],
+        drops={"block1_attn/attn": [0, 3]}, min_size=4,
+    )
+    assert "sharding/gqa-indivisible" not in checks(fs)
+
+
+def test_replication_fallback_reported_after_prune():
+    """A Dense whose width stops dividing the mesh silently replicates —
+    the analyzer names the arrays."""
+    model = SegmentedModel(
+        layers=(
+            L.Dense("fc1", 128, use_bias=False),
+            L.Activation("act", "relu"),
+            L.Dense("fc2", 4, use_bias=False),
+        ),
+        input_shape=(17,),
+    )
+    fs = lint_sharding(
+        model, {"model": 2}, partition="fsdp", targets=["fc1"],
+        drops={"fc1": [0]}, min_size=4,
+    )
+    found = [f for f in fs if f.check == "sharding/replicated-fallback"]
+    # fc1/w (17, 127): no dim divides 2 any more; fc2/w (127, 4) -> 4 ok
+    assert [f.path for f in found] == ["fc1/w"]
+    assert found[0].severity == "warning"
+    # pre-prune everything was fine
+    clean = lint_sharding(
+        model, {"model": 2}, partition="fsdp", targets=["fc1"],
+        drops={"fc1": []}, min_size=4,
+    )
+    assert "sharding/replicated-fallback" not in checks(clean)
+
+
+def test_hbm_delta_info_present_and_shrinks():
+    model = llama_tiny()
+    fs = lint_sharding(
+        model, {"model": 2}, targets=["block1_ffn/gate"],
+        drops={"block1_ffn/gate": list(range(32))}, min_size=4,
+    )
+    [f] = [x for x in fs if x.check == "sharding/hbm-delta"]
+    assert f.severity == "info" and "-" in f.message  # negative delta
+
+
+# ---------------------------------------------------------------------------
+# jaxpr lint
+# ---------------------------------------------------------------------------
+
+
+def test_clean_bf16_train_step_has_no_findings():
+    import optax
+
+    from torchpruner_tpu.utils.losses import lm_cross_entropy_loss
+
+    fs = lint_step(
+        llama_tiny(), lm_cross_entropy_loss, tx=optax.adam(1e-3),
+        compute_dtype=jnp.bfloat16,
+    )
+    assert fs == []
+
+
+def test_float64_in_trace_is_an_error():
+    import jax.experimental
+
+    with jax.experimental.enable_x64():
+        cj = jax.make_jaxpr(lambda x: x.astype(jnp.float64) * 2.0)(
+            jax.ShapeDtypeStruct((4,), jnp.float32)
+        )
+    assert "jaxpr/float64" in checks(lint_jaxpr(cj))
+
+
+def test_promoted_matmul_under_bf16_policy_is_flagged():
+    # an f32 weight leaks into a program whose policy says bf16
+    mixed = jax.make_jaxpr(lambda x, w: x @ w)(
+        jax.ShapeDtypeStruct((2, 8), jnp.bfloat16),
+        jax.ShapeDtypeStruct((8, 4), jnp.float32),
+    )
+    assert "jaxpr/mixed-precision-matmul" in checks(
+        lint_jaxpr(mixed, compute_dtype=jnp.bfloat16)
+    )
+    # a fully-promoted (all-f32) contraction under a bf16 policy
+    promoted = jax.make_jaxpr(lambda x, w: x @ w)(
+        jax.ShapeDtypeStruct((2, 8), jnp.float32),
+        jax.ShapeDtypeStruct((8, 4), jnp.float32),
+    )
+    assert "jaxpr/promoted-matmul" in checks(
+        lint_jaxpr(promoted, compute_dtype=jnp.bfloat16)
+    )
+    # the same programs under an f32 policy are what was asked for
+    assert lint_jaxpr(mixed, compute_dtype=jnp.float32) == []
+    assert lint_jaxpr(promoted, compute_dtype=jnp.float32) == []
+
+
+def test_quant_dtype_drift_is_flagged():
+    def serve(x, q):
+        return x @ q.astype(jnp.float32)  # dequantize to the WRONG dtype
+
+    cj = jax.make_jaxpr(serve)(
+        jax.ShapeDtypeStruct((2, 8), jnp.bfloat16),
+        jax.ShapeDtypeStruct((8, 4), jnp.int8),
+    )
+    assert "jaxpr/quant-dtype-drift" in checks(
+        lint_jaxpr(cj, compute_dtype=jnp.bfloat16)
+    )
+
+
+def test_closed_over_concrete_array_is_flagged():
+    big = jnp.ones((64, 64))
+    cj = jax.make_jaxpr(lambda x: x @ big)(
+        jax.ShapeDtypeStruct((2, 64), jnp.float32)
+    )
+    assert "jaxpr/const-capture" in checks(lint_jaxpr(cj))
+    # small scalars (eps constants etc.) stay silent
+    small = jnp.float32(1e-5)
+    cj2 = jax.make_jaxpr(lambda x: x + small)(
+        jax.ShapeDtypeStruct((4,), jnp.float32)
+    )
+    assert lint_jaxpr(cj2) == []
+
+
+# ---------------------------------------------------------------------------
+# integration points
+# ---------------------------------------------------------------------------
+
+
+def test_apply_plan_preflight_raises_descriptive_plan_error():
+    model = digits_fc()
+    params, state = init_model(model)
+    plan = plan_for_group(model, group_for(model, "fc1"))
+    bad = _corrupt(plan, 0, path=("fc9", "w"))
+    with pytest.raises(PlanError) as ei:
+        apply_plan(bad, [0], params, state=state)
+    msg = str(ei.value)
+    assert "fc9/w" in msg and "plan/missing-path" in msg
+    assert isinstance(ei.value, ValueError)  # catchable as before
+
+    # bad axis names the axis and the shape
+    bad = _corrupt(plan, 0, axis=6)
+    with pytest.raises(PlanError, match="axis 6"):
+        apply_plan(bad, [0], params, state=state)
+
+    # the good plan still applies
+    p2, s2, _ = apply_plan(plan, [0], params, state=state)
+    assert p2["fc1"]["w"].shape[1] == params["fc1"]["w"].shape[1] - 1
+
+
+def test_shard_params_warns_once_on_replication_fallback(caplog):
+    from torchpruner_tpu.parallel.mesh import make_mesh
+    from torchpruner_tpu.parallel.sharding import shard_params
+
+    mesh = make_mesh({"model": len(jax.devices())})
+    tree = {"w": jnp.ones((33, 513)), "small": jnp.ones((2,))}
+    with caplog.at_level(logging.INFO, logger="torchpruner_tpu"):
+        shard_params(tree, mesh, min_size=4)
+    msgs = [r.message for r in caplog.records]
+    assert any(
+        "sharding/replicated-fallback" in m and "w (33, 513)" in m
+        for m in msgs
+    )
+    assert not any("small" in m for m in msgs)
+
+    # downgradeable through the analyzer's severity config
+    caplog.clear()
+    severity_config.overrides["sharding/replicated-fallback"] = "ignore"
+    try:
+        with caplog.at_level(logging.DEBUG, logger="torchpruner_tpu"):
+            shard_params(tree, mesh, min_size=4)
+        assert not caplog.records
+    finally:
+        severity_config.overrides.pop("sharding/replicated-fallback")
+
+
+def test_severity_override_also_silences_apply_plan_preflight():
+    """One knob for both halves: a check downgraded below error in the
+    severity config must stop the inline pre-flight from raising too."""
+    model = digits_convnet()
+    params, state = init_model(model)
+    plan = plan_for_group(model, group_for(model, "conv1"))
+    with pytest.raises(PlanError):  # state required but not given
+        apply_plan(plan, [0], params, state=None)
+    severity_config.overrides["plan/missing-collection"] = "warning"
+    try:
+        p2, _, _ = apply_plan(plan, [0], params, state=None)
+        assert p2["conv1"]["w"].shape[3] == params["conv1"]["w"].shape[3] - 1
+    finally:
+        severity_config.overrides.pop("plan/missing-collection")
+
+
+def test_lint_config_with_broken_plan_reports_instead_of_crashing():
+    """A mesh config whose plan lint finds errors must still produce a
+    report (the sharding simulation of a broken plan is skipped, not
+    attempted and crashed)."""
+    from torchpruner_tpu.analysis import lint_config
+    from torchpruner_tpu.utils.config import ExperimentConfig
+
+    model = digits_fc()
+    plan = plan_for_group(model, group_for(model, "fc1"))
+    bad = _corrupt(plan, 0, path=("fc9", "w"))
+    cfg = ExperimentConfig(name="broken", model="digits_fc",
+                           mesh={"model": 2})
+    report = lint_config(cfg, model=model, plans=[bad], jaxpr=False)
+    assert not report.ok
+    assert [f.check for f in report.errors] == ["plan/missing-path"]
+    # and no sharding findings: the pass was skipped, not crashed
+    assert not any(f.lint == "sharding" for f in report.findings)
+
+
+def test_cli_lint_plan_without_lint_is_rejected():
+    from torchpruner_tpu.__main__ import main
+
+    with pytest.raises(SystemExit):
+        main(["--preset", "vgg16_digits32_layerwise", "--smoke",
+              "--lint-plan", "whatever.json"])
+
+
+def test_severity_overrides_regrade_report_findings():
+    model = llama_tiny()
+    severity_config.overrides["sharding/gqa-indivisible"] = "warning"
+    try:
+        from torchpruner_tpu.analysis.findings import merge_reports
+
+        fs = lint_sharding(
+            model, {"data": 1, "model": 2}, partition="tp",
+            targets=["block1_attn/attn"],
+            drops={"block1_attn/attn": [2, 3]}, min_size=4,
+        )
+        report = merge_reports("t", fs)
+        assert report.ok  # the error was regraded to warning
+        assert any(
+            f.check == "sharding/gqa-indivisible" for f in report.warnings
+        )
+    finally:
+        severity_config.overrides.pop("sharding/gqa-indivisible")
+
+
+# ---------------------------------------------------------------------------
+# CLI + preset sweep
+# ---------------------------------------------------------------------------
+
+
+def test_cli_lint_clean_preset_exits_zero(capsys):
+    from torchpruner_tpu.__main__ import main
+
+    assert main(["--lint", "vgg16_digits32_layerwise", "--smoke"]) == 0
+    out = capsys.readouterr().out
+    assert "tpu-lint" in out and "0 error(s)" in out
+
+
+def test_cli_lint_corrupted_plan_exits_nonzero(tmp_path, capsys):
+    from torchpruner_tpu.__main__ import main
+
+    model = digits_fc()
+    plan = plan_for_group(model, group_for(model, "fc1"))
+    d = plan_to_dict(_corrupt(plan, 0, path=("fc9", "w")))
+    path = tmp_path / "bad_plan.json"
+    path.write_text(json.dumps(d))
+    assert main([
+        "--lint", "vgg16_digits32_layerwise", "--smoke",
+        "--lint-plan", str(path),
+    ]) == 1
+    out = capsys.readouterr().out
+    assert "plan/missing-path" in out and "fc9/w" in out
+    # round-trip sanity: the uncorrupted plan comes back equal
+    assert plan_from_dict(plan_to_dict(plan)) == plan
+
+
+def test_lint_sweep_all_presets_smoke():
+    """Every shipped preset (smoke variants) must lint with zero
+    error-severity findings — the CI gate of the analyzer."""
+    for name in preset_names():
+        report = lint_preset(name, smoke=True)
+        assert report.ok, f"{name}: {report.format()}"
+
+
+def test_lint_sweep_all_presets_full():
+    """Full-size presets (8B llama on its 64-chip mesh included) lint
+    clean too — entirely abstract, no devices (slow lane)."""
+    for name in preset_names():
+        report = lint_preset(name, smoke=False)
+        assert report.ok, f"{name}: {report.format()}"

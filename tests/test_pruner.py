@@ -271,10 +271,12 @@ def test_pruner_class_wrapper():
 
 
 def test_bad_plan_path_raises():
-    from torchpruner_tpu.core.plan import Consumer, PruneGroup
+    from torchpruner_tpu.core.plan import Consumer, PlanError, PruneGroup
 
     m = small_mlp()
     p, _ = init_model(m)
     bad = PruneGroup(target="fc1", consumers=(Consumer(layer="nope"),))
-    with pytest.raises(KeyError):
+    # the analyzer pre-flight names the offending path instead of letting
+    # an anonymous KeyError surface from the slicing loop
+    with pytest.raises(PlanError, match="nope/w"):
         prune(m, p, bad, [0])

@@ -18,7 +18,9 @@ import jax
 import jax.numpy as jnp
 import optax
 import pytest
-from jax.sharding import AbstractMesh, NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from torchpruner_tpu.analysis import abstract_mesh
 
 from torchpruner_tpu.core.segment import init_model
 from torchpruner_tpu.models import llama3_8b
@@ -29,9 +31,31 @@ from torchpruner_tpu.parallel.sharding import (
 )
 from torchpruner_tpu.utils.losses import lm_cross_entropy_loss
 
-MESH = AbstractMesh((8, 8), ("data", "model"))
+MESH = abstract_mesh({"data": 8, "model": 8})
 #: any tensor at least this big left fully replicated is a sharding bug
 LARGE = 2**22  # 4M elements = 16 MB f32 per chip if replicated
+
+
+def _abstract_lowering_supported() -> bool:
+    """Whether this jax can AOT-lower a program whose sharded inputs
+    live on an AbstractMesh (0.4.x raises on ``_device_assignment``
+    whenever the lowering needs a device order, e.g. any reduction over
+    a sharded operand)."""
+    try:
+        m = abstract_mesh({"x": 2})
+        s = jax.ShapeDtypeStruct(
+            (4,), jnp.float32, sharding=NamedSharding(m, P("x"))
+        )
+        jax.jit(jnp.sum).trace(s).lower(lowering_platforms=("tpu",))
+        return True
+    except (ValueError, TypeError):  # pragma: no cover - older jax
+        return False
+
+
+needs_abstract_lowering = pytest.mark.skipif(
+    not _abstract_lowering_supported(),
+    reason="AbstractMesh AOT lowering unsupported by this jax",
+)
 
 
 def _shapes():
@@ -133,6 +157,7 @@ def _abstract_sharded_inputs(params, opt_shapes, p_sh, mesh):
 
 
 @pytest.mark.parametrize("partition", ["fsdp", "tp"])
+@needs_abstract_lowering
 def test_llama3_8b_train_step_lowers_on_abstract_pod_mesh(partition):
     """Trace + lower the full sharded train step (fwd, bwd, adam update)
     at 8B scale on the abstract {data: 8, model: 8} mesh — proves the
@@ -172,18 +197,21 @@ def test_llama3_8b_train_step_lowers_on_abstract_pod_mesh(partition):
     assert "sdy.sharding" in hlo or "mhlo.sharding" in hlo or "sharding" in hlo
 
 
+@needs_abstract_lowering
 def test_llama3_8b_sp_step_lowers_at_128k_context():
     """Long-context north star: the sequence-parallel train step (ring
     attention, RoPE at global offsets, psum'd masked loss/grads) traces
     and lowers for TPU at 8B scale and S = 131072 over an abstract
     {data: 4, seq: 16} pod mesh — each shard holds 8192 positions, and no
     (S, S) score tensor exists anywhere in the program."""
-    from jax import lax, shard_map
+    from jax import lax
+
+    from torchpruner_tpu.parallel.mesh import relaxed_shard_map
 
     from torchpruner_tpu.parallel.sp import sp_model
     from torchpruner_tpu.utils.dtypes import cast_floats
 
-    mesh = AbstractMesh((4, 16), ("data", "seq"))
+    mesh = abstract_mesh({"data": 4, "seq": 16})
     S = 131072
     model = sp_model(llama3_8b(seq_len=S), "ring")
     params, state = jax.eval_shape(
@@ -207,11 +235,10 @@ def test_llama3_8b_sp_step_lowers_at_128k_context():
 
     repl = P()
     bseq = P("data", "seq")
-    mapped = shard_map(
-        local_step, mesh=mesh,
+    mapped = relaxed_shard_map(
+        local_step, mesh,
         in_specs=(repl, bseq, bseq, bseq),
         out_specs=(repl, repl),
-        check_vma=False,
     )
     B = 4
     x_s = jax.ShapeDtypeStruct(
@@ -268,6 +295,7 @@ def test_llama3_8b_training_memory_budget_fits_v5p():
     assert b1.largest_replicated[1] > 1 * gib  # the embedding
 
 
+@needs_abstract_lowering
 def test_llama3_8b_pp_spmd_step_lowers_on_abstract_pod_mesh():
     """The collective-based pipeline step (parallel/pp_spmd.py) traces
     and lowers for TPU at 8B scale on an abstract {pp: 8, data: 8}
@@ -276,7 +304,7 @@ def test_llama3_8b_pp_spmd_step_lowers_on_abstract_pod_mesh():
     pod."""
     from torchpruner_tpu.parallel.pp_spmd import pp_spmd_train_step
 
-    mesh = AbstractMesh((8, 8), ("pp", "data"))
+    mesh = abstract_mesh({"pp": 8, "data": 8})
     model, params, _ = _shapes()
     tx = optax.adam(1e-4)
     opt_shapes = jax.eval_shape(tx.init, params)
@@ -296,6 +324,7 @@ def test_llama3_8b_pp_spmd_step_lowers_on_abstract_pod_mesh():
     assert "sharding" in lowered.as_text()
 
 
+@needs_abstract_lowering
 def test_llama3_8b_distributed_taylor_scoring_lowers():
     """The scoring third of the north-star loop (attribution -> prune ->
     retrain on pods): Taylor per-example rows at the BASELINE FFN prune
@@ -329,6 +358,7 @@ def test_llama3_8b_distributed_taylor_scoring_lowers():
     assert "sharding" in lowered.as_text()
 
 
+@needs_abstract_lowering
 def test_llama3_8b_distributed_shapley_rows_lower():
     """Shapley rows (the scan-over-units marginal chain x vmap over
     permutations) trace and lower at 8B on the abstract pod mesh with

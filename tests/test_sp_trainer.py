@@ -133,7 +133,7 @@ def test_sp_attention_rejects_taps():
 
     params, state = init_model(llama_tiny(), seed=0)
     mesh = make_mesh({"seq": 4}, devices=jax.devices()[:4])
-    from jax import shard_map
+    from torchpruner_tpu.parallel.mesh import relaxed_shard_map
     from jax.sharding import PartitionSpec as P
 
     def run(x):
@@ -142,7 +142,7 @@ def test_sp_attention_rejects_taps():
             unit_mask=("block1_attn/attn", np.ones((4,), np.float32)),
         )[0]
 
-    fn = shard_map(run, mesh=mesh, in_specs=(P(None, "seq"),),
-                   out_specs=P(None, "seq"), check_vma=False)
+    fn = relaxed_shard_map(run, mesh, in_specs=(P(None, "seq"),),
+                           out_specs=P(None, "seq"))
     with pytest.raises(NotImplementedError, match="taps"):
         fn(toks())

@@ -26,7 +26,12 @@ from torchpruner_tpu.core.graph import (
     find_best_evaluation_layer,
     nan_cascade_oracle,
 )
-from torchpruner_tpu.core.plan import PruneGroup, Consumer, PrunePlan
+from torchpruner_tpu.core.plan import (
+    Consumer,
+    PlanError,
+    PruneGroup,
+    PrunePlan,
+)
 from torchpruner_tpu.core.masking import (
     apply_masks,
     drop_masks,
@@ -76,6 +81,7 @@ __all__ = [
     "PruneGroup",
     "Consumer",
     "PrunePlan",
+    "PlanError",
     "prune",
     "prune_by_scores",
     "bucket_drop",

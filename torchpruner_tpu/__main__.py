@@ -9,6 +9,8 @@ Examples::
     python -m torchpruner_tpu --preset llama3_ffn_taylor --smoke
     python -m torchpruner_tpu --config my_experiment.json
     python -m torchpruner_tpu --list
+    python -m torchpruner_tpu --lint llama3_ffn_taylor
+    python -m torchpruner_tpu --lint my_experiment.json --lint-plan plan.json
 """
 
 from __future__ import annotations
@@ -36,6 +38,21 @@ def main(argv=None) -> int:
         "--list", action="store_true", help="list presets and exit"
     )
     p.add_argument(
+        "--lint", metavar="PRESET_OR_JSON", nargs="?", const="",
+        default=None,
+        help="run the tpu-lint static analyzer (plan / sharding / jaxpr "
+             "passes, CPU-only abstract evaluation) over a preset name or "
+             "config JSON path — or over --preset/--config when given "
+             "bare — print the findings report, and exit nonzero on "
+             "error-severity findings",
+    )
+    p.add_argument(
+        "--lint-plan", metavar="PATH",
+        help="with --lint: validate this JSON-serialized PrunePlan "
+             "against the config's model instead of the graph-derived "
+             "groups (see core.plan.plan_to_dict for the schema)",
+    )
+    p.add_argument(
         "--no-compilation-cache", action="store_true",
         help="disable the persistent XLA compilation cache",
     )
@@ -49,6 +66,9 @@ def main(argv=None) -> int:
         help="write the resolved config JSON to PATH and exit",
     )
     args = p.parse_args(argv)
+
+    if args.lint_plan and args.lint is None:
+        p.error("--lint-plan only makes sense together with --lint")
 
     if args.list:
         from torchpruner_tpu.experiments.presets import PRESETS
@@ -71,14 +91,38 @@ def main(argv=None) -> int:
 
     from torchpruner_tpu.utils.config import ExperimentConfig
 
-    if args.config:
+    if args.lint is not None and args.lint:
+        # --lint <preset-name-or-config-path> names its own target
+        if args.lint.endswith(".json"):
+            cfg = ExperimentConfig.from_json(args.lint)
+        else:
+            from torchpruner_tpu.experiments.presets import get_preset
+
+            cfg = get_preset(args.lint, smoke=args.smoke)
+    elif args.config:
         cfg = ExperimentConfig.from_json(args.config)
     elif args.preset:
         from torchpruner_tpu.experiments.presets import get_preset
 
         cfg = get_preset(args.preset, smoke=args.smoke)
     else:
-        p.error("one of --preset / --config / --list is required")
+        p.error(
+            "one of --preset / --config / --list / --lint PRESET is "
+            "required"
+        )
+
+    if args.lint is not None:
+        from torchpruner_tpu.analysis import lint_config
+
+        plans = None
+        if args.lint_plan:
+            from torchpruner_tpu.core.plan import plan_from_dict
+
+            with open(args.lint_plan) as f:
+                plans = [plan_from_dict(json.load(f))]
+        report = lint_config(cfg, plans=plans)
+        print(report.format())
+        return 0 if report.ok else 1
 
     if args.dump_config:
         cfg.to_json(args.dump_config)

@@ -1,0 +1,66 @@
+"""tpu-lint — static analysis for prune plans, sharding specs, and jaxpr
+hazards.
+
+Design note: every pass is abstract-eval-only, by construction
+=================================================================
+
+The failure modes this analyzer hunts share one property: they are all
+**decidable from shapes and dtypes alone**, yet in practice they surface
+minutes into an expensive pjit run on real chips — a pruned FFN width
+that stops dividing the mesh silently replicates 14 GiB of weights onto
+every device; a plan whose fan-out is off by the flatten factor raises a
+shape error out of ``jnp.take`` with no mention of which slice was
+wrong; a weak-typed scalar quietly promotes a bf16 matmul to f32 and
+halves MXU throughput.  JAX's abstract interpretation machinery exposes
+exactly the information needed to catch all of them up front:
+
+- ``jax.eval_shape`` runs ``model.init`` and ``apply_plan`` over
+  ``ShapeDtypeStruct`` trees — the REAL init and the REAL surgery code
+  paths, so the shapes the lint validates are the shapes production will
+  see, at zero FLOPs and zero bytes of parameters;
+- ``jax.sharding.AbstractMesh`` stands in for a device mesh, so the
+  production sharding rules (``fsdp_sharding`` / ``tp_sharding``) assign
+  the same PartitionSpecs they would on a 64-chip slice — on a laptop;
+- ``jax.make_jaxpr`` over abstract arguments yields the exact program
+  XLA would compile — every operand dtype, every closed-over constant —
+  without a device ever initializing.
+
+Because no pass touches an accelerator, the whole analyzer runs in CI on
+CPU in seconds (``python -m torchpruner_tpu --lint <preset>``), as a
+pre-flight inside ``apply_plan`` (raising
+:class:`~torchpruner_tpu.core.plan.PlanError` on error findings), and as
+a library (:func:`lint_config` / :func:`lint_preset`).  Findings are
+structured :class:`Finding` records with an error/warning/info split;
+per-check severities are re-gradeable through :data:`severity_config`.
+"""
+
+from torchpruner_tpu.analysis.findings import (
+    Finding,
+    LintReport,
+    SeverityConfig,
+    active_severity,
+    merge_reports,
+    severity_config,
+)
+from torchpruner_tpu.analysis.jaxpr_lint import lint_jaxpr, lint_step, trace_step
+from torchpruner_tpu.analysis.plan_lint import (
+    abstract_trees,
+    lint_group,
+    lint_model_plans,
+    lint_plan,
+)
+from torchpruner_tpu.analysis.sharding_lint import (
+    abstract_mesh,
+    lint_sharding,
+    simulate_prune,
+)
+from torchpruner_tpu.analysis.runner import lint_config, lint_preset
+
+__all__ = [
+    "Finding", "LintReport", "SeverityConfig", "severity_config",
+    "active_severity", "merge_reports",
+    "lint_plan", "lint_group", "lint_model_plans", "abstract_trees",
+    "lint_sharding", "simulate_prune", "abstract_mesh",
+    "lint_jaxpr", "lint_step", "trace_step",
+    "lint_config", "lint_preset",
+]

@@ -1,0 +1,241 @@
+"""Pass 3 — jaxpr hazard lint: trace the train/eval step abstractly and
+flag the dtype/retrace hazards that only surface on hardware.
+
+``jax.make_jaxpr`` over ``ShapeDtypeStruct`` arguments gives the exact
+program XLA would compile — operand dtypes, constants, sub-jaxprs —
+without touching a device or materializing an array.  Hazards:
+
+- ``jaxpr/float64`` (error): a float64 value anywhere in the trace.  TPUs
+  have no f64 ALU path (XLA emulates at >10x cost) — an accidentally
+  enabled ``jax_enable_x64`` or a stray np.float64 constant poisons every
+  downstream op;
+- ``jaxpr/mixed-precision-matmul`` (warning): a matmul/conv with one
+  bf16 and one f32 float operand — promotion runs the contraction at f32
+  rate, silently forfeiting the MXU bf16 fast path the compute_dtype
+  asked for (weak-typed Python scalars are the classic source);
+- ``jaxpr/quant-dtype-drift`` (warning): an int8/int4 quantized weight
+  dequantized to a dtype other than the activation compute dtype — the
+  convert then cannot fuse into the dot's operand read and a full-width
+  float copy of the weight materializes per step (the exact failure mode
+  ops/quant.py's formulation exists to avoid);
+- ``jaxpr/const-capture`` (warning): a concrete array closed over by the
+  traced function (a jaxpr constvar) above a size threshold — it is baked
+  into the compiled program, so every new value forces a retrace and a
+  recompile (pass it as an argument instead).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Set, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from torchpruner_tpu.analysis.findings import Finding
+
+PASS = "jaxpr"
+
+#: contraction primitives whose operand dtypes must agree for MXU rate
+_MATMUL_PRIMS = {"dot_general", "conv_general_dilated"}
+
+#: constvars above this many bytes are flagged as retrace bait
+CONST_BYTES_THRESHOLD = 2 ** 12
+
+
+def _sub_jaxprs(eqn):
+    for v in eqn.params.values():
+        if isinstance(v, jax.core.ClosedJaxpr):
+            yield v.jaxpr
+        elif isinstance(v, jax.core.Jaxpr):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for x in v:
+                if isinstance(x, jax.core.ClosedJaxpr):
+                    yield x.jaxpr
+                elif isinstance(x, jax.core.Jaxpr):
+                    yield x
+
+
+def _walk_eqns(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn):
+            yield from _walk_eqns(sub)
+
+
+def _aval(v):
+    return getattr(v, "aval", None)
+
+
+def lint_jaxpr(
+    closed: jax.core.ClosedJaxpr,
+    *,
+    compute_dtype=None,
+    const_bytes_threshold: int = CONST_BYTES_THRESHOLD,
+    site: str = "<traced fn>",
+) -> List[Finding]:
+    """Findings for one traced program.  ``compute_dtype`` is the dtype
+    the forward/backward is SUPPOSED to run in (quant-drift is judged
+    against it); None skips that check."""
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, str]] = set()
+
+    def once(check: str, key: str, severity: str, message: str):
+        if (check, key) not in seen:
+            seen.add((check, key))
+            findings.append(Finding(severity, PASS, check, site, message))
+
+    for c in closed.consts:
+        shape = np.shape(c)
+        nbytes = getattr(c, "nbytes", None)
+        if nbytes is None:
+            nbytes = int(np.prod(shape or (1,))) * np.dtype(
+                getattr(c, "dtype", np.float32)
+            ).itemsize
+        if nbytes >= const_bytes_threshold:
+            once(
+                "jaxpr/const-capture", str(shape), "warning",
+                f"closed-over concrete array {shape} "
+                f"({getattr(c, 'dtype', '?')}, {nbytes} bytes) is baked "
+                f"into the compiled program — a new value forces a full "
+                f"retrace/recompile; pass it as an argument instead",
+            )
+
+    for eqn in _walk_eqns(closed.jaxpr):
+        prim = eqn.primitive.name
+        out_avals = [a for a in map(_aval, eqn.outvars) if a is not None]
+        in_avals = [a for a in map(_aval, eqn.invars) if a is not None]
+
+        for a in out_avals:
+            if getattr(a, "dtype", None) == jnp.float64:
+                once(
+                    "jaxpr/float64", prim, "error",
+                    f"{prim} produces float64 {tuple(a.shape)} — TPUs "
+                    f"have no f64 fast path (check jax_enable_x64 and "
+                    f"np.float64 constants)",
+                )
+
+        bf16_policy = (
+            compute_dtype is not None
+            and jnp.dtype(compute_dtype) == jnp.dtype(jnp.bfloat16)
+        )
+        if prim in _MATMUL_PRIMS and len(in_avals) >= 2 and bf16_policy:
+            # a correct bf16 mixed-precision step's every contraction is
+            # pure bf16 (fwd casts params/inputs, bwd transposes through
+            # the casts) — anything else forfeits the MXU bf16 rate
+            fdts = {
+                jnp.dtype(a.dtype) for a in in_avals
+                if jnp.issubdtype(getattr(a, "dtype", jnp.int32),
+                                  jnp.floating)
+            }
+            shapes = [tuple(a.shape) for a in in_avals]
+            bf16, f32 = jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float32)
+            if bf16 in fdts and f32 in fdts:
+                once(
+                    "jaxpr/mixed-precision-matmul", f"{prim}:{shapes}",
+                    "warning",
+                    f"{prim} mixes bfloat16 and float32 operands "
+                    f"{shapes} — the contraction promotes to f32 and "
+                    f"forfeits the MXU bf16 rate (weak-typed scalar or "
+                    f"missing cast?)",
+                )
+            elif fdts == {f32}:
+                once(
+                    "jaxpr/promoted-matmul", f"{prim}:{shapes}",
+                    "warning",
+                    f"{prim} over {shapes} runs in float32 although the "
+                    f"compute dtype is bfloat16 — a weak-typed scalar or "
+                    f"stray f32 operand promoted the contraction off the "
+                    f"MXU bf16 fast path",
+                )
+
+        if (
+            prim == "convert_element_type"
+            and compute_dtype is not None
+            and in_avals
+            and getattr(in_avals[0], "dtype", None) == jnp.int8
+        ):
+            new_dtype = eqn.params.get("new_dtype")
+            if (
+                new_dtype is not None
+                and jnp.issubdtype(new_dtype, jnp.floating)
+                and new_dtype != jnp.dtype(compute_dtype)
+            ):
+                once(
+                    "jaxpr/quant-dtype-drift",
+                    f"{in_avals[0].dtype}->{new_dtype}", "warning",
+                    f"int8 quantized weight dequantizes to "
+                    f"{jnp.dtype(new_dtype).name} while activations "
+                    f"compute in {jnp.dtype(compute_dtype).name} — the "
+                    f"convert cannot fuse into the dot and a full float "
+                    f"weight copy materializes every step",
+                )
+    return findings
+
+
+def trace_step(
+    model,
+    loss_fn,
+    *,
+    tx=None,
+    train: bool = True,
+    compute_dtype=None,
+    remat: bool = False,
+    batch: int = 2,
+    lm: Optional[bool] = None,
+) -> jax.core.ClosedJaxpr:
+    """The train (or eval) step of ``model`` as a ClosedJaxpr, traced
+    over abstract params/state/opt-state and an abstract example batch —
+    pure CPU shape work, identical dtypes to the real step.
+
+    ``lm`` selects the target shape: token targets = inputs (language
+    modeling) vs per-example int class labels; default infers LM from an
+    int input dtype (token classifiers like BERT pass ``lm=False``)."""
+    from torchpruner_tpu.analysis.plan_lint import abstract_trees
+    from torchpruner_tpu.train.loop import make_loss_closure, make_step_body
+
+    params, state = abstract_trees(model)
+    x = jax.eval_shape(lambda: model.example_input(batch=batch))
+    if lm is None:
+        lm = model.input_dtype.startswith("int")
+    if lm:
+        y = x  # LM targets are the inputs (next-token loss shifts inside)
+    else:
+        y = jax.ShapeDtypeStruct((batch,), jnp.int32)
+    rng = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+
+    loss_c = make_loss_closure(model, loss_fn, compute_dtype, remat)
+    if train and tx is not None:
+        opt_state = jax.eval_shape(tx.init, params)
+        body = make_step_body(loss_c, tx)
+        return jax.make_jaxpr(body)(params, state, opt_state, x, y, rng)
+    if train:
+        grad_fn = jax.value_and_grad(loss_c, has_aux=True)
+        return jax.make_jaxpr(grad_fn)(params, state, x, y, rng)
+    return jax.make_jaxpr(loss_c)(params, state, x, y, rng)
+
+
+def lint_step(
+    model,
+    loss_fn,
+    *,
+    tx=None,
+    train: bool = True,
+    compute_dtype=None,
+    remat: bool = False,
+    batch: int = 2,
+    lm: Optional[bool] = None,
+) -> List[Finding]:
+    """Trace + lint in one call (the runner's entry point)."""
+    closed = trace_step(
+        model, loss_fn, tx=tx, train=train, compute_dtype=compute_dtype,
+        remat=remat, batch=batch, lm=lm,
+    )
+    dt = None
+    if compute_dtype is not None:
+        dt = compute_dtype
+    return lint_jaxpr(
+        closed, compute_dtype=dt,
+        site="train step" if train else "eval step",
+    )

@@ -1,0 +1,190 @@
+"""Pass 1 — plan lint: validate PruneGroups/PrunePlans against the real
+pytree shapes, entirely abstractly.
+
+The param/state trees a plan is checked against come from
+``jax.eval_shape`` over ``model.init`` — no parameter is ever
+materialized, so linting an 8B-param model costs milliseconds of shape
+arithmetic.  Checks, per slice:
+
+- the pytree path resolves in the named collection (missing optional
+  slices — a bias under ``use_bias=False`` — are legitimate and skipped);
+- the axis is in range for the resolved array's rank;
+- ``fan_out`` divides the axis length (the channels-last flatten map is
+  only meaningful on an exact multiple);
+- the surviving-unit count implied by the axis (``shape[axis] / fan_out``)
+  equals the plan's ``n_units`` — the single check that keeps a
+  producer's out-slices, its attached-norm slices, and its consumers'
+  in-slices all agreeing on how many units exist;
+- no two slices claim the same ``(collection, path, axis)`` — overlapping
+  slices would double-take and silently mis-shape.
+
+Group-level lint additionally resolves the group's layer names against
+the model spec (unknown layer / unprunable target) before the plan is
+even built.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Set, Tuple
+
+import jax
+
+from torchpruner_tpu.analysis.findings import Finding
+from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.core.plan import Path, PruneGroup, PrunePlan
+
+PASS = "plan"
+
+
+def path_str(path: Path) -> str:
+    return "/".join(str(k) for k in path)
+
+
+def abstract_trees(model) -> Tuple[Any, Any]:
+    """``(params, state)`` as ShapeDtypeStruct pytrees — the shapes a plan
+    is validated against, without materializing a single parameter."""
+    from torchpruner_tpu.core.segment import init_model
+
+    return jax.eval_shape(
+        lambda _k: init_model(model, seed=0), jax.random.PRNGKey(0)
+    )
+
+
+def _resolve(tree, path: Path):
+    node = tree
+    for k in path:
+        node = node[k]
+    return node
+
+
+def lint_plan(plan: PrunePlan, params, state=None) -> List[Finding]:
+    """Findings for one resolved plan against params/state trees (concrete
+    arrays or ShapeDtypeStructs — only ``.shape`` is read)."""
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, Tuple[str, ...], int]] = set()
+    if plan.n_units <= 0:
+        findings.append(Finding(
+            "error", PASS, "plan/empty-plan", "<plan>",
+            f"plan has n_units={plan.n_units}; nothing can be pruned",
+        ))
+        return findings
+
+    for s in plan.slices:
+        p = path_str(s.path)
+        tree = params if s.collection == "params" else state
+        if tree is None:
+            if not s.optional:
+                findings.append(Finding(
+                    "error", PASS, "plan/missing-collection", p,
+                    f"slice targets collection {s.collection!r}, but no "
+                    f"such tree was provided",
+                ))
+            continue
+        try:
+            arr = _resolve(tree, s.path)
+            shape = tuple(arr.shape)
+        except (KeyError, IndexError, TypeError, AttributeError):
+            if not s.optional:
+                findings.append(Finding(
+                    "error", PASS, "plan/missing-path", p,
+                    f"path does not resolve in the {s.collection} tree",
+                ))
+            continue
+        if not 0 <= s.axis < len(shape):
+            findings.append(Finding(
+                "error", PASS, "plan/axis-out-of-range", p,
+                f"axis {s.axis} out of range for shape {shape}",
+            ))
+            continue
+        key = (s.collection, tuple(str(k) for k in s.path), s.axis)
+        if key in seen:
+            findings.append(Finding(
+                "error", PASS, "plan/overlapping-slices", p,
+                f"two slices claim axis {s.axis} of the same array — "
+                f"they would double-slice",
+            ))
+            continue
+        seen.add(key)
+        if s.fan_out <= 0 or shape[s.axis] % s.fan_out:
+            findings.append(Finding(
+                "error", PASS, "plan/fanout-indivisible", p,
+                f"fan_out {s.fan_out} does not divide axis {s.axis} of "
+                f"length {shape[s.axis]}",
+            ))
+            continue
+        implied = shape[s.axis] // s.fan_out
+        if implied != plan.n_units:
+            findings.append(Finding(
+                "error", PASS, "plan/unit-count-mismatch", p,
+                f"axis {s.axis} of length {shape[s.axis]} / fan_out "
+                f"{s.fan_out} implies {implied} units, but the plan "
+                f"prunes a {plan.n_units}-unit producer",
+            ))
+    return findings
+
+
+def lint_group(
+    model, group: PruneGroup, params=None, state=None
+) -> List[Finding]:
+    """Resolve one group's layer names against the model, then lint the
+    plan it implies.  ``params``/``state`` default to abstract trees."""
+    from torchpruner_tpu.core.pruner import plan_for_group
+
+    findings: List[Finding] = []
+    names = [("target", group.target)]
+    names += [("attached norm", bn.layer) for bn in group.attached_bn]
+    names += [("attached dropout", d) for d in group.attached_dropout]
+    names += [("consumer", c.layer) for c in group.consumers]
+    resolvable = True
+    for role, name in names:
+        try:
+            model.layer(name)
+        except KeyError:
+            findings.append(Finding(
+                "error", PASS, "plan/unknown-layer", name,
+                f"group {role} names a layer the model does not have",
+            ))
+            resolvable = False
+    if resolvable:
+        try:
+            L.n_units(model.layer(group.target))
+        except TypeError:
+            findings.append(Finding(
+                "error", PASS, "plan/not-prunable", group.target,
+                f"group target is a "
+                f"{type(model.layer(group.target)).__name__}, which has "
+                f"no prunable units",
+            ))
+            resolvable = False
+    if not resolvable:
+        return findings
+
+    if params is None:
+        params, state = abstract_trees(model)
+    try:
+        plan = plan_for_group(model, group)
+    except (TypeError, KeyError) as e:
+        findings.append(Finding(
+            "error", PASS, "plan/unresolvable-group", group.target,
+            f"group does not resolve to a plan: {e}",
+        ))
+        return findings
+    return lint_plan(plan, params, state)
+
+
+def lint_model_plans(
+    model, targets: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    """Lint every prune group the static graph derives for ``model``
+    (``targets`` restricts to those layer paths) — the per-model half of
+    the preset sweep."""
+    from torchpruner_tpu.core.graph import pruning_graph
+
+    params, state = abstract_trees(model)
+    findings: List[Finding] = []
+    wanted = set(targets) if targets is not None else None
+    for g in pruning_graph(model, include_output=True):
+        if wanted is not None and g.target not in wanted:
+            continue
+        findings += lint_group(model, g, params, state)
+    return findings

@@ -1,0 +1,115 @@
+"""The tpu-lint driver: run every pass over one experiment config.
+
+``lint_config`` resolves the config's model from the experiment registry
+and runs
+
+1. **plan lint** over every prune group the static graph derives
+   (analysis/plan_lint.py),
+2. **sharding lint** for configs with a mesh — the config's own
+   mesh/partition/fraction/bucket, simulated over the config's filtered
+   targets (analysis/sharding_lint.py),
+3. **jaxpr hazard lint** on the config's train step — its real
+   loss/optimizer/compute_dtype/remat (analysis/jaxpr_lint.py),
+
+merges the findings under the active severity config, and returns a
+:class:`~torchpruner_tpu.analysis.findings.LintReport`.  Everything is
+abstract evaluation: an 8B-param mesh preset lints on a laptop CPU in
+seconds, with zero bytes of parameters materialized.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from torchpruner_tpu.analysis.findings import LintReport, merge_reports
+from torchpruner_tpu.analysis.jaxpr_lint import lint_step
+from torchpruner_tpu.analysis.plan_lint import (
+    abstract_trees,
+    lint_model_plans,
+    lint_plan,
+)
+from torchpruner_tpu.analysis.sharding_lint import lint_sharding
+from torchpruner_tpu.utils.config import ExperimentConfig
+
+
+def lint_config(
+    cfg: ExperimentConfig,
+    *,
+    model=None,
+    plans=None,
+    jaxpr: bool = True,
+) -> LintReport:
+    """Full tpu-lint run for one config.
+
+    ``model`` may be injected (tests / custom zoos); ``plans`` (explicit
+    :class:`~torchpruner_tpu.core.plan.PrunePlan` objects) are linted
+    INSTEAD of the graph-derived groups when given — the entry point for
+    validating hand-written or deserialized plans.  The sharding pass
+    simulates the CONFIG's sweep (its targets/fraction/bucket), so it is
+    skipped when explicit ``plans`` are given (its findings would
+    describe a different prune) and when the plan pass already found
+    errors (a broken plan cannot be meaningfully simulated).
+    ``jaxpr=False`` skips the (most expensive) trace pass.
+    """
+    from torchpruner_tpu.experiments.prune_retrain import (
+        LOSS_REGISTRY,
+        MODEL_REGISTRY,
+        filter_targets,
+        make_optimizer,
+    )
+    from torchpruner_tpu.core.graph import pruning_graph
+
+    if model is None:
+        model_fn, _ = MODEL_REGISTRY[cfg.model]
+        model = model_fn()
+
+    findings: list = []
+
+    # -- pass 1: plan lint ------------------------------------------------
+    if plans is not None:
+        params, state = abstract_trees(model)
+        for plan in plans:
+            findings += lint_plan(plan, params, state)
+    else:
+        findings += lint_model_plans(model)
+
+    # -- pass 2: sharding lint (mesh configs only; see docstring for the
+    # two skip conditions) ------------------------------------------------
+    plan_errors = any(f.severity == "error" for f in findings)
+    if cfg.mesh and plans is None and not plan_errors:
+        targets = filter_targets(
+            [g.target for g in pruning_graph(model)], cfg
+        )
+        fraction = cfg.fraction if cfg.policy == "fraction" else 0.5
+        data = cfg.mesh.get("data", 1)
+        cdtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else None
+        findings += lint_sharding(
+            model, dict(cfg.mesh), partition=cfg.partition,
+            targets=targets, fraction=fraction, bucket=cfg.bucket,
+            tx=make_optimizer(cfg),
+            batch_per_chip=max(1, cfg.batch_size // max(1, data)),
+            compute_dtype=cdtype, remat=cfg.remat,
+        )
+
+    # -- pass 3: jaxpr hazards --------------------------------------------
+    if jaxpr:
+        loss_fn = LOSS_REGISTRY[cfg.loss]
+        cdtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else None
+        train = bool(
+            cfg.finetune_epochs or cfg.epochs
+            or cfg.experiment in ("train", "train_robustness")
+        )
+        findings += lint_step(
+            model, loss_fn, tx=make_optimizer(cfg) if train else None,
+            train=train, compute_dtype=cdtype, remat=cfg.remat,
+            lm=cfg.loss == "lm_cross_entropy",
+        )
+
+    return merge_reports(cfg.name, findings)
+
+
+def lint_preset(name: str, smoke: bool = False, **kw) -> LintReport:
+    """``lint_config`` over a named preset."""
+    from torchpruner_tpu.experiments.presets import get_preset
+
+    return lint_config(get_preset(name, smoke=smoke), **kw)

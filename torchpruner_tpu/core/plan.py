@@ -29,6 +29,24 @@ import jax.numpy as jnp
 Path = Tuple[Any, ...]  # keys into a nested-dict pytree
 
 
+class PlanError(ValueError):
+    """A plan does not fit the pytrees it is being applied to.
+
+    Raised by :func:`apply_plan`'s pre-flight — the plan-lint pass of the
+    static analyzer (analysis/plan_lint.py) run over the actual trees —
+    so the message names the offending pytree path, axis and check
+    (instead of whatever ``jnp.take``/``KeyError`` would surface deep in
+    the slicing loop).  ``findings`` carries the structured records.
+    """
+
+    def __init__(self, findings):
+        self.findings = tuple(findings)
+        super().__init__(
+            "plan does not fit the provided pytrees:\n"
+            + "\n".join("  " + f.format() for f in self.findings)
+        )
+
+
 @dataclass(frozen=True)
 class ParamSlice:
     """Slice one array along ``axis``, keeping the rows for surviving units.
@@ -155,7 +173,22 @@ def apply_plan(
 
     Returns ``(params', state', opt_state')`` (the latter two may be None if
     not given).
+
+    Pre-flight: the analyzer's plan-lint pass runs over the given trees
+    first (pure shape arithmetic, works under tracing), and any
+    error-severity finding raises :class:`PlanError` naming the pytree
+    path, axis and check — before a single array is touched.  Severities
+    follow ``analysis.severity_config``: a check downgraded below error
+    (or ignored) there no longer raises here either.
     """
+    from torchpruner_tpu.analysis.findings import active_severity
+    from torchpruner_tpu.analysis.plan_lint import lint_plan
+
+    problems = [f for f in lint_plan(plan, params, state)
+                if active_severity(f.check, f.severity) == "error"]
+    if problems:
+        raise PlanError(problems)
+
     keep = keep_indices(plan.n_units, drop)
 
     # (path -> (axis, expanded keep, old_shape)) for optimizer-state matching.
@@ -165,27 +198,12 @@ def apply_plan(
     for s in plan.slices:
         tree = new_params if s.collection == "params" else new_state
         if tree is None:
-            if not s.optional:
-                raise KeyError(
-                    f"plan slice {s.path} targets collection "
-                    f"{s.collection!r}, but none was provided"
-                )
-            continue
+            continue  # optional slice (lint guarantees non-optional exist)
         try:
             arr = _get_path(tree, s.path)
         except (KeyError, IndexError, TypeError):
-            if not s.optional:
-                raise KeyError(
-                    f"plan slice path {s.path} does not resolve in "
-                    f"{s.collection}"
-                )
-            continue  # e.g. bias absent (use_bias=False)
+            continue  # e.g. bias absent (use_bias=False): optional
         idx = expand_keep(keep, plan.n_units, s.fan_out)
-        if arr.shape[s.axis] != plan.n_units * s.fan_out:
-            raise ValueError(
-                f"plan mismatch at {s.path}: axis {s.axis} has size "
-                f"{arr.shape[s.axis]}, expected {plan.n_units * s.fan_out}"
-            )
         sliced = jnp.take(arr, idx, axis=s.axis)
         if s.collection == "params":
             param_slices[tuple(str(k) for k in s.path)] = (s.axis, idx, arr.shape)
@@ -197,6 +215,49 @@ def apply_plan(
     if opt_state is not None:
         new_opt_state = _slice_opt_state(opt_state, param_slices)
     return new_params, new_state, new_opt_state
+
+
+def plan_to_dict(plan: PrunePlan) -> dict:
+    """JSON-safe dict form of a plan (CLI ``--lint-plan`` files)."""
+    return {
+        "n_units": plan.n_units,
+        "slices": [
+            {
+                "path": list(s.path),
+                "axis": s.axis,
+                "fan_out": s.fan_out,
+                "collection": s.collection,
+                "optional": s.optional,
+            }
+            for s in plan.slices
+        ],
+    }
+
+
+def plan_from_dict(d: dict) -> PrunePlan:
+    """Inverse of :func:`plan_to_dict`; pytree path keys come back as the
+    JSON types (strings / ints)."""
+    return PrunePlan(
+        n_units=int(d["n_units"]),
+        slices=tuple(
+            ParamSlice(
+                path=tuple(s["path"]),
+                axis=int(s["axis"]),
+                fan_out=int(s.get("fan_out", 1)),
+                collection=s.get("collection", "params"),
+                optional=bool(s.get("optional", False)),
+            )
+            for s in d["slices"]
+        ),
+    )
+
+
+def key_path_str(path) -> str:
+    """Human ``a/b/c`` form of a ``tree_flatten_with_path`` key path —
+    the ONE spelling of pytree paths shared by the analyzer's findings
+    and the inline ``shard_params`` warning, so severity overrides and
+    log messages always name the same string."""
+    return "/".join(_key_name(k) for k in path)
 
 
 def _key_name(k) -> str:

@@ -179,19 +179,30 @@ def prune(
     new_params, new_state, new_opt = apply_plan(
         plan, drop, params, state=state, opt_state=opt_state
     )
+    new_model = pruned_model_spec(model, group, drop)
+    return PruneResult(new_model, new_params, new_state, new_opt)
 
-    # Rebuild the static spec: smaller target width, rescaled dropout rates.
+
+def pruned_model_spec(
+    model: SegmentedModel, group: PruneGroup, drop: Sequence[int]
+) -> SegmentedModel:
+    """The static model spec after pruning ``drop`` units of ``group``:
+    smaller target width, rescaled dropout rates.  Pure shape arithmetic
+    (no arrays touched) — ``prune`` uses it on the real pytrees, and the
+    static analyzer (analysis/sharding_lint.py) uses it to recompute
+    post-prune shapes without materializing a parameter."""
     target = model.layer(group.target)
-    keep = [u for u in range(L.n_units(target)) if u not in set(drop.tolist())]
+    dropped = set(int(d) for d in np.asarray(drop).reshape(-1).tolist())
+    keep = [u for u in range(L.n_units(target)) if u not in dropped]
     new_model = model.replace_layer(group.target, L.pruned_spec(target, keep))
     for d_name in group.attached_dropout:
         d = model.layer(d_name)
         # Preserve expected active-unit count (reference pruner.py:117-127).
-        new_rate = d.rate * (1.0 - len(drop) / plan.n_units)
+        new_rate = d.rate * (1.0 - len(dropped) / L.n_units(target))
         new_model = new_model.replace_layer(
             d_name, dataclasses.replace(d, rate=new_rate)
         )
-    return PruneResult(new_model, new_params, new_state, new_opt)
+    return new_model
 
 
 def bucket_drop(

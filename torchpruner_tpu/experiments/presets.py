@@ -152,6 +152,13 @@ PRESETS: Dict[str, Callable[..., ExperimentConfig]] = {
 }
 
 
+def preset_names() -> tuple:
+    """Every shipped preset name — the sweep surface CI lints
+    (``--lint <name>`` must report zero errors for each) and the CLI
+    lists."""
+    return tuple(PRESETS)
+
+
 def get_preset(name: str, smoke: bool = False) -> ExperimentConfig:
     if name not in PRESETS:
         raise KeyError(f"unknown preset {name!r}; known: {list(PRESETS)}")

@@ -96,6 +96,7 @@ def training_memory(
     compute_dtype=None,
     remat: bool = False,
     seed: int = 0,
+    params=None,
 ) -> MemoryBudget:
     """Per-chip byte budget for training ``model`` under ``shardings``.
 
@@ -105,12 +106,18 @@ def training_memory(
     Gradients mirror the parameter shardings; optimizer slots are counted
     from ``jax.eval_shape(tx.init, params)`` with param-shaped leaves
     sharded like their param.
+
+    ``params`` (concrete or ShapeDtypeStruct tree) overrides the
+    re-initialized tree — required for pruned models, whose surgered
+    trees (e.g. an irregular GQA head set) cannot round-trip through
+    ``model.init``.
     """
     from torchpruner_tpu.core.segment import init_model
 
-    params, _ = jax.eval_shape(
-        lambda k: init_model(model, seed=seed), jax.random.PRNGKey(seed)
-    )
+    if params is None:
+        params, _ = jax.eval_shape(
+            lambda k: init_model(model, seed=seed), jax.random.PRNGKey(seed)
+        )
     flat_p, _ = jax.tree_util.tree_flatten_with_path(params)
     flat_s = jax.tree_util.tree_leaves(
         shardings, is_leaf=lambda x: hasattr(x, "spec") or _is_pspec(x)

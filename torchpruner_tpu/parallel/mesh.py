@@ -119,3 +119,39 @@ def make_mesh(
 
 def mesh_axes(mesh: Mesh) -> Dict[str, int]:
     return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def axis_size(axis: str) -> int:
+    """Static size of a named mesh axis from inside ``shard_map``,
+    portable across jax versions (``lax.axis_size`` arrived in 0.8; the
+    older spelling ``lax.psum(1, axis)`` constant-folds to the same
+    Python int under tracing)."""
+    from jax import lax
+
+    try:
+        return lax.axis_size(axis)  # jax >= 0.8
+    except AttributeError:  # pragma: no cover - older jax
+        return lax.psum(1, axis)
+
+
+def relaxed_shard_map(fn, mesh, in_specs, out_specs):
+    """``shard_map`` with the varying-mesh-axes/replication check
+    disabled, portable across jax versions: the entry point moved from
+    ``jax.experimental.shard_map`` to ``jax.shard_map`` (0.8) and the
+    flag was renamed ``check_rep`` -> ``check_vma``.  Used by the SP /
+    Ulysses paths, whose Pallas flash kernel produces outputs the checker
+    cannot annotate even though the computation is correctly per-shard.
+    """
+    import inspect
+
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+    flag = (
+        "check_vma"
+        if "check_vma" in inspect.signature(shard_map).parameters
+        else "check_rep"
+    )
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, **{flag: False})

@@ -314,8 +314,9 @@ def pp_spmd_apply(
         carry0 = (jnp.zeros_like(x_all[0]), jnp.zeros_like(x_all))
         if hasattr(jax.lax, "pcast"):
             carry0 = jax.lax.pcast(carry0, axis, to="varying")
-        else:  # pragma: no cover - older jax
+        elif hasattr(jax.lax, "pvary"):  # pragma: no cover - older jax
             carry0 = jax.lax.pvary(carry0, axis)
+        # else: pre-VMA jax — no varying-axes typing to seed
         (_, out_buf), _ = jax.lax.scan(tick, carry0, jnp.arange(T))
         # only the last stage ever banks outputs; the psum both collects
         # them and re-replicates the result for the post layers

@@ -25,7 +25,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from torchpruner_tpu.parallel.mesh import axis_size as mesh_axis_size
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
 
 _NEG_INF = -1e30
 
@@ -137,7 +143,7 @@ def ring_attention_local(q, k, v, *, axis: str, causal: bool = False):
             f"ring attention is self-attention: K/V shard length "
             f"{k.shape[1]} must equal Q's {q.shape[1]}"
         )
-    n = lax.axis_size(axis)
+    n = mesh_axis_size(axis)
     idx = lax.axis_index(axis)
     B, S_loc, H, Dh = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -157,16 +163,15 @@ def ring_attention_local(q, k, v, *, axis: str, causal: bool = False):
         return m, l, acc, k_nxt, v_nxt
 
     # initial state must be marked varying over the ring axis (the loop
-    # carry mixes it with axis-varying values under shard_map)
-    m0, l0, acc0 = lax.pcast(
-        (
-            jnp.full((B, H, S_loc), _NEG_INF, jnp.float32),
-            jnp.zeros((B, H, S_loc), jnp.float32),
-            jnp.zeros((B, H, S_loc, Dh), jnp.float32),
-        ),
-        (axis,),
-        to="varying",
+    # carry mixes it with axis-varying values under shard_map; pre-VMA
+    # jax has no such typing and needs no seed)
+    m0, l0, acc0 = (
+        jnp.full((B, H, S_loc), _NEG_INF, jnp.float32),
+        jnp.zeros((B, H, S_loc), jnp.float32),
+        jnp.zeros((B, H, S_loc, Dh), jnp.float32),
     )
+    if hasattr(lax, "pcast"):
+        m0, l0, acc0 = lax.pcast((m0, l0, acc0), (axis,), to="varying")
     # n-1 hops; the last chunk merges without a (discarded) final rotate
     m, l, acc, k_last, v_last = lax.fori_loop(
         0, n - 1, step, (m0, l0, acc0, k, v)

@@ -80,10 +80,54 @@ def fsdp_sharding(tree, mesh: Mesh, axis="model",
 def shard_params(tree, mesh: Mesh, axis: str = "model",
                  min_size: int = 2**14):
     """Place a params-like pytree on the mesh under the FSDP rule.
-    Returns ``(sharded_tree, sharding_tree)``."""
+    Returns ``(sharded_tree, sharding_tree)``.
+
+    Arrays large enough to shard whose every dim fails the divisibility
+    check fall back to replication; that is no longer silent — one
+    warning line lists the affected paths (downgrade or silence it via
+    ``analysis.severity_config["sharding/replicated-fallback"]``)."""
     shardings = fsdp_sharding(tree, mesh, axis, min_size)
+    fallbacks = _replication_fallbacks(tree, shardings, mesh, axis, min_size)
+    if fallbacks:
+        from torchpruner_tpu.train.logger import lint_warning
+
+        lint_warning(
+            "sharding/replicated-fallback",
+            f"{len(fallbacks)} array(s) no longer divide mesh axis "
+            f"{axis!r} and fall back to replication: "
+            + ", ".join(fallbacks),
+        )
     placed = jax.device_put(tree, shardings)
     return placed, shardings
+
+
+def _replication_fallbacks(tree, shardings, mesh: Mesh, axis,
+                           min_size: int):
+    """Paths of arrays the FSDP rule WANTED to shard (big enough, axis
+    size > 1) but left replicated because no dim divides the mesh axis —
+    the post-prune hazard the static analyzer reports as
+    ``sharding/replicated-fallback``."""
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    axes = tuple(a for a in axes if a in mesh.axis_names)
+    if not axes:
+        return []
+    size = int(np.prod([mesh.shape[a] for a in axes]))
+    if size == 1:
+        return []
+    flat_t, _ = jax.tree_util.tree_flatten_with_path(tree)
+    flat_s = jax.tree_util.tree_leaves(
+        shardings, is_leaf=lambda x: hasattr(x, "spec")
+    )
+    from torchpruner_tpu.core.plan import key_path_str
+
+    out = []
+    for (path, leaf), sh in zip(flat_t, flat_s):
+        shape = np.shape(leaf)
+        if int(np.prod(shape)) < min_size:
+            continue
+        if all(a is None for a in sh.spec):
+            out.append(f"{key_path_str(path)} {tuple(shape)}")
+    return out
 
 
 def _tp_target_specs(spec, size: int) -> Dict[str, P]:

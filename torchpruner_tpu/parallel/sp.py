@@ -33,10 +33,12 @@ import jax
 import numpy as np
 import jax.numpy as jnp
 import optax
-from jax import lax, shard_map
+from jax import lax
+
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from torchpruner_tpu.core import layers as L
+from torchpruner_tpu.parallel.mesh import relaxed_shard_map
 from torchpruner_tpu.core.segment import SegmentedModel
 
 
@@ -191,13 +193,12 @@ class SPTrainer:
             new_params = optax.apply_updates(params, updates)
             return new_params, new_state, new_opt, l
 
-        mapped = shard_map(
+        mapped = relaxed_shard_map(
             local_step,
-            mesh=mesh,
+            mesh,
             in_specs=(repl, repl, repl, bseq, bseq, bseq, repl),
             out_specs=(repl, repl, repl, repl),
-            check_vma=False,  # the ulysses path runs a Pallas kernel
-        )
+        )  # check disabled: the ulysses path runs a Pallas kernel
         self._step_fn = jax.jit(mapped, donate_argnums=(0, 2))
         self._bseq = NamedSharding(mesh, bseq)
 

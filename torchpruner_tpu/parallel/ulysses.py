@@ -38,7 +38,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from torchpruner_tpu.parallel.mesh import (
+    axis_size as mesh_axis_size,
+    relaxed_shard_map,
+)
 
 from torchpruner_tpu.ops.flash_attention import flash_attention
 
@@ -53,7 +56,7 @@ def ulysses_attention_local(q, k, v, *, axis: str, causal: bool = False,
     ``attn_fn(q, k, v, causal=...)`` is the full-sequence attention run on
     each device's head subset; default is the Pallas flash kernel.
     """
-    n = lax.axis_size(axis)
+    n = mesh_axis_size(axis)
     H = q.shape[2]
     if H % n:
         raise ValueError(
@@ -98,15 +101,14 @@ def ulysses_attention(
     # check_vma=False: the Pallas flash kernel's outputs carry no varying-
     # mesh-axes annotation, which the checker (newer jax) rejects inside
     # shard_map even though the computation is correctly per-shard
-    fn = shard_map(
+    fn = relaxed_shard_map(
         functools.partial(
             ulysses_attention_local, axis=axis, causal=causal,
             attn_fn=attn_fn,
         ),
-        mesh=mesh,
+        mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
     )
     sharding = NamedSharding(mesh, spec)
     return fn(

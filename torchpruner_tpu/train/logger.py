@@ -18,6 +18,25 @@ from typing import Optional
 
 log = logging.getLogger("torchpruner_tpu")
 
+
+def lint_warning(check: str, message: str, *, default: str = "warning"):
+    """One-line runtime diagnostic whose severity follows the static
+    analyzer's severity config — the inline twin of a tpu-lint finding.
+
+    Integration points (``shard_params``'s replication fallback) route
+    through here so ``analysis.severity_config`` downgrades/CANCELS the
+    runtime warning and the batch lint finding with one knob:
+    ``"ignore"`` silences, ``"info"`` logs at info level, anything else
+    logs at warning level.
+    """
+    from torchpruner_tpu.analysis.findings import active_severity
+
+    sev = active_severity(check, default)
+    if sev == "ignore":
+        return
+    emit = log.info if sev == "info" else log.warning
+    emit("[%s] %s", check, message)
+
 CSV_FIELDS = [
     "timestamp",
     "experiment",
