@@ -907,6 +907,19 @@ def _assemble(legs: dict, platform: str, device_kind, cache_dir,
     if _leg_ok(legs, "vgg16_train"):
         out["mfu"] = legs["vgg16_train"]["mfu"]
         out["img_per_s_per_chip"] = legs["vgg16_train"]["img_per_s_per_chip"]
+    try:
+        from torchpruner_tpu import obs
+
+        session = obs.get()
+        if session is not None:
+            out["obs_phases"] = {
+                k: {"total_s": round(v["total_s"], 3), "calls": v["calls"],
+                    "compile_s": round(v["compile_s"], 3),
+                    "compile_count": int(v["compile_count"])}
+                for k, v in session.tracer.phase_summary().items()
+            }
+    except Exception:  # telemetry must never break a bench snapshot
+        pass
     return out
 
 
@@ -933,6 +946,22 @@ def main() -> dict:
     platform = jax.devices()[0].platform
     device_kind = getattr(jax.devices()[0], "device_kind", None)
     on_tpu = platform == "tpu"
+    # runtime telemetry: every leg runs under an obs span, so the BENCH
+    # rows carry wall/compile accounting per leg (and the full event
+    # stream lands in $BENCH_OBS_DIR when set).  Telemetry must never
+    # break a bench run — an unwritable BENCH_OBS_DIR degrades to
+    # in-memory-only tracking.
+    from torchpruner_tpu import obs
+
+    try:
+        obs.configure(os.environ.get("BENCH_OBS_DIR") or None)
+    except Exception as e:  # noqa: BLE001
+        print(f"[bench] obs dir unusable ({e}); in-memory telemetry only",
+              file=sys.stderr, flush=True)
+        try:
+            obs.configure(None)
+        except Exception:  # noqa: BLE001
+            pass
     legs: dict = {}
     commit = _git_commit()  # once — it cannot change mid-run
     # absolute deadline handed down by the orchestrator (epoch seconds);
@@ -996,8 +1025,22 @@ def main() -> dict:
                 legs[_name] = dict(partial, in_progress=True)
                 snapshot()
             kw["progress"] = _progress
+        from torchpruner_tpu import obs
+
         try:
-            legs[name] = fn(smoke, **kw)
+            with obs.span(f"leg:{name}") as leg_span:
+                legs[name] = fn(smoke, **kw)
+            if isinstance(legs[name], dict) and leg_span is not None:
+                # attach the obs accounting so every BENCH row carries its
+                # phase timings and compile bill (span ids join with the
+                # child phases in the events stream / obs_phases block)
+                legs[name]["obs"] = {
+                    "span": leg_span.id,
+                    "wall_s": round(leg_span.dur_s, 3),
+                    "compile_s": round(leg_span.compile_s, 3),
+                    "compile_count": leg_span.compile_count,
+                    "trace_count": leg_span.trace_count,
+                }
         except Exception as e:  # noqa: BLE001 - diagnostic, re-raised as data
             import traceback
 
@@ -1038,7 +1081,16 @@ def main() -> dict:
         # a decode number on SOME platform (round-2 gap)
         run_leg("llama_decode", _leg_llama_decode)
 
-    return _assemble(legs, platform, device_kind, cache_dir, smoke)
+    # assemble BEFORE shutdown (it reads the live session's phase
+    # summary), then flush the exporters — with BENCH_OBS_DIR set this
+    # writes the run_summary event + metrics.prom and unregisters the
+    # compile listener
+    result = _assemble(legs, platform, device_kind, cache_dir, smoke)
+    try:
+        obs.shutdown()
+    except Exception:  # noqa: BLE001
+        pass
+    return result
 
 
 def _stream_child(cmd: list[str], timeout_s: float, enrich) -> tuple:

@@ -1,0 +1,577 @@
+"""Unified runtime telemetry (torchpruner_tpu.obs): span nesting and the
+JSONL event stream, metrics math (MFU/tokens-s from known inputs),
+exporter formats, multi-host gating, compile-counter attribution across a
+forced retrace, the CSVLogger satellites, and the end-to-end CLI smoke
+run with ``--obs-dir`` (the quick-lane acceptance check)."""
+
+import csv
+import json
+import math
+import os
+import re
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from torchpruner_tpu import obs
+from torchpruner_tpu.obs.exporters import prometheus_text, write_prometheus
+from torchpruner_tpu.obs.metrics import (
+    MetricsRegistry,
+    StepTelemetry,
+    train_flops_per_step,
+)
+from torchpruner_tpu.obs.spans import SpanTracer
+
+
+@pytest.fixture(autouse=True)
+def _clean_session():
+    """Every test starts and ends without a global obs session."""
+    obs.shutdown()
+    yield
+    obs.shutdown()
+
+
+def _read_events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# -- span tracer ------------------------------------------------------------
+
+
+def test_span_nesting_and_event_ordering(tmp_path):
+    events = []
+    tracer = SpanTracer(sink=events.append, annotate=False)
+    with tracer.span("outer", run=1) as outer:
+        assert tracer.current_id() == outer.id
+        with tracer.span("inner") as inner:
+            assert inner.parent == outer.id
+            assert inner.depth == 1
+            assert tracer.current_id() == inner.id
+        with tracer.span("inner") as inner2:
+            assert inner2.parent == outer.id
+    assert tracer.current_id() is None
+
+    kinds = [(e["event"], e["name"]) for e in events]
+    assert kinds == [
+        ("span_begin", "outer"), ("span_begin", "inner"),
+        ("span_end", "inner"), ("span_begin", "inner"),
+        ("span_end", "inner"), ("span_end", "outer"),
+    ]
+    # ids are unique, meta rides on both begin and end
+    assert len({e["span"] for e in events}) == 3
+    assert events[0]["run"] == 1 and events[-1]["run"] == 1
+    # aggregates: inner called twice, durations accumulate under one name
+    agg = tracer.phase_summary()
+    assert agg["inner"]["calls"] == 2
+    assert agg["outer"]["calls"] == 1
+    assert agg["outer"]["total_s"] >= agg["inner"]["total_s"] >= 0.0
+
+
+def test_span_exception_still_closes():
+    tracer = SpanTracer(annotate=False)
+    with pytest.raises(RuntimeError):
+        with tracer.span("boom"):
+            raise RuntimeError("x")
+    assert tracer.current_id() is None
+    assert tracer.phase_summary()["boom"]["calls"] == 1
+
+
+# -- metrics math -----------------------------------------------------------
+
+
+def test_mfu_from_known_flops_and_step_time():
+    reg = MetricsRegistry()
+    st = StepTelemetry(reg)
+    st.configure(flops_per_step=1e9, peak_flops=1e12)
+    for _ in range(10):
+        st.on_step(0.001, examples=32, tokens=64)
+    d = st.derive()
+    # 10 steps × 1e9 FLOPs over 0.01 s = 1e12 FLOP/s achieved = peak
+    assert d["steps"] == 10
+    assert d["mfu"] == pytest.approx(1.0)
+    assert d["step_time_mean_s"] == pytest.approx(0.001)
+    assert d["examples_per_s"] == pytest.approx(32 / 0.001)
+    assert d["tokens_per_s"] == pytest.approx(64 / 0.001)
+    # derived gauges land in the registry for the exporters
+    assert reg.get("mfu").value == pytest.approx(1.0)
+    assert reg.get("tokens_per_s").value == pytest.approx(64000.0)
+
+
+def test_multi_step_dispatch_counts_k_steps():
+    st = StepTelemetry(MetricsRegistry())
+    st.on_step(0.08, examples=8 * 4, tokens=None, steps=8)
+    d = st.derive()
+    assert d["steps"] == 8
+    assert d["step_time_mean_s"] == pytest.approx(0.01)
+    assert d["examples_per_s"] == pytest.approx(32 / 0.08)
+
+
+def test_train_flops_per_step_is_3x_forward():
+    assert train_flops_per_step(7.0) == 21.0
+
+
+def test_mfu_unknown_denominators_reported_as_none_and_nan_gauge():
+    reg = MetricsRegistry()
+    st = StepTelemetry(reg)
+    st.on_step(0.001, examples=4)
+    d = st.derive()
+    assert d["mfu"] is None
+    assert math.isnan(reg.get("mfu").value)  # stable textfile schema
+    assert reg.get("tokens_per_s").value == 0.0
+
+
+# -- exporter formats -------------------------------------------------------
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? \S+$"
+)
+
+
+def test_prometheus_textfile_format(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("compile_count_total", "compilations").inc(3)
+    reg.gauge("mfu").set(0.42)
+    h = reg.histogram("step_time_seconds", buckets=(0.001, 0.01, 0.1))
+    for v in (0.0005, 0.005, 0.05, 5.0):
+        h.observe(v)
+    text = prometheus_text(reg)
+    lines = [l for l in text.splitlines() if l]
+    for line in lines:
+        assert line.startswith("#") or _PROM_LINE.match(line), line
+    assert "# TYPE compile_count_total counter" in lines
+    assert "compile_count_total 3" in lines
+    assert "mfu 0.42" in lines
+    # histogram buckets are CUMULATIVE and end at +Inf == count
+    assert 'step_time_seconds_bucket{le="0.001"} 1' in lines
+    assert 'step_time_seconds_bucket{le="0.01"} 2' in lines
+    assert 'step_time_seconds_bucket{le="0.1"} 3' in lines
+    assert 'step_time_seconds_bucket{le="+Inf"} 4' in lines
+    assert "step_time_seconds_count 4" in lines
+
+    path = tmp_path / "m.prom"
+    write_prometheus(reg, str(path))
+    assert path.read_text() == text
+
+
+# -- multi-host gating ------------------------------------------------------
+
+
+def test_non_zero_process_index_emits_no_files(tmp_path):
+    session = obs.configure(str(tmp_path / "obs"), process_index=1,
+                            annotate=False, watch_compiles=False)
+    assert not session.is_emitter
+    with obs.span("phase"):
+        assert obs.current_span_id() is not None  # local tracking stays on
+    obs.shutdown()
+    assert not os.path.exists(tmp_path / "obs" / "events.jsonl")
+    assert not os.path.exists(tmp_path / "obs" / "metrics.prom")
+
+
+def test_process_zero_emits_files(tmp_path):
+    obs.configure(str(tmp_path / "obs"), process_index=0, annotate=False)
+    with obs.span("phase"):
+        pass
+    obs.shutdown()
+    events = _read_events(tmp_path / "obs" / "events.jsonl")
+    assert [e["event"] for e in events] == [
+        "obs_init", "span_begin", "span_end", "run_summary"]
+    assert os.path.exists(tmp_path / "obs" / "metrics.prom")
+
+
+# -- compile accounting -----------------------------------------------------
+
+
+def test_compile_counter_increments_across_forced_retrace(tmp_path):
+    session = obs.configure(str(tmp_path), process_index=0, annotate=False)
+
+    def f(x):
+        return jnp.tanh(x) * 2.0
+
+    jf = jax.jit(f)
+    with obs.span("compile_phase") as rec:
+        jf(jnp.ones(5)).block_until_ready()
+        c1, t1 = rec.compile_count, rec.trace_count
+        # a new shape forces a retrace AND a fresh backend compile
+        jf(jnp.ones(7)).block_until_ready()
+        assert rec.compile_count > c1
+        assert rec.trace_count > t1
+    assert c1 >= 1 and t1 >= 1
+    counts = session.compiles.counts()
+    assert counts["compile_count"] >= 2
+    assert counts["compile_s"] > 0
+    # the span_end event carries the attribution
+    obs.shutdown()
+    end = [e for e in _read_events(tmp_path / "events.jsonl")
+           if e["event"] == "span_end"][0]
+    assert end["compile_count"] >= 2
+    assert end["compile_s"] > 0
+
+
+def test_compile_listener_unregisters_on_shutdown():
+    session = obs.configure(process_index=0, annotate=False)
+    jax.jit(lambda x: x - 3)(jnp.ones(3))
+    before = session.compiles.counts()["compile_count"]
+    assert before >= 1
+    obs.shutdown()
+    jax.jit(lambda x: x - 4)(jnp.ones(3))  # after shutdown: not counted
+    assert session.compiles.counts()["compile_count"] == before
+
+
+# -- overhead guard ---------------------------------------------------------
+
+
+def test_step_instrumentation_overhead_under_budget():
+    """The per-step hot path must stay under 2% of even a FAST (5 ms)
+    compiled step — i.e. <=100 µs per call; measured it is ~1-2 µs."""
+    obs.configure(process_index=0, annotate=False, watch_compiles=False)
+    n = 2000
+    obs.record_step(0.001, 32, 64)  # warm the path
+    t0 = time.perf_counter()
+    for _ in range(n):
+        obs.record_step(0.001, 32, 64)
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 100e-6, f"record_step cost {per_call * 1e6:.1f} µs"
+
+    # disabled path (no session) is pure no-op territory
+    obs.shutdown()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        obs.record_step(0.001, 32, 64)
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 20e-6
+
+
+# -- trainer integration ----------------------------------------------------
+
+
+def _tiny_trainer(**kw):
+    from torchpruner_tpu.core import layers as L
+    from torchpruner_tpu.core.segment import SegmentedModel
+    from torchpruner_tpu.train.loop import Trainer
+    from torchpruner_tpu.utils.losses import cross_entropy_loss
+
+    model = SegmentedModel(
+        (L.Dense("fc1", 8), L.Activation("r", "relu"), L.Dense("out", 3)),
+        (6,),
+    )
+    return Trainer.create(model, optax.sgd(0.01), cross_entropy_loss, **kw)
+
+
+def test_trainer_steps_feed_step_telemetry():
+    session = obs.configure(process_index=0, annotate=False,
+                            watch_compiles=False)
+    trainer = _tiny_trainer()
+    x = jnp.ones((16, 6), jnp.float32)
+    y = jnp.zeros((16,), jnp.int32)
+    for _ in range(3):
+        trainer.step(x, y)
+    # a streak's FIRST step is unrecorded (async backends would log
+    # dispatch-only µs for it), so 3 calls -> 2 recorded intervals
+    d = session.step.derive()
+    assert d["steps"] == 2
+    assert session.metrics.counter("examples_total").value == 32
+    # evaluate() breaks the streak: the next step is a first step again
+    trainer.evaluate([(x, y)])
+    trainer.step(x, y)
+    assert session.step.derive()["steps"] == 2
+    trainer.step(x, y)
+    assert session.step.derive()["steps"] == 3
+
+
+def test_trainer_grad_norm_opt_in_records_gauge():
+    session = obs.configure(process_index=0, annotate=False,
+                            watch_compiles=False)
+    trainer = _tiny_trainer(grad_norm=True)
+    x = jnp.ones((8, 6), jnp.float32)
+    y = jnp.zeros((8,), jnp.int32)
+    l = trainer.step(x, y)
+    assert np.isfinite(float(l))  # loss unwraps from the (loss, gnorm) pair
+    g = session.metrics.get("grad_norm")
+    assert g is not None and g.value > 0
+
+
+# -- CSVLogger satellites ---------------------------------------------------
+
+
+def test_csvlogger_resume_continues_step_ids(tmp_path):
+    from torchpruner_tpu.train.logger import CSVLogger
+
+    path = str(tmp_path / "log.csv")
+    with CSVLogger(path, experiment="e") as lg:
+        for _ in range(2):
+            lg.log_prune_step(
+                layer="fc1", method="m", test_loss=1.0, test_acc=0.5,
+                test_loss_pp=1.1, test_acc_pp=0.4, n_params=10,
+            )
+    # resume: step ids continue instead of restarting at 0
+    with CSVLogger(path, experiment="e") as lg:
+        assert lg._step == 2
+        lg.log_epoch(epoch=0, train_loss=0.9, test_loss=1.0, test_acc=0.5)
+    with open(path) as f:
+        rows = list(csv.DictReader(f))
+    assert [r["step"] for r in rows] == ["0", "1", "2"]
+    # exactly one header line
+    with open(path) as f:
+        assert sum(l.startswith("timestamp,") for l in f) == 1
+
+
+def test_csvlogger_jsonl_mirror_keeps_header_order(tmp_path):
+    from torchpruner_tpu.train.logger import CSV_FIELDS, CSVLogger
+
+    path = str(tmp_path / "log.csv")
+    with CSVLogger(path, experiment="e") as lg:
+        lg.log_prune_step(
+            layer="fc1", method="m", test_loss=1.0, test_acc=0.5,
+            test_loss_pp=1.1, test_acc_pp=0.4, n_params=10,
+        )
+        lg.log_epoch(epoch=0, train_loss=0.9, test_loss=1.0, test_acc=0.5)
+    for rec in _read_events(path + ".jsonl"):
+        assert list(rec.keys()) == CSV_FIELDS
+
+
+def test_csvlogger_rows_carry_active_span_id(tmp_path):
+    from torchpruner_tpu.train.logger import CSVLogger
+
+    obs.configure(process_index=0, annotate=False, watch_compiles=False)
+    path = str(tmp_path / "log.csv")
+    with CSVLogger(path, experiment="e") as lg:
+        with obs.span("retrain") as rec:
+            lg.log_epoch(epoch=0, train_loss=1.0, test_loss=1.0,
+                         test_acc=0.1)
+        lg.log_epoch(epoch=1, train_loss=1.0, test_loss=1.0, test_acc=0.1)
+    with open(path) as f:
+        rows = list(csv.DictReader(f))
+    assert rows[0]["span_id"] == rec.id
+    assert rows[1]["span_id"] == ""
+
+
+def test_csvlogger_resumes_pre_span_id_schema(tmp_path):
+    """A CSV written before the span_id column keeps its own (narrower)
+    header on resume — no ragged rows, no rewritten history."""
+    from torchpruner_tpu.train.logger import CSVLogger
+
+    path = str(tmp_path / "old.csv")
+    old_fields = ["timestamp", "experiment", "step", "layer", "method",
+                  "test_loss", "test_acc", "test_loss_pp", "test_acc_pp",
+                  "n_params", "flops", "widths", "prune_time",
+                  "prune_ratio", "train_loss"]
+    with open(path, "w", newline="") as f:
+        w = csv.DictWriter(f, old_fields)
+        w.writeheader()
+        w.writerow({k: ("7" if k == "step" else "x") for k in old_fields})
+    with CSVLogger(path, experiment="e") as lg:
+        assert lg._step == 8
+        lg.log_epoch(epoch=0, train_loss=1.0, test_loss=1.0, test_acc=0.1)
+    with open(path) as f:
+        rows = list(csv.DictReader(f))
+    assert rows[-1]["step"] == "8"
+    assert "span_id" not in rows[-1]
+
+
+def test_configure_failure_keeps_previous_session(tmp_path):
+    """A failing constructor (unwritable obs_dir) must leave the existing
+    session installed and usable; close() is idempotent either way."""
+    session = obs.configure(str(tmp_path / "ok"), process_index=0,
+                            annotate=False, watch_compiles=False)
+    blocked = tmp_path / "blocked"
+    blocked.write_text("")  # a FILE where a directory is needed
+    with pytest.raises(OSError):
+        obs.configure(str(blocked / "obs"), process_index=0, annotate=False)
+    assert obs.get() is session
+    with obs.span("still_alive"):
+        pass
+    obs.shutdown()
+    session.close()  # second close: no I/O on the closed event file
+    events = _read_events(tmp_path / "ok" / "events.jsonl")
+    assert sum(e["event"] == "run_summary" for e in events) == 1
+    assert any(e.get("name") == "still_alive" for e in events)
+
+
+def test_reused_obs_dir_summarizes_latest_run_only(tmp_path):
+    from torchpruner_tpu.utils.profiling import span_phase_summary
+
+    obs_dir = str(tmp_path / "obs")
+    for _ in range(2):  # same dir twice: events.jsonl appends
+        obs.configure(obs_dir, process_index=0, annotate=False,
+                      watch_compiles=False)
+        with obs.span("phase"):
+            pass
+        obs.shutdown()
+    phases = span_phase_summary(os.path.join(obs_dir, "events.jsonl"))
+    assert phases["phase"]["calls"] == 1  # not 2: latest session only
+
+
+# -- span JSONL joins (profiling / trace_analysis) --------------------------
+
+
+def _write_span_stream(path):
+    events = [
+        {"event": "obs_init", "ts": 0},
+        {"event": "span_begin", "span": "s1", "name": "retrain",
+         "parent": None, "depth": 0, "ts": 1.0},
+        {"event": "span_end", "span": "s1", "name": "retrain",
+         "parent": None, "depth": 0, "ts": 3.0, "dur_s": 2.0,
+         "compile_count": 2, "compile_s": 0.5, "trace_count": 3},
+        {"event": "span_end", "span": "s2", "name": "eval",
+         "parent": None, "depth": 0, "ts": 4.0, "dur_s": 1.0,
+         "compile_count": 0, "compile_s": 0.0, "trace_count": 0},
+    ]
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+        f.write("{torn-line")  # killed-run tail must be tolerated
+
+
+def test_steptimer_from_span_jsonl(tmp_path):
+    from torchpruner_tpu.utils.profiling import StepTimer, span_phase_summary
+
+    path = str(tmp_path / "events.jsonl")
+    _write_span_stream(path)
+    timer = StepTimer.from_span_jsonl(path)
+    assert timer.summary()["retrain"] == {
+        "total_s": 2.0, "calls": 1, "mean_s": 2.0}
+    phases = span_phase_summary(path)
+    assert phases["retrain"]["compile_count"] == 2
+    assert phases["eval"]["total_s"] == 1.0
+
+
+def test_trace_summary_joins_span_phases(tmp_path):
+    from torchpruner_tpu.utils.profiling import trace
+    from torchpruner_tpu.utils.trace_analysis import (
+        markdown_summary,
+        summarize_trace,
+    )
+
+    f = jax.jit(lambda a: (a @ a).sum())
+    a = jnp.ones((64, 64))
+    f(a).block_until_ready()
+    with trace(str(tmp_path / "tr")):
+        f(a).block_until_ready()
+    spans = str(tmp_path / "events.jsonl")
+    _write_span_stream(spans)
+    s = summarize_trace(str(tmp_path / "tr"), spans_jsonl=spans)
+    assert s["phases"]["retrain"]["total_s"] == 2.0
+    assert s["phases"]["retrain"]["compile_count"] == 2
+    md = markdown_summary(s)
+    assert "phase (runtime spans)" in md and "| retrain |" in md
+
+
+# -- end-to-end CLI smoke (quick lane) --------------------------------------
+
+
+def test_cli_obs_dir_end_to_end(tmp_path, monkeypatch, capsys):
+    """The acceptance check at smoke scale: the MLP prune→retrain preset
+    under ``--obs-dir`` produces a parseable span stream covering all
+    pipeline phases, a Prometheus textfile with the step/compile series,
+    and phase wall times that sum to within 10% of the run's total."""
+    from torchpruner_tpu.__main__ import main
+
+    monkeypatch.chdir(tmp_path)  # default log_path lands in tmp
+    obs_dir = str(tmp_path / "obs")
+    rc = main(["--preset", "mnist_mlp_shapley", "--smoke",
+               "--obs-dir", obs_dir, "--no-compilation-cache"])
+    assert rc == 0
+    out = capsys.readouterr()
+    assert json.loads(out.out.strip().splitlines()[-1])["steps"] == 2
+    assert "observability summary" in out.err
+
+    events = _read_events(os.path.join(obs_dir, "events.jsonl"))
+    names = {e["name"] for e in events if e["event"] == "span_end"}
+    for phase in ("run", "prune_retrain", "setup", "attribution", "plan",
+                  "apply_plan", "retrain", "eval", "flops"):
+        assert phase in names, f"missing phase span {phase!r}"
+    # begin/end pair up per span id
+    begins = {e["span"] for e in events if e["event"] == "span_begin"}
+    ends = {e["span"] for e in events if e["event"] == "span_end"}
+    assert begins == ends
+
+    # phase coverage: direct children of prune_retrain account for >=90%
+    # of its wall time (the ISSUE's 10% accounting criterion)
+    by_id = {e["span"]: e for e in events if e["event"] == "span_end"}
+    root = next(e for e in by_id.values() if e["name"] == "prune_retrain")
+    child_s = sum(e["dur_s"] for e in by_id.values()
+                  if e["parent"] == root["span"])
+    assert child_s >= 0.9 * root["dur_s"]
+    assert child_s <= 1.01 * root["dur_s"]
+
+    # run_summary event carries derived metrics + compile accounting
+    summary = [e for e in events if e["event"] == "run_summary"][-1]
+    assert summary["derived"]["steps"] > 0
+    assert summary["compiles"]["compile_count"] > 0
+
+    # Prometheus textfile: the promised series exist
+    prom = open(os.path.join(obs_dir, "metrics.prom")).read()
+    for series in ("step_time_seconds_sum", "step_time_seconds_count",
+                   "steps_total", "examples_per_s", "tokens_per_s", "mfu",
+                   "compile_count_total", "compile_seconds_total"):
+        assert re.search(rf"^{series}", prom, re.M), f"missing {series}"
+
+    # CSV rows cross-reference emitted span ids
+    with open(tmp_path / "logs" / "experiment.csv") as f:
+        rows = list(csv.DictReader(f))
+    assert rows and all(r["span_id"] in ends for r in rows)
+
+
+@pytest.mark.slow
+def test_cli_obs_full_size_mlp_sweep(tmp_path, monkeypatch, capsys):
+    """The same pipeline at the mid-size digits MLP (512-wide hiddens,
+    taylor scoring) — the closest CI gets to a full obs sweep."""
+    import dataclasses
+
+    from torchpruner_tpu.__main__ import main
+    from torchpruner_tpu.experiments.presets import mnist_mlp_shapley
+
+    cfg = dataclasses.replace(
+        mnist_mlp_shapley(smoke=True), model="digits_fc",
+        method="taylor", method_kwargs={}, name="obs_full",
+        log_path=str(tmp_path / "logs" / "log.csv"),
+    )
+    cfg_path = str(tmp_path / "cfg.json")
+    cfg.to_json(cfg_path)
+    monkeypatch.chdir(tmp_path)
+    obs_dir = str(tmp_path / "obs")
+    rc = main(["--config", cfg_path, "--obs-dir", obs_dir,
+               "--no-compilation-cache"])
+    assert rc == 0
+    events = _read_events(os.path.join(obs_dir, "events.jsonl"))
+    summary = [e for e in events if e["event"] == "run_summary"][-1]
+    assert summary["phases"]["retrain"]["calls"] == 2
+    assert summary["compiles"]["compile_count"] > 0
+
+
+def test_cli_flushes_telemetry_when_the_run_crashes(tmp_path, monkeypatch):
+    """A crashed run is when telemetry matters most: the exporters must
+    flush (and the compile listener unregister) on the error path too."""
+    from torchpruner_tpu.__main__ import main
+
+    monkeypatch.chdir(tmp_path)
+    cfg_path = tmp_path / "bad.json"
+    cfg_path.write_text(json.dumps({
+        "name": "crash", "model": "no_such_model",
+        "dataset": "digits_flat",
+    }))
+    obs_dir = str(tmp_path / "obs")
+    with pytest.raises(KeyError):
+        main(["--config", str(cfg_path), "--obs-dir", obs_dir,
+              "--no-compilation-cache"])
+    assert obs.get() is None  # session torn down
+    events = _read_events(os.path.join(obs_dir, "events.jsonl"))
+    assert events[-1]["event"] == "run_summary"
+    assert os.path.exists(os.path.join(obs_dir, "metrics.prom"))
+
+
+def test_cli_no_obs_disables_everything(tmp_path, monkeypatch, capsys):
+    from torchpruner_tpu.__main__ import main
+
+    monkeypatch.chdir(tmp_path)
+    rc = main(["--preset", "mnist_mlp_shapley", "--smoke", "--no-obs",
+               "--no-compilation-cache"])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "observability summary" not in err
+    assert obs.get() is None

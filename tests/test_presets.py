@@ -21,7 +21,8 @@ from torchpruner_tpu.utils.config import ExperimentConfig
 
 def test_all_presets_resolve_and_roundtrip(tmp_path):
     # the five BASELINE.json configs + the runnable-here digits32 variant
-    assert len(PRESETS) == 6
+    # + the reference MNIST MLP recipe (the obs smoke target)
+    assert len(PRESETS) == 7
     for name in PRESETS:
         for smoke in (False, True):
             cfg = get_preset(name, smoke=smoke)

@@ -62,6 +62,17 @@ def main(argv=None) -> int:
              "(view in XProf/TensorBoard)",
     )
     p.add_argument(
+        "--obs-dir", metavar="DIR",
+        help="write runtime telemetry into DIR: events.jsonl (span/phase "
+             "stream) and metrics.prom (Prometheus textfile); the "
+             "end-of-run summary prints to stderr either way",
+    )
+    p.add_argument(
+        "--no-obs", action="store_true",
+        help="disable runtime telemetry entirely (no spans, no step "
+             "metrics, no compile accounting, no summary)",
+    )
+    p.add_argument(
         "--dump-config", metavar="PATH",
         help="write the resolved config JSON to PATH and exit",
     )
@@ -69,6 +80,8 @@ def main(argv=None) -> int:
 
     if args.lint_plan and args.lint is None:
         p.error("--lint-plan only makes sense together with --lint")
+    if args.obs_dir and args.no_obs:
+        p.error("--obs-dir and --no-obs are mutually exclusive")
 
     if args.list:
         from torchpruner_tpu.experiments.presets import PRESETS
@@ -137,7 +150,33 @@ def main(argv=None) -> int:
 
         profile_ctx = profiling.trace(args.profile)
 
-    with profile_ctx:
+    obs = None
+    if not args.no_obs:
+        from torchpruner_tpu import obs
+
+        obs.configure(args.obs_dir)
+
+    run_ctx = obs.span("run", experiment=cfg.name,
+                       experiment_kind=cfg.experiment) \
+        if obs is not None else contextlib.nullcontext()
+    try:
+        _run_experiment(cfg, profile_ctx, run_ctx)
+    finally:
+        # a crashed run is exactly when the telemetry matters: flush the
+        # summary/exporters (and unregister the compile listener) on
+        # every exit path
+        if obs is not None:
+            obs.shutdown(print_to=sys.stderr)
+            if args.obs_dir:
+                print(f"telemetry written to {args.obs_dir}",
+                      file=sys.stderr)
+    if args.profile:
+        print(f"profiler trace written to {args.profile}", file=sys.stderr)
+    return 0
+
+
+def _run_experiment(cfg, profile_ctx, run_ctx) -> None:
+    with profile_ctx, run_ctx:
         if cfg.experiment == "robustness":
             from torchpruner_tpu.experiments.robustness import (
                 run_robustness_config,
@@ -176,9 +215,6 @@ def main(argv=None) -> int:
                 "final_acc": last.post_acc if last else None,
                 "final_params": last.n_params if last else None,
             }))
-    if args.profile:
-        print(f"profiler trace written to {args.profile}", file=sys.stderr)
-    return 0
 
 
 if __name__ == "__main__":

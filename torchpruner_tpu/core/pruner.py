@@ -173,13 +173,17 @@ def prune(
             "quantize; slicing q/scale along mismatched axes would "
             "corrupt the weights silently)"
         )
+    from torchpruner_tpu import obs
+
     group = layer if isinstance(layer, PruneGroup) else G.group_for(model, layer)
     drop = np.unique(np.asarray(drop, dtype=np.int64).reshape(-1))
-    plan = plan_for_group(model, group)
-    new_params, new_state, new_opt = apply_plan(
-        plan, drop, params, state=state, opt_state=opt_state
-    )
-    new_model = pruned_model_spec(model, group, drop)
+    with obs.span("plan", target=group.target):
+        plan = plan_for_group(model, group)
+    with obs.span("apply_plan", target=group.target, n_drop=len(drop)):
+        new_params, new_state, new_opt = apply_plan(
+            plan, drop, params, state=state, opt_state=opt_state
+        )
+        new_model = pruned_model_spec(model, group, drop)
     return PruneResult(new_model, new_params, new_state, new_opt)
 
 

@@ -17,6 +17,27 @@ from typing import Callable, Dict
 from torchpruner_tpu.utils.config import ExperimentConfig
 
 
+def mnist_mlp_shapley(smoke: bool = False) -> ExperimentConfig:
+    """Config 0: the reference's "Pruning Untrained Networks" MNIST MLP —
+    784-2024-2024-10 FC net, Shapley attribution on both hidden layers,
+    all-negative-attribution prune, short fine-tune.  The smoke variant
+    runs the identical recipe on the 64-64-64-10 digits MLP in seconds on
+    one CPU — the obs quick-lane smoke target (tests/test_obs.py)."""
+    return ExperimentConfig(
+        name="mnist_mlp_shapley",
+        model="digits_fc_tiny" if smoke else "mnist_fc",
+        dataset="digits_flat" if smoke else "mnist_flat",
+        method="shapley",
+        method_kwargs={"sv_samples": 2 if smoke else 5},
+        policy="negative",
+        finetune_epochs=1,
+        score_examples=32 if smoke else 1000,
+        batch_size=32 if smoke else 64,
+        eval_batch_size=64 if smoke else 250,
+        lr=0.05 if smoke else 0.01,
+    )
+
+
 def vgg16_layerwise(smoke: bool = False) -> ExperimentConfig:
     """Config 1 — the reference's own recipe: CIFAR-10 VGG16 layerwise
     pruning (VGG notebook; SURVEY.md §2.8)."""
@@ -143,6 +164,7 @@ def llama3_ffn_taylor(smoke: bool = False) -> ExperimentConfig:
 
 
 PRESETS: Dict[str, Callable[..., ExperimentConfig]] = {
+    "mnist_mlp_shapley": mnist_mlp_shapley,
     "vgg16_layerwise": vgg16_layerwise,
     "vgg16_digits32_layerwise": vgg16_digits32_layerwise,
     "resnet50_taylor": resnet50_taylor,

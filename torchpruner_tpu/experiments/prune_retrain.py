@@ -13,6 +13,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 import optax
 
+from torchpruner_tpu import obs
 from torchpruner_tpu.attributions import (
     APoZAttributionMetric,
     RandomAttributionMetric,
@@ -31,6 +32,7 @@ from torchpruner_tpu.models import (
     cifar10_fc,
     digits_convnet,
     digits_fc,
+    fc_net,
     fmnist_convnet,
     llama3_8b,
     llama_tiny,
@@ -69,6 +71,12 @@ MODEL_REGISTRY = {
     "mnist_fc": (mnist_fc, "mnist_flat"),
     "cifar10_fc": (cifar10_fc, "cifar10_flat"),
     "digits_fc": (digits_fc, "digits_flat"),
+    "digits_fc_tiny": (
+        # 64-64-64-10: the reference MLP recipe at quick-lane scale
+        # (mnist_mlp_shapley --smoke / the obs CLI smoke test)
+        lambda: fc_net(64, hidden=(64, 64)),
+        "digits_flat",
+    ),
     "digits_convnet": (digits_convnet, "digits"),
     "fmnist_convnet": (fmnist_convnet, "fashion_mnist"),
     "vgg16_bn": (vgg16_bn, "cifar10"),
@@ -204,42 +212,66 @@ def run_prune_retrain(
 
     ``model`` / ``datasets=(train, val, test)`` may be injected (tests,
     custom zoos); defaults come from the registries.
+
+    Telemetry: when an obs session is active (``obs.configure``), the run
+    emits nested phase spans — setup → per-target attribution / eval /
+    prune (plan, apply_plan) / shard / retrain — and the CSV rows carry
+    the active span id for offline joins.
     """
-    model, (train, val, test) = resolve_model_and_data(cfg, model, datasets)
+    with obs.span("prune_retrain", experiment=cfg.name):
+        return _run_prune_retrain(cfg, model=model, datasets=datasets,
+                                  verbose=verbose)
 
-    groups = list(pruning_graph(model))
-    if cfg.prune_order == "reverse":
-        groups = groups[::-1]  # outermost layer first (reference recipe)
-    targets = filter_targets([g.target for g in groups], cfg)
 
-    # one opt_state spans every target's fine-tune pass, so decaying
-    # schedules must be sized for the whole run, not one pass
-    tx = make_optimizer(
-        cfg, steps_per_epoch=max(1, len(train) // cfg.batch_size),
-        total_epochs=cfg.finetune_epochs * max(1, len(targets)),
-    )
-    loss_fn = LOSS_REGISTRY[cfg.loss]
-    import jax.numpy as jnp
+def _run_prune_retrain(
+    cfg: ExperimentConfig,
+    *,
+    model=None,
+    datasets=None,
+    verbose: bool = True,
+) -> List[PruneStepRecord]:
+    with obs.span("setup"):
+        model, (train, val, test) = resolve_model_and_data(
+            cfg, model, datasets)
 
-    cdtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else None
-    mesh = None
-    if cfg.mesh:
-        # SPMD loop: sharded training over the configured mesh and
-        # data-parallel scoring over its data axis (SURVEY.md §5.8)
-        from torchpruner_tpu.parallel import ShardedTrainer, make_mesh
+        groups = list(pruning_graph(model))
+        if cfg.prune_order == "reverse":
+            groups = groups[::-1]  # outermost layer first (reference recipe)
+        targets = filter_targets([g.target for g in groups], cfg)
 
-        mesh = make_mesh(cfg.mesh)
-        trainer = ShardedTrainer.create(
-            model, tx, loss_fn, mesh, seed=cfg.seed,
-            partition=cfg.partition, compute_dtype=cdtype, remat=cfg.remat,
-            accum_steps=cfg.accum_steps, moe_aux_weight=cfg.moe_aux_weight,
+        # one opt_state spans every target's fine-tune pass, so decaying
+        # schedules must be sized for the whole run, not one pass
+        tx = make_optimizer(
+            cfg, steps_per_epoch=max(1, len(train) // cfg.batch_size),
+            total_epochs=cfg.finetune_epochs * max(1, len(targets)),
         )
-    else:
-        trainer = Trainer.create(
-            model, tx, loss_fn, seed=cfg.seed,
-            compute_dtype=cdtype, remat=cfg.remat,
-            accum_steps=cfg.accum_steps, moe_aux_weight=cfg.moe_aux_weight,
-        )
+        loss_fn = LOSS_REGISTRY[cfg.loss]
+        import jax.numpy as jnp
+
+        cdtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else None
+        mesh = None
+        if cfg.mesh:
+            # SPMD loop: sharded training over the configured mesh and
+            # data-parallel scoring over its data axis (SURVEY.md §5.8)
+            from torchpruner_tpu.parallel import ShardedTrainer, make_mesh
+
+            mesh = make_mesh(cfg.mesh)
+            trainer = ShardedTrainer.create(
+                model, tx, loss_fn, mesh, seed=cfg.seed,
+                partition=cfg.partition, compute_dtype=cdtype,
+                remat=cfg.remat, accum_steps=cfg.accum_steps,
+                moe_aux_weight=cfg.moe_aux_weight,
+                grad_norm=cfg.obs_grad_norm,
+            )
+        else:
+            trainer = Trainer.create(
+                model, tx, loss_fn, seed=cfg.seed,
+                compute_dtype=cdtype, remat=cfg.remat,
+                accum_steps=cfg.accum_steps,
+                moe_aux_weight=cfg.moe_aux_weight,
+                grad_norm=cfg.obs_grad_norm,
+            )
+        _configure_mfu(cfg, trainer)
     logger = CSVLogger(cfg.log_path, experiment=cfg.name)
     history: List[PruneStepRecord] = []
 
@@ -251,64 +283,77 @@ def run_prune_retrain(
 
     score_dtype = jnp.bfloat16 if cfg.score_dtype == "bfloat16" else None
     for target in targets:
-        metric = build_metric(
-            cfg.method, trainer.model, trainer.params, val_batches,
-            loss_fn, state=trainer.state,
-            reduction=cfg.reduction, seed=cfg.seed,
-            compute_dtype=score_dtype, **cfg.method_kwargs,
-        )
-        t0 = time.perf_counter()
-        if mesh is not None and "data" in cfg.mesh:
-            from torchpruner_tpu.parallel import DistributedScorer
+        with obs.span("attribution", target=target, method=cfg.method):
+            metric = build_metric(
+                cfg.method, trainer.model, trainer.params, val_batches,
+                loss_fn, state=trainer.state,
+                reduction=cfg.reduction, seed=cfg.seed,
+                compute_dtype=score_dtype, **cfg.method_kwargs,
+            )
+            t0 = time.perf_counter()
+            if mesh is not None and "data" in cfg.mesh:
+                from torchpruner_tpu.parallel import DistributedScorer
 
-            scorer = DistributedScorer(metric, mesh)
-        else:
-            scorer = metric
-        scores = scorer.run(
-            target, find_best_evaluation_layer=cfg.find_best_evaluation_layer
-        )
-        pre_loss, pre_acc = trainer.evaluate(test_batches)
+                scorer = DistributedScorer(metric, mesh)
+            else:
+                scorer = metric
+            scores = scorer.run(
+                target,
+                find_best_evaluation_layer=cfg.find_best_evaluation_layer,
+            )
+        with obs.span("eval", target=target, which="pre"):
+            pre_loss, pre_acc = trainer.evaluate(test_batches)
         if cfg.simulate:
             # mask the same slices a real prune would remove — shapes (and
             # therefore compiled programs) never change across the sweep
             from torchpruner_tpu.core.masking import apply_masks, drop_masks
 
-            drop_idx = score_drop_indices(
-                scores, policy=cfg.policy, fraction=cfg.fraction,
-                bucket=cfg.bucket,
-            )
-            pm, sm = drop_masks(
-                trainer.model, trainer.params, {target: drop_idx},
-                state=trainer.state,
-            )
-            trainer.params = apply_masks(trainer.params, pm)
-            if trainer.state:
-                trainer.state = apply_masks(trainer.state, sm)
+            with obs.span("prune", target=target, simulate=True):
+                drop_idx = score_drop_indices(
+                    scores, policy=cfg.policy, fraction=cfg.fraction,
+                    bucket=cfg.bucket,
+                )
+                pm, sm = drop_masks(
+                    trainer.model, trainer.params, {target: drop_idx},
+                    state=trainer.state,
+                )
+                trainer.params = apply_masks(trainer.params, pm)
+                if trainer.state:
+                    trainer.state = apply_masks(trainer.state, sm)
             prune_time = time.perf_counter() - t0
             n_dropped = len(drop_idx)
         else:
-            res = prune_by_scores(
-                trainer.model, trainer.params, target, scores,
-                policy=cfg.policy, fraction=cfg.fraction, bucket=cfg.bucket,
-                state=trainer.state, opt_state=trainer.opt_state,
-            )
-            prune_time = time.perf_counter() - t0
-            n_dropped = L.n_units(trainer.model.layer(target)) - L.n_units(
-                res.model.layer(target)
-            )
-            trainer = trainer.rebuild(res.model, res.params, res.state,
-                                      res.opt_state)
+            with obs.span("prune", target=target):
+                res = prune_by_scores(
+                    trainer.model, trainer.params, target, scores,
+                    policy=cfg.policy, fraction=cfg.fraction,
+                    bucket=cfg.bucket,
+                    state=trainer.state, opt_state=trainer.opt_state,
+                )
+                prune_time = time.perf_counter() - t0
+                n_dropped = L.n_units(
+                    trainer.model.layer(target)
+                ) - L.n_units(res.model.layer(target))
+                # rebuild recompiles at the new shapes (ShardedTrainer
+                # re-places under its own "shard" span)
+                trainer = trainer.rebuild(res.model, res.params, res.state,
+                                          res.opt_state)
+            _configure_mfu(cfg, trainer)
 
-        for epoch in range(cfg.finetune_epochs):
-            train_epoch(
-                trainer, train.batches(cfg.batch_size, shuffle=True,
-                                       seed=cfg.seed + epoch,
-                                       drop_remainder=drop),
-                epoch=epoch, verbose=False,
-            )
+        with obs.span("retrain", target=target, epochs=cfg.finetune_epochs):
+            for epoch in range(cfg.finetune_epochs):
+                train_epoch(
+                    trainer, train.batches(cfg.batch_size, shuffle=True,
+                                           seed=cfg.seed + epoch,
+                                           drop_remainder=drop),
+                    epoch=epoch, verbose=False,
+                )
 
-        post_loss, post_acc = trainer.evaluate(test_batches)
-        n_params, flops = model_cost(trainer.model, trainer.params, trainer.state)
+        with obs.span("eval", target=target, which="post"):
+            post_loss, post_acc = trainer.evaluate(test_batches)
+        with obs.span("flops", target=target):
+            n_params, flops = model_cost(trainer.model, trainer.params,
+                                         trainer.state)
         rec = PruneStepRecord(
             layer=target, pre_loss=pre_loss, pre_acc=pre_acc,
             post_loss=post_loss, post_acc=post_acc, n_params=n_params,
@@ -329,4 +374,22 @@ def run_prune_retrain(
                 f"acc {pre_acc:.4f}→{post_acc:.4f}, params {n_params}",
                 flush=True,
             )
+    logger.close()
     return history
+
+
+def _configure_mfu(cfg: ExperimentConfig, trainer):
+    """Point the obs step telemetry at the CURRENT model's training FLOPs
+    (3× a forward at the training batch size — re-aimed after every prune,
+    since the denominator shrinks with the model).  Costs one cost-analysis
+    compile, so it only runs while a session is active."""
+    if obs.get() is None:
+        return
+    try:
+        _, fwd = model_cost(trainer.model, trainer.params, trainer.state,
+                            batch_size=cfg.batch_size)
+        if fwd:
+            obs.configure_step_flops(
+                flops_per_step=obs.train_flops_per_step(fwd))
+    except Exception:
+        pass

@@ -21,6 +21,7 @@ import jax
 import numpy as np
 import jax.numpy as jnp
 
+from torchpruner_tpu import obs
 from torchpruner_tpu.core.graph import find_best_evaluation_layer, pruning_graph
 from torchpruner_tpu.core.segment import SegmentedModel
 
@@ -274,71 +275,74 @@ def layerwise_robustness(
             state = jax.device_put(state, repl)
     results: Dict[str, Dict[str, List[Dict]]] = {}
     for layer in layers:
-        results[layer] = {}
-        # The ablation mask point is always the post-BN/activation layer,
-        # for every method — matching the reference sweep, which masks at
-        # find_best_module_for_attributions(module) regardless of how
-        # scores were computed (VGG notebook cell 8).  Zeroing there is
-        # what unit removal actually does.
-        eval_layer = (
-            find_best_evaluation_layer(model, layer)
-            if find_best_evaluation_layer_
-            else layer
-        )
-        # phase 1: score every (method, run); collect the rankings
-        pending = []  # (name, scores, score_seconds)
-        for name, factory in methods.items():
-            n_runs = (
-                runs_stochastic
-                if any(s in name.lower() for s in stochastic)
-                else 1
+        with obs.span("robustness_layer", layer=layer):
+            results[layer] = {}
+            # The ablation mask point is always the post-BN/activation
+            # layer, for every method — matching the reference sweep,
+            # which masks at find_best_module_for_attributions(module)
+            # regardless of how scores were computed (VGG notebook cell
+            # 8).  Zeroing there is what unit removal actually does.
+            eval_layer = (
+                find_best_evaluation_layer(model, layer)
+                if find_best_evaluation_layer_
+                else layer
             )
-            takes_run = bool(inspect.signature(factory).parameters)
-            for run_idx in range(n_runs):
-                t0 = time.perf_counter()
-                metric = factory(run_idx) if takes_run else factory()
-                scores = metric.run(
-                    layer,
-                    find_best_evaluation_layer=find_best_evaluation_layer_,
+            # phase 1: score every (method, run); collect the rankings
+            pending = []  # (name, scores, score_seconds)
+            for name, factory in methods.items():
+                n_runs = (
+                    runs_stochastic
+                    if any(s in name.lower() for s in stochastic)
+                    else 1
                 )
-                pending.append((name, scores, time.perf_counter() - t0))
+                takes_run = bool(inspect.signature(factory).parameters)
+                fbel = find_best_evaluation_layer_
+                for run_idx in range(n_runs):
+                    t0 = time.perf_counter()
+                    metric = factory(run_idx) if takes_run else factory()
+                    scores = metric.run(
+                        layer, find_best_evaluation_layer=fbel,
+                    )
+                    pending.append(
+                        (name, scores, time.perf_counter() - t0))
 
-        # phase 2: ONE batched walk for the whole method panel (each data
-        # batch's suffix forwards vectorize over all rankings; under a
-        # mesh the example dim additionally shards over the data axis)
-        if not pending:
-            continue
-        t0 = time.perf_counter()
-        curves = ablation_curves_batch(
-            model, params, state, layer,
-            np.stack([np.argsort(s) for _, s, _ in pending]),
-            test_data, loss_fn,
-            eval_layer=eval_layer, mesh=mesh, data_axis=data_axis,
-            compute_dtype=compute_dtype,
-        )
-        walk_share = (time.perf_counter() - t0) / len(pending)
+            # phase 2: ONE batched walk for the whole method panel (each
+            # data batch's suffix forwards vectorize over all rankings;
+            # under a mesh the example dim additionally shards over the
+            # data axis)
+            if not pending:
+                continue
+            t0 = time.perf_counter()
+            curves = ablation_curves_batch(
+                model, params, state, layer,
+                np.stack([np.argsort(s) for _, s, _ in pending]),
+                test_data, loss_fn,
+                eval_layer=eval_layer, mesh=mesh, data_axis=data_axis,
+                compute_dtype=compute_dtype,
+            )
+            walk_share = (time.perf_counter() - t0) / len(pending)
 
-        for (name, scores, score_s), curve in zip(pending, curves):
-            results[layer].setdefault(name, []).append({
-                "scores": scores,
-                "loss": curve["loss"],
-                "acc": curve["acc"],
-                "base_loss": curve["base_loss"],
-                "base_acc": curve["base_acc"],
-                "auc": loss_increase_auc(curve),
-                "seconds": score_s + walk_share,
-            })
-        if verbose:
-            for name, runs in results[layer].items():
-                aucs = [r["auc"] for r in runs]
-                print(
-                    f"[robustness] {layer} / {name}: auc "
-                    f"{np.mean(aucs):.4f} ± {np.std(aucs):.4f} "
-                    f"({runs[0]['seconds']:.1f}s/run)",
-                    flush=True,
-                )
-        if on_layer is not None:
-            on_layer(layer, results[layer])
+            for (name, scores, score_s), curve in zip(pending, curves):
+                results[layer].setdefault(name, []).append({
+                    "scores": scores,
+                    "loss": curve["loss"],
+                    "acc": curve["acc"],
+                    "base_loss": curve["base_loss"],
+                    "base_acc": curve["base_acc"],
+                    "auc": loss_increase_auc(curve),
+                    "seconds": score_s + walk_share,
+                })
+            if verbose:
+                for name, runs in results[layer].items():
+                    aucs = [r["auc"] for r in runs]
+                    print(
+                        f"[robustness] {layer} / {name}: auc "
+                        f"{np.mean(aucs):.4f} ± {np.std(aucs):.4f} "
+                        f"({runs[0]['seconds']:.1f}s/run)",
+                        flush=True,
+                    )
+            if on_layer is not None:
+                on_layer(layer, results[layer])
     return results
 
 

@@ -19,6 +19,7 @@ from typing import Optional, Tuple
 import jax
 import numpy as np
 
+from torchpruner_tpu import obs
 from torchpruner_tpu.checkpoint import restore_checkpoint, save_checkpoint
 from torchpruner_tpu.core.segment import SegmentedModel
 from torchpruner_tpu.data.native import (
@@ -126,17 +127,19 @@ def run_train(
         stream = epoch_batches(train, cfg, epoch)
         if cfg.device_prefetch:
             stream = device_prefetch(stream, size=cfg.device_prefetch)
-        for x, y in stream:
-            # keep the loss on device: a float() here would fence every
-            # step and forfeit both async dispatch and the prefetch; the
-            # periodic fence on a loss 8 steps back bounds dispatch
-            # run-ahead (each in-flight step pins its batch in HBM)
-            # without draining the pipeline
-            losses.append(trainer.step(x, y))
-            if len(losses) % 8 == 0:
-                jax.block_until_ready(losses[-8])
-        losses = [float(l) for l in losses]  # full sync once per epoch
-        test_loss, test_acc = trainer.evaluate(test_batches)
+        with obs.span("train", epoch=epoch):
+            for x, y in stream:
+                # keep the loss on device: a float() here would fence every
+                # step and forfeit both async dispatch and the prefetch; the
+                # periodic fence on a loss 8 steps back bounds dispatch
+                # run-ahead (each in-flight step pins its batch in HBM)
+                # without draining the pipeline
+                losses.append(trainer.step(x, y))
+                if len(losses) % 8 == 0:
+                    jax.block_until_ready(losses[-8])
+            losses = [float(l) for l in losses]  # full sync once per epoch
+        with obs.span("eval", epoch=epoch):
+            test_loss, test_acc = trainer.evaluate(test_batches)
         dt = time.perf_counter() - t0
         rec = {
             "epoch": epoch,

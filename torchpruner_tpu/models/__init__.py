@@ -6,7 +6,7 @@ the BASELINE.json capability targets: ResNet (filter pruning), ViT (head +
 MLP pruning), BERT (Linear pruning), and Llama (FFN channel pruning)."""
 
 from torchpruner_tpu.models.analytic import max_model
-from torchpruner_tpu.models.mlp import mnist_fc, cifar10_fc, digits_fc
+from torchpruner_tpu.models.mlp import fc_net, mnist_fc, cifar10_fc, digits_fc
 from torchpruner_tpu.models.convnet import digits_convnet, fmnist_convnet
 from torchpruner_tpu.models.vgg import vgg16_bn
 from torchpruner_tpu.models.resnet import resnet18, resnet20_cifar, resnet50

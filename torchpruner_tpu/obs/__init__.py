@@ -1,0 +1,236 @@
+"""Unified runtime telemetry: span tracing, step metrics, compile
+accounting, exporters.
+
+One :class:`ObsSession` per process, installed with :func:`configure` and
+torn down with :func:`shutdown`.  Instrumented code talks to the module
+functions — :func:`span`, :func:`record_step`, :func:`current_span_id` —
+which are no-ops (one global load + ``None`` check) when no session is
+active, so libraries can instrument unconditionally and pay nothing
+unless a driver turned telemetry on.
+
+Typical driver::
+
+    from torchpruner_tpu import obs
+
+    obs.configure(obs_dir="logs/obs")        # or obs_dir=None: summary only
+    with obs.span("run", experiment=cfg.name):
+        ...                                   # phases open nested spans
+    print(obs.shutdown(), file=sys.stderr)    # summary table; writes
+                                              # events.jsonl + metrics.prom
+
+Multi-host: only ``process_index == 0`` emits files (every process still
+tracks spans/metrics locally, so in-memory summaries work anywhere).
+The index is read lazily from ``jax.process_index()`` on first emission
+and can be overridden for tests via ``configure(process_index=...)``.
+
+Design refs: JaxPruner's cheap per-step instrumentation argument
+(arXiv:2304.14082) and the TPU structured-pruning study's MFU/step-time
+reporting (arXiv:2107.04191) — see PAPERS.md.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Any, Dict, Optional
+
+from torchpruner_tpu.obs.compile_watch import CompileWatcher
+from torchpruner_tpu.obs.exporters import (
+    JsonlWriter,
+    prometheus_text,
+    summary_table,
+    write_prometheus,
+)
+from torchpruner_tpu.obs.metrics import (
+    MetricsRegistry,
+    StepTelemetry,
+    record_device_memory,
+    train_flops_per_step,
+)
+from torchpruner_tpu.obs.spans import SpanRecord, SpanTracer
+
+__all__ = [
+    "ObsSession", "configure", "get", "shutdown", "span",
+    "current_span_id", "record_step", "record_grad_norm",
+    "configure_step_flops", "MetricsRegistry", "StepTelemetry",
+    "SpanTracer", "SpanRecord", "train_flops_per_step",
+    "prometheus_text", "summary_table",
+]
+
+EVENTS_FILENAME = "events.jsonl"
+PROM_FILENAME = "metrics.prom"
+
+_session: Optional["ObsSession"] = None
+
+
+class ObsSession:
+    """The wiring: tracer + registry + step telemetry + compile watcher
+    + (optional) file exporters rooted at ``obs_dir``."""
+
+    def __init__(self, obs_dir: Optional[str] = None,
+                 process_index: Optional[int] = None,
+                 annotate: bool = True, watch_compiles: bool = True):
+        self.obs_dir = obs_dir
+        self._process_index = process_index
+        self._closed = False
+        self.t_start = time.perf_counter()
+        self.metrics = MetricsRegistry()
+        self.events: Optional[JsonlWriter] = None
+        if obs_dir and self.is_emitter:
+            self.events = JsonlWriter(os.path.join(obs_dir, EVENTS_FILENAME))
+        self.tracer = SpanTracer(sink=self.events, annotate=annotate)
+        self.step = StepTelemetry(self.metrics)
+        self.compiles = CompileWatcher(self.metrics, self.tracer)
+        if watch_compiles:
+            self.compiles.start()
+        if self.events is not None:
+            self.events({
+                "event": "obs_init", "ts": time.time(), "pid": os.getpid(),
+                "process_index": self.process_index,
+            })
+
+    # -- multi-host gate ---------------------------------------------------
+
+    @property
+    def process_index(self) -> int:
+        if self._process_index is None:
+            try:
+                import jax
+
+                self._process_index = jax.process_index()
+            except Exception:
+                self._process_index = 0
+        return self._process_index
+
+    @property
+    def is_emitter(self) -> bool:
+        """True on the one process allowed to write files."""
+        return self.process_index == 0
+
+    # -- summaries / teardown ---------------------------------------------
+
+    def derived(self) -> Dict[str, Optional[float]]:
+        return self.step.derive()
+
+    def summary(self) -> str:
+        return summary_table(
+            self.tracer.phase_summary(), self.derived(),
+            self.compiles.counts(),
+            total_wall_s=time.perf_counter() - self.t_start,
+        )
+
+    def close(self) -> str:
+        """Stop listeners, flush files, return the terminal summary.
+        Idempotent: a second close reports again but never re-touches the
+        (already closed) event file."""
+        self.compiles.stop()
+        already_closed, self._closed = self._closed, True
+        derived = self.derived()          # writes derived gauges
+        record_device_memory(self.metrics)
+        text = summary_table(
+            self.tracer.phase_summary(), derived, self.compiles.counts(),
+            total_wall_s=time.perf_counter() - self.t_start,
+        )
+        if self.events is not None and not already_closed:
+            self.events({
+                "event": "run_summary", "ts": time.time(),
+                "wall_s": round(time.perf_counter() - self.t_start, 6),
+                "phases": self.tracer.phase_summary(),
+                "derived": derived,
+                "compiles": self.compiles.counts(),
+                "metrics": self.metrics.snapshot(),
+            })
+            self.events.close()
+        if self.obs_dir and self.is_emitter:
+            try:
+                write_prometheus(
+                    self.metrics, os.path.join(self.obs_dir, PROM_FILENAME))
+            except Exception:
+                pass
+        return text
+
+
+# -- module-level convenience (the instrumentation surface) -----------------
+
+
+def configure(obs_dir: Optional[str] = None, *,
+              process_index: Optional[int] = None, annotate: bool = True,
+              watch_compiles: bool = True) -> ObsSession:
+    """Install the process-wide session (replacing any previous one).
+    The new session is constructed BEFORE the old one is torn down, so a
+    failing constructor (e.g. unwritable ``obs_dir``) leaves the previous
+    session installed and intact."""
+    global _session
+    new = ObsSession(obs_dir, process_index=process_index,
+                     annotate=annotate, watch_compiles=watch_compiles)
+    if _session is not None:
+        _session.close()
+    _session = new
+    return new
+
+
+def get() -> Optional[ObsSession]:
+    return _session
+
+
+def shutdown(print_to=None) -> str:
+    """Tear down the active session; returns (and optionally prints) the
+    end-of-run summary table.  No-op empty string without a session."""
+    global _session
+    if _session is None:
+        return ""
+    text = _session.close()
+    _session = None
+    if print_to is not None:
+        print(text, file=print_to, flush=True)
+    return text
+
+
+def span(name: str, **meta):
+    """Open a named phase span (no-op context manager when telemetry is
+    off).  Usable as ``with obs.span("retrain", target=t):``."""
+    s = _session
+    if s is None:
+        return contextlib.nullcontext()
+    return s.tracer.span(name, **meta)
+
+
+def current_span_id() -> Optional[str]:
+    s = _session
+    return s.tracer.current_id() if s is not None else None
+
+
+def record_step(dt_s: float, examples: int, tokens: Optional[int] = None,
+                steps: int = 1):
+    """Per-train-step hot path — microseconds; see StepTelemetry."""
+    s = _session
+    if s is not None:
+        s.step.on_step(dt_s, examples, tokens, steps)
+
+
+def record_grad_norm(gnorm) -> None:
+    s = _session
+    if s is not None:
+        s.step.on_grad_norm(float(gnorm))
+
+
+def configure_step_flops(flops_per_step: Optional[float] = None,
+                         peak_flops: Optional[float] = None):
+    """Give the step telemetry its MFU denominators (training FLOPs per
+    step and the chip's spec-sheet peak).  When ``peak_flops`` is omitted,
+    the first local device's bf16 peak is looked up (None off-TPU —
+    MFU then stays unreported rather than guessed)."""
+    s = _session
+    if s is None:
+        return
+    if peak_flops is None:
+        try:
+            import jax
+
+            from torchpruner_tpu.utils.flops import peak_bf16_flops
+
+            peak_flops = peak_bf16_flops(jax.local_devices()[0])
+        except Exception:
+            peak_flops = None
+    s.step.configure(flops_per_step=flops_per_step, peak_flops=peak_flops)

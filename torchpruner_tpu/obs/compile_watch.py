@@ -1,0 +1,92 @@
+"""Compile-vs-execute accounting via ``jax.monitoring`` listeners.
+
+Every jit compilation fires ``/jax/core/compile/backend_compile_duration``
+and every (re)trace fires ``/jax/core/compile/jaxpr_trace_duration`` on
+the thread doing the work.  Counting them during a run answers the
+questions the static analyzer (tpu-lint) can only predict: how many
+recompiles did this prune schedule actually trigger, and how many
+seconds went to the compiler instead of the accelerator — attributed to
+the phase (span) that paid them.
+
+The listener registry is process-global in JAX, so :class:`CompileWatcher`
+keeps exactly one listener registered between :meth:`start` and
+:meth:`stop` and guards double-starts; the monitoring module is private
+(``jax._src.monitoring``), so every touch is wrapped — on a JAX version
+without it the watcher degrades to inert counters instead of failing.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+#: monitoring event key → (kind charged to spans, counter name)
+_EVENTS = {
+    "/jax/core/compile/backend_compile_duration":
+        ("compile", "compile_count_total", "compile_seconds_total"),
+    "/jax/core/compile/jaxpr_trace_duration":
+        ("trace", "trace_count_total", "trace_seconds_total"),
+    "/jax/core/compile/jaxpr_to_mlir_module_duration":
+        (None, "lower_count_total", "lower_seconds_total"),
+}
+
+
+class CompileWatcher:
+    """Counts compilations/retraces into ``registry`` and charges their
+    seconds to the innermost active span of ``tracer``."""
+
+    def __init__(self, registry, tracer=None):
+        self.registry = registry
+        self.tracer = tracer
+        self._listener: Optional[Callable] = None
+        for _, cname, sname in _EVENTS.values():
+            registry.counter(cname)
+            registry.counter(sname)
+
+    def start(self):
+        if self._listener is not None:
+            return
+        try:
+            from jax._src import monitoring
+        except Exception:
+            return
+
+        def listener(event: str, duration_secs: float, **kw):
+            spec = _EVENTS.get(event)
+            if spec is None:
+                return
+            kind, cname, sname = spec
+            self.registry.counter(cname).inc()
+            self.registry.counter(sname).inc(duration_secs)
+            if kind is not None and self.tracer is not None:
+                self.tracer.attribute_compile(kind, duration_secs)
+
+        try:
+            monitoring.register_event_duration_secs_listener(listener)
+            self._listener = listener
+        except Exception:
+            self._listener = None
+
+    def stop(self):
+        if self._listener is None:
+            return
+        try:
+            from jax._src import monitoring
+
+            monitoring._unregister_event_duration_listener_by_callback(
+                self._listener
+            )
+        except Exception:
+            pass
+        self._listener = None
+
+    def counts(self) -> dict:
+        """Current totals, rounded for reporting."""
+        g = self.registry.counter
+        return {
+            "compile_count": int(g("compile_count_total").value),
+            "compile_s": round(g("compile_seconds_total").value, 3),
+            "trace_count": int(g("trace_count_total").value),
+            "trace_s": round(g("trace_seconds_total").value, 3),
+            "lower_count": int(g("lower_count_total").value),
+            "lower_s": round(g("lower_seconds_total").value, 3),
+        }

@@ -15,6 +15,7 @@ recompilation economics in SURVEY.md §7.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -24,7 +25,9 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from torchpruner_tpu import obs
 from torchpruner_tpu.core.segment import SegmentedModel
+from torchpruner_tpu.train.loop import _batch_tokens
 from torchpruner_tpu.parallel.sharding import (
     batch_sharding,
     fsdp_sharding,
@@ -47,12 +50,15 @@ def make_sharded_train_step(
     remat: bool = False,
     accum_steps: int = 1,
     moe_aux_weight: float = 0.0,
+    grad_norm: bool = False,
 ):
     """Compile the SPMD train step with explicit in/out shardings.
     Mixed precision / remat / gradient accumulation come from the shared
     ``train.loop`` step body — one forward-and-update policy for the local
     and the SPMD steps.  With ``accum_steps``, each scanned microbatch
-    keeps its example dim sharded on ``data_axis``."""
+    keeps its example dim sharded on ``data_axis``.  ``grad_norm`` makes
+    the loss output a ``(loss, global grad norm)`` pair (XLA inserts the
+    cross-shard reduction; the ``rep`` out-sharding prefix covers both)."""
     from torchpruner_tpu.train.loop import make_loss_closure, make_step_body
 
     loss_c = make_loss_closure(model, loss_fn, compute_dtype, remat,
@@ -61,7 +67,7 @@ def make_sharded_train_step(
     rep = replicate(mesh)
 
     return jax.jit(
-        make_step_body(loss_c, tx, accum_steps),
+        make_step_body(loss_c, tx, accum_steps, grad_norm),
         in_shardings=(param_shardings, state_shardings, opt_shardings,
                       bs, bs, rep),
         out_shardings=(param_shardings, state_shardings, opt_shardings, rep),
@@ -95,7 +101,12 @@ class ShardedTrainer:
     accum_steps: int = 1
     #: >0 adds that multiple of the MoE load-balancing loss
     moe_aux_weight: float = 0.0
+    #: opt-in telemetry: step also returns the global grad norm
+    grad_norm: bool = False
     _step_fn: Any = field(default=None, repr=False)
+    #: previous step's end timestamp — see train.loop.Trainer._t_stream
+    #: (telemetry records return-to-return intervals within a streak)
+    _t_stream: Any = field(default=None, repr=False)
     step_count: int = 0
 
     @classmethod
@@ -114,6 +125,7 @@ class ShardedTrainer:
         remat: bool = False,
         accum_steps: int = 1,
         moe_aux_weight: float = 0.0,
+        grad_norm: bool = False,
     ) -> "ShardedTrainer":
         key = jax.random.PRNGKey(seed)
         params, state = model.init(key)
@@ -125,6 +137,7 @@ class ShardedTrainer:
             min_shard_size=min_shard_size, partition=partition,
             compute_dtype=compute_dtype, remat=remat,
             accum_steps=accum_steps, moe_aux_weight=moe_aux_weight,
+            grad_norm=grad_norm,
         )
         t._place()
         return t
@@ -155,16 +168,45 @@ class ShardedTrainer:
         return ps, ss, os_
 
     def _place(self):
-        ps, ss, os_ = self._shardings()
-        self.params = jax.device_put(self.params, ps)
-        self.state = jax.device_put(self.state, ss)
-        self.opt_state = jax.device_put(self.opt_state, os_)
-        self._step_fn = make_sharded_train_step(
-            self.model, self.tx, self.loss_fn, self.mesh, ps, ss, os_,
-            self.data_axis, compute_dtype=self.compute_dtype,
-            remat=self.remat, accum_steps=self.accum_steps,
-            moe_aux_weight=self.moe_aux_weight,
-        )
+        with obs.span("shard", partition=self.partition):
+            ps, ss, os_ = self._shardings()
+            self.params = jax.device_put(self.params, ps)
+            self.state = jax.device_put(self.state, ss)
+            self.opt_state = jax.device_put(self.opt_state, os_)
+            self._step_fn = make_sharded_train_step(
+                self.model, self.tx, self.loss_fn, self.mesh, ps, ss, os_,
+                self.data_axis, compute_dtype=self.compute_dtype,
+                remat=self.remat, accum_steps=self.accum_steps,
+                moe_aux_weight=self.moe_aux_weight,
+                grad_norm=self.grad_norm,
+            )
+            self._record_memory_budget(ps)
+
+    def _record_memory_budget(self, param_shardings):
+        """Planned per-chip bytes (parallel.memory.training_memory) as obs
+        gauges, plus live device bytes where the runtime reports them —
+        the HBM side of the step telemetry.  Best-effort: telemetry must
+        never block placement."""
+        session = obs.get()
+        if session is None:
+            return
+        try:
+            from torchpruner_tpu.obs.metrics import record_device_memory
+            from torchpruner_tpu.parallel.memory import training_memory
+
+            budget = training_memory(
+                self.model, param_shardings, dict(self.mesh.shape),
+                tx=self.tx, compute_dtype=self.compute_dtype,
+                remat=self.remat, params=self.params,
+            )
+            g = session.metrics.gauge
+            g("planned_params_bytes_per_chip").set(budget.params_bytes)
+            g("planned_grads_bytes_per_chip").set(budget.grads_bytes)
+            g("planned_opt_bytes_per_chip").set(budget.opt_bytes)
+            g("planned_total_bytes_per_chip").set(budget.total_bytes)
+            record_device_memory(session.metrics)
+        except Exception:
+            pass
 
     # -- training ----------------------------------------------------------
 
@@ -176,6 +218,16 @@ class ShardedTrainer:
             self.params, self.state, self.opt_state, x, y, sub
         )
         self.step_count += 1
+        if self.grad_norm:
+            l, gnorm = l
+            obs.record_grad_norm(gnorm)
+        now = time.perf_counter()
+        if self._t_stream is not None:
+            # first step of a streak: dispatch-only time, not recorded
+            # (see train.loop.Trainer.step)
+            obs.record_step(now - self._t_stream, x.shape[0],
+                            _batch_tokens(x, y))
+        self._t_stream = now
         return l
 
     def rebuild(self, model, params, state, opt_state) -> "ShardedTrainer":
@@ -189,7 +241,7 @@ class ShardedTrainer:
             model_axis=self.model_axis, min_shard_size=self.min_shard_size,
             partition=self.partition, compute_dtype=self.compute_dtype,
             remat=self.remat, accum_steps=self.accum_steps,
-            moe_aux_weight=self.moe_aux_weight,
+            moe_aux_weight=self.moe_aux_weight, grad_norm=self.grad_norm,
             step_count=self.step_count,
         )
         t._place()
@@ -204,6 +256,7 @@ class ShardedTrainer:
         while still counting exactly the real examples."""
         from torchpruner_tpu.train.loop import make_masked_eval_step
 
+        self._t_stream = None  # eval wall time is not step time
         # multi-process mesh: each host feeds its LOCAL shard (the same
         # contract as step()/shard_batch), pads to its addressable share
         # of the data axis, and the mask keeps global counts exact

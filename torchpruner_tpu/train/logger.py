@@ -53,23 +53,72 @@ CSV_FIELDS = [
     "prune_time",
     "prune_ratio",
     "train_loss",     # from-scratch training rows only (run_train)
+    "span_id",        # obs span active when the row was written ("" when
+                      # telemetry is off) — joins rows with the events.jsonl
+                      # phase stream (obs.current_span_id)
 ]
 
 
 @dataclass
 class CSVLogger:
-    """Append one row per prune step to ``path`` (+ ``path.jsonl``)."""
+    """Append one row per prune step to ``path`` (+ ``path.jsonl``).
+
+    - Appending to an EXISTING csv resumes: ``_step`` continues from the
+      last row's step id and the file's own header order is honored (a
+      pre-``span_id`` file keeps its narrower schema).
+    - File handles are opened once and held (flushed per row), not
+      reopened per write; the ``.jsonl`` mirror writes keys in the CSV
+      header order so both artifacts agree column-for-column.
+    """
 
     path: str
     experiment: str = "experiment"
     _step: int = 0
 
     def __post_init__(self):
-        new = not os.path.exists(self.path)
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        if new:
-            with open(self.path, "w", newline="") as f:
-                csv.DictWriter(f, CSV_FIELDS).writeheader()
+        self._fields = list(CSV_FIELDS)
+        header_needed = True
+        if os.path.exists(self.path) and os.path.getsize(self.path):
+            with open(self.path, newline="") as f:
+                reader = csv.reader(f)
+                header = next(reader, None)
+                if header:
+                    self._fields = header
+                    header_needed = False
+                last = None
+                for last in reader:
+                    pass
+            if last is not None and "step" in self._fields:
+                try:
+                    self._step = int(last[self._fields.index("step")]) + 1
+                except (ValueError, IndexError):
+                    pass
+        self._csv_f = open(self.path, "a", newline="")
+        self._writer = csv.DictWriter(self._csv_f, self._fields,
+                                      extrasaction="ignore")
+        if header_needed:
+            self._writer.writeheader()
+        self._jsonl_f = open(self.path + ".jsonl", "a")
+
+    def close(self):
+        for f in (getattr(self, "_csv_f", None),
+                  getattr(self, "_jsonl_f", None)):
+            if f is not None and not f.closed:
+                f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def log_prune_step(
         self,
@@ -146,7 +195,13 @@ class CSVLogger:
         self._step += 1
 
     def _write(self, row: dict):
-        with open(self.path, "a", newline="") as f:
-            csv.DictWriter(f, CSV_FIELDS).writerow(row)
-        with open(self.path + ".jsonl", "a") as f:
-            f.write(json.dumps(row) + "\n")
+        from torchpruner_tpu import obs
+
+        row.setdefault("span_id", obs.current_span_id() or "")
+        self._writer.writerow(row)
+        self._csv_f.flush()
+        # mirror in the CSV's own column order — consumers diffing the two
+        # artifacts see identical key sequences row for row
+        ordered = {k: row.get(k, "") for k in self._fields}
+        self._jsonl_f.write(json.dumps(ordered) + "\n")
+        self._jsonl_f.flush()

@@ -22,6 +22,7 @@ import numpy as np
 import jax.numpy as jnp
 import optax
 
+from torchpruner_tpu import obs
 from torchpruner_tpu.core.segment import SegmentedModel
 from torchpruner_tpu.utils.losses import accuracy
 
@@ -73,10 +74,13 @@ def make_loss_closure(model: SegmentedModel, loss_fn, compute_dtype=None,
 
 def make_train_step(model: SegmentedModel, tx, loss_fn, donate: bool = True,
                     compute_dtype=None, remat: bool = False,
-                    accum_steps: int = 1, moe_aux_weight: float = 0.0):
+                    accum_steps: int = 1, moe_aux_weight: float = 0.0,
+                    grad_norm: bool = False):
     """(params, state, opt_state, x, y, rng) -> (params, state, opt_state,
     loss).  Donation reuses the input buffers for the outputs.  Mixed
-    precision / remat per :func:`make_loss_closure`.
+    precision / remat per :func:`make_loss_closure`.  ``grad_norm=True``
+    makes the loss output a ``(loss, global grad norm)`` pair (opt-in
+    telemetry — the extra reduction is fused into the same program).
 
     ``accum_steps > 1`` = gradient accumulation: the batch splits into that
     many microbatches, a ``lax.scan`` inside the SAME jit accumulates their
@@ -89,14 +93,19 @@ def make_train_step(model: SegmentedModel, tx, loss_fn, donate: bool = True,
     loss_c = make_loss_closure(model, loss_fn, compute_dtype, remat,
                                moe_aux_weight)
     donate_argnums = (0, 2) if donate else ()
-    return jax.jit(make_step_body(loss_c, tx, accum_steps),
+    return jax.jit(make_step_body(loss_c, tx, accum_steps, grad_norm),
                    donate_argnums=donate_argnums)
 
 
-def make_step_body(loss_c, tx, accum_steps: int = 1):
+def make_step_body(loss_c, tx, accum_steps: int = 1,
+                   grad_norm: bool = False):
     """The un-jitted ``(params, state, opt_state, x, y, rng) -> (params,
     state, opt_state, loss)`` body shared by the local and SPMD trainers —
-    callers add their own ``jit`` (with explicit shardings for SPMD)."""
+    callers add their own ``jit`` (with explicit shardings for SPMD).
+    With ``grad_norm`` the last output is ``(loss, global grad norm)``."""
+
+    def _out(l, grads):
+        return (l, optax.global_norm(grads)) if grad_norm else l
 
     def step(params, state, opt_state, x, y, rng):
         (l, new_state), grads = jax.value_and_grad(
@@ -104,7 +113,7 @@ def make_step_body(loss_c, tx, accum_steps: int = 1):
         )(params)
         updates, new_opt = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
-        return new_params, new_state, new_opt, l
+        return new_params, new_state, new_opt, _out(l, grads)
 
     def step_accum(params, state, opt_state, x, y, rng):
         B = x.shape[0]
@@ -132,7 +141,7 @@ def make_step_body(loss_c, tx, accum_steps: int = 1):
         grads = jax.tree_util.tree_map(lambda g: g / accum_steps, gsum)
         updates, new_opt = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
-        return new_params, new_state, new_opt, lsum / accum_steps
+        return new_params, new_state, new_opt, _out(lsum / accum_steps, grads)
 
     return step if accum_steps <= 1 else step_accum
 
@@ -217,6 +226,15 @@ def make_masked_eval_step(model: SegmentedModel, loss_fn):
     return jax.jit(step)
 
 
+def _batch_tokens(x, y):
+    """Token count of one batch for LM workloads (targets carry a sequence
+    dim); ``None`` for classification — keeps ``tokens_per_s`` honest."""
+    shape = getattr(y, "shape", ())
+    if len(shape) >= 2:
+        return int(shape[0]) * int(shape[1])
+    return None
+
+
 def evaluate(model, params, state, data, loss_fn):
     """Average loss and accuracy over ``data`` (reference train.py:51-72).
     Loss averages per example; accuracy per prediction (== per example for
@@ -276,14 +294,27 @@ class Trainer:
     accum_steps: int = 1
     #: >0 adds that multiple of the MoE load-balancing loss
     moe_aux_weight: float = 0.0
+    #: opt-in telemetry: the compiled step also returns the global grad
+    #: norm, recorded via ``obs.record_grad_norm`` (one extra fused
+    #: reduction; off by default because fetching it adds a host read)
+    grad_norm: bool = False
     _step_fn: Any = field(default=None, repr=False)
     _multi_fn: Any = field(default=None, repr=False)
+    #: end timestamp of the previous step in the current stepping streak.
+    #: Step telemetry records RETURN-TO-RETURN intervals within a streak:
+    #: on an async backend the jitted call returns a future in
+    #: microseconds and the device time surfaces in the CALLER's fence
+    #: (train_epoch's float(loss), run_train's 8-back block) — which lands
+    #: between two step calls, so only the interval sees it.  evaluate()
+    #: and rebuild() break the streak (their wall time is not step time).
+    _t_stream: Any = field(default=None, repr=False)
     step_count: int = 0
 
     @classmethod
     def create(cls, model, tx, loss_fn, seed: int = 0, params=None,
                state=None, compute_dtype=None, remat: bool = False,
-               accum_steps: int = 1, moe_aux_weight: float = 0.0):
+               accum_steps: int = 1, moe_aux_weight: float = 0.0,
+               grad_norm: bool = False):
         key = jax.random.PRNGKey(seed)
         if params is None:
             params, state = model.init(key)
@@ -299,6 +330,7 @@ class Trainer:
             remat=remat,
             accum_steps=accum_steps,
             moe_aux_weight=moe_aux_weight,
+            grad_norm=grad_norm,
         )
 
     def step(self, x, y) -> float:
@@ -309,12 +341,24 @@ class Trainer:
                 remat=self.remat,
                 accum_steps=self.accum_steps,
                 moe_aux_weight=self.moe_aux_weight,
+                grad_norm=self.grad_norm,
             )
         self.rng, sub = jax.random.split(self.rng)
         self.params, self.state, self.opt_state, l = self._step_fn(
             self.params, self.state, self.opt_state, x, y, sub
         )
         self.step_count += 1
+        if self.grad_norm:
+            l, gnorm = l
+            obs.record_grad_norm(gnorm)
+        now = time.perf_counter()
+        if self._t_stream is not None:
+            # a streak's FIRST step is not recorded: on an async backend
+            # its within-call time is dispatch-only (µs) and would pollute
+            # the histogram floor and inflate derived throughput/MFU
+            obs.record_step(now - self._t_stream, x.shape[0],
+                            _batch_tokens(x, y))
+        self._t_stream = now
         return l
 
     def multi_step(self, xs, ys):
@@ -334,7 +378,16 @@ class Trainer:
          losses) = self._multi_fn(
             self.params, self.state, self.opt_state, xs, ys, self.rng
         )
-        self.step_count += int(xs.shape[0])
+        k = int(xs.shape[0])
+        self.step_count += k
+        now = time.perf_counter()
+        if self._t_stream is not None:  # see step(): first of a streak
+            yshape = getattr(ys, "shape", ())  # (K, B[, S]), no device read
+            tok = int(yshape[0] * yshape[1] * yshape[2]) \
+                if len(yshape) >= 3 else None
+            obs.record_step(now - self._t_stream, int(xs.shape[1]) * k,
+                            tok, steps=k)
+        self._t_stream = now
         return losses
 
     def rebuild(self, model, params, state, opt_state) -> "Trainer":
@@ -350,8 +403,10 @@ class Trainer:
             remat=self.remat,
             accum_steps=self.accum_steps,
             moe_aux_weight=self.moe_aux_weight,
+            grad_norm=self.grad_norm,
             step_count=self.step_count,
         )
 
     def evaluate(self, data):
+        self._t_stream = None  # eval wall time is not step time
         return evaluate(self.model, self.params, self.state, data, self.loss_fn)

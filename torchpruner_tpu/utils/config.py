@@ -97,6 +97,11 @@ class ExperimentConfig:
     checkpoint_path: str = ""        # save/resume training checkpoints here
     checkpoint_every_epochs: int = 0  # 0 = only at the end
 
+    #: opt-in runtime telemetry: the train step also computes the global
+    #: gradient norm, recorded as an obs gauge (one extra fused reduction
+    #: in the compiled step; off by default — see torchpruner_tpu.obs)
+    obs_grad_norm: bool = False
+
     seed: int = 0
     log_path: str = "logs/experiment.csv"
     #: when set, the robustness sweep writes its figures here (per-layer

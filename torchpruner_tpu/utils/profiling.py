@@ -178,7 +178,13 @@ def time_train_multi_step(trainer, xs, ys, iters: int = 5, warmup: int = 2,
 class StepTimer:
     """Accumulates per-phase wall-clock inside experiment loops (score /
     prune / recompile / finetune) — the breakdown the north-star metric
-    needs (SURVEY.md §7 'recompilation economics')."""
+    needs (SURVEY.md §7 'recompilation economics').
+
+    For new code prefer ``obs.span`` (same accounting plus JSONL events,
+    trace annotations and compile attribution); :meth:`from_span_jsonl`
+    rebuilds a StepTimer from an obs event stream so existing consumers
+    of ``summary()`` can read either source.
+    """
 
     totals: Dict[str, float] = field(default_factory=dict)
     counts: Dict[str, int] = field(default_factory=dict)
@@ -193,9 +199,73 @@ class StepTimer:
             self.totals[name] = self.totals.get(name, 0.0) + dt
             self.counts[name] = self.counts.get(name, 0) + 1
 
+    @classmethod
+    def from_span_jsonl(cls, path: str) -> "StepTimer":
+        """A StepTimer whose phases are the span names of an obs
+        ``events.jsonl`` (every ``span_end``'s duration, keyed by name;
+        latest run only — see :func:`load_span_events`)."""
+        timer = cls()
+        for name, v in span_phase_summary(path).items():
+            timer.totals[name] = v["total_s"]
+            timer.counts[name] = v["calls"]
+        return timer
+
     def summary(self) -> Dict[str, Dict[str, float]]:
         return {
             k: {"total_s": v, "calls": self.counts[k],
                 "mean_s": v / self.counts[k]}
             for k, v in self.totals.items()
         }
+
+
+def load_span_events(path: str, latest_run: bool = True) -> List[dict]:
+    """Parse an obs ``events.jsonl`` (one JSON object per line; malformed
+    lines — e.g. the torn last line of a killed run — are skipped).
+
+    The file is append-mode across sessions; every session opens with an
+    ``obs_init`` marker.  ``latest_run`` (default) returns only the
+    events after the LAST marker, so re-using an ``--obs-dir`` doesn't
+    double-count earlier runs in phase summaries (same contract as
+    ``trace_analysis.find_trace_files``)."""
+    import json
+
+    events: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(ev, dict):
+                continue
+            if latest_run and ev.get("event") == "obs_init":
+                events = []  # a new session starts: drop the earlier one
+            events.append(ev)
+    return events
+
+
+def span_phase_summary(path: str) -> Dict[str, Dict[str, float]]:
+    """Aggregate an obs event stream into per-phase runtime totals —
+    the join key for offline trace summaries
+    (``trace_analysis.summarize_trace(..., spans_jsonl=...)``)::
+
+        {name: {"total_s", "calls", "compile_s", "compile_count",
+                "trace_count"}}
+    """
+    out: Dict[str, Dict[str, float]] = {}
+    for ev in load_span_events(path):
+        if ev.get("event") != "span_end":
+            continue
+        agg = out.setdefault(ev.get("name", "?"), {
+            "total_s": 0.0, "calls": 0, "compile_s": 0.0,
+            "compile_count": 0, "trace_count": 0,
+        })
+        agg["total_s"] += float(ev.get("dur_s", 0.0))
+        agg["calls"] += 1
+        agg["compile_s"] += float(ev.get("compile_s", 0.0))
+        agg["compile_count"] += int(ev.get("compile_count", 0))
+        agg["trace_count"] += int(ev.get("trace_count", 0))
+    return out

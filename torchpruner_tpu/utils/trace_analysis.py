@@ -75,12 +75,21 @@ def find_trace_files(log_dir: str, latest_run: bool = True) -> List[str]:
 
 
 def summarize_trace(log_dir: str, top: int = 25,
-                    latest_run: bool = True) -> Dict:
+                    latest_run: bool = True,
+                    spans_jsonl: Optional[str] = None) -> Dict:
     """Aggregate the ``*.trace.json.gz`` of ``log_dir``'s newest profiler
     run (all runs with ``latest_run=False``).
 
     Returns ``{"total_ms", "by_category": {cat: ms}, "top_ops":
     [{"name", "ms", "pct", "category", "count"}, ...], "files"}``.
+
+    ``spans_jsonl`` joins an obs runtime event stream (the CLI's
+    ``--obs-dir``/``events.jsonl``) into the summary as a ``"phases"``
+    block — per-phase wall seconds and compile accounting next to the
+    device op table, so "where did the run spend its time" and "what ops
+    dominated" come from ONE artifact pair.  Malformed trace events
+    (missing pid/tid/name — seen on partial host-only captures) are
+    skipped, not KeyError'd.
     """
     files = find_trace_files(log_dir, latest_run=latest_run)
     if not files:
@@ -95,9 +104,10 @@ def summarize_trace(log_dir: str, top: int = 25,
             data = json.load(f)
         events = data.get("traceEvents", [])
         proc_names = {
-            e["pid"]: e.get("args", {}).get("name", "")
+            e["pid"]: (e.get("args") or {}).get("name", "")
             for e in events
             if e.get("ph") == "M" and e.get("name") == "process_name"
+            and "pid" in e
         }
         device_pids = {
             pid for pid, name in proc_names.items()
@@ -113,8 +123,9 @@ def summarize_trace(log_dir: str, top: int = 25,
             (e["pid"], e["tid"])
             for e in events
             if e.get("ph") == "M" and e.get("name") == "thread_name"
+            and "pid" in e and "tid" in e
             and e["pid"] in device_pids
-            and e.get("args", {}).get("name", "") in (
+            and (e.get("args") or {}).get("name", "") in (
                 "XLA Ops", "Async XLA Ops")
         }
         for e in events:
@@ -125,7 +136,9 @@ def summarize_trace(log_dir: str, top: int = 25,
                     continue
             elif device_pids and e.get("pid") not in device_pids:
                 continue
-            name = e.get("name", "")
+            name = e.get("name") or ""
+            if not name:  # nameless events can't be categorized — skip
+                continue
             # '$...' = Python frames; 'end: <op>' = nested completion
             # markers on host-only traces (counting them double-counts
             # the enclosing op)
@@ -150,7 +163,7 @@ def summarize_trace(log_dir: str, top: int = 25,
         }
         for name, us in sorted(durs.items(), key=lambda kv: -kv[1])[:top]
     ]
-    return {
+    out = {
         "total_ms": round(total_us / 1e3, 3),
         "by_category": {
             k: round(v / 1e3, 3)
@@ -159,24 +172,44 @@ def summarize_trace(log_dir: str, top: int = 25,
         "top_ops": top_ops,
         "files": files,
     }
+    if spans_jsonl:
+        from torchpruner_tpu.utils.profiling import span_phase_summary
+
+        out["phases"] = {
+            k: {"total_s": round(v["total_s"], 3), "calls": v["calls"],
+                "compile_s": round(v["compile_s"], 3),
+                "compile_count": v["compile_count"]}
+            for k, v in sorted(span_phase_summary(spans_jsonl).items(),
+                               key=lambda kv: -kv[1]["total_s"])
+        }
+    return out
 
 
 def markdown_summary(summary: Dict, top: Optional[int] = None) -> str:
     lines = [
-        f"Total op time: {summary['total_ms']:.1f} ms",
+        f"Total op time: {summary.get('total_ms', 0.0):.1f} ms",
         "",
         "| category | ms | % |",
         "|---|---|---|",
     ]
-    total = summary["total_ms"] or 1.0
-    for cat, ms in summary["by_category"].items():
+    total = summary.get("total_ms") or 1.0
+    for cat, ms in summary.get("by_category", {}).items():
         lines.append(f"| {cat} | {ms:.1f} | {100 * ms / total:.1f} |")
     lines += ["", "| op | category | ms | % | calls |", "|---|---|---|---|---|"]
-    for op in summary["top_ops"][: top or len(summary["top_ops"])]:
+    for op in summary.get("top_ops", [])[: top or None]:
         lines.append(
-            f"| `{op['name']}` | {op['category']} | {op['ms']} "
-            f"| {op['pct']} | {op['count']} |"
+            f"| `{op.get('name', '?')}` | {op.get('category', 'other')} "
+            f"| {op.get('ms', 0)} | {op.get('pct', 0)} "
+            f"| {op.get('count', 0)} |"
         )
+    if summary.get("phases"):
+        lines += ["", "| phase (runtime spans) | wall s | calls | "
+                      "compile s | compiles |", "|---|---|---|---|---|"]
+        for name, v in summary["phases"].items():
+            lines.append(
+                f"| {name} | {v['total_s']} | {v['calls']} "
+                f"| {v['compile_s']} | {v['compile_count']} |"
+            )
     return "\n".join(lines)
 
 
@@ -187,8 +220,14 @@ def main(argv=None):
     ap.add_argument("log_dir")
     ap.add_argument("--top", type=int, default=25)
     ap.add_argument("--json", action="store_true")
+    ap.add_argument(
+        "--spans", metavar="EVENTS_JSONL",
+        help="obs runtime event stream (--obs-dir's events.jsonl) to join "
+             "as a per-phase timing table",
+    )
     args = ap.parse_args(argv)
-    summary = summarize_trace(args.log_dir, top=args.top)
+    summary = summarize_trace(args.log_dir, top=args.top,
+                              spans_jsonl=args.spans)
     if args.json:
         print(json.dumps(summary, indent=1))
     else:
