@@ -384,7 +384,23 @@ def _leg_vgg_robustness(smoke: bool, progress=None) -> dict:
     # protocol (conservative: our 300-example measurement scaled up 10/3)
     adjusted_s = sweep_s * (1000.0 / max(1, len(test)))
     auc_stats = auc_summary_std(results)
+    # one-pass capture engine accounting, next to the generic obs row the
+    # leg wrapper attaches: hits/misses per scoring batch, the estimated
+    # prefix FLOPs the cache avoided, and the compile bill of the
+    # capture_fill span (CompileWatcher-attributed — the ≤2-prefix-
+    # programs invariant CI asserts on the smoke preset)
+    from torchpruner_tpu import obs as _obs
+
+    capture_row = dict(_obs.capture_counts())
+    _session = _obs.get()
+    if _session is not None:
+        fill = _session.tracer.phase_summary().get("capture_fill", {})
+        capture_row["fill_compile_count"] = int(
+            fill.get("compile_count", 0))
+        capture_row["fill_s"] = round(fill.get("total_s", 0.0), 3)
+        capture_row["fill_calls"] = int(fill.get("calls", 0))
     return {
+        "capture": capture_row,
         "value": round(sweep_s, 1),
         "unit": "s",
         "vs_baseline": round(SWEEP_BASELINE_S / adjusted_s, 3),

@@ -1,0 +1,286 @@
+"""One-pass sweep capture engine (attributions.base.ActivationCache).
+
+Pins the tentpole claims: (1) cached and uncached scoring/ablation are
+the SAME computation — all 8 panel methods' scores and the ablation
+curves agree with capture on/off, on both the single-device and the
+8-virtual-device mesh paths; (2) the whole multi-layer sweep compiles
+≤ 2 capture programs (one per batch shape) regardless of layer count —
+CompileWatcher-verified inside the ``capture_fill`` span; (3) mismatched
+or unsupported consumers fall back to the uncached path and are counted
+as misses, never silently served someone else's activations.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from torchpruner_tpu import obs
+from torchpruner_tpu.attributions.base import ActivationCache
+from torchpruner_tpu.core.graph import pruning_graph
+from torchpruner_tpu.core.segment import capture_fn, init_model
+from torchpruner_tpu.data.datasets import synthetic_dataset
+from torchpruner_tpu.experiments.robustness import (
+    ablation_curves_batch,
+    layerwise_robustness,
+    method_panel,
+)
+from torchpruner_tpu.models.mlp import fc_net
+from torchpruner_tpu.utils.losses import cross_entropy_loss
+
+
+def small_setup(n=32, bs=16, seed=0):
+    """A 3-hidden-layer MLP + synthetic batches: 3 prunable sites whose
+    eval layers shift through the LeakyReLUs."""
+    model = fc_net(16, hidden=(12, 10, 8))
+    params, state = init_model(model, seed=seed)
+    data = synthetic_dataset((16,), 10, n, seed=seed)
+    batches = [(jnp.asarray(x), jnp.asarray(y))
+               for x, y in data.batches(bs)]
+    return model, params, state, batches
+
+
+def run_sweep(model, params, state, batches, *, capture, mesh=None,
+              sv_samples=2):
+    methods = method_panel(model, params, batches, cross_entropy_loss,
+                           state=state, sv_samples=sv_samples)
+    if mesh is not None:
+        from torchpruner_tpu.parallel import DistributedScorer
+
+        base = methods
+
+        def wrap(factory):
+            def make(run=0):
+                return DistributedScorer(factory(run), mesh)
+            return make
+
+        methods = {name: wrap(f) for name, f in base.items()}
+    return layerwise_robustness(
+        model, params, state, batches, methods, cross_entropy_loss,
+        verbose=False, capture=capture, mesh=mesh,
+    )
+
+
+def assert_sweeps_equal(a, b, rtol=1e-5):
+    assert a.keys() == b.keys()
+    for layer in a:
+        assert a[layer].keys() == b[layer].keys()
+        for m in a[layer]:
+            for ra, rb in zip(a[layer][m], b[layer][m]):
+                np.testing.assert_allclose(
+                    ra["scores"], rb["scores"], rtol=rtol, atol=1e-6,
+                    err_msg=f"{layer}/{m} scores")
+                for k in ("loss", "acc", "base_loss", "base_acc"):
+                    np.testing.assert_allclose(
+                        ra[k], rb[k], rtol=rtol, atol=1e-6,
+                        err_msg=f"{layer}/{m} {k}")
+
+
+def test_capture_fn_matches_per_site_prefix():
+    """The ONE multi-site program emits exactly what L per-site prefix
+    runs would."""
+    model, params, state, batches = small_setup()
+    sites = ("act1", "act2", "act3")
+    fn = capture_fn(model, sites)
+    x = batches[0][0]
+    caps = fn(params, state, x)
+    for s in sites:
+        ref, _ = model.apply(params, x, state=state, to_layer=s)
+        np.testing.assert_array_equal(np.asarray(caps[s]),
+                                      np.asarray(ref))
+
+
+def test_panel_cached_vs_uncached_single_device():
+    """All 8 panel methods (incl. 3 stochastic repeats) and the ablation
+    walks: identical results with the capture engine on and off."""
+    model, params, state, batches = small_setup()
+    on = run_sweep(model, params, state, batches, capture=True)
+    off = run_sweep(model, params, state, batches, capture=False)
+    assert_sweeps_equal(on, off)
+
+
+def test_panel_cached_vs_uncached_mesh():
+    """Same equality through DistributedScorer + the SPMD ablation walk
+    on the 8-virtual-device mesh (cached activations are filled sharded
+    over the data axis)."""
+    from torchpruner_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"data": 8})
+    model, params, state, batches = small_setup(n=32, bs=16)
+    on = run_sweep(model, params, state, batches, capture=True, mesh=mesh)
+    off = run_sweep(model, params, state, batches, capture=False,
+                    mesh=mesh)
+    assert_sweeps_equal(on, off)
+    # and the mesh run equals the single-device run (same examples)
+    local = run_sweep(model, params, state, batches, capture=True)
+    assert_sweeps_equal(on, local, rtol=2e-5)
+
+
+def test_ablation_curves_batch_cached_matches():
+    model, params, state, batches = small_setup()
+    rankings = np.stack([np.argsort(np.arange(12)),
+                         np.argsort(-np.arange(12))])
+    cache = ActivationCache(model, params, batches, sites=("act1",),
+                            state=state)
+    kw = dict(eval_layer="act1")
+    a = ablation_curves_batch(model, params, state, "fc1", rankings,
+                              batches, cross_entropy_loss,
+                              capture_cache=cache, **kw)
+    b = ablation_curves_batch(model, params, state, "fc1", rankings,
+                              batches, cross_entropy_loss, **kw)
+    assert cache.hits > 0
+    for ca, cb in zip(a, b):
+        for k in ("loss", "acc", "base_loss", "base_acc"):
+            np.testing.assert_allclose(ca[k], cb[k], rtol=1e-5,
+                                       atol=1e-6, err_msg=k)
+
+
+def test_sweep_compiles_at_most_two_capture_programs():
+    """The CI invariant: prefix/capture compiles in the capture_fill span
+    stay ≤ 2 (one per distinct batch shape) no matter how many layers the
+    sweep walks — the O(L) compile bill collapses to O(1).  Uses a ragged
+    tail batch to exercise the =2 case, and CompileWatcher (not our own
+    counters) as the source of truth."""
+    model, params, state, _ = small_setup()
+    data = synthetic_dataset((16,), 10, 40, seed=0)
+    batches = [(jnp.asarray(x), jnp.asarray(y))
+               for x, y in data.batches(16)]  # 16, 16, 8: two shapes
+    session = obs.configure(None, process_index=0, annotate=False)
+    try:
+        run_sweep(model, params, state, batches, capture=True)
+        fill = session.tracer.phase_summary().get("capture_fill")
+        assert fill is not None, "capture_fill span never opened"
+        assert fill["calls"] == 1, "cache filled more than once"
+        assert fill["compile_count"] <= 2, fill
+        counts = obs.capture_counts()
+        assert counts["capture_hits"] > 0
+        assert counts["capture_misses"] == 0
+        assert counts["prefix_flops_saved"] > 0
+    finally:
+        obs.shutdown()
+
+
+def test_mismatched_metric_falls_back_and_counts_miss():
+    """A metric scoring DIFFERENT data than the cache was built from must
+    recompute its own prefix (correct scores), counted as a miss."""
+    from torchpruner_tpu.attributions import TaylorAttributionMetric
+
+    model, params, state, batches = small_setup()
+    other = synthetic_dataset((16,), 10, 32, seed=9)
+    other_batches = [(jnp.asarray(x), jnp.asarray(y))
+                     for x, y in other.batches(16)]
+    cache = ActivationCache(model, params, batches, sites=("act1",),
+                            state=state)
+    m = TaylorAttributionMetric(model, params, other_batches,
+                                cross_entropy_loss, state=state)
+    m.capture_cache = cache
+    got = m.run("fc1", find_best_evaluation_layer=True)
+    m2 = TaylorAttributionMetric(model, params, other_batches,
+                                 cross_entropy_loss, state=state)
+    ref = m2.run("fc1", find_best_evaluation_layer=True)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    assert cache.misses > 0 and cache.hits == 0
+
+
+def test_forced_masking_path_declines_cache():
+    """Shapley with use_partial=False cannot resume from a captured
+    activation — it must decline (miss) and still match the fast path."""
+    from torchpruner_tpu.attributions import ShapleyAttributionMetric
+
+    model, params, state, batches = small_setup()
+    cache = ActivationCache(model, params, batches, sites=("act1",),
+                            state=state)
+
+    def scores(use_partial, with_cache):
+        m = ShapleyAttributionMetric(
+            model, params, batches, cross_entropy_loss, state=state,
+            sv_samples=4, use_partial=use_partial, seed=3)
+        if with_cache:
+            m.capture_cache = cache
+        return m.run("fc1", find_best_evaluation_layer=True)
+
+    slow = scores(False, True)
+    assert cache.misses > 0
+    fast = scores(True, True)
+    assert cache.hits > 0
+    np.testing.assert_allclose(slow, fast, rtol=1e-4, atol=1e-5)
+
+
+def test_mesh_sweep_with_bn_state_hits_and_matches():
+    """Non-empty (BatchNorm) state on the mesh path: the sweep aliases
+    the replicated state copy, so the guards keep serving (no spurious
+    misses) and results equal the uncached run."""
+    from torchpruner_tpu.models import vgg16_bn
+    from torchpruner_tpu.parallel import DistributedScorer, make_mesh
+    from torchpruner_tpu.data import load_dataset
+
+    model = vgg16_bn(width_multiplier=0.125, classifier_width=64)
+    params, state = init_model(model, seed=0)
+    assert state  # BN running stats — the non-empty-state case
+    test = load_dataset("digits32", "test", n=16, seed=0)
+    batches = [(jnp.asarray(x), jnp.asarray(y))
+               for x, y in test.batches(16)]
+    mesh = make_mesh({"data": 8})
+    session = obs.configure(None, process_index=0, annotate=False)
+    try:
+        def sweep(capture):
+            base = method_panel(model, params, batches,
+                                cross_entropy_loss, state=state,
+                                sv_samples=2)
+            methods = {
+                n: (lambda f: (lambda run=0:
+                               DistributedScorer(f(run), mesh)))(f)
+                for n, f in base.items()
+            }
+            return layerwise_robustness(
+                model, params, state, batches, methods,
+                cross_entropy_loss, layers=["conv2"], verbose=False,
+                capture=capture, mesh=mesh)
+
+        on = sweep(True)
+        counts = obs.capture_counts()
+        assert counts["capture_misses"] == 0, counts
+        assert counts["capture_hits"] > 0, counts
+        off = sweep(False)
+        assert_sweeps_equal(on, off)
+    finally:
+        obs.shutdown()
+
+
+def test_drop_releases_site_and_sweep_drops_finished_layers():
+    """drop() frees a site's activations/gradients; the sweep drops each
+    layer's site once its panel is done (bounding the cache to live
+    sites, not O(L × dataset))."""
+    model, params, state, batches = small_setup()
+    cache = ActivationCache(model, params, batches,
+                            sites=("act1", "act2"), state=state)
+    list(cache.batches_for("act1"))  # fill
+    assert all("act2" in caps for caps, _ in cache._batches)
+    cache.drop("act2")
+    assert not cache.has("act2")
+    assert all("act2" not in caps for caps, _ in cache._batches)
+    assert cache.has("act1")  # untouched
+
+
+def test_nested_sites_are_skipped_not_cached():
+    """needs_taps sites (inside a Residual body) never enter the cache —
+    they stay on the instrumented full-forward path."""
+    from torchpruner_tpu.core import layers as L
+    from torchpruner_tpu.core.segment import SegmentedModel
+
+    model = SegmentedModel(
+        (L.Dense("fc1", 8), L.Activation("a1", "relu"),
+         L.Residual("blk", body=(L.Dense("inner", 8),
+                                 L.Activation("ia", "relu"),
+                                 L.Dense("proj", 8))),
+         L.Dense("out", 4)),
+        (16,),
+    )
+    params, state = init_model(model, seed=0)
+    data = synthetic_dataset((16,), 4, 16, seed=0)
+    batches = [(jnp.asarray(x), jnp.asarray(y))
+               for x, y in data.batches(16)]
+    cache = ActivationCache(model, params, batches,
+                            sites=("a1", "blk/ia"), state=state)
+    assert cache.sites == ("a1",)
+    assert cache.skipped_sites == ("blk/ia",)
+    assert not cache.has("blk/ia")

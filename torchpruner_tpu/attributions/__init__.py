@@ -7,9 +7,18 @@ jit-compiled functional scorers::
     metric = ShapleyAttributionMetric(model, params, data, loss_fn,
                                       state=state, sv_samples=5)
     scores = metric.run("fc1", find_best_evaluation_layer=True)
+
+Sweeps that score many metrics/layers over the same data share a
+one-pass :class:`ActivationCache` (install on ``metric.capture_cache``;
+``layerwise_robustness`` does this automatically): one compiled forward
+captures every eval site's activation, and row computation resumes from
+the cached ``z`` instead of re-running the prefix per metric × batch.
 """
 
-from torchpruner_tpu.attributions.base import AttributionMetric
+from torchpruner_tpu.attributions.base import (
+    ActivationCache,
+    AttributionMetric,
+)
 from torchpruner_tpu.attributions.simple import (
     RandomAttributionMetric,
     WeightNormAttributionMetric,
@@ -22,6 +31,7 @@ from torchpruner_tpu.attributions.activation import (
 from torchpruner_tpu.attributions.shapley import ShapleyAttributionMetric
 
 __all__ = [
+    "ActivationCache",
     "AttributionMetric",
     "RandomAttributionMetric",
     "WeightNormAttributionMetric",

@@ -23,6 +23,54 @@ from torchpruner_tpu.attributions.base import (
 )
 
 
+class _GradRowsMetric(AttributionMetric):
+    """Shared base of the forward/backward activation metrics: one
+    ``mode`` string selects the row math; cached (from-``z``) and
+    uncached row fns come from the same pair of compiled cores."""
+
+    mode: str = ""
+
+    def _mode(self) -> str:
+        return self.mode
+
+    def make_row_fn(self, eval_layer, **kw):
+        return grad_rows_fn(self.model, eval_layer, self.loss_fn,
+                            self._mode())
+
+    def make_cached_row_fn(self, eval_layer, **kw):
+        if needs_taps(self.model, eval_layer):
+            # the capture cache never holds these sites, but guard anyway:
+            # the from-z core resumes at a segment boundary only
+            return None
+        return grad_rows_from_z_fn(self.model, eval_layer, self.loss_fn,
+                                   self._mode())
+
+    def cached_row_stream(self, eval_layer, **kw):
+        """Gradient modes additionally share ONE memoized suffix gradient
+        per (site, loss) across the whole panel (``cache.grads_for``):
+        Sensitivity/Taylor/signed-Taylor reduce to elementwise row math
+        on the shared ``(z, g)``.  APoZ (no gradient) and every
+        miss/fallback case defer to the base implementation."""
+        cache = self.capture_cache
+        mode = self._mode()
+        if (cache is None or mode == "apoz"
+                or not cache.matches(self)
+                or not cache.has(eval_layer)
+                or needs_taps(self.model, eval_layer)):
+            return super().cached_row_stream(eval_layer, **kw)
+        cache.record_hit(eval_layer)
+        finish = finish_rows_fn(mode)
+        params = self.cast(self.params)
+
+        def gen():
+            grads = cache.grads_for(eval_layer, self.loss_fn, params,
+                                    self.state)
+            for (z, _y), g in zip(cache.batches_for(eval_layer), grads):
+                yield jnp.asarray(finish(z, g), jnp.float32)
+
+        return gen()
+
+
 def _finish(mode, z, g):
     # row math in f32 even under bf16 scoring: the spatial sum over a
     # feature map accumulates thousands of terms — the 'rows stay f32'
@@ -77,13 +125,30 @@ def grad_rows_fn(model, eval_layer, loss_fn, mode: str):
 
         return fn
 
-    suffix = suffix_loss_fn(model, eval_layer, loss_fn)
+    from_z = grad_rows_from_z_fn(model, eval_layer, loss_fn, mode)
 
     @jax.jit
     def fn(params, state, x, y):
         z, _ = model.apply(
             params, x, state=state, train=False, to_layer=eval_layer
         )
+        return from_z(params, state, z, y)
+
+    return fn
+
+
+@functools.lru_cache(maxsize=512)
+def grad_rows_from_z_fn(model, eval_layer, loss_fn, mode: str):
+    """jit: (params, state, z, y) -> (batch, n_units) rows from the
+    CAPTURED eval-site activation ``z`` — the prefix-free core of
+    :func:`grad_rows_fn` (which computes ``z`` itself and delegates here,
+    so cached and uncached rows are the same computation by construction).
+    What the one-pass sweep engine dispatches to when the activation
+    cache holds the site."""
+    suffix = suffix_loss_fn(model, eval_layer, loss_fn)
+
+    @jax.jit
+    def fn(params, state, z, y):
         if mode == "apoz":
             return spatial_sum((z > 0).astype(jnp.float32))
 
@@ -96,23 +161,53 @@ def grad_rows_fn(model, eval_layer, loss_fn, mode: str):
     return fn
 
 
-class APoZAttributionMetric(AttributionMetric):
+@functools.lru_cache(maxsize=512)
+def suffix_grad_fn(model, eval_layer, loss_fn):
+    """jit: (params, state, z, y) -> dL/dz of the batch-mean loss through
+    the model suffix — the ONE gradient program Sensitivity / Taylor /
+    signed-Taylor share on a layer.  The activation cache memoizes its
+    per-batch output (``ActivationCache.grads_for``), so a sweep panel
+    pays one suffix vjp per batch instead of one per gradient metric,
+    and compiles one suffix-vjp executable instead of three."""
+    suffix = suffix_loss_fn(model, eval_layer, loss_fn)
+
+    @jax.jit
+    def fn(params, state, z, y):
+        def mean_loss(z_):
+            return jnp.mean(suffix(params, state, z_, y))
+
+        return jax.grad(mean_loss)(z)
+
+    return fn
+
+
+@functools.lru_cache(maxsize=16)
+def finish_rows_fn(mode: str):
+    """jit: (z, g) -> rows — the per-mode row math on a shared gradient
+    (elementwise + spatial sum; compiles in milliseconds)."""
+
+    @jax.jit
+    def fn(z, g):
+        return _finish(mode, z, g)
+
+    return fn
+
+
+class APoZAttributionMetric(_GradRowsMetric):
     """1−APoZ: per-example count of positive activations per unit (Hu et al.;
     reference apoz.py:15-39). Higher = more alive."""
 
-    def make_row_fn(self, eval_layer, **kw):
-        return grad_rows_fn(self.model, eval_layer, self.loss_fn, "apoz")
+    mode = "apoz"
 
 
-class SensitivityAttributionMetric(AttributionMetric):
+class SensitivityAttributionMetric(_GradRowsMetric):
     """Average absolute gradient of the loss w.r.t. each unit's activation
     (Mittal et al.; reference sensitivity.py:13-34)."""
 
-    def make_row_fn(self, eval_layer, **kw):
-        return grad_rows_fn(self.model, eval_layer, self.loss_fn, "sensitivity")
+    mode = "sensitivity"
 
 
-class TaylorAttributionMetric(AttributionMetric):
+class TaylorAttributionMetric(_GradRowsMetric):
     """First-order Taylor expansion |−g·a| of the loss change on unit removal
     (Molchanov et al.; reference taylor.py:6-49). ``signed=True`` keeps the
     sign (reference taylor.py:44-45)."""
@@ -121,6 +216,5 @@ class TaylorAttributionMetric(AttributionMetric):
         super().__init__(*args, **kwargs)
         self.signed = signed
 
-    def make_row_fn(self, eval_layer, **kw):
-        mode = "taylor_signed" if self.signed else "taylor"
-        return grad_rows_fn(self.model, eval_layer, self.loss_fn, mode)
+    def _mode(self) -> str:
+        return "taylor_signed" if self.signed else "taylor"

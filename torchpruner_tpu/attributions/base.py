@@ -20,15 +20,16 @@ PRNG keys.
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 import jax.numpy as jnp
 
+from torchpruner_tpu import obs
 from torchpruner_tpu.core import layers as L
 from torchpruner_tpu.core.graph import find_best_evaluation_layer
-from torchpruner_tpu.core.segment import SegmentedModel
+from torchpruner_tpu.core.segment import SegmentedModel, capture_fn
 
 
 class AttributionMetric:
@@ -58,6 +59,12 @@ class AttributionMetric:
     #: metrics, reference weight_norm.py:21 / random.py:12).
     shiftable = True
 
+    #: whether scoring runs model forwards over the dataset (False for the
+    #: weight-only metrics, which override ``run`` and never build a row
+    #: fn) — what the capture cache and the distributed scorer key on
+    #: instead of reflecting on ``make_row_fn``.
+    data_dependent = True
+
     def __init__(
         self,
         model: SegmentedModel,
@@ -78,6 +85,12 @@ class AttributionMetric:
         self.reduction = reduction
         self.seed = seed
         self.compute_dtype = compute_dtype
+        #: an :class:`ActivationCache` installed by a sweep driver
+        #: (robustness.layerwise_robustness): when it matches this
+        #: metric's model/params/data/dtype, row computation starts from
+        #: the cached eval-site activation instead of re-running the
+        #: prefix forward per batch.
+        self.capture_cache: Optional["ActivationCache"] = None
 
     # ------------------------------------------------------------------ api
 
@@ -103,6 +116,9 @@ class AttributionMetric:
         return layer
 
     def compute_rows(self, layer: str, eval_layer: str, **kw) -> np.ndarray:
+        stream = self.cached_row_stream(eval_layer, **kw)
+        if stream is not None:
+            return np.asarray(jnp.concatenate(list(stream), axis=0))
         return self._collect(self.make_row_fn(eval_layer, **kw))
 
     def make_row_fn(self, eval_layer: str, **kw):
@@ -114,6 +130,45 @@ class AttributionMetric:
             f"{type(self).__name__} does not implement make_row_fn "
             "(weight-only metrics override run() instead)"
         )
+
+    def make_cached_row_fn(self, eval_layer: str, **kw):
+        """Return the jit row function ``(params, state, z, y) ->
+        (batch, n_units)`` consuming the CAPTURED activation ``z`` at
+        ``eval_layer`` — the prefix-free form of :meth:`make_row_fn` the
+        one-pass sweep engine dispatches to.  ``None`` (the default) means
+        this metric/site cannot start from a cached activation (weight-only
+        metrics; sites that need full-forward instrumentation) and the
+        caller falls back to the uncached path."""
+        return None
+
+    def cached_row_stream(self, eval_layer: str, **kw):
+        """A generator of per-batch f32 row arrays computed from the
+        installed capture cache, or ``None`` when the cache is absent,
+        mismatched, or cannot serve this metric/site.  Shared by the local
+        collector and the distributed scorer (the cache stores activations
+        already sharded over the data axis when built with a mesh), and
+        the single place hit/miss accounting happens."""
+        cache = self.capture_cache
+        if cache is None or not self.data_dependent:
+            return None
+        if not cache.matches(self):
+            cache.record_miss(eval_layer)
+            return None
+        fn = None
+        if cache.has(eval_layer):
+            fn = self.make_cached_row_fn(eval_layer, **kw)
+        if fn is None:
+            cache.record_miss(eval_layer)
+            return None
+        cache.record_hit(eval_layer)
+
+        def gen():
+            params = self.cast(self.params)
+            for z, y in cache.batches_for(eval_layer):
+                yield jnp.asarray(fn(params, self.state, z, y),
+                                  jnp.float32)
+
+        return gen()
 
     def aggregate_over_samples(self, rows: np.ndarray) -> np.ndarray:
         if self.reduction == "mean":
@@ -154,12 +209,23 @@ class AttributionMetric:
 
     def _collect(self, row_fn) -> np.ndarray:
         """Run ``row_fn`` over the dataset, stacking per-example rows
-        (always f32 on host, whatever the compute dtype)."""
+        (always f32 on host, whatever the compute dtype).
+
+        Rows stay DEVICE-resident across the loop — each batch's dispatch
+        is async, so batch k+1's host-side prep overlaps batch k's device
+        compute — and the host pays ONE fetch for the stacked matrix at
+        the end instead of a blocking ``np.asarray`` fence per batch
+        (the reference's per-batch numpy accumulation, and our old
+        behavior, kept the accelerator idle between batches)."""
         params = self.cast(self.params)
         out = []
         for x, y in self.batches():
-            out.append(np.asarray(self.run_rows(row_fn, params, x, y)))
-        return np.concatenate(out, axis=0)
+            out.append(self.run_rows(row_fn, params, x, y))
+        if not out:
+            raise ValueError(
+                f"{type(self).__name__}: empty dataset — no batches to "
+                "score")
+        return np.asarray(jnp.concatenate(out, axis=0))
 
 
 # ---------------------------------------------------------------------------
@@ -219,3 +285,252 @@ def spatial_sum(rows: jnp.ndarray) -> jnp.ndarray:
     if rows.ndim <= 2:
         return rows
     return rows.sum(axis=tuple(range(1, rows.ndim - 1)))
+
+
+# ---------------------------------------------------------------------------
+# One-pass sweep capture engine
+# ---------------------------------------------------------------------------
+
+
+class ActivationCache:
+    """Cross-layer activation capture shared by a whole scoring sweep.
+
+    The layerwise sweep evaluates every metric × stochastic run × the
+    ablation walk at L eval sites; without sharing, each recomputes the
+    prefix forward (input → site) per batch — O(L²) prefix layer-forwards
+    and L distinct compiled prefix programs across the sweep.  This cache
+    runs ONE compiled multi-site program (``core.segment.capture_fn``)
+    once per batch, stores each site's activation DEVICE-resident, and
+    serves them to every consumer: total prefix work drops to O(L) and
+    the prefix executables collapse into one (two with a ragged tail
+    batch).
+
+    - ``sites`` is filtered to segment-boundary sites (``needs_taps``
+      sites — nested or attention-head — cannot resume a suffix and stay
+      on the uncached path, counted as misses).
+    - ``compute_dtype`` applies the same float-cast policy the metrics
+      use (``bf16 forwards, f32 rows``), so cached and uncached rows
+      agree.
+    - With ``mesh``, batches are sharded over ``data_axis`` at fill time;
+      consumers' row fns then run SPMD on the stored activations with no
+      further placement (parallel.scoring.DistributedScorer's path).
+    - The fill happens lazily on first use, inside an obs
+      ``capture_fill`` span, so CompileWatcher attributes the (single)
+      capture compile to it — the CI bound "prefix compiles ≤ 2" reads
+      that span.
+
+    Consumers guard with :meth:`matches` (same model/params/data/state/
+    dtype objects) — a metric scoring different data or weights falls
+    back to computing its own prefix rather than silently reading
+    someone else's activations.
+    """
+
+    def __init__(self, model: SegmentedModel, params, data, *,
+                 sites: Sequence[str], state=None, compute_dtype=None,
+                 mesh=None, data_axis: str = "data"):
+        self.model = model
+        self.params = params          # identity anchor for matches()
+        self.state = state if state is not None else {}
+        self.data = data
+        self.compute_dtype = compute_dtype
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.sites: Tuple[str, ...] = tuple(dict.fromkeys(
+            s for s in sites if not needs_taps(model, s)))
+        self.skipped_sites: Tuple[str, ...] = tuple(
+            s for s in dict.fromkeys(sites) if needs_taps(model, s))
+        #: filled lazily: list of ({site: activation}, y) per batch
+        self._batches: Optional[List[Tuple[Dict[str, Any], Any]]] = None
+        self._param_aliases: set = set()
+        self._state_aliases: set = set()
+        #: mesh-placed copies registered by alias_params/alias_state —
+        #: _fill reuses them instead of re-replicating from host
+        self._params_placed = None
+        self._state_placed = None
+        #: (site, loss_fn) -> [dL/dz per batch]: the shared per-layer
+        #: suffix gradient (see :meth:`grads_for`)
+        self._grads: Dict[Tuple[str, Any], List[Any]] = {}
+        self.hits = 0
+        self.misses = 0
+        self.prefix_flops_saved = 0.0
+        self._examples = 0
+        # per-example prefix-FLOPs estimate per site (computed once; used
+        # to price each hit for the obs gauge)
+        from torchpruner_tpu.utils.flops import prefix_flops_estimate
+
+        self._site_flops = {
+            s: prefix_flops_estimate(model, params, s, batch_size=1)
+            for s in self.sites
+        }
+
+    # -- guards ------------------------------------------------------------
+
+    def matches(self, metric: AttributionMetric) -> bool:
+        """True when ``metric`` scores the exact objects this cache was
+        built from (identity, not equality — the cheap check that cannot
+        false-positive)."""
+        return self.provides_for(
+            metric.model, metric.params, metric.state, metric.data,
+            metric.compute_dtype,
+        )
+
+    def provides_for(self, model, params, state, data,
+                     compute_dtype) -> bool:
+        return (
+            model is self.model
+            and self.owns_params(params)
+            and self.owns_state(state)
+            and data is self.data
+            and compute_dtype == self.compute_dtype
+        )
+
+    def alias_params(self, params) -> None:
+        """Register another pytree holding the SAME parameter values (a
+        mesh-replicated copy the sweep made) as valid for consumers'
+        identity guards.  The latest alias is also reused by the fill as
+        the already-placed tree, skipping a second host→device
+        replication."""
+        self._param_aliases.add(id(params))
+        self._params_placed = params
+
+    def alias_state(self, state) -> None:
+        """Same as :meth:`alias_params`, for the state pytree."""
+        self._state_aliases.add(id(state))
+        self._state_placed = state
+
+    def owns_params(self, params) -> bool:
+        return params is self.params or id(params) in self._param_aliases
+
+    def owns_state(self, state) -> bool:
+        return (state is self.state
+                or (not state and not self.state)
+                or id(state) in self._state_aliases)
+
+    def has(self, site: str) -> bool:
+        return site in self.sites
+
+    # -- fill / serve ------------------------------------------------------
+
+    def _fill(self):
+        if self._batches is not None:
+            return
+        if not self.sites:
+            self._batches = []
+            return
+        from torchpruner_tpu.utils.dtypes import cast_floats
+
+        fn = capture_fn(self.model, self.sites)
+        # prefer the mesh-placed copies a sweep registered via
+        # alias_params/alias_state: the cast below then runs on-device on
+        # the already-replicated tree instead of paying a second
+        # host→device replication of the full model
+        params = self._params_placed if self._params_placed is not None \
+            else self.params
+        state = self._state_placed if self._state_placed is not None \
+            else self.state
+        if self.compute_dtype is not None:
+            params = cast_floats(params, self.compute_dtype)
+        put = lambda t: t  # noqa: E731 - identity on a single device
+        if self.mesh is not None:
+            from torchpruner_tpu.parallel.sharding import (
+                batch_sharding,
+                replicate,
+            )
+
+            if self._params_placed is None:
+                params = jax.device_put(params, replicate(self.mesh))
+            if state and self._state_placed is None:
+                state = jax.device_put(state, replicate(self.mesh))
+            bs = batch_sharding(self.mesh, self.data_axis)
+            put = lambda t: jax.device_put(t, bs)  # noqa: E731
+        # batch prep (asarray / cast / placement) happens OUTSIDE the
+        # span so capture_fill's compile bill is the capture program
+        # alone — the invariant CI asserts is "capture executables ≤ 2",
+        # not "≤ 2 plus a convert per batch shape"
+        prepared = []
+        n = 0
+        for x, y in (self.data() if callable(self.data)
+                     else iter(self.data)):
+            x = jnp.asarray(x)
+            if self.compute_dtype is not None:
+                x = cast_floats(x, self.compute_dtype)
+            prepared.append((put(x), put(jnp.asarray(y))))
+            n += int(np.shape(x)[0])
+        filled = []
+        with obs.span("capture_fill", sites=len(self.sites)):
+            for x, y in prepared:
+                filled.append((fn(params, state, x), y))
+        self._batches = filled
+        self._examples = n
+
+    def batches_for(self, site: str):
+        """Yield ``(z, y)`` device arrays per batch for ``site`` (fills
+        the cache on first use)."""
+        self._fill()
+        for caps, y in self._batches:
+            yield caps[site], y
+
+    def grads_for(self, site: str, loss_fn, params, state) -> List[Any]:
+        """Memoized per-batch suffix gradient dL/dz at ``site`` — the
+        SHARED per-layer scoring state: Sensitivity, Taylor and
+        signed-Taylor all differentiate the same batch-mean loss through
+        the same suffix, so the panel computes (and compiles) that vjp
+        once per (site, loss) and each metric keeps only its elementwise
+        row math.  ``params`` must already carry the metric's cast (the
+        guard in ``matches`` pins every consumer to the same params
+        values and compute dtype, so the first caller's cast is
+        everyone's cast).  Device-resident, like the activations."""
+        key = (site, loss_fn)
+        if key not in self._grads:
+            from torchpruner_tpu.attributions.activation import (
+                suffix_grad_fn,
+            )
+
+            gfn = suffix_grad_fn(self.model, site, loss_fn)
+            self._grads[key] = [
+                gfn(params, state, z, y)
+                for z, y in self.batches_for(site)
+            ]
+        return self._grads[key]
+
+    def drop(self, site: str) -> None:
+        """Release ``site``'s device-resident activations and memoized
+        gradients.  The sweep calls this once a layer's panel (scoring +
+        ablation walk) has finished and no later layer shares the site —
+        without it the cache pins O(L × dataset) activation memory for
+        the whole sweep instead of O(live sites)."""
+        self.sites = tuple(s for s in self.sites if s != site)
+        if self._batches is not None:
+            for caps, _y in self._batches:
+                caps.pop(site, None)
+        for key in [k for k in self._grads if k[0] == site]:
+            del self._grads[key]
+
+    # -- accounting --------------------------------------------------------
+    # hits/misses count SCORING PASSES (one metric run, or one ablation
+    # walk) — a unit that does not depend on whether the cache was
+    # filled yet, so two identical sweeps always report the same totals.
+
+    def record_hit(self, site: str):
+        """One scoring pass served from the cache; prices the avoided
+        prefix forwards into the gauge."""
+        self._fill()
+        self.hits += 1
+        saved = self._site_flops.get(site, 0.0) * self._examples
+        self.prefix_flops_saved += saved
+        obs.record_capture(hits=1, prefix_flops_saved=saved)
+
+    def record_miss(self, site: str):
+        """One scoring pass that recomputed its prefix despite this cache
+        (unsupported metric/site, or mismatched inputs)."""
+        self.misses += 1
+        obs.record_capture(misses=1)
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "sites": len(self.sites),
+            "skipped_sites": len(self.skipped_sites),
+            "hits": self.hits,
+            "misses": self.misses,
+            "prefix_flops_saved": self.prefix_flops_saved,
+        }

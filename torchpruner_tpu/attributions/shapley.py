@@ -32,6 +32,51 @@ from torchpruner_tpu.attributions.base import (
 
 
 @functools.lru_cache(maxsize=512)
+def shapley_rows_from_z_fn(model, eval_layer: str, loss_fn):
+    """jit: (params, state, z, y, perms) -> (batch, n_units) Shapley rows
+    from the CAPTURED eval-site activation ``z`` — the prefix-free core of
+    the ``use_partial`` fast path (:func:`shapley_rows_fn` computes ``z``
+    itself and delegates here, so cached and uncached rows are the same
+    computation by construction).  What the one-pass sweep engine
+    dispatches to when the activation cache holds the site."""
+    n = model.site_shape(eval_layer)[-1]
+    suffix = suffix_loss_fn(model, eval_layer, loss_fn)
+
+    @jax.jit
+    def fn(params, state, z, y, perms):
+        base = suffix(params, state, z, y)  # (B,) per-example loss
+        mask_dt = z.dtype  # matches the activation: a f32 mask would
+        # promote a bf16 suffix back to f32 and forfeit the MXU rate
+
+        def masked_loss(mask):
+            return suffix(params, state, z * mask, y)
+
+        return _perm_scan(masked_loss, base, perms, n, mask_dt)
+
+    return fn
+
+
+def _perm_scan(masked_loss, base, perms, n, mask_dt):
+    """The sequential marginal chain over sampled permutations shared by
+    both Shapley paths: a ``lax.scan`` of cumulative zeroing within a
+    permutation, vmapped over permutations."""
+
+    def per_perm(perm):
+        def step(carry, u):
+            mask, prev = carry
+            mask = mask.at[u].set(0.0)  # cumulative zeroing
+            loss = masked_loss(mask)
+            return (mask, loss), loss - prev
+
+        init = (jnp.ones((n,), mask_dt), base)
+        _, deltas = jax.lax.scan(step, init, perm)  # (n, B), perm order
+        return jnp.zeros_like(deltas).at[perm].set(deltas)  # unit order
+
+    svs = jax.vmap(per_perm)(perms)  # (S, n, B)
+    return jnp.mean(svs, axis=0).T  # (B, n): mean over permutations
+
+
+@functools.lru_cache(maxsize=512)
 def shapley_rows_fn(model, eval_layer: str, loss_fn, use_partial: bool):
     """jit: (params, state, x, y, perms) -> (batch, n_units) Shapley rows.
 
@@ -39,7 +84,8 @@ def shapley_rows_fn(model, eval_layer: str, loss_fn, use_partial: bool):
     fixed across batches (reference shapley_values.py:45-47).
     """
     n = model.site_shape(eval_layer)[-1]
-    suffix = suffix_loss_fn(model, eval_layer, loss_fn) if use_partial else None
+    from_z = (shapley_rows_from_z_fn(model, eval_layer, loss_fn)
+              if use_partial else None)
 
     @jax.jit
     def fn(params, state, x, y, perms):
@@ -47,13 +93,7 @@ def shapley_rows_fn(model, eval_layer: str, loss_fn, use_partial: bool):
             z, _ = model.apply(
                 params, x, state=state, train=False, to_layer=eval_layer
             )
-            base = suffix(params, state, z, y)  # (B,) per-example loss
-            mask_dt = z.dtype  # matches the activation: a f32 mask would
-            # promote a bf16 suffix back to f32 and forfeit the MXU rate
-
-            def masked_loss(mask):
-                return suffix(params, state, z * mask, y)
-
+            return from_z(params, state, z, y, perms)
         else:
             # the mask multiplies the site activation mid-forward; match
             # the dtype the model computes in (first floating param leaf —
@@ -78,20 +118,7 @@ def shapley_rows_fn(model, eval_layer: str, loss_fn, use_partial: bool):
                 return loss_fn(preds, y)
 
             base = masked_loss(jnp.ones((n,), mask_dt))
-
-        def per_perm(perm):
-            def step(carry, u):
-                mask, prev = carry
-                mask = mask.at[u].set(0.0)  # cumulative zeroing
-                loss = masked_loss(mask)
-                return (mask, loss), loss - prev
-
-            init = (jnp.ones((n,), mask_dt), base)
-            _, deltas = jax.lax.scan(step, init, perm)  # (n, B), perm order
-            return jnp.zeros_like(deltas).at[perm].set(deltas)  # unit order
-
-        svs = jax.vmap(per_perm)(perms)  # (S, n, B)
-        return jnp.mean(svs, axis=0).T  # (B, n): mean over permutations
+            return _perm_scan(masked_loss, base, perms, n, mask_dt)
 
     return fn
 
@@ -113,22 +140,45 @@ class ShapleyAttributionMetric(AttributionMetric):
         self.use_partial = use_partial
         self._calls = 0
 
-    def make_row_fn(self, eval_layer: str, sv_samples=None, use_partial=None):
-        """Draw fresh permutations (fixed across batches, reference
-        shapley_values.py:45-47), bind them, and return a plain
-        ``(params, state, x, y) -> rows`` function (also used by the
-        distributed scorer)."""
+    def _draw_perms(self, n: int, S: int):
+        """Fresh permutations, fixed across batches (reference
+        shapley_values.py:45-47) — one draw per scoring request, so the
+        cached and uncached paths see the same sequence for a given seed
+        and call count."""
+        self._calls += 1
+        key = jax.random.fold_in(jax.random.PRNGKey(self.seed), self._calls)
+        return jax.vmap(lambda k: jax.random.permutation(k, n))(
+            jax.random.split(key, S)
+        )
+
+    def _resolve(self, eval_layer, sv_samples, use_partial):
         S = sv_samples if sv_samples is not None else self.sv_samples
         partial = use_partial if use_partial is not None else self.use_partial
         if needs_taps(self.model, eval_layer):
             # nested / attention-head sites cannot be segment boundaries —
             # the masking path applies the cumulative unit mask mid-forward
             partial = False
-        n = self.n_units(eval_layer)
-        self._calls += 1
-        key = jax.random.fold_in(jax.random.PRNGKey(self.seed), self._calls)
-        perms = jax.vmap(lambda k: jax.random.permutation(k, n))(
-            jax.random.split(key, S)
-        )
+        return S, partial
+
+    def make_row_fn(self, eval_layer: str, sv_samples=None, use_partial=None):
+        """Bind drawn permutations and return a plain
+        ``(params, state, x, y) -> rows`` function (also used by the
+        distributed scorer)."""
+        S, partial = self._resolve(eval_layer, sv_samples, use_partial)
+        perms = self._draw_perms(self.n_units(eval_layer), S)
         fn = shapley_rows_fn(self.model, eval_layer, self.loss_fn, partial)
         return lambda params, state, x, y: fn(params, state, x, y, perms)
+
+    def make_cached_row_fn(self, eval_layer: str, sv_samples=None,
+                           use_partial=None):
+        """The prefix-free form: ``(params, state, z, y) -> rows`` from
+        the captured eval-site activation.  Only the ``use_partial`` fast
+        path can resume from ``z``; the forced masking path (explicit
+        ``use_partial=False``, or a site segmentation cannot cut) returns
+        ``None`` and scores uncached."""
+        S, partial = self._resolve(eval_layer, sv_samples, use_partial)
+        if not partial:
+            return None
+        perms = self._draw_perms(self.n_units(eval_layer), S)
+        fn = shapley_rows_from_z_fn(self.model, eval_layer, self.loss_fn)
+        return lambda params, state, z, y: fn(params, state, z, y, perms)

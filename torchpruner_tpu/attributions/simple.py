@@ -20,6 +20,7 @@ class RandomAttributionMetric(AttributionMetric):
     ``seed``; a fresh subkey per call)."""
 
     shiftable = False
+    data_dependent = False  # no forwards: capture-cache-neutral
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
@@ -38,6 +39,7 @@ class WeightNormAttributionMetric(AttributionMetric):
     reference weight_norm.py:13-19: abs then sum all non-out axes)."""
 
     shiftable = False
+    data_dependent = False  # weight-only: capture-cache-neutral
 
     def run(self, layer, *, find_best_evaluation_layer=False, **kw):
         spec = self.model.layer(layer)

@@ -654,13 +654,19 @@ class Taps:
     """Per-trace instrumentation: unit masking, additive perturbation,
     activation capture at named sites (paths), and auxiliary-loss
     collection (MoE load balancing).  Created fresh per ``apply`` call, so
-    the side-slots are trace-local and jit-safe."""
+    the side-slots are trace-local and jit-safe.
+
+    ``multi_capture`` records the activation at EVERY listed site into
+    ``captures`` (path string → array) in one forward — the primitive
+    behind the one-pass sweep capture (attributions.base.ActivationCache):
+    one compiled program emits all eval-site activations instead of L
+    prefix programs recomputing them."""
 
     __slots__ = ("unit_mask", "perturb", "capture", "captured",
-                 "collect_aux", "aux")
+                 "multi_capture", "captures", "collect_aux", "aux")
 
     def __init__(self, unit_mask=None, perturb=None, capture=None,
-                 collect_aux=False):
+                 collect_aux=False, multi_capture=()):
         self.unit_mask = (
             None if unit_mask is None else (parse_path(unit_mask[0]), unit_mask[1])
         )
@@ -669,6 +675,10 @@ class Taps:
         )
         self.capture = None if capture is None else parse_path(capture)
         self.captured = None
+        self.multi_capture = frozenset(
+            parse_path(p) for p in multi_capture
+        )
+        self.captures = {}  # {path string: activation} per capture site
         self.collect_aux = collect_aux
         self.aux = {}  # {path string: scalar} per collecting layer
 
@@ -677,6 +687,7 @@ class Taps:
             self.unit_mask is None
             and self.perturb is None
             and self.capture is None
+            and not self.multi_capture
         )
 
     def at_site(self, path: Tuple[str, ...], y):
@@ -688,6 +699,8 @@ class Taps:
             y = y + self.perturb[1]
         if self.capture == path:
             self.captured = y
+        if path in self.multi_capture:
+            self.captures["/".join(path)] = y
         return y
 
 

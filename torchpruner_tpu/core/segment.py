@@ -195,6 +195,7 @@ class SegmentedModel:
         unit_mask: Optional[Tuple[str, Any]] = None,
         perturb: Optional[Tuple[str, Any]] = None,
         capture: Optional[str] = None,
+        captures: Optional[Sequence[str]] = None,
         collect_aux: bool = False,
         remat: bool = False,
     ):
@@ -211,6 +212,10 @@ class SegmentedModel:
         - ``perturb=(site, delta)`` adds ``delta`` at the site — differentiate
           w.r.t. ``delta`` at zero for activation-gradient attributions.
         - ``capture=site`` additionally returns the activation at the site.
+        - ``captures=(site, ...)`` additionally returns ``{site: activation}``
+          for EVERY listed site from the same single forward — the one-pass
+          multi-site capture behind the sweep engine (see
+          :func:`capture_fn`).
         - ``collect_aux=True`` additionally returns the auxiliary training
           losses emitted by layers (MoE load balancing) as
           ``{layer_path: scalar}`` — empty for models without such layers.
@@ -219,8 +224,9 @@ class SegmentedModel:
           for deep transformer stacks.
 
         Returns ``(y, new_state)``; with ``capture`` also the captured
-        activation; with ``collect_aux`` also the aux-loss dict (in that
-        order when both are requested).
+        activation; with ``captures`` also the site→activation dict; with
+        ``collect_aux`` also the aux-loss dict (in that order when several
+        are requested).
         """
         state = state if state is not None else {}
         start = 0 if from_layer is None else self.index(from_layer) + 1
@@ -232,9 +238,10 @@ class SegmentedModel:
                 )
         taps = None
         if (unit_mask is not None or perturb is not None
-                or capture is not None or collect_aux):
+                or capture is not None or captures or collect_aux):
             taps = L.Taps(unit_mask=unit_mask, perturb=perturb,
-                          capture=capture, collect_aux=collect_aux)
+                          capture=capture, collect_aux=collect_aux,
+                          multi_capture=tuple(captures) if captures else ())
         y, new_state = L.apply_seq(
             self.layers[start:stop], params, state, x,
             train=train, rng=rng, taps=taps, remat=remat,
@@ -245,6 +252,8 @@ class SegmentedModel:
         out = (y, merged)
         if capture is not None:
             out = out + (taps.captured,)
+        if captures:
+            out = out + (taps.captures,)
         if collect_aux:
             out = out + (taps.aux,)
         return out
@@ -328,5 +337,39 @@ def segment_fn(
             params, x, state=state, train=train,
             from_layer=from_layer, to_layer=to_layer,
         )
+
+    return fn
+
+
+@functools.lru_cache(maxsize=128)
+def capture_fn(model: SegmentedModel, sites: Tuple[str, ...],
+               train: bool = False):
+    """ONE compiled multi-site capture program:
+    ``fn(params, state, x) -> {site: activation}``.
+
+    Runs the forward once, incrementally (``z_{k+1} = segment_k→k+1(z_k)``
+    is exactly what a single forward computes), emitting the activation at
+    every requested site — so a sweep that previously paid L prefix
+    programs and O(L²) prefix layer-forwards pays one program and O(L).
+    The forward stops at the deepest top-level layer containing a site;
+    layers past it are never computed.
+
+    Cached on the hashable ``(model, sites)`` so every metric × run × batch
+    of a sweep reuses one traced function object — with a fixed batch
+    shape this compiles exactly once per params version (a ragged tail
+    batch adds one more executable, hence the CI bound of ≤ 2).
+    """
+    if not sites:
+        raise ValueError("capture_fn needs at least one site")
+    stop = max(model.index(model.top_level_of(s)) for s in sites)
+    to_layer = model.layers[stop].name
+
+    @jax.jit
+    def fn(params, state, x):
+        _, _, caps = model.apply(
+            params, x, state=state, train=train,
+            to_layer=to_layer, captures=sites,
+        )
+        return caps
 
     return fn

@@ -26,6 +26,43 @@ from torchpruner_tpu.core.graph import find_best_evaluation_layer, pruning_graph
 from torchpruner_tpu.core.segment import SegmentedModel
 
 
+def _walk_from_z(model, eval_layer, loss_fn, compute_dtype, params, state,
+                 z, y, rankings):
+    """The cumulative-ablation walk given the eval-site activation ``z``
+    — the shared core of the uncached and capture-cached ablation
+    programs (one body, so the two paths are the same computation by
+    construction)."""
+    from torchpruner_tpu.utils.losses import prediction_counts
+
+    n = z.shape[-1]
+
+    def run_suffix(zz):
+        logits, _ = model.apply(params, zz, state=state,
+                                train=False, from_layer=eval_layer)
+        if compute_dtype is not None:
+            logits = logits.astype(jnp.float32)
+        return logits
+
+    def walk(ranking):
+        def step(mask, u):
+            mask = mask.at[u].set(0.0)
+            logits = run_suffix(z * mask)
+            losses = loss_fn(logits, y)
+            correct, _ = prediction_counts(logits, y)
+            return mask, (jnp.sum(losses), correct)
+
+        _, (loss_sums, corrects) = jax.lax.scan(
+            step, jnp.ones((n,), z.dtype), ranking
+        )
+        return loss_sums, corrects
+
+    loss_sums, corrects = jax.vmap(walk)(rankings)  # (R, n) each
+    base_logits = run_suffix(z)
+    base_correct, n_pred = prediction_counts(base_logits, y)
+    base_loss = jnp.sum(loss_fn(base_logits, y))
+    return loss_sums, corrects, base_loss, base_correct, n_pred
+
+
 @functools.lru_cache(maxsize=512)
 def _ablation_fn_batch(model: SegmentedModel, eval_layer: str, loss_fn,
                        compute_dtype=None):
@@ -41,7 +78,6 @@ def _ablation_fn_batch(model: SegmentedModel, eval_layer: str, loss_fn,
     loss sums accumulate in f32 — the shared mixed-precision policy)."""
 
     from torchpruner_tpu.utils.dtypes import cast_floats
-    from torchpruner_tpu.utils.losses import prediction_counts
 
     @jax.jit
     def fn(params, state, x, y, rankings):
@@ -50,33 +86,28 @@ def _ablation_fn_batch(model: SegmentedModel, eval_layer: str, loss_fn,
             x = cast_floats(x, compute_dtype)
         z, _ = model.apply(params, x, state=state, train=False,
                            to_layer=eval_layer)
-        n = z.shape[-1]
+        return _walk_from_z(model, eval_layer, loss_fn, compute_dtype,
+                            params, state, z, y, rankings)
 
-        def run_suffix(zz):
-            logits, _ = model.apply(params, zz, state=state,
-                                    train=False, from_layer=eval_layer)
-            if compute_dtype is not None:
-                logits = logits.astype(jnp.float32)
-            return logits
+    return fn
 
-        def walk(ranking):
-            def step(mask, u):
-                mask = mask.at[u].set(0.0)
-                logits = run_suffix(z * mask)
-                losses = loss_fn(logits, y)
-                correct, _ = prediction_counts(logits, y)
-                return mask, (jnp.sum(losses), correct)
 
-            _, (loss_sums, corrects) = jax.lax.scan(
-                step, jnp.ones((n,), z.dtype), ranking
-            )
-            return loss_sums, corrects
+@functools.lru_cache(maxsize=512)
+def _ablation_fn_batch_from_z(model: SegmentedModel, eval_layer: str,
+                              loss_fn, compute_dtype=None):
+    """jit: (params, state, z, y, rankings) — :func:`_ablation_fn_batch`
+    resuming from the CAPTURED eval-site activation (the one-pass sweep
+    engine's phase-2 program; ``z`` was already computed under the same
+    cast policy at capture-fill time)."""
 
-        loss_sums, corrects = jax.vmap(walk)(rankings)  # (R, n) each
-        base_logits = run_suffix(z)
-        base_correct, n_pred = prediction_counts(base_logits, y)
-        base_loss = jnp.sum(loss_fn(base_logits, y))
-        return loss_sums, corrects, base_loss, base_correct, n_pred
+    from torchpruner_tpu.utils.dtypes import cast_floats
+
+    @jax.jit
+    def fn(params, state, z, y, rankings):
+        if compute_dtype is not None:
+            params = cast_floats(params, compute_dtype)
+        return _walk_from_z(model, eval_layer, loss_fn, compute_dtype,
+                            params, state, z, y, rankings)
 
     return fn
 
@@ -94,15 +125,34 @@ def ablation_curves_batch(
     mesh=None,
     data_axis: str = "data",
     compute_dtype=None,
+    capture_cache=None,
 ) -> List[Dict[str, np.ndarray]]:
     """Batched :func:`ablation_curve`: ``rankings`` is ``(R, n)``; returns
     R curve dicts in order.  One vmapped scan per data batch evaluates
     every ranking simultaneously; with ``mesh`` the batch dim shards over
     ``data_axis`` (params/rankings replicated) and the same program runs
-    SPMD."""
+    SPMD.
+
+    ``capture_cache`` (an ``attributions.base.ActivationCache`` built from
+    the same model/data/dtype — the sweep's one-pass engine) supplies the
+    eval-site activation per batch, so the walk resumes from ``z`` instead
+    of recomputing the prefix; cached activations carry their fill-time
+    placement, so the ``mesh`` batch sharding is already applied."""
     eval_layer = eval_layer or layer
-    fn = _ablation_fn_batch(model, eval_layer, loss_fn, compute_dtype)
     rankings = jnp.asarray(np.asarray(rankings, dtype=np.int32))
+
+    use_cache = (
+        capture_cache is not None
+        and capture_cache.has(eval_layer)
+        and capture_cache.provides_for(model, params, state, data,
+                                       compute_dtype)
+    )
+    if capture_cache is not None and not use_cache:
+        capture_cache.record_miss(eval_layer)
+    fn = (_ablation_fn_batch_from_z(model, eval_layer, loss_fn,
+                                    compute_dtype)
+          if use_cache else
+          _ablation_fn_batch(model, eval_layer, loss_fn, compute_dtype))
 
     def put(t):  # identity on a single device
         return t
@@ -133,13 +183,19 @@ def ablation_curves_batch(
     base_l = base_c = 0.0
     n_examples = 0
     n_preds = 0
-    for x, y in (data() if callable(data) else data):
-        l, c, bl, bc, n_pred = fn(params, state, put(x), put(y), rankings)
+    if use_cache:
+        capture_cache.record_hit(eval_layer)
+        batches = capture_cache.batches_for(eval_layer)
+    else:
+        batches = ((put(x), put(y))
+                   for x, y in (data() if callable(data) else data))
+    for z_or_x, y in batches:
+        l, c, bl, bc, n_pred = fn(params, state, z_or_x, y, rankings)
         tot_l = l if tot_l is None else tot_l + l
         tot_c = c if tot_c is None else tot_c + c
         base_l += float(bl)
         base_c += float(bc)
-        n_examples += x.shape[0]
+        n_examples += z_or_x.shape[0]
         n_preds += int(n_pred)
     return [
         {
@@ -241,6 +297,7 @@ def layerwise_robustness(
     mesh=None,
     data_axis: str = "data",
     compute_dtype=None,
+    capture: bool = True,
     verbose: bool = True,
     on_layer: Optional[Callable[[str, Dict[str, List[Dict]]], None]] = None,
 ) -> Dict[str, Dict[str, List[Dict]]]:
@@ -253,6 +310,17 @@ def layerwise_robustness(
     factories are accepted but make the repeats identical).  Returns
     ``results[layer][method] = [ {scores, loss, acc, auc, seconds}, ... ]``.
 
+    ``capture=True`` (default) runs the one-pass capture engine: ONE
+    compiled program per params version computes every layer's eval-site
+    activation per batch (``attributions.base.ActivationCache``), and all
+    methods, stochastic runs, and the phase-2 ablation walks on a layer
+    consume that shared activation instead of each re-running the prefix
+    forward — O(L²) prefix layer-forwards drop to O(L) and the L prefix
+    executables collapse into one.  Metrics built from different
+    params/data than the sweep's fall back to the uncached path (counted
+    as ``attrib_capture_misses``); results are identical either way
+    (tests/test_capture.py pins equality on/off).
+
     ``on_layer(layer, results[layer])`` fires after each layer's panel
     completes — callers use it to checkpoint the multi-hour sweep so a
     kill mid-run keeps the finished layers (bench.py's streamed
@@ -262,6 +330,20 @@ def layerwise_robustness(
 
     if layers is None:
         layers = [g.target for g in pruning_graph(model)]
+    cache = None
+    layer_sites: List[str] = []
+    if capture and layers:
+        from torchpruner_tpu.attributions.base import ActivationCache
+
+        layer_sites = [
+            find_best_evaluation_layer(model, layer)
+            if find_best_evaluation_layer_ else layer
+            for layer in layers
+        ]
+        cache = ActivationCache(
+            model, params, test_data, sites=layer_sites, state=state,
+            compute_dtype=compute_dtype, mesh=mesh, data_axis=data_axis,
+        )
     if mesh is not None:
         # replicate ONCE for the whole sweep; ablation_curve's own
         # device_put then short-circuits on the already-placed trees
@@ -273,8 +355,16 @@ def layerwise_robustness(
         params = jax.device_put(params, repl)
         if state is not None:
             state = jax.device_put(state, repl)
+        if cache is not None:
+            # the replicated copies hold the same values — keep the
+            # cache's identity guards valid for the phase-2 walks, and
+            # let the fill reuse the placed trees instead of
+            # re-replicating from host
+            cache.alias_params(params)
+            if state is not None:
+                cache.alias_state(state)
     results: Dict[str, Dict[str, List[Dict]]] = {}
-    for layer in layers:
+    for li, layer in enumerate(layers):
         with obs.span("robustness_layer", layer=layer):
             results[layer] = {}
             # The ablation mask point is always the post-BN/activation
@@ -300,6 +390,11 @@ def layerwise_robustness(
                 for run_idx in range(n_runs):
                     t0 = time.perf_counter()
                     metric = factory(run_idx) if takes_run else factory()
+                    if cache is not None:
+                        # every method × run on this layer consumes the
+                        # ONE captured activation (mismatched metrics
+                        # fall back and count as misses)
+                        metric.capture_cache = cache
                     scores = metric.run(
                         layer, find_best_evaluation_layer=fbel,
                     )
@@ -318,7 +413,7 @@ def layerwise_robustness(
                 np.stack([np.argsort(s) for _, s, _ in pending]),
                 test_data, loss_fn,
                 eval_layer=eval_layer, mesh=mesh, data_axis=data_axis,
-                compute_dtype=compute_dtype,
+                compute_dtype=compute_dtype, capture_cache=cache,
             )
             walk_share = (time.perf_counter() - t0) / len(pending)
 
@@ -343,6 +438,12 @@ def layerwise_robustness(
                     )
             if on_layer is not None:
                 on_layer(layer, results[layer])
+            if cache is not None and \
+                    layer_sites[li] not in layer_sites[li + 1:]:
+                # this layer's panel is done and no later layer shares
+                # the site — release its activations/gradients so the
+                # cache holds O(live sites), not O(L × dataset)
+                cache.drop(layer_sites[li])
     return results
 
 
@@ -523,6 +624,7 @@ def run_robustness_config(cfg, *, model=None, datasets=None,
         find_best_evaluation_layer_=cfg.find_best_evaluation_layer,
         mesh=mesh,
         compute_dtype=score_dtype,
+        capture=cfg.capture,
         verbose=verbose,
     )
     aucs = auc_summary(results)

@@ -33,7 +33,7 @@ import time
 
 
 def run(layer: str = "conv8", sizes=(75, 150, 300, 1000),
-        smoke: bool = False) -> dict:
+        smoke: bool = False, capture: bool = True) -> dict:
     import jax
     import jax.numpy as jnp
     import optax
@@ -87,8 +87,10 @@ def run(layer: str = "conv8", sizes=(75, 150, 300, 1000),
             layers=[layer], verbose=False,
             # the headline leg's configuration, bf16 ablation walks
             # included (bench.py vgg16_robustness) — the calibration must
-            # measure the cost curve it calibrates
-            compute_dtype=jnp.bfloat16,
+            # measure the cost curve it calibrates.  capture defaults on
+            # (the one-pass engine the leg runs); --no-capture A/Bs the
+            # O(L²) prefix-recompute path this experiment used to time
+            compute_dtype=jnp.bfloat16, capture=capture,
         )
         rows.append({"n": n, "panel_seconds":
                      round(time.perf_counter() - t0, 2)})
@@ -107,6 +109,7 @@ def run(layer: str = "conv8", sizes=(75, 150, 300, 1000),
         "layer": layer,
         "platform": jax.devices()[0].platform,
         "device": getattr(jax.devices()[0], "device_kind", ""),
+        "capture": capture,
         "rows": rows,
         "base_n": base["n"],
         "verdict": (
@@ -129,12 +132,16 @@ def main(argv=None):
     ap.add_argument("--out", default="")
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--no-capture", action="store_true",
+                    help="disable the one-pass capture engine (A/B the "
+                         "per-method prefix-recompute path)")
     args = ap.parse_args(argv)
     if args.cpu:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    result = run(args.layer, smoke=args.smoke)
+    result = run(args.layer, smoke=args.smoke,
+                 capture=not args.no_capture)
     print(json.dumps(result, indent=1))
     if args.out:
         import os

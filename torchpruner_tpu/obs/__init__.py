@@ -53,7 +53,8 @@ from torchpruner_tpu.obs.spans import SpanRecord, SpanTracer
 __all__ = [
     "ObsSession", "configure", "get", "shutdown", "span",
     "current_span_id", "record_step", "record_grad_norm",
-    "configure_step_flops", "MetricsRegistry", "StepTelemetry",
+    "configure_step_flops", "record_capture", "capture_counts",
+    "MetricsRegistry", "StepTelemetry",
     "SpanTracer", "SpanRecord", "train_flops_per_step",
     "prometheus_text", "summary_table",
 ]
@@ -213,6 +214,55 @@ def record_grad_norm(gnorm) -> None:
     s = _session
     if s is not None:
         s.step.on_grad_norm(float(gnorm))
+
+
+def record_capture(hits: int = 0, misses: int = 0,
+                   prefix_flops_saved: float = 0.0) -> None:
+    """Attribution capture-cache accounting (one-pass sweep engine,
+    attributions.base.ActivationCache).  ``hits``/``misses`` count
+    SCORING PASSES (one metric run or ablation walk) whose prefix
+    forward was read from / recomputed despite the cache;
+    ``prefix_flops_saved`` adds to the monotone gauge of estimated
+    prefix FLOPs the cache avoided (utils.flops.prefix_flops_estimate).
+    No-op without a session."""
+    s = _session
+    if s is None:
+        return
+    if hits:
+        s.metrics.counter(
+            "attrib_capture_hits_total",
+            "scoring passes whose eval-site activation came from the "
+            "one-pass capture cache").inc(hits)
+    if misses:
+        s.metrics.counter(
+            "attrib_capture_misses_total",
+            "scoring passes that recomputed the prefix forward despite "
+            "an installed capture cache").inc(misses)
+    if prefix_flops_saved:
+        g = s.metrics.gauge(
+            "prefix_flops_saved",
+            "estimated prefix forward FLOPs avoided by capture reuse "
+            "(monotone within a session)")
+        g.set((g.value or 0.0) + prefix_flops_saved)
+
+
+def capture_counts() -> Dict[str, float]:
+    """Current capture-cache totals (zeros without a session) — what the
+    bench sweep leg surfaces next to its wall/compile accounting."""
+    s = _session
+    if s is None:
+        return {"capture_hits": 0, "capture_misses": 0,
+                "prefix_flops_saved": 0.0}
+
+    def val(name):
+        m = s.metrics.get(name)
+        return m.value if m is not None and m.value is not None else 0
+
+    return {
+        "capture_hits": int(val("attrib_capture_hits_total")),
+        "capture_misses": int(val("attrib_capture_misses_total")),
+        "prefix_flops_saved": float(val("prefix_flops_saved")),
+    }
 
 
 def configure_step_flops(flops_per_step: Optional[float] = None,

@@ -39,14 +39,24 @@ class DistributedScorer:
         self.mesh = mesh
         self.axis = axis
 
+    # the sweep installs the capture cache on whatever ``run`` object its
+    # factory returned — forward the attribute to the wrapped metric so a
+    # DistributedScorer is a drop-in AttributionMetric for the engine
+    @property
+    def capture_cache(self):
+        return self.metric.capture_cache
+
+    @capture_cache.setter
+    def capture_cache(self, cache):
+        self.metric.capture_cache = cache
+
     def run(self, layer: str, *, find_best_evaluation_layer: bool = False,
             **kw) -> np.ndarray:
         metric = self.metric
-        try:
-            metric.make_row_fn  # weight-only metrics have no rows to shard
-        except AttributeError:  # pragma: no cover
-            pass
-        if type(metric).make_row_fn is AttributionMetric.make_row_fn:
+        if (not metric.data_dependent
+                or type(metric).make_row_fn is AttributionMetric.make_row_fn):
+            # weight-only metrics (and any metric that overrides run()
+            # without a row fn) have no rows to shard
             return metric.run(
                 layer, find_best_evaluation_layer=find_best_evaluation_layer,
                 **kw,
@@ -54,7 +64,6 @@ class DistributedScorer:
         eval_layer = metric.find_evaluation_layer(
             layer, find_best_evaluation_layer
         )
-        row_fn = metric.make_row_fn(eval_layer, **kw)
         reduction = metric.reduction
         momentish = (
             reduction in ("mean", "sum", "mean+2std")
@@ -64,6 +73,12 @@ class DistributedScorer:
         # the metric's own cast + f32-rows invariant (base.run_rows), so
         # local and SPMD rows agree bit-for-bit in policy
         params = metric.cast(metric.params)
+        # row_fn is built lazily inside _rows: when the capture cache
+        # serves the site, the uncached row fn (and, for Shapley, its
+        # permutation draw) is never constructed
+        row_fn = None
+        if metric.capture_cache is None:
+            row_fn = metric.make_row_fn(eval_layer, **kw)
 
         if momentish:
             red = (
@@ -73,21 +88,29 @@ class DistributedScorer:
             )
             s1 = s2 = None
             n = 0
-            for batch in metric.batches():
-                x, y = shard_batch(batch, self.mesh, self.axis)
-                rows = metric.run_rows(row_fn, params, x, y)
+            for rows in self._rows(eval_layer, row_fn, params, **kw):
                 b1 = jnp.sum(rows, axis=0)   # cross-device psum via XLA
                 b2 = jnp.sum(rows * rows, axis=0)
                 s1 = b1 if s1 is None else s1 + b1
                 s2 = b2 if s2 is None else s2 + b2
-                n += int(np.shape(batch[0])[0])
+                n += int(rows.shape[0])
             return np.asarray(
                 from_moments(red, np.asarray(s1), np.asarray(s2), n)
             )
 
-        # row-gathering path: 'none' or arbitrary callables
-        out = []
-        for batch in metric.batches():
+        # row-gathering path: 'none' or arbitrary callables — rows stay
+        # device-resident until one final fetch (base._collect's policy)
+        out = list(self._rows(eval_layer, row_fn, params, **kw))
+        return metric.aggregate_over_samples(
+            np.asarray(jnp.concatenate(out, axis=0)))
+
+    def _rows(self, eval_layer, row_fn, params, **kw):
+        cached = self.metric.cached_row_stream(eval_layer, **kw)
+        if cached is not None:
+            yield from cached
+            return
+        if row_fn is None:
+            row_fn = self.metric.make_row_fn(eval_layer, **kw)
+        for batch in self.metric.batches():
             x, y = shard_batch(batch, self.mesh, self.axis)
-            out.append(np.asarray(metric.run_rows(row_fn, params, x, y)))
-        return metric.aggregate_over_samples(np.concatenate(out, axis=0))
+            yield self.metric.run_rows(row_fn, params, x, y)

@@ -29,6 +29,12 @@ class ExperimentConfig:
     method_kwargs: Dict[str, Any] = field(default_factory=dict)
     reduction: str = "mean"          # mean|sum|none|mean+2std
     find_best_evaluation_layer: bool = True
+    #: one-pass sweep capture (robustness experiments): ONE compiled
+    #: program computes every eval site's activation per batch and all
+    #: methods/runs/ablation walks share it (O(L²)→O(L) prefix work;
+    #: attributions.base.ActivationCache).  Disable to A/B the engine or
+    #: to trade the cached activations' device memory back for compute.
+    capture: bool = True
 
     # pruning schedule
     policy: str = "negative"         # negative|fraction

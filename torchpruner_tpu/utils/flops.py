@@ -46,6 +46,50 @@ def model_cost(
     return param_count(params), flops
 
 
+def prefix_flops_estimate(
+    model: SegmentedModel, params, eval_layer: str, batch_size: int = 1
+) -> float:
+    """Analytic forward-FLOPs estimate of the prefix input → ``eval_layer``
+    (inclusive, top-level boundary), for the capture engine's
+    ``prefix_flops_saved`` accounting (attributions.base.ActivationCache).
+
+    Matmul-dominated estimate: every ≥2-D float weight applied at each of
+    its layer's output positions costs ``2 · positions · weight_size``
+    MACs-as-FLOPs (exact for Dense/Conv/attention projections; attention's
+    S² score term and elementwise ops are ignored — this is a savings
+    gauge, not a cost model, so it errs low).  Embedding lookups are
+    gathers, not matmuls, and count zero.
+    """
+    from torchpruner_tpu.core import layers as L
+
+    stop = model.index(model.top_level_of(eval_layer))
+    total = 0.0
+
+    def weight_sizes(spec, p):
+        if isinstance(spec, L.Embedding) or not isinstance(p, dict):
+            return 0.0
+        n = 0.0
+        for v in p.values():
+            if isinstance(v, dict):  # composite child
+                n += weight_sizes(spec, v)
+            elif hasattr(v, "ndim") and getattr(v, "ndim", 0) >= 2:
+                n += float(np.prod(v.shape))
+        return n
+
+    for i, (spec, (_, out_shape)) in enumerate(
+        zip(model.layers, model.shapes)
+    ):
+        if i > stop:
+            break
+        p = params.get(spec.name)
+        if p is None:
+            continue
+        positions = float(np.prod(out_shape[:-1])) if len(out_shape) > 1 \
+            else 1.0
+        total += 2.0 * batch_size * positions * weight_sizes(spec, p)
+    return total
+
+
 #: bf16 peak FLOP/s per chip by ``device_kind`` prefix (public spec
 #: sheets) — longest prefix wins.  Shared by bench.py's MFU legs and the
 #: step-trace device-MFU computation so the denominators agree.
