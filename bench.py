@@ -112,6 +112,7 @@ _LEG_EST_S = {
     # cache (observed: mnist 60 s, vgg_train 32 s, mfu_llama 51 s,
     # decode 63 s, flash 10 s, sweep 928 s), with 2-6x cold margin
     "mnist_prune": (150, 520),
+    "resilience": (150, 240),
     "vgg16_train": (120, 3600),
     "mfu_llama": (180, 3600),
     "llama_decode": (180, 300),
@@ -883,6 +884,126 @@ def _leg_llama_decode(smoke: bool, progress=None) -> dict:
     return result
 
 
+def _leg_resilience(smoke: bool) -> dict:
+    """Leg: chaos drill — every resilience recovery path exercised and
+    timed on the digits smoke preset (torchpruner_tpu.resilience):
+
+    1. NaN-grad injection under the compiled non-finite guard (in
+       process): the poisoned step must be skipped, the run must finish.
+    2. Deterministic SIGKILL mid-retrain + manifest resume (subprocess,
+       CPU): measures the preemption tax — wall-clock of die+resume over
+       an uninterrupted run.
+    3. Corrupt-checkpoint detection: flipped bytes must surface as
+       CheckpointCorruptError (digest verification time included).
+
+    Value = total drill seconds; the real products are the recovery
+    counters and the resume_overhead_s ratio."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from torchpruner_tpu import obs
+    from torchpruner_tpu.checkpoint import (
+        CheckpointCorruptError,
+        restore_checkpoint,
+    )
+    from torchpruner_tpu.experiments.train_model import run_train
+    from torchpruner_tpu.resilience.chaos import corrupt_checkpoint_bytes
+    from torchpruner_tpu.utils.config import ExperimentConfig
+
+    root = tempfile.mkdtemp(prefix="bench_resilience_")
+
+    def cfg(run_dir, chaos=None):
+        return ExperimentConfig(
+            name="bench_resilience", model="digits_fc_tiny",
+            dataset="digits_flat", experiment="train",
+            epochs=1 if smoke else 2, batch_size=32, eval_batch_size=64,
+            lr=0.05, run_dir=run_dir, checkpoint_every_steps=10,
+            guard_nonfinite=True, chaos=chaos or {},
+            log_path=os.path.join(run_dir, "log.csv"),
+        )
+
+    t_total = time.perf_counter()
+    out: dict = {"unit": "s"}
+    try:
+        # 1. NaN injection recovered in-process
+        t0 = time.perf_counter()
+        _, hist = run_train(cfg(os.path.join(root, "nan"),
+                                chaos={"nan_at_step": 5}), verbose=False)
+        out["nan_leg_s"] = round(time.perf_counter() - t0, 3)
+        out["nan_skips"] = int(
+            obs.counter_value("resilience_nan_skips_total"))
+        assert hist and np.isfinite(hist[-1]["test_loss"]), \
+            "nan-injected run did not recover"
+
+        # 2. SIGKILL + resume (subprocess; CPU for hermeticity)
+        if not smoke:
+            repo = os.path.dirname(os.path.abspath(__file__))
+            cfg_path = os.path.join(root, "cfg.json")
+            kill_dir = os.path.join(root, "kill")
+            cfg(kill_dir).to_json(cfg_path)
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       PYTHONPATH=repo + os.pathsep
+                       + os.environ.get("PYTHONPATH", ""))
+
+            def cli(*extra):
+                return subprocess.run(
+                    [sys.executable, "-m", "torchpruner_tpu",
+                     "--config", cfg_path, "--cpu", "--resume", kill_dir,
+                     "--checkpoint-every", "10", "--no-obs", *extra],
+                    capture_output=True, text=True, env=env, cwd=repo,
+                    timeout=420,
+                )
+
+            t0 = time.perf_counter()
+            ref = cli()  # uninterrupted timing baseline (fresh dir later)
+            shutil.rmtree(kill_dir, ignore_errors=True)
+            base_s = time.perf_counter() - t0
+            assert ref.returncode == 0, ref.stderr[-800:]
+            t0 = time.perf_counter()
+            killed = cli("--chaos", '{"kill_at_step": 20}')
+            assert killed.returncode == -9, killed.returncode
+            resumed = cli()
+            die_resume_s = time.perf_counter() - t0
+            assert resumed.returncode == 0, resumed.stderr[-800:]
+            out["kill_resume_s"] = round(die_resume_s, 3)
+            out["uninterrupted_s"] = round(base_s, 3)
+            out["resume_overhead_s"] = round(die_resume_s - base_s, 3)
+
+        # 3. corrupt-checkpoint detection via digest
+        t0 = time.perf_counter()
+        nan_dir = os.path.join(root, "nan")
+        import json as _json
+
+        man = _json.load(open(os.path.join(nan_dir, "manifest.json")))
+        ckpt = os.path.join(nan_dir, man["checkpoint"])
+        restore_checkpoint(ckpt)  # intact
+        assert corrupt_checkpoint_bytes(ckpt, force=True)
+        try:
+            restore_checkpoint(ckpt)
+            raise AssertionError("corruption not detected")
+        except CheckpointCorruptError:
+            pass
+        out["corrupt_detect_s"] = round(time.perf_counter() - t0, 3)
+
+        h = obs.get().metrics.get("checkpoint_write_seconds") \
+            if obs.get() else None
+        if h is not None and h.count:
+            out["checkpoint_write_s_mean"] = round(h.mean, 4)
+        out["value"] = round(time.perf_counter() - t_total, 3)
+        return out
+    finally:
+        # the in-process run installed a PROCESS-GLOBAL chaos config; a
+        # leg failure before its injection fires would otherwise leave
+        # it armed to NaN-poison a later leg's step 5.  disable() (not
+        # configure({})) so a TORCHPRUNER_CHAOS env var can't re-arm.
+        from torchpruner_tpu.resilience import chaos as _chaos_mod
+
+        _chaos_mod.disable()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _leg_ok(legs: dict, name: str) -> bool:
     return (name in legs and "error" not in legs[name]
             and "skipped" not in legs[name]
@@ -1082,6 +1203,10 @@ def main() -> dict:
         snapshot()
 
     run_leg("mnist_prune", _leg_mnist)
+    # chaos drill: CPU-cheap on every platform, and the recovery paths it
+    # exercises (kill/resume, NaN skip, digest verify) are exactly what a
+    # preemptible TPU attempt of the legs below depends on
+    run_leg("resilience", _leg_resilience)
     if on_tpu or smoke or "--all-legs" in sys.argv:
         # cheap legs first, the long full-sweep leg last: if the child is
         # killed mid-run, the streamed snapshots hold the most

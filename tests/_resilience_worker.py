@@ -1,0 +1,66 @@
+"""Worker for the crash-resume tests (not collected by pytest).
+
+Run as ``python _resilience_worker.py <run_dir> [chaos_json]``: trains
+the digits smoke preset (``digits_fc_tiny``) resiliently into
+``run_dir``, optionally under a chaos config (e.g. a deterministic
+SIGKILL at a step boundary).  On a COMPLETED run prints one JSON line
+with the final eval metrics; a chaos-killed run prints nothing (SIGKILL
+allows no goodbye) — the parent detects death by exit code and re-runs
+without chaos to exercise the resume path.
+"""
+
+import json
+import os
+import sys
+
+import jax
+
+# in-process platform selection: with the experimental TPU plugin
+# installed the JAX_PLATFORMS env var alone does not defeat plugin
+# discovery (see tests/conftest.py)
+jax.config.update("jax_platforms", "cpu")
+
+
+def smoke_config(run_dir: str, chaos: dict):
+    from torchpruner_tpu.utils.config import ExperimentConfig
+
+    return ExperimentConfig(
+        name="resilience_smoke",
+        model="digits_fc_tiny",
+        dataset="digits_flat",
+        experiment="train",
+        epochs=2,
+        batch_size=32,
+        eval_batch_size=64,
+        lr=0.05,
+        run_dir=run_dir,
+        checkpoint_every_steps=7,
+        guard_nonfinite=True,
+        chaos=chaos,
+        log_path=os.path.join(run_dir, "log.csv"),
+    )
+
+
+def main() -> None:
+    run_dir = sys.argv[1]
+    chaos = json.loads(sys.argv[2]) if len(sys.argv) > 2 else {}
+    cfg = smoke_config(run_dir, chaos)
+    trainer, history = __import__(
+        "torchpruner_tpu.experiments.train_model",
+        fromlist=["run_train"],
+    ).run_train(cfg, verbose=False)
+    last = history[-1]
+    import numpy as np
+
+    w = np.asarray(jax.device_get(trainer.params["fc1"]["w"]))
+    print(json.dumps({
+        "epochs": len(history),
+        "final_test_loss": last["test_loss"],
+        "final_test_acc": last["test_acc"],
+        "steps": int(trainer.step_count),
+        "w_abs_sum": float(np.abs(w).sum()),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,11 @@ def bench(monkeypatch, tmp_path):
 
     monkeypatch.setattr(mod, "PARTIAL_PATH",
                         str(tmp_path / "partial.json"))
+    # the chaos-drill leg runs on every platform; stub it so harness-
+    # mechanics tests don't spend ~15 s per test actually killing and
+    # resuming subprocesses (tests/test_resilience.py owns the real leg)
+    monkeypatch.setattr(mod, "_leg_resilience",
+                        lambda smoke: {"value": 0.1, "unit": "s"})
     return mod
 
 
@@ -40,13 +45,15 @@ def test_partial_record_written_after_every_leg(bench, monkeypatch):
         return leg
 
     monkeypatch.setattr(bench, "_leg_mnist", stub("mnist_prune", 1.0))
+    monkeypatch.setattr(bench, "_leg_resilience", stub("resilience", 0.5))
     monkeypatch.setattr(bench, "_leg_llama_decode",
                         stub("llama_decode", 2.0))
     monkeypatch.setattr(sys, "argv", ["bench.py", "--run", "--cpu", "--no-cache"])
     out = bench.main()
-    assert calls == ["mnist_prune", "llama_decode"]
-    # the second leg saw the first leg's record already persisted
-    assert disk_at_call == [None, ["mnist_prune"]]
+    assert calls == ["mnist_prune", "resilience", "llama_decode"]
+    # each later leg saw the earlier legs' records already persisted
+    assert disk_at_call == [None, ["mnist_prune"],
+                            ["mnist_prune", "resilience"]]
     part = json.load(open(bench.PARTIAL_PATH))
     assert list(part["legs"]) == calls
     assert part["platform"] == "cpu"
@@ -88,14 +95,15 @@ def test_snapshot_streamed_after_every_leg(bench, monkeypatch, capsys):
     out = bench.main()
     lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
     snaps = [json.loads(ln) for ln in lines]
-    assert len(snaps) == 2  # one per leg
+    assert len(snaps) == 3  # one per leg (mnist, resilience, decode)
     for snap in snaps:
         assert snap["stream"] == "in_progress"
         assert {"metric", "value", "unit", "vs_baseline", "legs"} <= set(snap)
     # the first snapshot already carries the finished headline leg
     assert snaps[0]["metric"] == "mnist_fc_shapley_prune_wall_clock"
     assert snaps[0]["value"] == 1.5
-    assert list(snaps[1]["legs"]) == ["mnist_prune", "llama_decode"]
+    assert list(snaps[-1]["legs"]) == ["mnist_prune", "resilience",
+                                       "llama_decode"]
     assert out["value"] == 1.5 and "stream" not in out
 
 
@@ -112,12 +120,13 @@ def test_budget_guard_skips_unfinishable_legs(bench, monkeypatch, capsys):
     out = bench.main()
     assert ran == []
     assert "budget" in out["legs"]["mnist_prune"]["skipped"]
+    assert "budget" in out["legs"]["resilience"]["skipped"]
     assert "budget" in out["legs"]["llama_decode"]["skipped"]
     assert out["value"] is None  # skipped legs never fake a headline
     # ...but the skip decisions themselves were streamed
     snaps = [json.loads(ln)
              for ln in capsys.readouterr().out.splitlines() if ln.strip()]
-    assert len(snaps) == 2
+    assert len(snaps) == 3
 
 
 def test_leg_progress_checkpoints_are_streamed(bench, monkeypatch, capsys):

@@ -1,6 +1,8 @@
 """Shape-aware checkpoint/restore, including post-prune widths
 (SURVEY.md §5.4: layer widths are the extra metadata pruning forces)."""
 
+import os
+
 import jax
 import numpy as np
 import optax
@@ -126,3 +128,152 @@ def test_quantized_params_checkpoint_roundtrip(tmp_path):
     save_checkpoint(str(tmp_path / "plain"), model, params)
     _, p2, _, _, meta2 = restore_checkpoint(str(tmp_path / "plain"))
     assert "quantized" not in meta2
+
+
+def test_corrupted_checkpoint_raises_descriptive_error(tmp_path):
+    """Digest seal (resilience satellite): flipped bytes in the array
+    tree surface as CheckpointCorruptError naming the digest mismatch —
+    not a pickle/msgpack traceback from deep inside orbax."""
+    import pytest
+
+    from torchpruner_tpu.checkpoint import CheckpointCorruptError
+    from torchpruner_tpu.models.mlp import fc_net
+    from torchpruner_tpu.resilience.chaos import corrupt_checkpoint_bytes
+
+    model = fc_net(8, hidden=(8,), n_classes=3)
+    params, state = init_model(model, seed=0)
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, model, params, state)
+    restore_checkpoint(path)  # intact: verifies clean
+
+    assert corrupt_checkpoint_bytes(path, force=True)
+    with pytest.raises(CheckpointCorruptError, match="digest"):
+        restore_checkpoint(path)
+
+
+def test_truncated_spec_raises_descriptive_error(tmp_path):
+    import pytest
+
+    from torchpruner_tpu.checkpoint import CheckpointCorruptError
+    from torchpruner_tpu.models.mlp import fc_net
+
+    model = fc_net(8, hidden=(8,), n_classes=3)
+    params, state = init_model(model, seed=0)
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, model, params, state)
+
+    spec = os.path.join(path, "spec.json")
+    with open(spec, "r+b") as f:
+        f.truncate(os.path.getsize(spec) // 2)
+    with pytest.raises(CheckpointCorruptError, match="unreadable|truncated"):
+        restore_checkpoint(path)
+    # a missing checkpoint is corrupt-by-definition too, same error class
+    with pytest.raises(CheckpointCorruptError, match="no spec.json"):
+        restore_checkpoint(str(tmp_path / "nope"))
+
+
+def test_atomic_save_preserves_previous_on_overwrite(tmp_path):
+    """Overwriting a checkpoint leaves no tmp litter and the final state
+    restores cleanly (the swap path: old arrays displaced, new renamed
+    in, spec.json replaced last)."""
+    from torchpruner_tpu.models.mlp import fc_net
+
+    model = fc_net(8, hidden=(8,), n_classes=3)
+    params, state = init_model(model, seed=0)
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, model, params, state, step=1)
+    save_checkpoint(path, model, params, state, step=2)
+    _, _, _, _, meta = restore_checkpoint(path)
+    assert meta["step"] == 2
+    litter = [e for e in os.listdir(path) if e.startswith(".arrays.")
+              or e.endswith(".tmp")]
+    assert litter == []
+
+
+def test_qtensor_sharded_checkpoint_roundtrip_and_corruption(tmp_path):
+    """Resilience satellite: a quantized tree whose q/scale leaves live
+    SHARDED over an 8-virtual-device mesh round-trips through
+    save/restore (pack → orbax → unpack), and corrupted bytes raise
+    CheckpointCorruptError instead of deserializing garbage."""
+    import jax.numpy as jnp
+    import pytest
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from torchpruner_tpu.checkpoint import CheckpointCorruptError
+    from torchpruner_tpu.core.segment import init_model
+    from torchpruner_tpu.generate import generate
+    from torchpruner_tpu.models import llama_tiny
+    from torchpruner_tpu.ops.quant import QTensor, quantize_params
+    from torchpruner_tpu.parallel import make_mesh
+    from torchpruner_tpu.resilience.chaos import corrupt_checkpoint_bytes
+
+    model = llama_tiny()
+    params, _ = init_model(model, seed=0)
+    qp = quantize_params(model, params, bits=4)
+
+    mesh = make_mesh({"data": 8})
+    rep = NamedSharding(mesh, P())
+
+    def place(t):
+        if isinstance(t, QTensor):
+            # shard the packed payload's first axis where it divides the
+            # mesh; replicate the rest — mixed placements in one tree
+            spec = P("data") if t.q.shape[0] % 8 == 0 else P()
+            return QTensor(
+                jax.device_put(t.q, NamedSharding(mesh, spec)),
+                jax.device_put(t.scale, rep), t.in_axes, t.bits,
+                t.pack_axis,
+            )
+        return jax.device_put(t, rep)
+
+    qp_sharded = jax.tree_util.tree_map(
+        place, qp, is_leaf=lambda x: isinstance(x, QTensor))
+    assert any(
+        len(leaf.q.sharding.device_set) == 8
+        for leaf in jax.tree_util.tree_leaves(
+            qp_sharded, is_leaf=lambda x: isinstance(x, QTensor))
+        if isinstance(leaf, QTensor)
+    ), "no leaf actually sharded — test setup degenerate"
+
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, model, qp_sharded, step=1)
+    model2, qp2, _, _, meta = restore_checkpoint(path)
+    assert meta["quantized"]
+
+    leaf = qp2["block1_ffn"]["gate"]["wg"]
+    assert isinstance(leaf, QTensor) and leaf.bits == 4
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(generate(model2, qp2, prompt, 4)),
+        np.asarray(generate(model, qp, prompt, 4)),
+    )
+
+    assert corrupt_checkpoint_bytes(path, force=True)
+    with pytest.raises(CheckpointCorruptError):
+        restore_checkpoint(path)
+
+
+def test_interrupted_resave_recovers_displaced_old_tree(tmp_path):
+    """A kill inside the re-save swap window (old arrays renamed away,
+    new not yet committed) must still restore: the displaced tree at
+    .arrays.old.* matches the sealed digest and is swapped back."""
+    from torchpruner_tpu.models.mlp import fc_net
+
+    model = fc_net(8, hidden=(8,), n_classes=3)
+    params, state = init_model(model, seed=0)
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, model, params, state, step=1)
+
+    # simulate the crash window: arrays displaced, spec.json still the
+    # step-1 commit (its digest seals the displaced tree)
+    os.rename(os.path.join(path, "arrays"),
+              os.path.join(path, ".arrays.old.99999"))
+    _, _, _, _, meta = restore_checkpoint(path)
+    assert meta["step"] == 1
+    assert os.path.isdir(os.path.join(path, "arrays"))
+    # and a subsequent save sweeps any remaining litter
+    save_checkpoint(path, model, params, state, step=2)
+    assert [e for e in os.listdir(path)
+            if e.startswith((".arrays.old.", ".arrays.tmp."))] == []
+    _, _, _, _, meta = restore_checkpoint(path)
+    assert meta["step"] == 2

@@ -76,6 +76,27 @@ def main(argv=None) -> int:
         "--dump-config", metavar="PATH",
         help="write the resolved config JSON to PATH and exit",
     )
+    p.add_argument(
+        "--resume", metavar="DIR",
+        help="resilient run directory (resilience.RunManifest + "
+             "digest-verified checkpoints): a fresh DIR starts a "
+             "preemption-safe run recording into it; an existing one "
+             "resumes mid-round (train: exact epoch/step/data-cursor; "
+             "prune_retrain: mid-retrain of the interrupted target; "
+             "robustness: first unfinished layer)",
+    )
+    p.add_argument(
+        "--checkpoint-every", metavar="N", type=int, default=None,
+        help="with --resume: checkpoint every N optimizer steps "
+             "(prune_retrain: additionally after every retrain epoch); "
+             "default 0 = round/epoch boundaries only",
+    )
+    p.add_argument(
+        "--chaos", metavar="JSON_OR_PATH",
+        help="deterministic fault injection (resilience.chaos), e.g. "
+             "'{\"nan_at_step\": 5, \"kill_at_step\": 12}' — for "
+             "recovery-path testing; also via TORCHPRUNER_CHAOS env",
+    )
     args = p.parse_args(argv)
 
     if args.lint_plan and args.lint is None:
@@ -136,6 +157,26 @@ def main(argv=None) -> int:
         report = lint_config(cfg, plans=plans)
         print(report.format())
         return 0 if report.ok else 1
+
+    if args.resume:
+        cfg.run_dir = args.resume
+    if args.checkpoint_every is not None:
+        cfg.checkpoint_every_steps = args.checkpoint_every
+    if args.chaos:
+        from torchpruner_tpu.resilience.chaos import ChaosConfig
+
+        import dataclasses as _dc
+
+        # validate up front; stash as plain knobs so --dump-config
+        # round-trips and the drivers install it themselves
+        cfg.chaos = _dc.asdict(ChaosConfig.from_any(args.chaos))
+    else:
+        import os as _os
+
+        if _os.environ.get("TORCHPRUNER_CHAOS"):
+            from torchpruner_tpu.resilience import chaos as _chaos_mod
+
+            _chaos_mod.configure(None)  # reads the env var
 
     if args.dump_config:
         cfg.to_json(args.dump_config)

@@ -11,8 +11,10 @@ into the right static shapes.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import os
+import shutil
 from typing import Any, Dict, Optional
 
 import jax
@@ -20,6 +22,14 @@ import numpy as np
 
 from torchpruner_tpu.core import layers as L
 from torchpruner_tpu.core.segment import SegmentedModel
+
+
+class CheckpointCorruptError(RuntimeError):
+    """The checkpoint on disk is incomplete or damaged (truncated write,
+    bit rot, torn rename): the content digest recorded at save time does
+    not match the bytes present, or a required artifact is missing /
+    unparseable.  Restore from an older checkpoint — the atomic save
+    protocol guarantees the previously committed one is intact."""
 
 _LAYER_TYPES = {
     cls.__name__: cls
@@ -113,6 +123,147 @@ def _unpack_qtensors(tree, aux: Dict[str, list]):
     return walk(tree, "")
 
 
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype name including the ml_dtypes extension types
+    (bfloat16, int4, float8_*) jax arrays use on TPU."""
+    try:
+        dt = np.dtype(name)
+        if dt.kind != "V":
+            return dt
+    except TypeError:
+        pass
+    import ml_dtypes
+
+    return np.dtype(getattr(ml_dtypes, name))
+
+
+def _write_arrays(path: str, tree: Dict[str, Any]) -> None:
+    """Serialize the ``{"params": ..., "state": ..., "opt_state": ...}``
+    bundle as ``data.bin`` (concatenated raw leaf buffers) +
+    ``index.json`` (tree/path/dtype/shape/offset per leaf).
+
+    Pure numpy on purpose: the orbax/tensorstore writer pulls a second
+    native runtime into the training process, and the resilience chaos
+    drill caught its allocator corrupting the heap when a run restores a
+    checkpoint and compiles from the persistent XLA cache in the same
+    process (kill→resume cycles aborted in ``tensorstore`` context
+    setup).  Raw bytes + dtype names round-trip every jax dtype
+    (bfloat16, int4, float8) exactly, the write path is trivially
+    fsync-able, and there is nothing left to deserialize but buffers.
+
+    ``params``/``state`` are nested dicts (walked with sorted keys —
+    deterministic byte layout); ``opt_state`` is an arbitrary pytree
+    stored as its ``tree_leaves`` sequence (restore rebuilds structure
+    from ``tx.init``, exactly as the orbax path always did)."""
+    os.makedirs(path, exist_ok=True)
+    index = []
+    offset = 0
+
+    with open(os.path.join(path, "data.bin"), "wb") as f:
+
+        def emit(tree_name, keypath, leaf):
+            nonlocal offset
+            # NOT ascontiguousarray: it silently promotes 0-d arrays to
+            # shape (1,), and tobytes() already emits C order regardless
+            a = np.asarray(jax.device_get(leaf))
+            buf = a.tobytes()
+            f.write(buf)
+            index.append({
+                "tree": tree_name, "path": keypath,
+                "dtype": str(a.dtype), "shape": list(a.shape),
+                "offset": offset, "size": len(buf),
+            })
+            offset += len(buf)
+
+        def walk(tree_name, t, p):
+            if isinstance(t, dict):
+                for k in sorted(t):
+                    walk(tree_name, t[k], p + [k])
+            else:
+                emit(tree_name, p, t)
+
+        for name in ("params", "state"):
+            if name in tree:
+                walk(name, tree[name], [])
+        if "opt_state" in tree:
+            for i, leaf in enumerate(
+                    jax.tree_util.tree_leaves(tree["opt_state"])):
+                emit("opt_state", [str(i)], leaf)
+        f.flush()
+        os.fsync(f.fileno())
+
+    with open(os.path.join(path, "index.json"), "w") as f:
+        # "trees" lists what was SAVED, not just what has leaves: a
+        # stateless optimizer (plain sgd) has an opt_state with ZERO
+        # leaves, and restore must still rebuild it (an absent key would
+        # leave the resumed trainer with opt_state=None)
+        json.dump({"version": 1, "leaves": index,
+                   "trees": sorted(tree.keys())}, f)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _read_arrays(path: str) -> Dict[str, Any]:
+    """Inverse of :func:`_write_arrays` → ``{"params": nested dict,
+    "state": nested dict, "opt_state": [leaves...]}`` (keys present only
+    when saved)."""
+    with open(os.path.join(path, "index.json")) as f:
+        index = json.load(f)
+    out: Dict[str, Any] = {}
+    opt: list = []
+    with open(os.path.join(path, "data.bin"), "rb") as f:
+        # per-leaf reads into OWNED writable buffers: no whole-file
+        # bytes object (peak RAM = arrays, not 2× arrays) and no
+        # read-only frombuffer views aliasing shared immutable memory —
+        # these leaves feed a DONATING train step
+        for e in index["leaves"]:
+            f.seek(e["offset"])
+            dt = _np_dtype(e["dtype"])
+            a = np.empty(
+                int(np.prod(e["shape"], dtype=np.int64)), dtype=dt)
+            n = f.readinto(memoryview(a.view(np.uint8)))
+            if n != e["size"]:
+                raise CheckpointCorruptError(
+                    f"arrays data.bin truncated: leaf {e['path']} "
+                    f"expected {e['size']} bytes, got {n}"
+                )
+            a = a.reshape(e["shape"])
+            if e["tree"] == "opt_state":
+                opt.append(a)
+                continue
+            node = out.setdefault(e["tree"], {})
+            for k in e["path"][:-1]:
+                node = node.setdefault(k, {})
+            node[e["path"][-1] if e["path"] else ""] = a
+    for name in index.get("trees", []):
+        if name == "opt_state":
+            out["opt_state"] = opt  # possibly [] — stateless optimizer
+        else:
+            out.setdefault(name, {})
+    return out
+
+
+def _tree_digest(root: str) -> str:
+    """sha256 over every file under ``root`` in sorted relative-path
+    order (path bytes included, so a renamed/missing file changes the
+    digest as surely as changed contents)."""
+    h = hashlib.sha256()
+    root = os.path.abspath(root)
+    paths = []
+    for d, _dirs, files in os.walk(root):
+        for fn in files:
+            fp = os.path.join(d, fn)
+            paths.append((os.path.relpath(fp, root), fp))
+    for rel, fp in sorted(paths):
+        h.update(rel.encode())
+        h.update(b"\0")
+        with open(fp, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        h.update(b"\0")
+    return h.hexdigest()
+
+
 def save_checkpoint(
     path: str,
     model: SegmentedModel,
@@ -127,9 +278,15 @@ def save_checkpoint(
     """Write a checkpoint directory: ``spec.json`` + orbax array tree.
     Quantized (:class:`~torchpruner_tpu.ops.quant.QTensor`) params are
     supported: the int payload + scale save as arrays and the static
-    quantization metadata rides in ``spec.json``."""
-    import orbax.checkpoint as ocp
+    quantization metadata rides in ``spec.json``.
 
+    The write is ATOMIC and digest-sealed: arrays land in a temp
+    directory first, their content digest goes into the metadata, and
+    each artifact moves into place via ``os.replace``/``rename`` +
+    fsync.  A crash mid-save leaves either the previous complete
+    checkpoint or a digest mismatch that :func:`restore_checkpoint`
+    reports as :class:`CheckpointCorruptError` — never a silently
+    half-written tree restored as if it were whole."""
     path = os.path.abspath(path)
     os.makedirs(path, exist_ok=True)
     params, q_aux = _pack_qtensors(params)
@@ -147,16 +304,51 @@ def save_checkpoint(
         # refuses to rebuild under a *different* optimizer whose state
         # happens to flatten to the same leaf count/shapes
         meta["opt_treedef"] = str(jax.tree_util.tree_structure(opt_state))
-    with open(os.path.join(path, "spec.json"), "w") as f:
-        json.dump(meta, f, indent=2)
 
     tree = {"params": params}
     if state:
         tree["state"] = state
     if opt_state is not None:
         tree["opt_state"] = opt_state
-    ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(os.path.join(path, "arrays"), tree, force=True)
+
+    # 0. sweep TMP litter from ANY earlier pid (a crashed previous
+    #    save's half-written trees would otherwise accumulate forever).
+    #    .arrays.old.* is deliberately NOT swept here: after a mid-swap
+    #    crash it is the only sealed copy of the previous checkpoint,
+    #    and deleting it before THIS save reaches its commit point would
+    #    make a second crash unrecoverable — old dirs die in step 3.
+    for entry in os.listdir(path):
+        if entry.startswith(".arrays.tmp."):
+            shutil.rmtree(os.path.join(path, entry), ignore_errors=True)
+
+    # 1. arrays → temp dir (raw numpy buffers + index), digest computed
+    #    over the real bytes
+    tmp_arrays = os.path.join(path, f".arrays.tmp.{os.getpid()}")
+    _write_arrays(tmp_arrays, tree)
+    meta["digest"] = _tree_digest(tmp_arrays)
+
+    # 2. swap arrays into place (rename is atomic; the displaced old tree
+    #    is removed only after the NEW spec.json commits below, so a
+    #    crash inside the swap window is recoverable: restore finds the
+    #    old tree at .arrays.old.* and verifies it against the old spec)
+    final_arrays = os.path.join(path, "arrays")
+    old_arrays = os.path.join(path, f".arrays.old.{os.getpid()}")
+    if os.path.exists(final_arrays):
+        os.rename(final_arrays, old_arrays)
+    os.rename(tmp_arrays, final_arrays)
+
+    # 3. spec.json (with the digest) last, atomically (shared helper with
+    #    the run manifests): its replace is the commit point — a reader
+    #    never sees new-spec/old-arrays.  Only THEN does the displaced
+    #    old tree die.
+    from torchpruner_tpu.resilience.manifest import atomic_write_json
+
+    atomic_write_json(os.path.join(path, "spec.json"), meta)
+    # committed: every displaced tree (this save's and any earlier
+    # crashed save's) is now superseded by a consistent arrays+spec pair
+    for entry in os.listdir(path):
+        if entry.startswith(".arrays.old."):
+            shutil.rmtree(os.path.join(path, entry), ignore_errors=True)
 
 
 def restore_checkpoint(path: str, tx=None, *, check_opt_structure: bool = True):
@@ -169,15 +361,81 @@ def restore_checkpoint(path: str, tx=None, *, check_opt_structure: bool = True):
     (two optimizers can flatten to identical leaf layouts); pass ``False``
     only when a jax/optax upgrade changed the treedef *repr* of the SAME
     optimizer and the leaf-count/shape checks are trusted instead.
-    """
-    import orbax.checkpoint as ocp
 
+    Integrity: checkpoints written by this module carry a sha256 content
+    digest over the array files; a mismatch (truncated write, bit rot,
+    torn rename) raises :class:`CheckpointCorruptError` up front instead
+    of a deserialization traceback deep inside the array reader.
+    Pre-digest checkpoints restore without verification; pre-numpy-format
+    (orbax) checkpoints restore through a lazy orbax fallback.
+    """
     path = os.path.abspath(path)
-    with open(os.path.join(path, "spec.json")) as f:
-        meta = json.load(f)
+    spec_path = os.path.join(path, "spec.json")
+    if not os.path.exists(spec_path):
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r} has no spec.json — the directory is "
+            "empty, mid-write, or not a checkpoint"
+        )
+    try:
+        with open(spec_path) as f:
+            meta = json.load(f)
+    except (json.JSONDecodeError, UnicodeDecodeError, OSError) as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r} spec.json is unreadable/truncated: "
+            f"{type(e).__name__}: {e}"
+        ) from e
+    arrays_dir = os.path.join(path, "arrays")
+    expected = meta.get("digest")
+    # hash the tree ONCE (multi-GB checkpoints on the hot resume path)
+    actual = _tree_digest(arrays_dir) \
+        if expected and os.path.isdir(arrays_dir) else None
+    verified = os.path.isdir(arrays_dir) and (not expected
+                                              or actual == expected)
+    if not verified and expected:
+        # crash-window recovery for in-place re-saves: a kill during the
+        # arrays swap leaves the PREVIOUS tree (whose digest the current
+        # spec.json seals) displaced at .arrays.old.<pid> — verify and
+        # swap it back before declaring corruption
+        for entry in sorted(os.listdir(path)):
+            if not entry.startswith(".arrays.old."):
+                continue
+            candidate = os.path.join(path, entry)
+            if os.path.isdir(candidate) \
+                    and _tree_digest(candidate) == expected:
+                shutil.rmtree(arrays_dir, ignore_errors=True)
+                os.rename(candidate, arrays_dir)
+                verified = True
+                break
+    if not os.path.isdir(arrays_dir):
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r} has spec.json but no arrays/ tree — "
+            "the save was interrupted before its commit point"
+        )
+    if expected and not verified:
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r} failed digest verification: "
+            f"spec.json sealed sha256 {expected[:16]}… but the array "
+            f"files hash to {(actual or '<missing>')[:16]}… — the bytes "
+            "on disk were truncated or corrupted after the save "
+            "committed"
+        )
     model = spec_from_dict(meta["spec"])
-    ckptr = ocp.PyTreeCheckpointer()
-    restored = ckptr.restore(os.path.join(path, "arrays"))
+    try:
+        if os.path.exists(os.path.join(arrays_dir, "index.json")):
+            restored = _read_arrays(arrays_dir)
+        else:
+            # pre-numpy-format checkpoint: orbax read-only fallback
+            import orbax.checkpoint as ocp
+
+            restored = ocp.PyTreeCheckpointer().restore(arrays_dir)
+    except CheckpointCorruptError:
+        raise
+    except Exception as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r} arrays failed to deserialize "
+            f"({type(e).__name__}: {str(e)[:200]}) — the tree is "
+            "incomplete or damaged"
+        ) from e
     params = restored["params"]
     if meta.get("quantized"):
         params = _unpack_qtensors(params, meta["quantized"])

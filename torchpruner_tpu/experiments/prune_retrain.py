@@ -239,12 +239,45 @@ def _run_prune_retrain(
             groups = groups[::-1]  # outermost layer first (reference recipe)
         targets = filter_targets([g.target for g in groups], cfg)
 
-        # one opt_state spans every target's fine-tune pass, so decaying
-        # schedules must be sized for the whole run, not one pass
-        tx = make_optimizer(
-            cfg, steps_per_epoch=max(1, len(train) // cfg.batch_size),
-            total_epochs=cfg.finetune_epochs * max(1, len(targets)),
-        )
+        journal = guard = None
+        if cfg.chaos:
+            from torchpruner_tpu.resilience import chaos as _chaos
+
+            _chaos.configure(cfg.chaos)
+        if cfg.guard_nonfinite:
+            from torchpruner_tpu.resilience import StepGuard
+
+            guard = StepGuard(cfg.max_bad_steps)
+        if cfg.run_dir:
+            from torchpruner_tpu.resilience.runner import PruneJournal
+
+            journal = PruneJournal(cfg)
+
+        # a resumed run re-enters at the journal's (possibly
+        # OOM-degraded) accumulation factor, not the config's
+        accum_steps = (journal.manifest.accum_steps
+                       if journal is not None
+                       and journal.manifest.accum_steps
+                       else cfg.accum_steps)
+        spe = max(1, len(train) // cfg.batch_size)
+        total_ft_epochs = cfg.finetune_epochs * max(1, len(targets))
+
+        def build_tx():
+            # one opt_state spans every target's fine-tune pass, so
+            # decaying schedules must be sized for the whole run, not one
+            # pass.  In a resilient run the LR-backoff stage rides along
+            # (empty state, so the opt-state treedef survives rollbacks).
+            if journal is not None:
+                from torchpruner_tpu.resilience.runner import (
+                    scaled_optimizer,
+                )
+
+                return scaled_optimizer(cfg, spe, journal.lr_scale,
+                                        total_epochs=total_ft_epochs)
+            return make_optimizer(cfg, steps_per_epoch=spe,
+                                  total_epochs=total_ft_epochs)
+
+        tx = build_tx()
         loss_fn = LOSS_REGISTRY[cfg.loss]
         import jax.numpy as jnp
 
@@ -259,21 +292,40 @@ def _run_prune_retrain(
             trainer = ShardedTrainer.create(
                 model, tx, loss_fn, mesh, seed=cfg.seed,
                 partition=cfg.partition, compute_dtype=cdtype,
-                remat=cfg.remat, accum_steps=cfg.accum_steps,
+                remat=cfg.remat, accum_steps=accum_steps,
                 moe_aux_weight=cfg.moe_aux_weight,
-                grad_norm=cfg.obs_grad_norm,
+                grad_norm=cfg.obs_grad_norm, guard=guard,
             )
         else:
             trainer = Trainer.create(
                 model, tx, loss_fn, seed=cfg.seed,
                 compute_dtype=cdtype, remat=cfg.remat,
-                accum_steps=cfg.accum_steps,
+                accum_steps=accum_steps,
                 moe_aux_weight=cfg.moe_aux_weight,
-                grad_norm=cfg.obs_grad_norm,
+                grad_norm=cfg.obs_grad_norm, guard=guard,
             )
         _configure_mfu(cfg, trainer)
     logger = CSVLogger(cfg.log_path, experiment=cfg.name)
     history: List[PruneStepRecord] = []
+    if journal is not None and journal.resuming:
+        from torchpruner_tpu.resilience.runner import rng_from_list
+
+        with obs.span("resume"):
+            m2, p2, s2, o2, meta = journal.restore(tx)
+            trainer = trainer.rebuild(m2, p2, s2, o2)
+            rng = meta.get("extra", {}).get("rng")
+            if rng is not None:
+                trainer.rng = rng_from_list(rng)
+            trainer.step_count = int(meta.get("step", 0))
+            history = [PruneStepRecord(**r) for r in journal.records()]
+        _configure_mfu(cfg, trainer)
+        if verbose:
+            print(
+                f"[{cfg.name}] resumed prune-retrain from "
+                f"{journal.manifest.checkpoint}: "
+                f"{len(journal.completed)}/{len(targets)} targets done",
+                flush=True,
+            )
 
     # sharded paths split batches over the data axis — remainder batches
     # can't shard (sharding.shard_batch contract), so mesh mode drops them
@@ -282,72 +334,181 @@ def _run_prune_retrain(
     test_batches = test.batches(cfg.eval_batch_size)
 
     score_dtype = jnp.bfloat16 if cfg.score_dtype == "bfloat16" else None
-    for target in targets:
-        with obs.span("attribution", target=target, method=cfg.method):
-            metric = build_metric(
-                cfg.method, trainer.model, trainer.params, val_batches,
-                loss_fn, state=trainer.state,
-                reduction=cfg.reduction, seed=cfg.seed,
-                compute_dtype=score_dtype, **cfg.method_kwargs,
-            )
-            t0 = time.perf_counter()
-            if mesh is not None and "data" in cfg.mesh:
-                from torchpruner_tpu.parallel import DistributedScorer
 
-                scorer = DistributedScorer(metric, mesh)
-            else:
-                scorer = metric
-            scores = scorer.run(
-                target,
-                find_best_evaluation_layer=cfg.find_best_evaluation_layer,
-            )
-        with obs.span("eval", target=target, which="pre"):
-            pre_loss, pre_acc = trainer.evaluate(test_batches)
-        if cfg.simulate:
-            # mask the same slices a real prune would remove — shapes (and
-            # therefore compiled programs) never change across the sweep
-            from torchpruner_tpu.core.masking import apply_masks, drop_masks
+    def _restore_to(trainer, tx):
+        """Roll the trainer back to the journal's committed checkpoint
+        under a (possibly rebuilt) optimizer — rebuild() recompiles at
+        the restored shapes with the trainer's current accum/guard."""
+        from torchpruner_tpu.resilience.runner import rng_from_list
 
-            with obs.span("prune", target=target, simulate=True):
-                drop_idx = score_drop_indices(
-                    scores, policy=cfg.policy, fraction=cfg.fraction,
-                    bucket=cfg.bucket,
+        m2, p2, s2, o2, meta = journal.restore(tx)
+        trainer.tx = tx
+        trainer._step_fn = None
+        t = trainer.rebuild(m2, p2, s2, o2)
+        rng = meta.get("extra", {}).get("rng")
+        if rng is not None:
+            t.rng = rng_from_list(rng)
+        t.step_count = int(meta.get("step", 0))
+        if guard is not None:
+            guard.reset()
+        return t
+
+    def _run_target(target):
+        nonlocal trainer
+        stage = journal.stage_for(target) if journal is not None else None
+        if stage is None:
+            with obs.span("attribution", target=target, method=cfg.method):
+                metric = build_metric(
+                    cfg.method, trainer.model, trainer.params, val_batches,
+                    loss_fn, state=trainer.state,
+                    reduction=cfg.reduction, seed=cfg.seed,
+                    compute_dtype=score_dtype, **cfg.method_kwargs,
                 )
-                pm, sm = drop_masks(
-                    trainer.model, trainer.params, {target: drop_idx},
-                    state=trainer.state,
+                t0 = time.perf_counter()
+                if mesh is not None and "data" in cfg.mesh:
+                    from torchpruner_tpu.parallel import DistributedScorer
+
+                    scorer = DistributedScorer(metric, mesh)
+                else:
+                    scorer = metric
+                scores = scorer.run(
+                    target,
+                    find_best_evaluation_layer=(
+                        cfg.find_best_evaluation_layer),
                 )
-                trainer.params = apply_masks(trainer.params, pm)
-                if trainer.state:
-                    trainer.state = apply_masks(trainer.state, sm)
-            prune_time = time.perf_counter() - t0
-            n_dropped = len(drop_idx)
-        else:
-            with obs.span("prune", target=target):
-                res = prune_by_scores(
-                    trainer.model, trainer.params, target, scores,
-                    policy=cfg.policy, fraction=cfg.fraction,
-                    bucket=cfg.bucket,
-                    state=trainer.state, opt_state=trainer.opt_state,
+            with obs.span("eval", target=target, which="pre"):
+                pre_loss, pre_acc = trainer.evaluate(test_batches)
+            if cfg.simulate:
+                # mask the same slices a real prune would remove — shapes
+                # (and compiled programs) never change across the sweep
+                from torchpruner_tpu.core.masking import (
+                    apply_masks,
+                    drop_masks,
                 )
+
+                with obs.span("prune", target=target, simulate=True):
+                    drop_idx = score_drop_indices(
+                        scores, policy=cfg.policy, fraction=cfg.fraction,
+                        bucket=cfg.bucket,
+                    )
+                    pm, sm = drop_masks(
+                        trainer.model, trainer.params, {target: drop_idx},
+                        state=trainer.state,
+                    )
+                    trainer.params = apply_masks(trainer.params, pm)
+                    if trainer.state:
+                        trainer.state = apply_masks(trainer.state, sm)
                 prune_time = time.perf_counter() - t0
-                n_dropped = L.n_units(
-                    trainer.model.layer(target)
-                ) - L.n_units(res.model.layer(target))
-                # rebuild recompiles at the new shapes (ShardedTrainer
-                # re-places under its own "shard" span)
-                trainer = trainer.rebuild(res.model, res.params, res.state,
-                                          res.opt_state)
-            _configure_mfu(cfg, trainer)
+                n_dropped = len(drop_idx)
+            else:
+                with obs.span("prune", target=target):
+                    res = prune_by_scores(
+                        trainer.model, trainer.params, target, scores,
+                        policy=cfg.policy, fraction=cfg.fraction,
+                        bucket=cfg.bucket,
+                        state=trainer.state, opt_state=trainer.opt_state,
+                    )
+                    prune_time = time.perf_counter() - t0
+                    n_dropped = L.n_units(
+                        trainer.model.layer(target)
+                    ) - L.n_units(res.model.layer(target))
+                    # rebuild recompiles at the new shapes (ShardedTrainer
+                    # re-places under its own "shard" span)
+                    trainer = trainer.rebuild(res.model, res.params,
+                                              res.state, res.opt_state)
+                _configure_mfu(cfg, trainer)
+                if journal is not None:
+                    # the mid-round anchor: prune applied, retrain not
+                    # started — a kill during fine-tune resumes HERE
+                    journal.pruned(trainer, target, {
+                        "pre_loss": float(pre_loss),
+                        "pre_acc": float(pre_acc),
+                        "n_dropped": int(n_dropped),
+                        "prune_time": float(prune_time),
+                    })
+            epoch_i = 0
+        else:
+            # resumed mid-round: the restored checkpoint already holds the
+            # pruned shapes; skip scoring/prune, finish the retrain
+            pre_loss = float(stage["pre_loss"])
+            pre_acc = float(stage["pre_acc"])
+            n_dropped = int(stage["n_dropped"])
+            prune_time = float(stage["prune_time"])
+            epoch_i = int(stage.get("retrain_epoch", 0))
 
-        with obs.span("retrain", target=target, epochs=cfg.finetune_epochs):
-            for epoch in range(cfg.finetune_epochs):
-                train_epoch(
-                    trainer, train.batches(cfg.batch_size, shuffle=True,
-                                           seed=cfg.seed + epoch,
-                                           drop_remainder=drop),
-                    epoch=epoch, verbose=False,
+        while True:
+            try:
+                with obs.span("retrain", target=target,
+                              epochs=cfg.finetune_epochs):
+                    while epoch_i < cfg.finetune_epochs:
+                        # OOM-degraded accumulation can't split a ragged
+                        # tail batch (step_accum raises on it) — drop
+                        # and count the tail, same policy as the train
+                        # runner's degraded path
+                        drop_now = drop or trainer.accum_steps > 1
+                        if (drop_now and not drop
+                                and len(train) % cfg.batch_size):
+                            obs.inc(
+                                "resilience_ragged_drops_total",
+                                help="tail batches dropped because "
+                                     "they don't divide the degraded "
+                                     "accum_steps")
+                        train_epoch(
+                            trainer,
+                            train.batches(cfg.batch_size, shuffle=True,
+                                          seed=cfg.seed + epoch_i,
+                                          drop_remainder=drop_now),
+                            epoch=epoch_i, verbose=False,
+                        )
+                        epoch_i += 1
+                        if journal is not None:
+                            journal.retrain_epoch_done(trainer, target,
+                                                       epoch_i)
+                            # snapshot-on-preempt must carry the TRUE
+                            # position of the trainer it checkpoints
+                            journal.check_preempt(
+                                trainer,
+                                stage=dict(journal.manifest.stage,
+                                           retrain_epoch=epoch_i))
+                break
+            except NonFiniteStreakError as e:
+                if journal is None or cfg.simulate:
+                    raise
+                journal.on_streak(e)  # budget check + LR backoff
+                trainer = _restore_to(trainer, build_tx())
+                st = journal.manifest.stage
+                epoch_i = (int(st.get("retrain_epoch", 0))
+                           if st.get("target") == target else 0)
+                if verbose:
+                    print(
+                        f"[{cfg.name}] non-finite streak in {target} "
+                        f"retrain: rolled back, lr_scale -> "
+                        f"{journal.lr_scale:g}", flush=True,
+                    )
+            except Exception as e:  # noqa: BLE001 - classified below
+                from torchpruner_tpu.resilience import is_oom_error
+                from torchpruner_tpu.resilience.guards import (
+                    next_accum_for_oom,
                 )
+
+                if journal is None or cfg.simulate or not is_oom_error(e):
+                    raise
+                new_accum = next_accum_for_oom(trainer.accum_steps,
+                                               cfg.batch_size)
+                if new_accum is None:
+                    raise
+                obs.inc("resilience_oom_retries_total",
+                        help="OOM recoveries via doubled accum_steps")
+                trainer.accum_steps = new_accum
+                trainer = _restore_to(trainer, build_tx())
+                st = journal.manifest.stage
+                epoch_i = (int(st.get("retrain_epoch", 0))
+                           if st.get("target") == target else 0)
+                if verbose:
+                    print(
+                        f"[{cfg.name}] OOM in {target} retrain: rolled "
+                        f"back with accum_steps={new_accum}", flush=True,
+                    )
 
         with obs.span("eval", target=target, which="post"):
             post_loss, post_acc = trainer.evaluate(test_batches)
@@ -361,6 +522,10 @@ def _run_prune_retrain(
             widths=trainer.model.widths(),
         )
         history.append(rec)
+        if journal is not None:
+            import dataclasses as _dc
+
+            journal.round_done(trainer, target, _dc.asdict(rec))
         logger.log_prune_step(
             layer=target, method=cfg.method,
             test_loss=pre_loss, test_acc=pre_acc,
@@ -374,7 +539,36 @@ def _run_prune_retrain(
                 f"acc {pre_acc:.4f}→{post_acc:.4f}, params {n_params}",
                 flush=True,
             )
-    logger.close()
+
+    from torchpruner_tpu.resilience.guards import (
+        NonFiniteStreakError,
+        Preempted,
+    )
+
+    try:
+        for target in targets:
+            if journal is not None:
+                if target in journal.completed:
+                    continue
+                journal.check_preempt(trainer)
+            _run_target(target)
+        if journal is not None:
+            journal.done()
+    except Preempted:
+        if verbose:
+            print(
+                f"[{cfg.name}] preempted: manifest committed "
+                f"({len(journal.completed)}/{len(targets)} targets); "
+                f"re-run with --resume {cfg.run_dir} to continue",
+                flush=True,
+            )
+    finally:
+        # every exit path (done, preempted, crashed) must give the
+        # SIGTERM handler back — a leaked handler makes the rest of the
+        # process silently ignore preemption notices
+        if journal is not None:
+            journal.close()
+        logger.close()
     return history
 
 

@@ -491,15 +491,45 @@ def run_train_robustness(cfg, *, verbose: bool = True) -> Dict[str, float]:
     # with the default cfg.dataset would only be rejected AFTER the whole
     # training phase
     model, datasets = resolve_model_and_data(cfg, None, None)
+    # resilient two-phase run: each phase journals into its OWN subdir
+    # of run_dir (their manifests have different kinds — train vs
+    # robustness — and must not collide)
+    tcfg, scfg = cfg, cfg
+    if cfg.run_dir:
+        import dataclasses
+        import os
+
+        tcfg = dataclasses.replace(
+            cfg, run_dir=os.path.join(cfg.run_dir, "train"))
+        scfg = dataclasses.replace(
+            cfg, run_dir=os.path.join(cfg.run_dir, "sweep"))
     trainer, history = run_train(
-        cfg, model=model, datasets=datasets, verbose=verbose
+        tcfg, model=model, datasets=datasets, verbose=verbose
     )
+    if cfg.run_dir:
+        # a preempted train phase RETURNS like a finished one (that is
+        # its contract) — but sweeping half-trained params would commit
+        # wrong layer results into the sweep journal forever.  Only a
+        # 'done' train manifest may proceed.
+        from torchpruner_tpu.resilience.manifest import RunManifest
+
+        if RunManifest.exists_in(tcfg.run_dir):
+            tman = RunManifest.load(tcfg.run_dir)
+            if tman.status != "done":
+                if verbose:
+                    print(
+                        f"[{cfg.name}] training phase status "
+                        f"{tman.status!r} — sweep NOT started (re-run "
+                        f"with --resume {cfg.run_dir} to finish "
+                        "training first)", flush=True,
+                    )
+                return {}
     if verbose and history:
         print(f"[{cfg.name}] trained: test acc "
               f"{history[-1]['test_acc']:.4f} — starting sweep",
               flush=True)
     return run_robustness_config(
-        cfg, model=trainer.model, datasets=datasets,
+        scfg, model=trainer.model, datasets=datasets,
         params=trainer.params, state=trainer.state, verbose=verbose,
     )
 
@@ -618,16 +648,64 @@ def run_robustness_config(cfg, *, model=None, datasets=None,
     layers = filter_targets(
         [g.target for g in pruning_graph(model)], cfg
     )
-    results = layerwise_robustness(
-        model, params, state, test_batches, methods, loss_fn,
-        layers=layers,
-        find_best_evaluation_layer_=cfg.find_best_evaluation_layer,
-        mesh=mesh,
-        compute_dtype=score_dtype,
-        capture=cfg.capture,
-        verbose=verbose,
-    )
+    # resumable sweep (cfg.run_dir / CLI --resume): completed layers'
+    # results persist atomically per layer; a killed/preempted sweep
+    # restarts at the first unfinished layer instead of hour zero
+    journal = None
+    on_layer = None
+    if cfg.run_dir:
+        from torchpruner_tpu.resilience.runner import SweepJournal
+
+        journal = SweepJournal(cfg)
+        on_layer = journal.on_layer
+        done_layers = len(layers) - len(journal.remaining(layers))
+        if verbose and journal.resuming:
+            print(
+                f"[{cfg.name}] resuming sweep: {done_layers}/"
+                f"{len(layers)} layers already complete", flush=True,
+            )
+        layers = journal.remaining(layers)
+    preempted = False
+    try:
+        results = layerwise_robustness(
+            model, params, state, test_batches, methods, loss_fn,
+            layers=layers,
+            find_best_evaluation_layer_=cfg.find_best_evaluation_layer,
+            mesh=mesh,
+            compute_dtype=score_dtype,
+            capture=cfg.capture,
+            verbose=verbose,
+            on_layer=on_layer,
+        )
+        if journal is not None:
+            journal.done()
+    except Exception as e:
+        from torchpruner_tpu.resilience.guards import Preempted
+
+        if journal is None or not isinstance(e, Preempted):
+            raise
+        # every completed layer is already on disk; report what we have
+        results = {}
+        preempted = True
+        if verbose:
+            print(
+                f"[{cfg.name}] sweep preempted: "
+                f"{len(journal.manifest.completed)} layers committed; "
+                f"re-run with --resume {cfg.run_dir} to continue",
+                flush=True,
+            )
+    finally:
+        if journal is not None:
+            journal.close()  # give the SIGTERM handler back, always
+    if journal is not None:
+        results = journal.merged(results)
     aucs = auc_summary(results)
+    if preempted:
+        # a half-finished sweep must NOT masquerade as a complete one:
+        # no results_path / plot artifacts (the run-dir journal holds
+        # the partials + a 'preempted' manifest); the partial summary is
+        # returned for the resume message only
+        return aucs
     if cfg.results_path:
         import json
         import os

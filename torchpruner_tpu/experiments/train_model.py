@@ -81,7 +81,20 @@ def run_train(
     ``cfg.checkpoint_every_epochs`` and at the end.  Returns the final
     trainer and the per-epoch history
     ``[{epoch, train_loss, test_loss, test_acc, seconds}, ...]``.
+
+    With ``cfg.run_dir`` set the run is PREEMPTION-SAFE and delegates to
+    :func:`torchpruner_tpu.resilience.runner.run_resilient_train`:
+    manifest + digest-verified checkpoints every
+    ``cfg.checkpoint_every_steps`` steps, SIGTERM snapshot-and-exit,
+    mid-epoch restart at the exact data cursor, non-finite guard with
+    rollback + LR backoff, and OOM retry with doubled ``accum_steps``
+    (CLI: ``--resume DIR`` / ``--checkpoint-every N`` / ``--chaos``).
     """
+    if cfg.run_dir:
+        from torchpruner_tpu.resilience.runner import run_resilient_train
+
+        return run_resilient_train(cfg, model=model, datasets=datasets,
+                                   verbose=verbose)
     from torchpruner_tpu.experiments.prune_retrain import (
         LOSS_REGISTRY,
         make_optimizer,
@@ -90,6 +103,10 @@ def run_train(
 
     import jax.numpy as jnp
 
+    if cfg.chaos:
+        from torchpruner_tpu.resilience import chaos as _chaos
+
+        _chaos.configure(cfg.chaos)
     model, (train, _val, test) = resolve_model_and_data(cfg, model, datasets)
     steps_per_epoch = max(1, len(train) // cfg.batch_size)
     tx = make_optimizer(cfg, steps_per_epoch=steps_per_epoch)

@@ -54,6 +54,7 @@ __all__ = [
     "ObsSession", "configure", "get", "shutdown", "span",
     "current_span_id", "record_step", "record_grad_norm",
     "configure_step_flops", "record_capture", "capture_counts",
+    "inc", "observe", "gauge_set", "counter_value",
     "MetricsRegistry", "StepTelemetry",
     "SpanTracer", "SpanRecord", "train_flops_per_step",
     "prometheus_text", "summary_table",
@@ -263,6 +264,40 @@ def capture_counts() -> Dict[str, float]:
         "capture_misses": int(val("attrib_capture_misses_total")),
         "prefix_flops_saved": float(val("prefix_flops_saved")),
     }
+
+
+def inc(name: str, n: float = 1, help: str = "") -> None:
+    """Bump a named counter (no-op without a session) — the generic
+    instrumentation hook subsystems like ``resilience`` use for their
+    ``*_total`` counters without each holding a registry reference."""
+    s = _session
+    if s is not None:
+        s.metrics.counter(name, help).inc(n)
+
+
+def observe(name: str, value: float, help: str = "") -> None:
+    """Record one observation into a named histogram (no-op without a
+    session) — e.g. ``checkpoint_write_seconds``."""
+    s = _session
+    if s is not None:
+        s.metrics.histogram(name, help).observe(value)
+
+
+def gauge_set(name: str, value: float, help: str = "") -> None:
+    s = _session
+    if s is not None:
+        s.metrics.gauge(name, help).set(value)
+
+
+def counter_value(name: str) -> float:
+    """Current value of a named counter/gauge (0 without a session or
+    before the first bump) — lets tests and smoke scripts assert on
+    recovery counters without walking the registry."""
+    s = _session
+    if s is None:
+        return 0.0
+    v = getattr(s.metrics.get(name), "value", None)
+    return float(v) if v is not None else 0.0
 
 
 def configure_step_flops(flops_per_step: Optional[float] = None,

@@ -51,6 +51,7 @@ def make_sharded_train_step(
     accum_steps: int = 1,
     moe_aux_weight: float = 0.0,
     grad_norm: bool = False,
+    guard: bool = False,
 ):
     """Compile the SPMD train step with explicit in/out shardings.
     Mixed precision / remat / gradient accumulation come from the shared
@@ -58,7 +59,10 @@ def make_sharded_train_step(
     and the SPMD steps.  With ``accum_steps``, each scanned microbatch
     keeps its example dim sharded on ``data_axis``.  ``grad_norm`` makes
     the loss output a ``(loss, global grad norm)`` pair (XLA inserts the
-    cross-shard reduction; the ``rep`` out-sharding prefix covers both)."""
+    cross-shard reduction; the ``rep`` out-sharding prefix covers both).
+    ``guard`` compiles the non-finite skip guard into the SPMD program
+    (the ``ok`` decision is a replicated scalar, so every shard skips or
+    applies the update identically — mesh-consistent by construction)."""
     from torchpruner_tpu.train.loop import make_loss_closure, make_step_body
 
     loss_c = make_loss_closure(model, loss_fn, compute_dtype, remat,
@@ -67,7 +71,7 @@ def make_sharded_train_step(
     rep = replicate(mesh)
 
     return jax.jit(
-        make_step_body(loss_c, tx, accum_steps, grad_norm),
+        make_step_body(loss_c, tx, accum_steps, grad_norm, guard),
         in_shardings=(param_shardings, state_shardings, opt_shardings,
                       bs, bs, rep),
         out_shardings=(param_shardings, state_shardings, opt_shardings, rep),
@@ -103,6 +107,9 @@ class ShardedTrainer:
     moe_aux_weight: float = 0.0
     #: opt-in telemetry: step also returns the global grad norm
     grad_norm: bool = False
+    #: optional ``resilience.StepGuard`` — non-finite skip guard compiled
+    #: into the SPMD step; see ``train.loop.Trainer.guard``
+    guard: Any = None
     _step_fn: Any = field(default=None, repr=False)
     #: previous step's end timestamp — see train.loop.Trainer._t_stream
     #: (telemetry records return-to-return intervals within a streak)
@@ -126,6 +133,7 @@ class ShardedTrainer:
         accum_steps: int = 1,
         moe_aux_weight: float = 0.0,
         grad_norm: bool = False,
+        guard: Any = None,
     ) -> "ShardedTrainer":
         key = jax.random.PRNGKey(seed)
         params, state = model.init(key)
@@ -137,7 +145,7 @@ class ShardedTrainer:
             min_shard_size=min_shard_size, partition=partition,
             compute_dtype=compute_dtype, remat=remat,
             accum_steps=accum_steps, moe_aux_weight=moe_aux_weight,
-            grad_norm=grad_norm,
+            grad_norm=grad_norm, guard=guard,
         )
         t._place()
         return t
@@ -178,7 +186,7 @@ class ShardedTrainer:
                 self.data_axis, compute_dtype=self.compute_dtype,
                 remat=self.remat, accum_steps=self.accum_steps,
                 moe_aux_weight=self.moe_aux_weight,
-                grad_norm=self.grad_norm,
+                grad_norm=self.grad_norm, guard=self.guard is not None,
             )
             self._record_memory_budget(ps)
 
@@ -211,6 +219,14 @@ class ShardedTrainer:
     # -- training ----------------------------------------------------------
 
     def step(self, x, y) -> float:
+        from torchpruner_tpu.resilience import chaos as _chaos
+
+        if _chaos.active():
+            # same deterministic fault-injection boundary as the local
+            # Trainer (kill / synthetic OOM / NaN-poisoned batch)
+            _chaos.maybe_kill(self.step_count)
+            _chaos.maybe_oom(self.step_count)
+            x = _chaos.poison_batch(self.step_count, x)
         x, y = shard_batch((jnp.asarray(x), jnp.asarray(y)), self.mesh,
                            self.data_axis)
         self.rng, sub = jax.random.split(self.rng)
@@ -218,9 +234,13 @@ class ShardedTrainer:
             self.params, self.state, self.opt_state, x, y, sub
         )
         self.step_count += 1
-        if self.grad_norm:
-            l, gnorm = l
-            obs.record_grad_norm(gnorm)
+        if self.grad_norm or self.guard is not None:
+            parts = l if isinstance(l, tuple) else (l,)
+            l = parts[0]
+            if self.grad_norm:
+                obs.record_grad_norm(parts[1])
+            if self.guard is not None:
+                self.guard.observe(bool(parts[-1]))
         now = time.perf_counter()
         if self._t_stream is not None:
             # first step of a streak: dispatch-only time, not recorded
@@ -242,7 +262,7 @@ class ShardedTrainer:
             partition=self.partition, compute_dtype=self.compute_dtype,
             remat=self.remat, accum_steps=self.accum_steps,
             moe_aux_weight=self.moe_aux_weight, grad_norm=self.grad_norm,
-            step_count=self.step_count,
+            guard=self.guard, step_count=self.step_count,
         )
         t._place()
         return t
@@ -283,5 +303,8 @@ class ShardedTrainer:
             tot_n += int(nn)
             tot_p += int(n_pred)
         if tot_n == 0:
+            from torchpruner_tpu.train.loop import _warn_empty_eval
+
+            _warn_empty_eval("ShardedTrainer.evaluate()")
             raise ValueError("evaluate() got an empty dataset")
         return tot_l / tot_n, tot_c / tot_p

@@ -1,0 +1,71 @@
+"""Resilience layer: preemption-safe resumable pipelines, deterministic
+fault injection, non-finite/OOM step guards with rollback, and
+retry/backoff for transient failures.
+
+The north-star workload — attribution → prune → retrain on preemptible
+TPU slices — dies mid-run as a matter of course: SIGTERM'd by the
+scheduler, NaN'd by an unlucky LR, RESOURCE_EXHAUSTED by a batch that no
+longer fits.  This package makes every one of those a *resume*, not a
+*restart*:
+
+- :mod:`~torchpruner_tpu.resilience.manifest` — :class:`RunManifest`:
+  atomically-written JSON pipeline position (prune round, epoch, data
+  cursor, rng, LR backoff) next to digest-verified checkpoints.
+- :mod:`~torchpruner_tpu.resilience.chaos` — deterministic fault
+  injection (NaN grads at step k, SIGKILL, synthetic OOM, corrupt
+  checkpoint bytes, data-load failures) so recovery paths are *tested*
+  code, not hope.
+- :mod:`~torchpruner_tpu.resilience.guards` — host half of the compiled
+  non-finite guard (:class:`StepGuard` → rollback + LR backoff after M
+  consecutive skips), OOM classification, SIGTERM → snapshot handling.
+- :mod:`~torchpruner_tpu.resilience.retry` — exponential backoff with
+  deterministic jitter for transient data/host-callback errors.
+- :mod:`~torchpruner_tpu.resilience.runner` — the resumable drivers
+  wiring all of it through ``run_train`` / ``run_prune_retrain`` / the
+  robustness sweep (imported lazily: it depends on the train loop, which
+  itself uses the chaos hooks above).
+
+Everything emits obs counters/spans (``resilience_nan_skips_total``,
+``resilience_resumes_total``, ``resilience_rollbacks_total``,
+``checkpoint_write_seconds``, ``chaos:*``), so recovery is visible in
+the same telemetry stream as the work it saves.
+
+Design refs: JaxPruner's checkpointable-sparsity-state argument
+(arXiv:2304.14082) and the TPU structured-pruning study's long
+prune/retrain schedules (arXiv:2107.04191) — see PAPERS.md.
+"""
+
+from torchpruner_tpu.resilience import chaos
+from torchpruner_tpu.resilience.guards import (
+    NonFiniteStreakError,
+    Preempted,
+    PreemptionHandler,
+    StepGuard,
+    is_oom_error,
+)
+from torchpruner_tpu.resilience.manifest import (
+    RunManifest,
+    atomic_write_json,
+)
+from torchpruner_tpu.resilience.retry import (
+    RetryPolicy,
+    retriable,
+    retry_call,
+)
+
+__all__ = [
+    "chaos",
+    "ChaosConfig",
+    "NonFiniteStreakError",
+    "Preempted",
+    "PreemptionHandler",
+    "StepGuard",
+    "is_oom_error",
+    "RunManifest",
+    "atomic_write_json",
+    "RetryPolicy",
+    "retriable",
+    "retry_call",
+]
+
+ChaosConfig = chaos.ChaosConfig

@@ -1,0 +1,188 @@
+"""Step guards: non-finite detection/skip, OOM classification, preemption.
+
+The compiled half of the non-finite guard lives in
+``train.loop.make_step_body(guard=True)``: the step computes
+``ok = isfinite(loss) & isfinite(global_norm(grads))`` and applies the
+optimizer update, BN-state update, and opt-state transition only under
+``ok`` (``jnp.where`` — the skip is inside the jitted program, so a NaN
+step costs one wasted forward/backward, never a poisoned parameter).
+This module holds the host half:
+
+- :class:`StepGuard` counts skips, and after ``max_bad_steps``
+  CONSECUTIVE bad steps raises :class:`NonFiniteStreakError` — the
+  signal the resilient runner turns into rollback-to-last-checkpoint +
+  LR backoff.  (One bad step is usually a data/numerics fluke the skip
+  absorbs; a streak means the params or LR are already unhealthy, so
+  skipping forever would silently stop training.)
+- :func:`is_oom_error` classifies RESOURCE_EXHAUSTED / out-of-memory
+  failures from any backend (and the chaos-injected synthetic one), the
+  trigger for the runner's retry-with-doubled-``accum_steps`` path.
+- :class:`PreemptionHandler` converts SIGTERM (the preemption notice TPU
+  VMs get before the SIGKILL) into a flag checked at step boundaries, so
+  the runner snapshots once, mesh-consistently, instead of dying
+  mid-step.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from torchpruner_tpu import obs
+
+
+class NonFiniteStreakError(RuntimeError):
+    """``max_bad_steps`` consecutive steps produced non-finite loss or
+    gradients; the in-program skip is no longer enough."""
+
+    def __init__(self, streak: int, total: int):
+        self.streak = streak
+        self.total = total
+        super().__init__(
+            f"{streak} consecutive non-finite train steps "
+            f"({total} skipped total) — params are being held at their "
+            "last finite values but training is not progressing; roll "
+            "back to the last checkpoint and back off the LR"
+        )
+
+
+@dataclass
+class StepGuard:
+    """Host-side tracker fed one bool per guarded step."""
+
+    max_bad_steps: int = 3
+    consecutive: int = 0
+    total_skips: int = 0
+
+    def observe(self, bad: bool) -> bool:
+        """Record one step's guard flag; returns ``bad``.  Raises
+        :class:`NonFiniteStreakError` when the streak limit is hit."""
+        if not bad:
+            self.consecutive = 0
+            return False
+        self.consecutive += 1
+        self.total_skips += 1
+        obs.inc("resilience_nan_skips_total",
+                help="train steps skipped by the non-finite guard")
+        if self.max_bad_steps and self.consecutive >= self.max_bad_steps:
+            raise NonFiniteStreakError(self.consecutive, self.total_skips)
+        return True
+
+    def reset(self) -> None:
+        self.consecutive = 0
+
+
+#: message fragments that identify an allocation failure across backends
+#: (TPU/GPU XlaRuntimeError, CPU allocator, and the chaos injection)
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                "OOM", "failed to allocate")
+
+
+def is_oom_error(e: BaseException) -> bool:
+    """True when ``e`` is an allocation failure worth retrying with a
+    smaller memory footprint (doubled ``accum_steps`` → halved
+    microbatch activations)."""
+    if isinstance(e, MemoryError):
+        return True
+    msg = str(e)
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+def next_accum_for_oom(accum: int, batch_size: int) -> Optional[int]:
+    """The ONE degradation policy after an OOM: double ``accum_steps``
+    (halved microbatch activations), or ``None`` when nothing is left
+    to degrade to (already at per-example microbatches, or the batch
+    stops dividing).  Shared by the train runner and the prune-retrain
+    recovery path so the cap logic cannot drift between them."""
+    new = max(1, accum) * 2
+    if new > batch_size or batch_size % new:
+        return None
+    return new
+
+
+class Preempted(Exception):
+    """Raised (by runner code, never by the handler itself) after a
+    preemption snapshot commits — unwinds the pipeline cleanly."""
+
+
+class PreemptionHandler:
+    """SIGTERM → "snapshot at the next step boundary" flag.
+
+    Use as a context manager around the training loop; poll
+    :meth:`should_snapshot` at step boundaries.  Multi-process meshes
+    must all snapshot at the SAME boundary: process 0's flag is the
+    decision, broadcast through
+    ``jax.experimental.multihost_utils.broadcast_one_to_all`` when more
+    than one process is attached (every process checkpoints its region
+    consistently; only process 0 writes the manifest).  The broadcast is
+    a collective, so multi-process callers should poll at checkpoint
+    boundaries, not every step; single-process polling is a plain flag
+    read.  A second SIGTERM during a slow snapshot still terminates via
+    the default handler once the context exits.
+    """
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self._signals = signals
+        self._old = {}
+        self._flag = threading.Event()
+        self.installed = False
+
+    # -- context management ------------------------------------------------
+
+    def __enter__(self) -> "PreemptionHandler":
+        try:
+            for s in self._signals:
+                self._old[s] = signal.signal(s, self._on_signal)
+            self.installed = True
+        except ValueError:
+            # not the main thread (tests, embedded use): stay poll-only
+            self.installed = False
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        for s, old in self._old.items():
+            try:
+                signal.signal(s, old)
+            except ValueError:
+                pass
+        self._old.clear()
+        return False
+
+    def _on_signal(self, signum, _frame) -> None:
+        self._flag.set()
+        obs.inc("resilience_preemptions_total",
+                help="preemption signals observed (SIGTERM)")
+
+    # -- polling -----------------------------------------------------------
+
+    @property
+    def requested(self) -> bool:
+        """This process's local view (no collective)."""
+        return self._flag.is_set()
+
+    def request(self) -> None:
+        """Programmatic preemption (tests; in-process drain)."""
+        self._flag.set()
+
+    def should_snapshot(self) -> bool:
+        """Mesh-consistent decision: in a multi-process runtime, process
+        0's flag wins (broadcast); single-process reads the local flag."""
+        local = self._flag.is_set()
+        try:
+            import jax
+
+            if jax.process_count() <= 1:
+                return local
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            agreed = bool(
+                multihost_utils.broadcast_one_to_all(np.asarray(local))
+            )
+            if agreed:
+                self._flag.set()  # every process commits to the snapshot
+            return agreed
+        except Exception:
+            return local

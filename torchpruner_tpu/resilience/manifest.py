@@ -1,0 +1,180 @@
+"""Run manifests: the durable record of *pipeline position*.
+
+A checkpoint (``checkpoint.py``) captures the training *state* — params,
+optimizer slots, BN statistics, model widths.  It does not say where the
+PIPELINE was: which prune round, which retrain epoch, how many batches of
+the current epoch were consumed, what LR backoff is in force.  The
+:class:`RunManifest` records exactly that, as a small JSON file written
+atomically (tmp + fsync + ``os.replace``) next to the checkpoints it
+points at, so a preempted or killed run re-enters ``run_prune_retrain`` /
+``run_train`` / the robustness sweep mid-round instead of from scratch.
+
+Commit protocol (see ``resilience.runner``): write the new checkpoint
+directory first, then atomically replace ``manifest.json`` to point at
+it.  A crash at ANY instant leaves the manifest referencing a complete,
+digest-verified checkpoint — the half-written one is garbage-collected on
+the next resume.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+MANIFEST_NAME = "manifest.json"
+MANIFEST_VERSION = 1
+
+
+def fsync_dir(path: str) -> None:
+    """Best-effort directory fsync — makes the rename durable on POSIX
+    filesystems that need it; silently skipped where unsupported."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_json(path: str, obj: Any) -> None:
+    """Write ``obj`` as JSON such that ``path`` is either the old complete
+    file or the new complete file — never a truncated hybrid.  The
+    standard tmp-in-same-dir + flush + fsync + ``os.replace`` dance."""
+    path = os.path.abspath(path)
+    d = os.path.dirname(path)
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp.", suffix=".json")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(obj, f, indent=2)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        fsync_dir(d)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def read_json(path: str) -> Any:
+    with open(path) as f:
+        return json.load(f)
+
+
+@dataclass
+class RunManifest:
+    """Pipeline position for one resumable run directory.
+
+    ``kind`` names the driver that owns the run ("train",
+    "prune_retrain", "robustness") — resuming under a different driver is
+    refused, since their ``stage`` payloads are not interchangeable.
+    ``checkpoint`` is the run-dir-relative name of the last COMMITTED
+    checkpoint directory ("" before the first commit).  ``stage`` is the
+    driver's own mid-round position (retrain epoch, partial-epoch loss
+    list, pre-prune eval stats, ...), opaque to this module.
+    """
+
+    kind: str = "train"
+    experiment: str = "experiment"
+    version: int = MANIFEST_VERSION
+    #: run-dir-relative directory name of the last committed checkpoint
+    checkpoint: str = ""
+    #: global optimizer-step count at the last commit
+    step: int = 0
+    #: epoch (train) / round index (prune_retrain) at the last commit
+    epoch: int = 0
+    #: batches of the CURRENT epoch already consumed at the last commit —
+    #: the data cursor a resume fast-forwards the deterministic shuffle
+    #: stream past
+    batch_cursor: int = 0
+    #: completed unit-of-work names (prune targets / sweep layers)
+    completed: List[str] = field(default_factory=list)
+    #: serialized per-round records (PruneStepRecord dicts / epoch rows) so
+    #: a resumed run returns the FULL history, not just its own tail
+    records: List[Dict[str, Any]] = field(default_factory=list)
+    #: LR backoff multiplier currently in force (rollback halves it)
+    lr_scale: float = 1.0
+    #: accum_steps override after OOM degradation (0 = use the config's)
+    accum_steps: int = 0
+    #: monotone commit counter (names checkpoint dirs uniquely even when
+    #: two commits land at the same optimizer step)
+    commits: int = 0
+    #: how many times this run has been resumed
+    resumes: int = 0
+    #: how many rollback-to-checkpoint recoveries have fired
+    rollbacks: int = 0
+    #: "running" | "preempted" | "done"
+    status: str = "running"
+    #: driver-specific mid-round position (opaque here)
+    stage: Dict[str, Any] = field(default_factory=dict)
+
+    # -- persistence -------------------------------------------------------
+
+    @staticmethod
+    def path_in(run_dir: str) -> str:
+        return os.path.join(os.path.abspath(run_dir), MANIFEST_NAME)
+
+    @classmethod
+    def exists_in(cls, run_dir: str) -> bool:
+        return os.path.exists(cls.path_in(run_dir))
+
+    @classmethod
+    def load(cls, run_dir: str) -> "RunManifest":
+        raw = read_json(cls.path_in(run_dir))
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in raw.items() if k in known})
+
+    @classmethod
+    def load_or_new(cls, run_dir: str, *, kind: str,
+                    experiment: str) -> "RunManifest":
+        """Resume semantics: an existing manifest is loaded (and must have
+        been written by the same ``kind`` of driver); otherwise a fresh
+        one is created in memory (committed on the first checkpoint)."""
+        if cls.exists_in(run_dir):
+            m = cls.load(run_dir)
+            if m.kind != kind:
+                raise ValueError(
+                    f"run dir {run_dir!r} holds a {m.kind!r} manifest — "
+                    f"refusing to resume it as a {kind!r} run (their "
+                    "stage payloads are not interchangeable; use a fresh "
+                    "directory)"
+                )
+            return m
+        return cls(kind=kind, experiment=experiment)
+
+    def save(self, run_dir: str) -> None:
+        atomic_write_json(self.path_in(run_dir), dataclasses.asdict(self))
+
+    # -- checkpoint dir bookkeeping ---------------------------------------
+
+    def gc_checkpoints(self, run_dir: str, keep: int = 2) -> None:
+        """Delete ``ckpt-*`` directories not among the ``keep`` most
+        recently committed (the manifest's current pointer is always
+        kept).  A half-written checkpoint from a crash is itself a
+        ``ckpt-*`` dir, so it ages out here too; intra-checkpoint
+        ``.arrays.*`` litter is swept by ``save_checkpoint``.
+        Best-effort: GC failure never fails a commit."""
+        import shutil
+
+        try:
+            entries = sorted(
+                (e for e in os.listdir(run_dir) if e.startswith("ckpt-")),
+                key=lambda e: os.path.getmtime(os.path.join(run_dir, e)),
+            )
+        except OSError:
+            return
+        survivors = set(entries[-keep:]) | {self.checkpoint}
+        for e in entries:
+            if e not in survivors:
+                shutil.rmtree(os.path.join(run_dir, e), ignore_errors=True)

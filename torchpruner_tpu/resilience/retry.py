@@ -1,0 +1,115 @@
+"""Retry with exponential backoff and deterministic jitter.
+
+For *transient* failures — flaky data loads, host-callback hiccups,
+filesystem blips while writing a checkpoint — where the right response
+is "wait a moment and try again", not "roll back to a checkpoint".
+Persistent failures (the exception keeps coming) re-raise after the
+budget is spent; non-retryable exception types pass straight through.
+
+Jitter is deterministic (splitmix-style hash of ``seed`` + attempt), the
+same policy the repo uses for data shuffling: two runs of the same
+config produce the same sleep schedule, so retry behavior never makes a
+resumed run diverge from an uninterrupted one.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple, Type
+
+from torchpruner_tpu import obs
+
+#: exception types considered transient by default: data-loading /
+#: host-callback I/O.  Deliberately narrow — an OOM or a NaN streak must
+#: NOT be retried blindly (they have their own recovery paths in
+#: ``guards`` / ``runner``).
+DEFAULT_TRANSIENT: Tuple[Type[BaseException], ...] = (
+    OSError, IOError, ConnectionError, TimeoutError,
+)
+
+
+def _jitter01(seed: int, attempt: int) -> float:
+    """Deterministic uniform-ish [0, 1) from (seed, attempt) — splitmix64
+    finalizer, matching the repo's shuffle hashing idiom."""
+    z = (seed * 0x9E3779B97F4A7C15 + attempt * 0xBF58476D1CE4E5B9) \
+        & 0xFFFFFFFFFFFFFFFF
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return ((z ^ (z >> 31)) & 0xFFFFFFFFFFFFFFFF) / 2.0**64
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    tries: int = 4                 # total attempts (1 = no retry)
+    base_delay_s: float = 0.05     # delay before the 1st retry
+    factor: float = 2.0            # exponential growth per retry
+    max_delay_s: float = 2.0       # backoff ceiling
+    jitter: float = 0.5            # +- fraction of the delay randomized
+    seed: int = 0                  # jitter determinism
+
+    def delay(self, attempt: int) -> float:
+        """Sleep before retry ``attempt`` (1-based)."""
+        d = min(self.base_delay_s * self.factor ** (attempt - 1),
+                self.max_delay_s)
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * _jitter01(self.seed, attempt)
+                                      - 1.0)
+        return max(0.0, d)
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    policy: RetryPolicy = RetryPolicy(),
+    retry_on: Tuple[Type[BaseException], ...] = DEFAULT_TRANSIENT,
+    label: str = "call",
+    sleep: Callable[[float], None] = time.sleep,
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    **kwargs,
+):
+    """Call ``fn(*args, **kwargs)``, retrying transient failures with
+    exponential backoff.  Each retry bumps ``resilience_retries_total``;
+    exhausting the budget re-raises the LAST exception unchanged (the
+    caller sees the real failure, not a wrapper)."""
+    last: Optional[BaseException] = None
+    for attempt in range(1, policy.tries + 1):
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as e:  # noqa: PERF203 - retry loop by design
+            last = e
+            if attempt == policy.tries:
+                raise
+            obs.inc("resilience_retries_total",
+                    help="transient-failure retries (retry_call)")
+            if label != "call":
+                # per-site breakdown: checkpoint-FS retries vs data-
+                # stream retries are different operational signals
+                obs.inc(f"resilience_retries_{label}_total",
+                        help=f"transient-failure retries ({label})")
+            if on_retry is not None:
+                on_retry(attempt, e)
+            sleep(policy.delay(attempt))
+    raise last  # unreachable; keeps type checkers honest
+
+
+def retriable(policy: RetryPolicy = RetryPolicy(),
+              retry_on: Tuple[Type[BaseException], ...] = DEFAULT_TRANSIENT,
+              label: str = "call"):
+    """Decorator form of :func:`retry_call`::
+
+        @retriable(RetryPolicy(tries=3))
+        def fetch_shard(i): ...
+    """
+
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return retry_call(fn, *args, policy=policy, retry_on=retry_on,
+                              label=label, **kwargs)
+
+        return wrapped
+
+    return deco

@@ -1,0 +1,664 @@
+"""Resumable pipeline drivers: the wiring between checkpoints, manifests,
+guards, chaos, and the experiment loops.
+
+Commit protocol (everything else follows from it):
+
+1. write a NEW digest-sealed checkpoint directory ``ckpt-…`` (atomic
+   within itself — ``checkpoint.save_checkpoint``);
+2. atomically replace ``manifest.json`` to point at it (position: epoch,
+   data cursor, completed rounds, LR backoff, accum override);
+3. garbage-collect superseded checkpoint dirs.
+
+A SIGKILL between any two instructions leaves the manifest referencing a
+complete checkpoint; resume = load manifest → restore its checkpoint →
+fast-forward the deterministic data stream past ``batch_cursor`` → keep
+going.  The resumed trajectory is the uninterrupted one (same rng, same
+shuffle, same batches), which is what the crash-resume test pins.
+
+Recovery paths on top of the same machinery:
+
+- **NaN/Inf streak** (``StepGuard`` raising ``NonFiniteStreakError``):
+  roll back to the manifest's checkpoint, multiply the LR by
+  ``cfg.lr_backoff`` (an ``optax.scale`` stage whose factor changes but
+  whose treedef doesn't, so restored opt-state stays valid), retry.
+- **OOM** (``is_oom_error``): roll back, double ``accum_steps`` (halved
+  microbatch activations), recompile, retry — the classic graceful
+  degradation for a batch that stopped fitting after a config change.
+- **Preemption** (SIGTERM): snapshot at the next step boundary
+  (process 0 writes; the flag is broadcast so a mesh snapshots one
+  consistent boundary), mark the manifest ``preempted``, unwind.
+
+Single-writer note: checkpoint/manifest writes are gated on
+``jax.process_index() == 0``.  Multi-host sharded array trees would need
+orbax's collective save; the manifest/commit protocol is already
+host-agnostic.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from torchpruner_tpu import obs
+from torchpruner_tpu.resilience import chaos
+from torchpruner_tpu.resilience.guards import (
+    NonFiniteStreakError,
+    Preempted,
+    PreemptionHandler,
+    StepGuard,
+    is_oom_error,
+    next_accum_for_oom,
+)
+from torchpruner_tpu.resilience.manifest import RunManifest, atomic_write_json
+from torchpruner_tpu.resilience.retry import (
+    DEFAULT_TRANSIENT,
+    RetryPolicy,
+    retry_call,
+)
+
+_CKPT_RETRY = RetryPolicy(tries=3, base_delay_s=0.1)
+
+
+def rng_to_list(rng) -> list:
+    import jax
+
+    return np.asarray(jax.device_get(rng), dtype=np.uint32).tolist()
+
+
+def rng_from_list(lst):
+    import jax.numpy as jnp
+
+    return jnp.asarray(np.asarray(lst, dtype=np.uint32))
+
+
+def _is_writer() -> bool:
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+def _preempt_agreed(pre: PreemptionHandler, at_boundary: bool = True) -> bool:
+    """Mesh-safe preemption poll.  Single-process: the local flag, any
+    time.  Multi-process: ONLY at ``at_boundary`` points that every
+    process reaches deterministically (checkpoint cadence, epoch/round
+    ends) — the broadcast inside ``should_snapshot`` is a collective,
+    so gating it on the process-LOCAL flag would have the signalled
+    process enter the collective while the others skip it, hanging the
+    mesh.  Here every process either calls it or doesn't, together."""
+    try:
+        import jax
+
+        multi = jax.process_count() > 1
+    except Exception:
+        multi = False
+    if multi:
+        return at_boundary and pre.should_snapshot()
+    return pre.requested
+
+
+def _quarantine_cache_on_resume(verbose: bool) -> None:
+    """CPU resume processes must not read the persistent XLA cache —
+    see ``utils.compilation_cache.quarantine_for_resume`` for the
+    chaos-drill evidence (heap corruption in cache deserialize)."""
+    from torchpruner_tpu.utils.compilation_cache import quarantine_for_resume
+
+    if quarantine_for_resume() and verbose:
+        print(
+            "[resilience] resume on CPU: persistent XLA compilation "
+            "cache disabled for this process (deserialize instability; "
+            "recompiles instead)", flush=True,
+        )
+
+
+def scaled_optimizer(cfg, steps_per_epoch: int, lr_scale: float,
+                     total_epochs: Optional[int] = None):
+    """The config's optimizer with the rollback LR-backoff stage chained
+    on.  ``optax.scale``'s state is empty, so every ``lr_scale`` value
+    yields the SAME opt-state treedef — a checkpoint saved before a
+    backoff restores cleanly after it."""
+    import optax
+
+    from torchpruner_tpu.experiments.prune_retrain import make_optimizer
+
+    return optax.chain(
+        make_optimizer(cfg, steps_per_epoch=steps_per_epoch,
+                       total_epochs=total_epochs),
+        optax.scale(lr_scale),
+    )
+
+
+def commit_checkpoint(run_dir: str, manifest: RunManifest, trainer, *,
+                      epoch: int, batch_cursor: int,
+                      stage: Optional[Dict[str, Any]] = None,
+                      records: Optional[List[dict]] = None,
+                      status: str = "running") -> None:
+    """The 3-step commit described in the module docstring.  Timed into
+    ``checkpoint_write_seconds``; the checkpoint write itself is
+    retry-wrapped (transient FS errors happen exactly when a preempting
+    node is being drained).  No-op on non-writer processes."""
+    if not _is_writer():
+        return
+    from torchpruner_tpu.checkpoint import save_checkpoint
+
+    manifest.commits = getattr(manifest, "commits", 0) + 1
+    name = f"ckpt-{manifest.commits:06d}-s{int(trainer.step_count):08d}"
+    path = os.path.join(run_dir, name)
+    t0 = time.perf_counter()
+    with obs.span("checkpoint_write", ckpt=name):
+        retry_call(
+            save_checkpoint, path, trainer.model, trainer.params,
+            trainer.state, trainer.opt_state,
+            step=int(trainer.step_count),
+            extra={"rng": rng_to_list(trainer.rng), "epoch": epoch,
+                   "batch_cursor": batch_cursor},
+            policy=_CKPT_RETRY, label="checkpoint_write",
+        )
+    obs.observe("checkpoint_write_seconds", time.perf_counter() - t0,
+                help="wall seconds per committed checkpoint write")
+    if chaos.active():
+        # fault injection AFTER the write: the digest must catch it
+        chaos.corrupt_checkpoint_bytes(path)
+    manifest.checkpoint = name
+    manifest.step = int(trainer.step_count)
+    manifest.epoch = epoch
+    manifest.batch_cursor = batch_cursor
+    if stage is not None:
+        manifest.stage = stage
+    if records is not None:
+        manifest.records = records
+    manifest.status = status
+    retry_call(manifest.save, run_dir, policy=_CKPT_RETRY,
+               label="manifest_write")
+    manifest.gc_checkpoints(run_dir)
+
+
+def restore_committed(run_dir: str, manifest: RunManifest, tx):
+    """Load the manifest's checkpoint → ``(model, params, state,
+    opt_state, meta)`` (digest-verified; raises CheckpointCorruptError on
+    damage)."""
+    from torchpruner_tpu.checkpoint import restore_checkpoint
+
+    return restore_checkpoint(os.path.join(run_dir, manifest.checkpoint),
+                              tx=tx)
+
+
+# -- the resumable from-scratch training driver -----------------------------
+
+
+_SENTINEL = object()
+
+
+def _floats(losses) -> List[float]:
+    """Fence + filter: device scalars → finite floats (guard-skipped
+    steps report NaN loss and are excluded from epoch means)."""
+    out = []
+    for v in losses:
+        f = float(v)
+        if np.isfinite(f):
+            out.append(f)
+    return out
+
+
+def run_resilient_train(cfg, *, model=None, datasets=None,
+                        verbose: bool = True):
+    """``experiments.train_model.run_train`` semantics with the full
+    resilience loop (activated by ``cfg.run_dir``; ``run_train``
+    delegates here).  Returns ``(trainer, history)`` where ``history``
+    spans ALL attempts — a resumed run returns the epochs its
+    predecessors completed too."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchpruner_tpu.data.native import device_prefetch
+    from torchpruner_tpu.experiments.prune_retrain import (
+        LOSS_REGISTRY,
+        resolve_model_and_data,
+    )
+    from torchpruner_tpu.experiments.train_model import epoch_batches
+    from torchpruner_tpu.train.logger import CSVLogger
+    from torchpruner_tpu.train.loop import Trainer
+
+    if cfg.chaos:
+        chaos.configure(cfg.chaos)
+    run_dir = os.path.abspath(cfg.run_dir)
+    os.makedirs(run_dir, exist_ok=True)
+    manifest = RunManifest.load_or_new(run_dir, kind="train",
+                                       experiment=cfg.name)
+    resuming = bool(manifest.checkpoint)
+
+    model, (train, _val, test) = resolve_model_and_data(cfg, model, datasets)
+    spe = max(1, len(train) // cfg.batch_size)
+    loss_fn = LOSS_REGISTRY[cfg.loss]
+    cdtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else None
+    accum = manifest.accum_steps or cfg.accum_steps
+    guard = StepGuard(cfg.max_bad_steps) if cfg.guard_nonfinite else None
+
+    def build_trainer(params=None, state=None) -> Trainer:
+        t = Trainer.create(
+            model, scaled_optimizer(cfg, spe, manifest.lr_scale), loss_fn,
+            seed=cfg.seed, params=params, state=state,
+            compute_dtype=cdtype, remat=cfg.remat, accum_steps=accum,
+            moe_aux_weight=cfg.moe_aux_weight, grad_norm=cfg.obs_grad_norm,
+            guard=guard,
+        )
+        return t
+
+    def restore_trainer() -> Trainer:
+        nonlocal model
+        tx = scaled_optimizer(cfg, spe, manifest.lr_scale)
+        m2, p2, s2, o2, meta = restore_committed(run_dir, manifest, tx)
+        model = m2
+        t = build_trainer(params=p2, state=s2)
+        if o2 is not None:
+            t.opt_state = o2
+        rng = meta.get("extra", {}).get("rng")
+        if rng is not None:
+            t.rng = rng_from_list(rng)
+        t.step_count = int(meta.get("step", 0))
+        return t
+
+    if resuming:
+        _quarantine_cache_on_resume(verbose)
+        # injections already survived before the commit stay dead — a
+        # kill step coinciding with a commit boundary must not re-kill
+        chaos.disarm_through(manifest.step)
+        trainer = restore_trainer()
+        manifest.resumes += 1
+        obs.inc("resilience_resumes_total",
+                help="runs resumed from a manifest + checkpoint")
+        if verbose:
+            print(
+                f"[{cfg.name}] resumed from {manifest.checkpoint} "
+                f"(epoch {manifest.epoch}, step {manifest.step}, "
+                f"cursor {manifest.batch_cursor}, "
+                f"resume #{manifest.resumes})", flush=True,
+            )
+    else:
+        trainer = build_trainer()
+
+    logger = CSVLogger(cfg.log_path, experiment=cfg.name)
+    test_batches = test.batches(cfg.eval_batch_size)
+    history: List[dict] = [dict(r) for r in manifest.records]
+    epoch = manifest.epoch
+    cursor = manifest.batch_cursor
+    losses: List[Any] = list(manifest.stage.get("losses", []))
+    every = cfg.checkpoint_every_steps
+    data_retry = RetryPolicy(tries=4, base_delay_s=0.02, seed=cfg.seed)
+
+    def snapshot(status: str = "running") -> None:
+        losses[:] = _floats(losses)
+        commit_checkpoint(
+            run_dir, manifest, trainer, epoch=epoch, batch_cursor=cursor,
+            stage={"losses": list(losses)},
+            records=list(history), status=status,
+        )
+
+    def rollback(reason: str):
+        nonlocal trainer, epoch, cursor, losses
+        if not manifest.checkpoint:
+            raise RuntimeError(
+                f"cannot roll back ({reason}): no checkpoint committed "
+                "yet — set checkpoint_every_steps > 0 for early coverage"
+            )
+        obs.inc("resilience_rollbacks_total",
+                help="rollback-to-checkpoint recoveries")
+        trainer = restore_trainer()
+        if guard is not None:
+            guard.reset()
+        epoch = manifest.epoch
+        cursor = manifest.batch_cursor
+        losses = list(manifest.stage.get("losses", []))
+        if verbose:
+            print(f"[{cfg.name}] rolled back to {manifest.checkpoint} "
+                  f"({reason})", flush=True)
+
+    try:
+        with PreemptionHandler() as pre:
+            while epoch < cfg.epochs:
+                try:
+                    t0 = time.perf_counter()
+
+                    def open_stream():
+                        """(Re)establish this epoch's batch stream
+                        fast-forwarded to the current cursor — the
+                        shuffle is deterministic, so re-opening after a
+                        transient failure replays the exact remaining
+                        batches."""
+                        s = epoch_batches(train, cfg, epoch)
+                        for _ in range(cursor):
+                            next(s)
+                        if cfg.device_prefetch:
+                            s = device_prefetch(
+                                s, size=cfg.device_prefetch)
+                        return iter(s)
+
+                    def next_batch(it):
+                        """One fetch, with REAL transient-data retry: a
+                        generator that raised is closed for good, so
+                        recovery re-opens the stream at the cursor
+                        rather than re-polling the corpse (which would
+                        silently truncate the epoch)."""
+                        attempt = 0
+                        while True:
+                            try:
+                                if chaos.active():
+                                    chaos.maybe_fail_data(
+                                        trainer.step_count)
+                                    chaos.maybe_delay()
+                                return it, next(it, _SENTINEL)
+                            except DEFAULT_TRANSIENT:
+                                attempt += 1
+                                if attempt >= data_retry.tries:
+                                    raise
+                                obs.inc("resilience_retries_total",
+                                        help="transient-failure "
+                                             "retries (retry_call)")
+                                obs.inc(
+                                    "resilience_retries_data_fetch_total",
+                                    help="transient-failure retries "
+                                         "(data_fetch)")
+                                time.sleep(data_retry.delay(attempt))
+                                it = open_stream()
+
+                    it = open_stream()
+                    with obs.span("train", epoch=epoch):
+                        while True:
+                            it, batch = next_batch(it)
+                            if batch is _SENTINEL:
+                                break
+                            x, y = batch
+                            if accum > 1 and x.shape[0] % accum:
+                                # OOM-degraded accumulation can't split a
+                                # ragged tail batch; drop it (counted —
+                                # never silently) and keep the cursor
+                                # aligned with the stream
+                                cursor += 1
+                                obs.inc(
+                                    "resilience_ragged_drops_total",
+                                    help="tail batches dropped because "
+                                         "they don't divide the degraded "
+                                         "accum_steps")
+                                continue
+                            losses.append(trainer.step(x, y))
+                            cursor += 1
+                            if len(losses) % 8 == 0:
+                                # bound async run-ahead without draining
+                                jax.block_until_ready(losses[-8])
+                            due = bool(every
+                                       and trainer.step_count % every == 0)
+                            if _preempt_agreed(pre, at_boundary=due):
+                                snapshot(status="preempted")
+                                raise Preempted()
+                            if due:
+                                snapshot()
+                    epoch_losses = _floats(losses)
+                    with obs.span("eval", epoch=epoch):
+                        test_loss, test_acc = trainer.evaluate(test_batches)
+                    rec = {
+                        "epoch": epoch,
+                        "train_loss": float(np.mean(epoch_losses))
+                        if epoch_losses else float("nan"),
+                        "test_loss": test_loss,
+                        "test_acc": test_acc,
+                        "seconds": time.perf_counter() - t0,
+                    }
+                    history.append(rec)
+                    logger.log_epoch(
+                        epoch=epoch, train_loss=rec["train_loss"],
+                        test_loss=test_loss, test_acc=test_acc,
+                        seconds=rec["seconds"],
+                    )
+                    if verbose:
+                        print(
+                            f"[{cfg.name}] epoch {epoch}: train "
+                            f"{rec['train_loss']:.4f} test {test_loss:.4f} "
+                            f"acc {test_acc:.4f} "
+                            f"({rec['seconds']:.1f}s)", flush=True,
+                        )
+                    epoch += 1
+                    cursor = 0
+                    losses = []
+                    # epoch boundaries always commit: the manifest must
+                    # never point BEHIND completed work.  They are also
+                    # the multi-process preemption boundary when no step
+                    # cadence is configured.
+                    if _preempt_agreed(pre, at_boundary=True):
+                        snapshot(status="preempted")
+                        raise Preempted()
+                    snapshot()
+                except NonFiniteStreakError as e:
+                    manifest.rollbacks += 1
+                    if manifest.rollbacks > cfg.max_rollbacks:
+                        raise
+                    manifest.lr_scale *= cfg.lr_backoff
+                    rollback(f"{e.streak} consecutive non-finite steps; "
+                             f"lr_scale -> {manifest.lr_scale:g}")
+                except Exception as e:  # noqa: BLE001 - classified below
+                    if not is_oom_error(e):
+                        raise
+                    new_accum = next_accum_for_oom(accum, cfg.batch_size)
+                    if new_accum is None:
+                        raise  # nothing left to degrade to
+                    obs.inc("resilience_oom_retries_total",
+                            help="OOM recoveries via doubled accum_steps")
+                    accum = new_accum
+                    manifest.accum_steps = accum
+                    rollback(f"OOM; accum_steps -> {accum} "
+                             f"(microbatch {cfg.batch_size // accum})")
+    except Preempted:
+        if verbose:
+            print(f"[{cfg.name}] preempted: snapshot committed at step "
+                  f"{manifest.step}; re-run with --resume {run_dir} to "
+                  "continue", flush=True)
+        logger.close()
+        return trainer, history
+
+    manifest.status = "done"
+    if _is_writer():
+        manifest.save(run_dir)
+    logger.close()
+    return trainer, history
+
+
+# -- prune-retrain journal ---------------------------------------------------
+
+
+class PruneJournal:
+    """Round-granular resume for ``run_prune_retrain``: which targets
+    completed (with their full :class:`PruneStepRecord` payloads), and —
+    mid-round — whether the prune was applied and how many retrain
+    epochs ran, so a kill during fine-tuning resumes at the next epoch
+    of the SAME target instead of re-scoring it."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.run_dir = os.path.abspath(cfg.run_dir)
+        os.makedirs(self.run_dir, exist_ok=True)
+        self.manifest = RunManifest.load_or_new(
+            self.run_dir, kind="prune_retrain", experiment=cfg.name)
+        self.resuming = bool(self.manifest.checkpoint)
+        self.pre = PreemptionHandler().__enter__()
+        if self.resuming:
+            _quarantine_cache_on_resume(verbose=True)
+            chaos.disarm_through(self.manifest.step)
+            self.manifest.resumes += 1
+            obs.inc("resilience_resumes_total",
+                    help="runs resumed from a manifest + checkpoint")
+
+    @property
+    def completed(self) -> List[str]:
+        return self.manifest.completed
+
+    @property
+    def lr_scale(self) -> float:
+        return self.manifest.lr_scale
+
+    def records(self) -> List[dict]:
+        return [dict(r) for r in self.manifest.records]
+
+    def stage_for(self, target: str) -> Optional[Dict[str, Any]]:
+        """Mid-round state for ``target`` if the run died during its
+        retrain phase (prune already applied)."""
+        st = self.manifest.stage
+        if st.get("phase") == "retrain" and st.get("target") == target:
+            return st
+        return None
+
+    def restore(self, tx):
+        return restore_committed(self.run_dir, self.manifest, tx)
+
+    # -- commits ----------------------------------------------------------
+
+    def _commit(self, trainer, stage, status="running"):
+        # persist OOM degradation: without this a resumed run would
+        # rebuild at the config's accum_steps and re-OOM on its first
+        # retrain step, paying a rollback cycle per resume
+        acc = int(getattr(trainer, "accum_steps", 0) or 0)
+        self.manifest.accum_steps = \
+            acc if acc != self.cfg.accum_steps else 0
+        commit_checkpoint(
+            self.run_dir, self.manifest, trainer,
+            epoch=len(self.manifest.completed), batch_cursor=0,
+            stage=stage, records=self.manifest.records, status=status,
+        )
+
+    def pruned(self, trainer, target: str, stage: Dict[str, Any]) -> None:
+        """Prune applied, retrain not started — the mid-round anchor."""
+        stage = dict(stage, phase="retrain", target=target,
+                     retrain_epoch=0)
+        self._commit(trainer, stage)
+
+    def retrain_epoch_done(self, trainer, target: str, epoch: int) -> None:
+        if not self.cfg.checkpoint_every_steps:
+            # round-boundary-only cadence: no per-epoch checkpoint, and
+            # the stage's retrain_epoch deliberately stays at the last
+            # COMMITTED anchor (advancing it without a checkpoint would
+            # make resume skip epochs the checkpoint never saw)
+            return
+        stage = dict(self.manifest.stage, retrain_epoch=epoch)
+        self._commit(trainer, stage)
+
+    def round_done(self, trainer, target: str, record: dict) -> None:
+        self.manifest.completed.append(target)
+        self.manifest.records.append(record)
+        self._commit(trainer, stage={})
+
+    def check_preempt(self, trainer,
+                      stage: Optional[Dict[str, Any]] = None) -> None:
+        """Target/retrain-epoch boundaries — deterministic across the
+        mesh, so the multi-process agreement can poll here.  ``stage``
+        must describe the trainer being snapshotted: a mid-retrain call
+        passes its current ``retrain_epoch``, otherwise the (possibly
+        stale) last-committed stage would make the resumed run redo
+        epochs on top of already-retrained params."""
+        if _preempt_agreed(self.pre, at_boundary=True):
+            self._commit(trainer,
+                         stage=(stage if stage is not None
+                                else dict(self.manifest.stage)),
+                         status="preempted")
+            raise Preempted()
+
+    def on_streak(self, e: NonFiniteStreakError) -> None:
+        """Budget + LR backoff bookkeeping; caller restores the trainer."""
+        self.manifest.rollbacks += 1
+        if self.manifest.rollbacks > self.cfg.max_rollbacks:
+            raise e
+        self.manifest.lr_scale *= self.cfg.lr_backoff
+        obs.inc("resilience_rollbacks_total",
+                help="rollback-to-checkpoint recoveries")
+
+    def close(self) -> None:
+        """Restore the SIGTERM handler (idempotent) — MUST run on every
+        exit path, or later code in the process silently swallows
+        preemption notices."""
+        self.pre.__exit__(None, None, None)
+
+    def done(self) -> None:
+        self.manifest.status = "done"
+        if _is_writer():
+            self.manifest.save(self.run_dir)
+        self.close()
+
+
+# -- robustness-sweep journal ------------------------------------------------
+
+
+class SweepJournal:
+    """Layer-granular resume for the robustness sweep: completed layers'
+    full results persist (atomically) in ``sweep_results.json`` inside
+    the run dir; a resumed sweep skips them and merges at the end.  The
+    sweep holds no optimizer state, so there is no checkpoint — the
+    results file IS the durable artifact."""
+
+    RESULTS_NAME = "sweep_results.json"
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.run_dir = os.path.abspath(cfg.run_dir)
+        os.makedirs(self.run_dir, exist_ok=True)
+        self.manifest = RunManifest.load_or_new(
+            self.run_dir, kind="robustness", experiment=cfg.name)
+        self.pre = PreemptionHandler().__enter__()
+        self.results_path = os.path.join(self.run_dir, self.RESULTS_NAME)
+        self.saved: Dict[str, Any] = {}
+        if os.path.exists(self.results_path):
+            from torchpruner_tpu.resilience.manifest import read_json
+
+            self.saved = read_json(self.results_path)
+        self.resuming = bool(self.manifest.completed)
+        if self.resuming:
+            self.manifest.resumes += 1
+            obs.inc("resilience_resumes_total",
+                    help="runs resumed from a manifest + checkpoint")
+            if _is_writer():
+                self.manifest.save(self.run_dir)
+
+    def remaining(self, layers: List[str]) -> List[str]:
+        done = set(self.manifest.completed)
+        return [l for l in layers if l not in done]
+
+    def on_layer(self, layer: str, layer_results: Dict[str, list]) -> None:
+        """Persist one finished layer (listified for JSON) and advance
+        the manifest — then honor a pending preemption at this
+        boundary."""
+        self.saved[layer] = {
+            m: [
+                {k: (np.asarray(v).tolist()
+                     if hasattr(v, "__array__") else v)
+                 for k, v in r.items()}
+                for r in runs
+            ]
+            for m, runs in layer_results.items()
+        }
+        if _is_writer():
+            retry_call(atomic_write_json, self.results_path, self.saved,
+                       policy=_CKPT_RETRY, label="sweep_results")
+            self.manifest.completed.append(layer)
+            self.manifest.save(self.run_dir)
+        if _preempt_agreed(self.pre, at_boundary=True):
+            self.manifest.status = "preempted"
+            if _is_writer():
+                self.manifest.save(self.run_dir)
+            raise Preempted()
+
+    def merged(self, fresh: Dict[str, Dict[str, list]]):
+        out = dict(self.saved)
+        for layer, methods in fresh.items():
+            out[layer] = methods
+        return out
+
+    def close(self) -> None:
+        """See PruneJournal.close — idempotent handler restore."""
+        self.pre.__exit__(None, None, None)
+
+    def done(self) -> None:
+        self.manifest.status = "done"
+        if _is_writer():
+            self.manifest.save(self.run_dir)
+        self.close()

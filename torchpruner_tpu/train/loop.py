@@ -24,6 +24,7 @@ import optax
 
 from torchpruner_tpu import obs
 from torchpruner_tpu.core.segment import SegmentedModel
+from torchpruner_tpu.resilience import chaos as _chaos
 from torchpruner_tpu.utils.losses import accuracy
 
 
@@ -75,12 +76,14 @@ def make_loss_closure(model: SegmentedModel, loss_fn, compute_dtype=None,
 def make_train_step(model: SegmentedModel, tx, loss_fn, donate: bool = True,
                     compute_dtype=None, remat: bool = False,
                     accum_steps: int = 1, moe_aux_weight: float = 0.0,
-                    grad_norm: bool = False):
+                    grad_norm: bool = False, guard: bool = False):
     """(params, state, opt_state, x, y, rng) -> (params, state, opt_state,
     loss).  Donation reuses the input buffers for the outputs.  Mixed
     precision / remat per :func:`make_loss_closure`.  ``grad_norm=True``
     makes the loss output a ``(loss, global grad norm)`` pair (opt-in
     telemetry — the extra reduction is fused into the same program).
+    ``guard=True`` adds the compiled non-finite guard (see
+    :func:`make_step_body`).
 
     ``accum_steps > 1`` = gradient accumulation: the batch splits into that
     many microbatches, a ``lax.scan`` inside the SAME jit accumulates their
@@ -93,27 +96,54 @@ def make_train_step(model: SegmentedModel, tx, loss_fn, donate: bool = True,
     loss_c = make_loss_closure(model, loss_fn, compute_dtype, remat,
                                moe_aux_weight)
     donate_argnums = (0, 2) if donate else ()
-    return jax.jit(make_step_body(loss_c, tx, accum_steps, grad_norm),
+    return jax.jit(make_step_body(loss_c, tx, accum_steps, grad_norm, guard),
                    donate_argnums=donate_argnums)
 
 
 def make_step_body(loss_c, tx, accum_steps: int = 1,
-                   grad_norm: bool = False):
+                   grad_norm: bool = False, guard: bool = False):
     """The un-jitted ``(params, state, opt_state, x, y, rng) -> (params,
     state, opt_state, loss)`` body shared by the local and SPMD trainers —
     callers add their own ``jit`` (with explicit shardings for SPMD).
-    With ``grad_norm`` the last output is ``(loss, global grad norm)``."""
+    With ``grad_norm`` the last output is ``(loss, global grad norm)``.
 
-    def _out(l, grads):
-        return (l, optax.global_norm(grads)) if grad_norm else l
+    ``guard=True`` compiles the non-finite step guard INTO the program:
+    ``ok = isfinite(loss) & isfinite(global_norm(grads))`` gates the
+    parameter update, the BN-state update, and the opt-state transition
+    through ``jnp.where`` — a NaN/Inf step costs its forward/backward but
+    leaves the training bundle bit-identical (true skip-and-count, no
+    host round-trip in the decision).  The loss output grows a trailing
+    ``bad`` flag (0./1.) the host-side ``resilience.StepGuard`` consumes:
+    ``(loss, bad)`` / ``(loss, gnorm, bad)`` with ``grad_norm``."""
+
+    def _finish(l, grads, params, state, opt_state, new_state):
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        gnorm = optax.global_norm(grads) if (grad_norm or guard) else None
+        if guard:
+            ok = jnp.isfinite(l) & jnp.isfinite(gnorm)
+
+            def pick(new, old):
+                return jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(ok, a, b), new, old
+                )
+
+            new_params = pick(new_params, params)
+            new_state = pick(new_state, state)
+            new_opt = pick(new_opt, opt_state)
+        out = (l,)
+        if grad_norm:
+            out += (gnorm,)
+        if guard:
+            out += ((~ok).astype(jnp.float32),)
+        return new_params, new_state, new_opt, \
+            out if len(out) > 1 else out[0]
 
     def step(params, state, opt_state, x, y, rng):
         (l, new_state), grads = jax.value_and_grad(
             lambda p: loss_c(p, state, x, y, rng), has_aux=True
         )(params)
-        updates, new_opt = tx.update(grads, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
-        return new_params, new_state, new_opt, _out(l, grads)
+        return _finish(l, grads, params, state, opt_state, new_state)
 
     def step_accum(params, state, opt_state, x, y, rng):
         B = x.shape[0]
@@ -139,9 +169,8 @@ def make_step_body(loss_c, tx, accum_steps: int = 1,
             body, (state, zeros, jnp.float32(0.0)), (xs, ys, rngs)
         )
         grads = jax.tree_util.tree_map(lambda g: g / accum_steps, gsum)
-        updates, new_opt = tx.update(grads, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
-        return new_params, new_state, new_opt, _out(lsum / accum_steps, grads)
+        return _finish(lsum / accum_steps, grads, params, state, opt_state,
+                       new_state)
 
     return step if accum_steps <= 1 else step_accum
 
@@ -235,6 +264,22 @@ def _batch_tokens(x, y):
     return None
 
 
+def _warn_empty_eval(where: str) -> None:
+    """An empty/exhausted evaluation iterator is almost always a caller
+    bug (a consumed generator passed where a re-iterable was expected) —
+    make it loud: a logger warning plus the ``eval_empty_total`` obs
+    counter, so it shows up in telemetry even when logs are swallowed."""
+    from torchpruner_tpu.train.logger import log
+
+    obs.inc("eval_empty_total",
+            help="evaluate()/train_epoch() calls that saw zero batches")
+    log.warning(
+        "%s received an empty or exhausted data iterator — no examples "
+        "were evaluated (did you pass a one-shot generator instead of a "
+        "re-iterable batch list?)", where,
+    )
+
+
 def evaluate(model, params, state, data, loss_fn):
     """Average loss and accuracy over ``data`` (reference train.py:51-72).
     Loss averages per example; accuracy per prediction (== per example for
@@ -248,6 +293,7 @@ def evaluate(model, params, state, data, loss_fn):
         tot_n += int(n)
         tot_p += int(n_pred)
     if tot_n == 0:
+        _warn_empty_eval("evaluate()")
         raise ValueError("evaluate() got an empty dataset")
     return tot_l / tot_n, tot_c / tot_p
 
@@ -255,7 +301,10 @@ def evaluate(model, params, state, data, loss_fn):
 def train_epoch(trainer, data, epoch: int = 0, log_every: int = 20,
                 verbose: bool = True):
     """One epoch over ``data``; returns (avg loss, avg acc is not computed
-    here — use evaluate).  Mirrors reference train.py:11-48's cadence."""
+    here — use evaluate).  Mirrors reference train.py:11-48's cadence.
+    An empty iterator logs a warning + ``eval_empty_total`` and returns
+    ``nan`` (not raised: a final ragged epoch of zero batches should not
+    kill a long run, but it must not pass silently either)."""
     t0 = time.perf_counter()
     losses = []
     for i, (x, y) in enumerate(data() if callable(data) else data):
@@ -267,7 +316,10 @@ def train_epoch(trainer, data, epoch: int = 0, log_every: int = 20,
                 f"epoch {epoch} batch {i}: loss {losses[-1]:.4f} "
                 f"({dt:.1f}s)", flush=True
             )
-    return float(np.mean(losses)) if losses else float("nan")
+    if not losses:
+        _warn_empty_eval("train_epoch()")
+        return float("nan")
+    return float(np.mean(losses))
 
 
 @dataclass
@@ -298,6 +350,13 @@ class Trainer:
     #: norm, recorded via ``obs.record_grad_norm`` (one extra fused
     #: reduction; off by default because fetching it adds a host read)
     grad_norm: bool = False
+    #: optional ``resilience.StepGuard``: compiles the non-finite guard
+    #: into the step (skip-and-count inside the program) and feeds the
+    #: per-step bad flag to the guard — which raises
+    #: ``NonFiniteStreakError`` after M consecutive skips.  Reading the
+    #: flag fences each step, trading async-dispatch overlap for
+    #: fail-fast safety; leave ``None`` on latency-critical paths.
+    guard: Any = None
     _step_fn: Any = field(default=None, repr=False)
     _multi_fn: Any = field(default=None, repr=False)
     #: end timestamp of the previous step in the current stepping streak.
@@ -314,7 +373,7 @@ class Trainer:
     def create(cls, model, tx, loss_fn, seed: int = 0, params=None,
                state=None, compute_dtype=None, remat: bool = False,
                accum_steps: int = 1, moe_aux_weight: float = 0.0,
-               grad_norm: bool = False):
+               grad_norm: bool = False, guard: Any = None):
         key = jax.random.PRNGKey(seed)
         if params is None:
             params, state = model.init(key)
@@ -331,6 +390,7 @@ class Trainer:
             accum_steps=accum_steps,
             moe_aux_weight=moe_aux_weight,
             grad_norm=grad_norm,
+            guard=guard,
         )
 
     def step(self, x, y) -> float:
@@ -342,15 +402,30 @@ class Trainer:
                 accum_steps=self.accum_steps,
                 moe_aux_weight=self.moe_aux_weight,
                 grad_norm=self.grad_norm,
+                guard=self.guard is not None,
             )
+        if _chaos.active():
+            # deterministic fault injection at the step boundary (kill /
+            # synthetic OOM / NaN-poisoned batch) — zero-cost when no
+            # chaos config is installed
+            _chaos.maybe_kill(self.step_count)
+            _chaos.maybe_oom(self.step_count)
+            x = _chaos.poison_batch(self.step_count, x)
         self.rng, sub = jax.random.split(self.rng)
         self.params, self.state, self.opt_state, l = self._step_fn(
             self.params, self.state, self.opt_state, x, y, sub
         )
         self.step_count += 1
-        if self.grad_norm:
-            l, gnorm = l
-            obs.record_grad_norm(gnorm)
+        if self.grad_norm or self.guard is not None:
+            parts = l if isinstance(l, tuple) else (l,)
+            l = parts[0]
+            if self.grad_norm:
+                obs.record_grad_norm(parts[1])
+            if self.guard is not None:
+                # host read of the compiled guard's flag — may raise
+                # NonFiniteStreakError (params already held finite by
+                # the in-program skip)
+                self.guard.observe(bool(parts[-1]))
         now = time.perf_counter()
         if self._t_stream is not None:
             # a streak's FIRST step is not recorded: on an async backend
@@ -404,6 +479,7 @@ class Trainer:
             accum_steps=self.accum_steps,
             moe_aux_weight=self.moe_aux_weight,
             grad_norm=self.grad_norm,
+            guard=self.guard,
             step_count=self.step_count,
         )
 

@@ -30,6 +30,108 @@ _DEFAULT = os.path.join(
 )
 
 
+def _install_atomic_cache_writes() -> None:
+    """Make jax's persistent-cache entry writes crash-safe.
+
+    jax 0.4.x's ``LRUCache.put`` is a bare ``Path.write_bytes`` — a
+    process killed mid-write (exactly what preemption does) leaves a
+    TORN cache entry, and the next run deserializes it into a garbage
+    XLA executable: observed as glibc heap corruption aborts and as
+    silently-diverging (NaN) train steps on resume.  Found by the
+    resilience chaos drill's ``kill_at_step`` injection (bench.py
+    resilience leg / tests).  The patch rewrites ``put`` to the standard
+    tmp + fsync + ``os.replace`` in the same directory, preserving the
+    existing skip-if-present and eviction behavior.  Best-effort: if the
+    internals moved in a newer jax, the patch silently stands down (the
+    newer versions write atomically themselves).
+    """
+    try:
+        from jax._src import lru_cache as _lru
+
+        if getattr(_lru.LRUCache.put, "_tpt_atomic", False):
+            return
+        suffix = _lru._CACHE_SUFFIX
+        atime_suffix = _lru._ATIME_SUFFIX
+        orig_evict = _lru.LRUCache._evict_if_needed
+
+        swept = [False]
+
+        def put(self, key: str, val: bytes) -> None:
+            if not key:
+                raise ValueError("key cannot be empty")
+            if self.eviction_enabled and len(val) > self.max_size:
+                return
+            cache_path = self.path / f"{key}{suffix}"
+            atime_path = self.path / f"{key}{atime_suffix}"
+            if self.eviction_enabled:
+                self.lock.acquire(timeout=self.lock_timeout_secs)
+            try:
+                if cache_path.exists():
+                    return
+                if not swept[0]:
+                    # once per process: stale tmps from earlier killed
+                    # writers (nothing else ever cleans them)
+                    swept[0] = True
+                    for stale in self.path.glob(".cctmp.*"):
+                        try:
+                            stale.unlink()
+                        except OSError:
+                            pass
+                orig_evict(self, additional_size=len(val))
+                # tmp name must NOT end with the cache suffix: jax's
+                # eviction pass globs f"*{suffix}" and reads each
+                # match's sibling -atime file, so a suffix-matching tmp
+                # (from a kill mid-write, or a concurrent put) would
+                # make every later eviction raise FileNotFoundError
+                tmp = self.path / f".cctmp.{os.getpid()}.{key}"
+                with open(tmp, "wb") as f:
+                    f.write(val)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, cache_path)
+                import time as _time
+
+                atime_path.write_bytes(
+                    _time.time_ns().to_bytes(8, "little"))
+            finally:
+                if self.eviction_enabled:
+                    self.lock.release()
+
+        put._tpt_atomic = True
+        _lru.LRUCache.put = put
+    except Exception:  # noqa: BLE001 - hardening, never fatal
+        pass
+
+
+def quarantine_for_resume() -> bool:
+    """Disable the persistent cache for THIS process when resuming on
+    the CPU backend.  Returns True when it disabled anything.
+
+    Empirical finding from the resilience chaos drill (kill→resume
+    cycles on the digits preset, jax/jaxlib 0.4.37): a resumed process
+    that restores a checkpoint and then loads executables from the
+    persistent cache corrupts its heap ~60% of the time — glibc aborts
+    ("corrupted double-linked list"), segfaults inside subsequent jit
+    TRACING, or silently-NaN train steps.  With the cache disabled the
+    same cycles are 10/10 clean and bit-identical to uninterrupted
+    runs; uninterrupted warm-cache runs are also clean — only the
+    resume+deserialize combination is unstable, pointing at the CPU
+    ``deserialize_executable`` path upstream.  Correctness beats a few
+    seconds of recompilation, so resumable pipelines call this before
+    their first compile.  TPU backends keep the cache (the instability
+    is CPU-specific and resume-after-preemption is the cache's headline
+    use case there)."""
+    try:
+        if jax.default_backend() != "cpu":
+            return False
+        if jax.config.jax_compilation_cache_dir is None:
+            return False
+        jax.config.update("jax_compilation_cache_dir", None)
+        return True
+    except Exception:  # noqa: BLE001 - never fatal
+        return False
+
+
 def enable_persistent_cache(path: str | None = None) -> str | None:
     """Point jax's persistent compilation cache at ``path`` (default:
     ``$TORCHPRUNER_TPU_COMPILATION_CACHE`` or ``~/.cache/torchpruner_tpu/xla``).
@@ -38,6 +140,9 @@ def enable_persistent_cache(path: str | None = None) -> str | None:
     cache is an optimization — failure to enable it must never break a
     run).  Thresholds are lowered so even sub-second compiles are cached:
     the prune loop's many small recompiles are exactly the target.
+    Entry writes are patched atomic (tmp + fsync + replace) so a
+    preemption SIGKILL mid-write cannot poison later runs — see
+    :func:`_install_atomic_cache_writes`.
     """
     path = path or os.environ.get(ENV_VAR) or _DEFAULT
     try:
@@ -51,4 +156,5 @@ def enable_persistent_cache(path: str | None = None) -> str | None:
         jax.config.update("jax_compilation_cache_dir", path)
     except Exception:  # noqa: BLE001 - optional optimization, never fatal
         return None
+    _install_atomic_cache_writes()
     return path

@@ -103,6 +103,35 @@ class ExperimentConfig:
     checkpoint_path: str = ""        # save/resume training checkpoints here
     checkpoint_every_epochs: int = 0  # 0 = only at the end
 
+    # resilience (torchpruner_tpu.resilience; CLI --resume / --chaos)
+    #: resumable-run directory: manifest.json (pipeline position) +
+    #: digest-verified ckpt-* checkpoints.  Non-empty = the run is
+    #: preemption-safe: SIGTERM/SIGKILL mid-run, then re-run with the
+    #: same run_dir (CLI ``--resume DIR``) restarts mid-round
+    run_dir: str = ""
+    #: mid-epoch checkpoint cadence in OPTIMIZER STEPS (train runs; for
+    #: prune_retrain it additionally checkpoints after every retrain
+    #: epoch).  0 = round/epoch boundaries only.  CLI --checkpoint-every
+    checkpoint_every_steps: int = 0
+    #: compile the non-finite step guard into the train step: NaN/Inf
+    #: loss-or-grad steps are skipped inside the program (params held),
+    #: counted (``resilience_nan_skips_total``), and after
+    #: ``max_bad_steps`` consecutive skips the run rolls back to the
+    #: last checkpoint with the LR scaled by ``lr_backoff``.  Reading
+    #: the guard flag fences each step — off by default
+    guard_nonfinite: bool = False
+    #: consecutive non-finite steps before rollback (guard_nonfinite)
+    max_bad_steps: int = 3
+    #: LR multiplier applied at each rollback (0 < lr_backoff <= 1)
+    lr_backoff: float = 0.5
+    #: rollback-recovery budget per run (NaN streaks; OOM retries have
+    #: their own implicit cap at accum_steps == batch_size)
+    max_rollbacks: int = 3
+    #: deterministic fault injection (resilience.chaos knob dict, e.g.
+    #: {"nan_at_step": 5, "kill_at_step": 12}); {} = chaos off.  Also
+    #: settable via CLI --chaos / TORCHPRUNER_CHAOS env
+    chaos: Dict[str, Any] = field(default_factory=dict)
+
     #: opt-in runtime telemetry: the train step also computes the global
     #: gradient norm, recorded as an obs gauge (one extra fused reduction
     #: in the compiled step; off by default — see torchpruner_tpu.obs)
@@ -161,6 +190,23 @@ class ExperimentConfig:
                     f"unknown {fld} {getattr(self, fld)!r} "
                     "(use 'float32' or 'bfloat16')"
                 )
+        if not 0.0 < self.lr_backoff <= 1.0:
+            raise ValueError(
+                f"lr_backoff must be in (0, 1], got {self.lr_backoff}"
+            )
+        if self.max_bad_steps < 1:
+            raise ValueError(
+                f"max_bad_steps must be >= 1, got {self.max_bad_steps}"
+            )
+        if self.checkpoint_every_steps < 0 or self.max_rollbacks < 0:
+            raise ValueError(
+                "checkpoint_every_steps and max_rollbacks must be >= 0"
+            )
+        if self.chaos:
+            # fail at config time, not at injection time mid-run
+            from torchpruner_tpu.resilience.chaos import ChaosConfig
+
+            ChaosConfig.from_any(self.chaos)
         if self.simulate and self.finetune_epochs:
             raise ValueError(
                 "simulate=True masks parameters without pinning them in "
