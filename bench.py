@@ -120,6 +120,24 @@ _LEG_EST_S = {
     "vgg16_robustness": (1500, 100000),
 }
 
+#: committed obs reports live here (obs_report_*<platform>*.json): a
+#: bench run with BENCH_OBS_DIR auto-diffs its fresh report against the
+#: newest matching one (torchpruner_tpu.obs.report) and attaches the
+#: outcome to the result — the regression check nobody has to eyeball.
+RESULTS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results")
+
+#: default gates for the bench auto-diff (informational: violations are
+#: REPORTED in the result record, never fail the bench).  Timing gates
+#: are generous — bench hosts vary; the CI smoke applies its own file.
+BENCH_GATES = {
+    "step_time_mean_s": {"max_increase_pct": 75},
+    "mfu": {"max_decrease_pct": 25},
+    "compile_s": {"max_increase_pct": 200},
+    "missing_rounds": {"max": 0},
+    "round_post_acc": {"max_decrease": 0.1},
+}
+
 MNIST_BASELINE_S = 28.0  # reference MNIST FC prune wall-clock (BASELINE.md)
 SWEEP_BASELINE_S = 6.5 * 3600.0  # reference 15-layer × 8-method sweep
 SWEEP_PANEL_RUNS = 14  # 5 deterministic + 3 stochastic × 3 runs per layer
@@ -1231,7 +1249,60 @@ def main() -> dict:
         obs.shutdown()
     except Exception:  # noqa: BLE001
         pass
+    _attach_obs_diff(result, platform)
     return result
+
+
+def _attach_obs_diff(result: dict, platform: str) -> None:
+    """Auto-diff this run's obs report (BENCH_OBS_DIR) against the newest
+    committed ``results/obs_report_*<platform>*.json`` and attach the
+    outcome — scalar deltas + any BENCH_GATES violations — to the result
+    record.  Informational only (a bench must report regressions, not
+    abort on them); ``BENCH_SAVE_OBS_REPORT=1`` additionally copies the
+    fresh report into results/ as the next baseline.  Never raises."""
+    obs_dir = os.environ.get("BENCH_OBS_DIR")
+    if not obs_dir:
+        return
+    try:
+        from torchpruner_tpu.obs.report import (
+            check_gates,
+            diff_runs,
+            load_run,
+            newest_report,
+        )
+
+        current = load_run(obs_dir)
+        # baseline BEFORE save: saving first would make newest_report
+        # return the just-written file and diff the run against itself
+        baseline = newest_report(RESULTS_DIR, match=platform)
+        if os.environ.get("BENCH_SAVE_OBS_REPORT"):
+            stamp = time.strftime("%Y-%m-%d_%H%M", time.gmtime())
+            dst = os.path.join(
+                RESULTS_DIR, f"obs_report_{platform}_{stamp}.json")
+            import shutil
+
+            os.makedirs(RESULTS_DIR, exist_ok=True)
+            shutil.copyfile(os.path.join(obs_dir, "report.json"), dst)
+            result["obs_report_saved"] = dst
+        if baseline is None:
+            result["obs_diff"] = {"baseline": None,
+                                  "note": "no committed obs_report_* "
+                                          f"for {platform} in results/"}
+            return
+        with open(baseline) as f:
+            base = json.load(f)
+        d = diff_runs(base, current)
+        violations = check_gates(d, BENCH_GATES)
+        result["obs_diff"] = {
+            "baseline": os.path.basename(baseline),
+            "scalars": d["scalars"],
+            "violations": violations,
+        }
+        for v in violations:
+            print(f"[bench] obs-diff gate violation [{v['gate']}]: "
+                  f"{v['detail']}", file=sys.stderr, flush=True)
+    except Exception as e:  # noqa: BLE001 - telemetry never fails a bench
+        result["obs_diff"] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
 
 def _stream_child(cmd: list[str], timeout_s: float, enrich) -> tuple:

@@ -32,6 +32,9 @@ def main() -> None:
     if mode == "pp":
         run_pp(pid)
         return
+    if mode == "obs":
+        run_obs(pid, sys.argv[5])
+        return
 
     import numpy as np
     import optax
@@ -64,6 +67,52 @@ def main() -> None:
         "eval_loss": eval_loss,
         "eval_acc": eval_acc,
         "w_abs_sum": float(np.abs(w).sum()),
+    }), flush=True)
+
+
+def run_obs(pid: int, obs_dir: str) -> None:
+    """Cross-host metric aggregation under a REAL multi-process runtime:
+    each process runs an obs session over a shared obs_dir, records
+    process-distinct counters/gauges/steps, and closes.  Only process 0
+    may emit events.jsonl/metrics.prom/report.json, but EVERY process
+    must leave a metrics.shard<i>.json, and process 0's merged export
+    must carry the sum/max across hosts (asserted by the parent test)."""
+    from torchpruner_tpu import obs
+
+    session = obs.configure(obs_dir, annotate=False)
+    assert session.process_index == jax.process_index()
+    # barrier: the emitter's session INIT clears stale shards — no
+    # process may reach close() (which writes its shard) until every
+    # session is open, or a fast worker's shard could be swept.
+    # Filesystem-based: the CPU gloo backend has no jit collectives
+    # (multihost_utils.sync_global_devices raises INVALID_ARGUMENT)
+    import os
+    import time
+
+    os.makedirs(obs_dir, exist_ok=True)
+    open(os.path.join(obs_dir, f".ready.{pid}"), "w").close()
+    deadline = time.time() + 60
+    while time.time() < deadline and not all(
+            os.path.exists(os.path.join(obs_dir, f".ready.{i}"))
+            for i in range(jax.process_count())):
+        time.sleep(0.05)
+    with obs.span("work", host=pid):
+        # distinct per-process totals so the merge is distinguishable
+        # from any single shard: counter sums, gauge max/min
+        obs.inc("mp_examples_total", 10 * (pid + 1))
+        obs.gauge_set("mp_hbm_gauge", 100.0 * (pid + 1))
+        for _ in range(pid + 1):
+            obs.record_step(0.01, examples=8)
+    # no explicit pre-close wait: the emitter's close() itself blocks
+    # (bounded, aggregate.wait_for_peer_shards) until the peers' shard
+    # writes land — the production path the parent test asserts on
+    obs.shutdown()
+    print(json.dumps({
+        "pid": pid,
+        "process_count": jax.process_count(),
+        "global_devices": jax.device_count(),
+        "local_devices": jax.local_device_count(),
+        "is_emitter": session.is_emitter,
     }), flush=True)
 
 

@@ -105,6 +105,74 @@ def test_two_process_dp_matches_single_process():
                                rtol=1e-6)
 
 
+def test_two_process_obs_metric_shards_merge(tmp_path):
+    """Cross-host metric aggregation (obs.aggregate) under a real
+    two-process runtime: every process writes a ``metrics.shard<i>.json``
+    at close, and process 0's merged export sums counters / maxes gauges
+    across hosts — the fix for non-zero processes' metrics vanishing."""
+    worker = os.path.join(REPO, "tests", "_mp_worker.py")
+    obs_dir = str(tmp_path / "obs")
+    env = os.environ.copy()
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), "2", str(port), "obs",
+             obs_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=REPO, env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=420)
+            assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+            outs.append((out, err))
+    finally:
+        for p in procs:
+            p.kill()
+    results = []
+    for out, err in outs:
+        lines = [ln for ln in out.splitlines() if ln.startswith("{")]
+        assert lines, f"no JSON from worker:\n{out}\n{err[-1000:]}"
+        results.append(json.loads(lines[-1]))
+    results.sort(key=lambda r: r["pid"])
+    assert [r["is_emitter"] for r in results] == [True, False]
+
+    # every process left its shard; only process 0 emitted the stream
+    assert os.path.exists(os.path.join(obs_dir, "metrics.shard0.json"))
+    assert os.path.exists(os.path.join(obs_dir, "metrics.shard1.json"))
+    assert os.path.exists(os.path.join(obs_dir, "events.jsonl"))
+
+    # the exported textfile carries the MERGED totals: counters summed
+    # (10 + 20), steps summed (1 + 2 recorded intervals... process i
+    # records i+1 steps -> 3 total), gauges maxed with a _min companion
+    prom = open(os.path.join(obs_dir, "metrics.prom")).read()
+    import re
+
+    def series(name):
+        m = re.search(rf"^{name} (\S+)$", prom, re.M)
+        return float(m.group(1)) if m else None
+
+    assert series("mp_examples_total") == 30.0
+    assert series("mp_hbm_gauge") == 200.0
+    assert series("mp_hbm_gauge_min") == 100.0
+    assert series("step_time_seconds_count") == 3.0
+    assert series("examples_total") == 24.0
+
+    # merged registry re-derivable offline from the shards alone
+    from torchpruner_tpu.obs.aggregate import load_shards, merge_shards
+
+    shards = load_shards(obs_dir)
+    assert [s["process_index"] for s in shards] == [0, 1]
+    snap = merge_shards(shards).snapshot()
+    assert snap["mp_examples_total"] == 30.0
+    assert snap["step_time_seconds_count"] == 3
+
+
 def test_two_process_spmd_pipeline_matches_single_process():
     """The collective-based PP path (parallel/pp_spmd.py) across two
     processes: a 4-stage pp mesh axis spanning 2 hosts x 2 devices, so

@@ -1,0 +1,640 @@
+"""Run ledger & reports (torchpruner_tpu.obs.{ledger,aggregate,
+trace_export,report}): score-distribution math, recorder dedup/resume/
+backfill, histogram percentiles, Prometheus text lint, Perfetto trace
+schema round-tripped through ``load_span_events``, event-stream
+rotation, cross-host shard merging, the ``obs report`` / ``obs diff``
+CLI with gates, the planted-regression catch, and kill-9 ledger
+continuity through a CLI resume."""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from torchpruner_tpu import obs
+from torchpruner_tpu.obs.aggregate import (
+    load_shards,
+    merge_shards,
+    registry_to_shard,
+    write_shard,
+)
+from torchpruner_tpu.obs.ledger import (
+    ProvenanceRecorder,
+    load_ledger,
+    score_distribution,
+)
+from torchpruner_tpu.obs.metrics import Histogram, MetricsRegistry
+from torchpruner_tpu.obs.report import (
+    check_gates,
+    diff_runs,
+    load_run,
+    obs_main,
+)
+from torchpruner_tpu.obs.trace_export import (
+    trace_events_from_spans,
+    write_trace,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_session():
+    obs.shutdown()
+    yield
+    obs.shutdown()
+
+
+# -- score distributions -----------------------------------------------------
+
+
+def test_score_distribution_margins_and_near_ties():
+    scores = np.arange(10.0)  # 0..9
+    d = score_distribution(scores, drop=[0, 1, 2])
+    assert d["n"] == 10 and d["n_pruned"] == 3 and d["n_kept"] == 7
+    assert d["kept_min"] == 3.0 and d["pruned_max"] == 2.0
+    assert d["margin"] == pytest.approx(1.0)
+    # boundary 2.5, span p99-p1 ≈ 8.8, eps ≈ 0.44: no unit within eps
+    assert d["near_ties"] == 0
+    assert d["p50"] == pytest.approx(4.5)
+
+    # a near-tie cluster right at the decision boundary is counted
+    tied = np.array([0.0, 0.999, 1.0, 1.001, 10.0, 20.0, 30.0, 40.0])
+    d2 = score_distribution(tied, drop=[0, 1, 2])
+    assert d2["margin"] == pytest.approx(0.001, rel=1e-6)
+    assert d2["near_ties"] >= 3
+
+    # negative margin: the policy removed a unit scoring above a kept one
+    d3 = score_distribution(np.array([5.0, 1.0, 2.0, 3.0]), drop=[0])
+    assert d3["margin"] < 0
+
+    assert score_distribution(np.array([]))["n"] == 0
+    assert "margin" not in score_distribution(np.arange(4.0), drop=[])
+
+
+# -- recorder ----------------------------------------------------------------
+
+
+def test_recorder_dedupes_in_session_and_scopes_view_per_run(tmp_path):
+    d = str(tmp_path)
+    rec = ProvenanceRecorder(d)
+    assert rec.record_round(target="fc1", round=0, n_dropped=3)
+    assert not rec.record_round(target="fc1", round=0)  # dup in-session
+    assert rec.record_round(target="fc2", round=1, n_dropped=1)
+    assert [r["target"] for r in rec.rounds()] == ["fc1", "fc2"]
+    rec.close()
+
+    # a NEW session reusing the dir starts its OWN view: a fresh run's
+    # report must never carry a predecessor's rounds...
+    rec2 = ProvenanceRecorder(d)
+    assert rec2.rounds() == []
+    assert rec2.record_round(target="fc1", round=0, n_dropped=9)
+    assert [r["n_dropped"] for r in rec2.rounds()] == [9]
+    # ...but can ADOPT a prior record explicitly (the resume bridge)
+    assert rec2.adopt(("round", "fc2", 1))
+    assert not rec2.adopt(("round", "fc2", 1))      # once
+    assert not rec2.adopt(("round", "nothere", 0))  # unknown key
+    assert [r["target"] for r in rec2.rounds()] == ["fc1", "fc2"]
+    assert rec2.rounds()[1]["n_dropped"] == 1  # prior payload intact
+    rec2.close()
+
+
+def test_iterative_schedule_ledgers_every_round_of_a_layer(tmp_path):
+    """Pruning the SAME layer in successive rounds must ledger each
+    round (dedup keys include the round index), and diffs must pair
+    them round-for-round."""
+    rec = ProvenanceRecorder(str(tmp_path))
+    assert rec.record_round(target="fc1", round=0, n_dropped=10)
+    assert rec.record_round(target="fc1", round=1, n_dropped=5)
+    assert rec.record_round(target="fc1", round=2, n_dropped=2)
+    assert not rec.record_round(target="fc1", round=1)  # true dup
+    assert len(rec.rounds()) == 3
+    rec.close()
+
+    from torchpruner_tpu.obs.ledger import build_report
+
+    rep = build_report(records=rec.rounds())
+    d = diff_runs(rep, rep)
+    assert set(d["rounds"]) == {"fc1", "fc1#1", "fc1#2"}
+    assert d["missing_rounds"] == []
+
+
+def test_recorder_backfill_fills_only_missing_rounds(tmp_path):
+    rec = ProvenanceRecorder(str(tmp_path))
+    rec.record_round(target="fc2", round=0, n_dropped=5)
+    manifest_records = [
+        {"layer": "fc2", "pre_acc": 0.5, "post_acc": 0.6, "n_dropped": 5,
+         "n_params": 100, "pre_loss": 1.0, "post_loss": 0.9,
+         "prune_time": 0.1, "widths": {"fc2": 59}},
+        {"layer": "fc1", "pre_acc": 0.6, "post_acc": 0.7, "n_dropped": 3,
+         "n_params": 80, "pre_loss": 0.9, "post_loss": 0.8,
+         "prune_time": 0.1, "widths": {"fc1": 61}},
+    ]
+    assert rec.backfill_rounds(manifest_records) == 1  # fc2 already there
+    rounds = rec.rounds()
+    assert [r["target"] for r in rounds] == ["fc2", "fc1"]
+    assert rounds[1]["backfilled"] is True
+    assert rounds[1]["post"]["acc"] == 0.7
+    rec.close()
+
+
+def test_ledger_tolerates_torn_tail(tmp_path):
+    path = tmp_path / "ledger.jsonl"
+    path.write_text('{"event": "round", "target": "a"}\n{"torn')
+    rec = ProvenanceRecorder(str(tmp_path))  # opens despite the tear
+    # the intact record is adoptable (no round field -> None in key)
+    assert rec.adopt(("round", "a", None))
+    rec.close()
+
+
+def test_report_json_is_strict_json_even_with_nan_metrics(tmp_path):
+    """CPU runs gauge mfu as NaN — report.json (and its ledger lines)
+    must still parse under STRICT JSON (null, not the NaN extension)."""
+    d = str(tmp_path / "obs")
+    obs.configure(d, process_index=0, annotate=False, watch_compiles=False)
+    obs.record_step(0.01, 32)
+    obs.gauge_set("weird", float("nan"))
+    obs.record_round(target="fc1", round=0,
+                     score_dist=score_distribution(
+                         np.array([0.0, np.nan, 1.0]), [0]))
+    obs.shutdown()
+    raw = open(os.path.join(d, "report.json")).read()
+    assert "NaN" not in raw and "Infinity" not in raw
+    rep = json.loads(raw)  # strict enough; the string check above is
+    assert rep["rounds"][0]["target"] == "fc1"  # the real assertion
+    for line in open(os.path.join(d, "ledger.jsonl")):
+        assert "NaN" not in line
+
+
+# -- histogram percentiles ---------------------------------------------------
+
+
+def test_histogram_quantiles_from_buckets():
+    h = Histogram("t", buckets=(0.001, 0.01, 0.1, 1.0))
+    for v in [0.005] * 90 + [0.05] * 9 + [0.5]:
+        h.observe(v)
+    assert 0.001 <= h.quantile(0.5) <= 0.01
+    assert 0.01 <= h.quantile(0.95) <= 0.1
+    assert h.quantile(0.99) <= 0.5  # clamped to observed max
+    p = h.percentiles()
+    assert p["p50"] <= p["p95"] <= p["p99"]
+    assert Histogram("e").quantile(0.5) is None
+
+    reg = MetricsRegistry()
+    hh = reg.histogram("step_time_seconds")
+    hh.observe(0.01)
+    snap = reg.snapshot()
+    assert snap["step_time_seconds_p50"] == pytest.approx(0.01)
+    assert "step_time_seconds_p99" in snap
+
+
+# -- Prometheus text lint ----------------------------------------------------
+
+_SERIES = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (\S+)$")
+
+
+def _prom_lint(text):
+    """Minimal textfile lint: every line is a comment or a series sample;
+    every sampled family has a TYPE; cumulative buckets are monotone and
+    end at +Inf == count."""
+    typed = {}
+    series = []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ")
+            typed[name] = kind
+            continue
+        if line.startswith("#"):
+            assert line.startswith("# HELP "), line
+            continue
+        m = _SERIES.match(line)
+        assert m, f"unparseable series line: {line!r}"
+        series.append(m.groups())
+    hist_buckets = {}
+    for name, labels, value in series:
+        family = re.sub(r"_(bucket|sum|count)$", "", name) \
+            if re.search(r"_(bucket|sum|count)$", name) and \
+            re.sub(r"_(bucket|sum|count)$", "", name) in typed else name
+        assert family in typed, f"series {name} has no TYPE"
+        if name.endswith("_bucket"):
+            le = re.search(r'le="([^"]+)"', labels or "").group(1)
+            hist_buckets.setdefault(family, []).append(
+                (float("inf") if le == "+Inf" else float(le),
+                 float(value)))
+    for family, buckets in hist_buckets.items():
+        bounds = [b for b, _ in buckets]
+        counts = [c for _, c in buckets]
+        assert bounds == sorted(bounds)
+        assert counts == sorted(counts), f"{family} buckets not cumulative"
+        assert bounds[-1] == float("inf"), f"{family} missing +Inf"
+        count = [float(v) for n, _, v in series
+                 if n == f"{family}_count"][0]
+        assert counts[-1] == count, f"{family} +Inf bucket != count"
+    return typed
+
+
+def test_prometheus_text_lints_and_carries_percentiles():
+    from torchpruner_tpu.obs.exporters import prometheus_text
+
+    reg = MetricsRegistry()
+    reg.counter("examples_total", "ex").inc(32)
+    reg.gauge("mfu", "model flops util").set(0.5)
+    h = reg.histogram("step_time_seconds", "steps")
+    for v in (0.001, 0.002, 0.004, 2.0):
+        h.observe(v)
+    text = prometheus_text(reg)
+    typed = _prom_lint(text)
+    assert typed["examples_total"] == "counter"
+    assert typed["step_time_seconds"] == "histogram"
+    # percentile companion gauges ship in the same textfile
+    assert typed["step_time_seconds_p50"] == "gauge"
+    for q in ("p50", "p95", "p99"):
+        assert re.search(rf"^step_time_seconds_{q} \S+$", text, re.M)
+
+
+# -- event-stream rotation ---------------------------------------------------
+
+
+def test_event_rotation_and_rotated_load(tmp_path):
+    from torchpruner_tpu.utils.profiling import (
+        load_span_events,
+        span_phase_summary,
+    )
+
+    obs_dir = str(tmp_path / "obs")
+    # cap sized so the ~12 KB stream rotates 2-3 times but stays within
+    # the default 3 retained backups (beyond that the oldest falls off —
+    # the bound is the point)
+    obs.configure(obs_dir, process_index=0, annotate=False,
+                  watch_compiles=False, rotate_bytes=4000)
+    for i in range(40):
+        with obs.span("phase", i=i):
+            pass
+    obs.shutdown()
+    events_path = os.path.join(obs_dir, "events.jsonl")
+    assert os.path.exists(events_path + ".1")  # rotated at least once
+    # the rotated set reads back as ONE stream: every span still there
+    events = load_span_events(events_path)
+    phases = span_phase_summary(events_path)
+    assert phases["phase"]["calls"] == 40
+    begins = {e["span"] for e in events if e["event"] == "span_begin"}
+    assert len(begins) == 40
+
+    # rotation off (default): a long stream stays one file
+    obs_dir2 = str(tmp_path / "obs2")
+    obs.configure(obs_dir2, process_index=0, annotate=False,
+                  watch_compiles=False)
+    for i in range(40):
+        with obs.span("phase", i=i):
+            pass
+    obs.shutdown()
+    assert not os.path.exists(
+        os.path.join(obs_dir2, "events.jsonl.1"))
+
+
+# -- Perfetto trace export ---------------------------------------------------
+
+
+def test_trace_export_schema_roundtrip(tmp_path):
+    """The exported trace.json satisfies the Trace Event Format schema:
+    B/E pairing balances per track, ts monotonic per tid, pid from the
+    process index — round-tripped through load_span_events."""
+    from torchpruner_tpu.utils.profiling import load_span_events
+
+    obs_dir = str(tmp_path / "obs")
+    obs.configure(obs_dir, process_index=0, annotate=False,
+                  watch_compiles=False)
+    with obs.span("run"):
+        with obs.span("retrain", target="fc1"):
+            pass
+        with obs.span("eval"):
+            pass
+    obs.shutdown()
+    trace_path = os.path.join(obs_dir, "trace.json")
+    assert os.path.exists(trace_path)
+    trace = json.load(open(trace_path))
+    evs = trace["traceEvents"]
+    assert trace["displayTimeUnit"] == "ms"
+
+    stacks = {}
+    last_ts = {}
+    for e in evs:
+        assert {"ph", "pid", "tid"} <= set(e)
+        if e["ph"] == "M":
+            continue
+        assert e["ph"] in ("B", "E")
+        key = (e["pid"], e["tid"])
+        assert e["ts"] >= last_ts.get(key, 0), "ts not monotonic"
+        last_ts[key] = e["ts"]
+        if e["ph"] == "B":
+            stacks.setdefault(key, []).append(e["name"])
+        else:
+            assert stacks[key].pop() == e["name"], "B/E mis-paired"
+    assert all(not s for s in stacks.values()), "unbalanced B/E"
+    names = {e["name"] for e in evs if e["ph"] == "B"}
+    assert {"run", "retrain", "eval"} <= names
+    # args carry span meta
+    retrain_b = next(e for e in evs
+                     if e["ph"] == "B" and e["name"] == "retrain")
+    assert retrain_b["args"]["target"] == "fc1"
+
+    # the same converter over the parsed stream gives identical events
+    again = trace_events_from_spans(load_span_events(
+        os.path.join(obs_dir, "events.jsonl")))
+    assert [e["ph"] for e in again] == [e["ph"] for e in evs]
+
+
+def test_trace_export_closes_torn_spans(tmp_path):
+    """A SIGKILLed run leaves span_begin without span_end — the exporter
+    synthesizes the E so the trace still opens balanced."""
+    path = str(tmp_path / "events.jsonl")
+    with open(path, "w") as f:
+        for ev in [
+            {"event": "obs_init", "ts": 0, "process_index": 3},
+            {"event": "span_begin", "span": "s1", "name": "run",
+             "ts": 1.0, "tid": 7},
+            {"event": "span_begin", "span": "s2", "name": "retrain",
+             "ts": 2.0, "tid": 7},
+        ]:
+            f.write(json.dumps(ev) + "\n")
+    out = write_trace(path)
+    evs = json.load(open(out))["traceEvents"]
+    bs = [e for e in evs if e["ph"] == "B"]
+    es = [e for e in evs if e["ph"] == "E"]
+    assert len(bs) == len(es) == 2
+    assert all(e["args"].get("torn") for e in es)
+    assert all(e["pid"] == 3 and e["tid"] == 7 for e in bs + es)
+    # innermost closes first
+    assert es[0]["name"] == "retrain" and es[1]["name"] == "run"
+
+
+# -- shard merge (single-process unit; the real 2-process path is in
+#    test_multiprocess.py) --------------------------------------------------
+
+
+def test_shard_merge_rules(tmp_path):
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("examples_total").inc(10)
+    b.counter("examples_total").inc(20)
+    a.gauge("hbm").set(100)
+    b.gauge("hbm").set(300)
+    ha = a.histogram("step_time_seconds", buckets=(0.01, 0.1))
+    hb = b.histogram("step_time_seconds", buckets=(0.01, 0.1))
+    ha.observe(0.005)
+    hb.observe(0.05)
+    hb.observe(5.0)
+    merged = merge_shards([registry_to_shard(a, 0),
+                           registry_to_shard(b, 1)])
+    snap = merged.snapshot()
+    assert snap["examples_total"] == 30
+    assert snap["hbm"] == 300          # max wins
+    assert snap["hbm_min"] == 100      # spread companion
+    h = merged.get("step_time_seconds")
+    assert h.count == 3 and h.counts == [1, 1, 1]
+    assert h.min == 0.005 and h.max == 5.0
+
+
+def test_nonzero_process_writes_shard_and_emitter_merges(tmp_path):
+    from torchpruner_tpu.obs import ObsSession
+
+    obs_dir = str(tmp_path / "obs")
+    # a pod's real ordering: every process OPENS its session up front
+    # (emitter first clears any dead run's shards), closes write shards
+    s0 = ObsSession(obs_dir, process_index=0, annotate=False,
+                    watch_compiles=False)
+    s1 = ObsSession(obs_dir, process_index=1, annotate=False,
+                    watch_compiles=False)
+    s1.metrics.counter("mp_total").inc(5)
+    s0.metrics.counter("mp_total").inc(7)
+    s1.close()  # worker host drains first
+    assert os.path.exists(os.path.join(obs_dir, "metrics.shard1.json"))
+    assert not os.path.exists(os.path.join(obs_dir, "metrics.prom"))
+    s0.close()  # emitter merges whatever shards are present
+    prom = open(os.path.join(obs_dir, "metrics.prom")).read()
+    assert re.search(r"^mp_total 12$", prom, re.M)
+    assert len(load_shards(obs_dir)) == 2
+
+
+def test_new_session_clears_stale_shards_and_scopes_report(tmp_path):
+    """A FRESH run reusing an obs dir must not inherit its predecessor:
+    stale shards are cleared at init (no double-counted counters) and
+    report.json carries only the new run's rounds."""
+    obs_dir = str(tmp_path / "obs")
+    obs.configure(obs_dir, process_index=0, annotate=False,
+                  watch_compiles=False)
+    obs.inc("mp_total", 5)
+    obs.record_round(target="old_round", round=0)
+    obs.shutdown()
+    # pretend a dead 2-process run also left a foreign shard behind
+    import shutil
+
+    shutil.copyfile(os.path.join(obs_dir, "metrics.shard0.json"),
+                    os.path.join(obs_dir, "metrics.shard7.json"))
+
+    obs.configure(obs_dir, process_index=0, annotate=False,
+                  watch_compiles=False)
+    obs.inc("mp_total", 2)
+    obs.record_round(target="new_round", round=0)
+    obs.shutdown()
+    prom = open(os.path.join(obs_dir, "metrics.prom")).read()
+    assert re.search(r"^mp_total 2$", prom, re.M)  # not 7, not 12
+    rep = load_run(obs_dir)
+    assert [r["target"] for r in rep["rounds"]] == ["new_round"]
+
+
+# -- report / diff / gates ---------------------------------------------------
+
+
+def _make_run(tmp_path, name, step_t, post_acc, p50=4.5, targets=("fc1",)):
+    d = str(tmp_path / name)
+    obs.configure(d, process_index=0, annotate=False, watch_compiles=False)
+    obs.annotate_run(experiment=name)
+    for _ in range(10):
+        obs.record_step(step_t, 32)
+    scores = np.arange(10.0) + (p50 - 4.5)
+    for i, t in enumerate(targets):
+        obs.record_round(
+            target=t, round=i, method="taylor", n_dropped=3,
+            score_dist=score_distribution(scores, [0, 1, 2]),
+            pre={"loss": 1.0, "acc": 0.7},
+            post={"loss": 0.9, "acc": post_acc}, params=100)
+    obs.shutdown()
+    return d
+
+
+def test_report_load_render_and_json(tmp_path, capsys):
+    d = _make_run(tmp_path, "runA", 0.01, 0.65)
+    report = load_run(d)
+    assert len(report["rounds"]) == 1
+    assert report["run"]["experiment"] == "runA"
+    assert report["derived"]["steps"] == 10
+    rc = obs_main(["report", d])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "| fc1 |" in out and "obs report" in out
+    rc = obs_main(["report", d, "--json"])
+    assert rc == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["rounds"][0]["target"] == "fc1"
+    assert obs_main(["report", str(tmp_path / "nope")]) == 2
+
+
+def test_report_reconstructs_from_ledger_when_killed_before_close(tmp_path):
+    """No report.json (killed run): load_run rebuilds from ledger.jsonl
+    + events.jsonl + shards."""
+    d = _make_run(tmp_path, "runA", 0.01, 0.65)
+    os.unlink(os.path.join(d, "report.json"))
+    report = load_run(d)
+    assert report["run"].get("reconstructed")
+    assert len(report["rounds"]) == 1
+    assert report["derived"]["steps"] == 10  # from the metric shard
+
+
+def test_diff_and_gates_catch_regressions(tmp_path):
+    a = load_run(_make_run(tmp_path, "A", 0.01, 0.65,
+                           targets=("fc1", "fc2")))
+    b = load_run(_make_run(tmp_path, "B", 0.02, 0.40, p50=14.5,
+                           targets=("fc1",)))
+    d = diff_runs(a, b)
+    assert d["scalars"]["step_time_mean_s"]["pct"] == pytest.approx(100.0)
+    assert d["rounds"]["fc1"]["post_acc_delta"] == pytest.approx(-0.25)
+    assert d["rounds"]["fc1"]["score_p50_drift"] > 1.0
+    assert d["missing_rounds"] == ["fc2"]
+
+    gates = {
+        "step_time_mean_s": {"max_increase_pct": 50},
+        "round_post_acc": {"max_decrease": 0.1},
+        "score_p50_drift": {"max": 0.25},
+        "missing_rounds": {"max": 0},
+    }
+    violated = {v["gate"] for v in check_gates(d, gates)}
+    assert violated == set(gates)
+    # self-diff is clean under the same gates
+    assert check_gates(diff_runs(a, a), gates) == []
+    # unknown gate names are violations, not silent no-ops
+    assert check_gates(diff_runs(a, a), {"step_tme": {}})[0]["gate"] == \
+        "step_tme"
+
+
+def test_diff_cli_gate_exit_codes(tmp_path, capsys):
+    a = _make_run(tmp_path, "A", 0.01, 0.65)
+    b = _make_run(tmp_path, "B", 0.03, 0.65)
+    gate_path = str(tmp_path / "gates.json")
+    json.dump({"step_time_mean_s": {"max_increase_pct": 50}},
+              open(gate_path, "w"))
+    assert obs_main(["diff", a, b, "--gate", gate_path]) == 1
+    err = capsys.readouterr().err
+    assert "GATE VIOLATION [step_time_mean_s]" in err
+    assert obs_main(["diff", a, a, "--gate", gate_path]) == 0
+    assert obs_main(["diff", a, b]) == 0  # no --gate: report-only
+
+
+# -- end-to-end: planted regression through the real pipeline ---------------
+
+
+def test_cli_planted_regression_trips_the_gate(tmp_path, monkeypatch):
+    """The acceptance check: the digits smoke preset twice — normal vs
+    config-degraded (halved batch => ~2x the optimizer steps) — and
+    ``obs diff --gate`` exits 1 naming the violated gate, while the
+    normal-vs-normal diff passes the same gates."""
+    import dataclasses
+
+    from torchpruner_tpu.__main__ import main
+    from torchpruner_tpu.experiments.presets import mnist_mlp_shapley
+
+    monkeypatch.chdir(tmp_path)
+    dir_a = str(tmp_path / "obs_a")
+    dir_b = str(tmp_path / "obs_b")
+    cfg = mnist_mlp_shapley(smoke=True)
+    cfg_a = dataclasses.replace(
+        cfg, log_path=str(tmp_path / "a.csv"))
+    cfg_b = dataclasses.replace(
+        cfg, batch_size=cfg.batch_size // 2, name="degraded",
+        log_path=str(tmp_path / "b.csv"))
+    cfg_a.to_json(str(tmp_path / "a.json"))
+    cfg_b.to_json(str(tmp_path / "b.json"))
+    assert main(["--config", str(tmp_path / "a.json"), "--obs-dir", dir_a,
+                 "--no-compilation-cache"]) == 0
+    assert main(["--config", str(tmp_path / "b.json"), "--obs-dir", dir_b,
+                 "--no-compilation-cache"]) == 0
+
+    report = load_run(dir_a)
+    assert len(report["rounds"]) == 2  # fc1, fc2
+    assert all(r["score_dist"]["n"] > 0 for r in report["rounds"])
+
+    gate_path = str(tmp_path / "gates.json")
+    json.dump({"steps": {"max_increase_pct": 50},
+               "missing_rounds": {"max": 0},
+               "round_post_acc": {"max_decrease": 0.3}},
+              open(gate_path, "w"))
+    rc = main(["obs", "diff", dir_a, dir_b, "--gate", gate_path])
+    assert rc == 1  # halved batch doubled steps_total: gate named
+    rc = main(["obs", "diff", dir_a, dir_a, "--gate", gate_path])
+    assert rc == 0
+
+
+# -- kill-9 ledger continuity ------------------------------------------------
+
+
+@pytest.mark.slow
+def test_killed_and_resumed_run_has_one_continuous_ledger(tmp_path):
+    """SIGKILL mid second-round retrain, resume with the SAME obs dir:
+    `obs report` shows exactly one record per target — the pre-kill
+    round survives, the post-resume round lands, nothing duplicates."""
+    from torchpruner_tpu.utils.config import ExperimentConfig
+
+    run_dir = str(tmp_path / "run")
+    obs_dir = str(tmp_path / "obs")
+    cfg_path = str(tmp_path / "cfg.json")
+    ExperimentConfig(
+        name="ledger_kill", model="digits_fc_tiny", dataset="digits_flat",
+        method="weight_norm", policy="fraction", fraction=0.25,
+        finetune_epochs=1, score_examples=32, batch_size=32,
+        eval_batch_size=64, lr=0.05, run_dir=run_dir,
+        log_path=os.path.join(run_dir, "log.csv"),
+    ).to_json(cfg_path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+
+    def cli(*extra):
+        return subprocess.run(
+            [sys.executable, "-m", "torchpruner_tpu", "--config", cfg_path,
+             "--cpu", "--resume", run_dir, "--checkpoint-every", "10",
+             "--obs-dir", obs_dir, *extra],
+            capture_output=True, text=True, env=env, cwd=REPO,
+            timeout=420)
+
+    # ~40 steps/retrain epoch: step 55 is mid the SECOND target's retrain
+    killed = cli("--chaos", json.dumps({"kill_at_step": 55}))
+    assert killed.returncode == -signal.SIGKILL, (
+        killed.returncode, killed.stderr[-2000:])
+    rounds = [r for r in load_ledger(os.path.join(obs_dir, "ledger.jsonl"))
+              if r.get("event") == "round"]
+    assert len(rounds) == 1  # first round committed before the kill
+
+    resumed = cli()
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    report = load_run(obs_dir)
+    targets = [r["target"] for r in report["rounds"]]
+    assert sorted(targets) == ["fc1", "fc2"]
+    assert len(targets) == len(set(targets)) == 2
+    # the resumed round still carries its staged score distribution
+    assert all((r.get("score_dist") or {}).get("n", 0) > 0
+               for r in report["rounds"])
+
+    # and the CLI renders it: one row per round, exit 0
+    out = subprocess.run(
+        [sys.executable, "-m", "torchpruner_tpu", "obs", "report",
+         obs_dir, "--json"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=120)
+    assert out.returncode == 0, out.stderr[-1000:]
+    rep = json.loads(out.stdout)
+    assert len(rep["rounds"]) == 2

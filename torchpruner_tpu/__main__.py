@@ -21,9 +21,18 @@ import sys
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "obs":
+        # ledger tooling: `python -m torchpruner_tpu obs report DIR` /
+        # `obs diff A B [--gate tolerances.json]` (obs.report)
+        from torchpruner_tpu.obs.report import obs_main
+
+        return obs_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="torchpruner_tpu",
-        description="TPU-native structured pruning experiments",
+        description="TPU-native structured pruning experiments "
+                    "(subcommand: obs report/diff — run-ledger tooling)",
     )
     p.add_argument("--preset", help="named preset (see --list)")
     p.add_argument("--config", help="path to an ExperimentConfig JSON")
@@ -64,7 +73,9 @@ def main(argv=None) -> int:
     p.add_argument(
         "--obs-dir", metavar="DIR",
         help="write runtime telemetry into DIR: events.jsonl (span/phase "
-             "stream) and metrics.prom (Prometheus textfile); the "
+             "stream), metrics.prom (Prometheus textfile), ledger.jsonl "
+             "+ report.json (per-round prune provenance; see `obs "
+             "report`), and trace.json (open in ui.perfetto.dev); the "
              "end-of-run summary prints to stderr either way",
     )
     p.add_argument(
@@ -196,6 +207,9 @@ def main(argv=None) -> int:
         from torchpruner_tpu import obs
 
         obs.configure(args.obs_dir)
+        obs.annotate_run(experiment=cfg.name, kind=cfg.experiment,
+                         model=cfg.model, method=cfg.method,
+                         resumed=bool(args.resume))
 
     run_ctx = obs.span("run", experiment=cfg.name,
                        experiment_kind=cfg.experiment) \

@@ -108,7 +108,13 @@ class AttributionMetric:
             layer, find_best_evaluation_layer
         )
         rows = self.compute_rows(layer, eval_layer, **kw)
-        return self.aggregate_over_samples(rows)
+        scores = self.aggregate_over_samples(rows)
+        # provenance: the per-unit score distribution (percentiles, not
+        # raw scores) goes to the run ledger, keyed by scoring site —
+        # the "by what margin" half of every prune decision's record
+        obs.record_scores(eval_layer, scores, layer=layer,
+                          method=type(self).__name__, run=self.seed)
+        return scores
 
     def find_evaluation_layer(self, layer: str, find_best: bool = False) -> str:
         if find_best and self.shiftable:

@@ -177,6 +177,11 @@ def prune(
 
     group = layer if isinstance(layer, PruneGroup) else G.group_for(model, layer)
     drop = np.unique(np.asarray(drop, dtype=np.int64).reshape(-1))
+    # provenance: the concrete decision (site + rows) goes to the run
+    # ledger before the plan is applied, so even a run that dies inside
+    # apply_plan leaves a record of what it was about to remove
+    obs.record_prune(group.target, drop,
+                     L.n_units(model.layer(group.target)))
     with obs.span("plan", target=group.target):
         plan = plan_for_group(model, group)
     with obs.span("apply_plan", target=group.target, n_drop=len(drop)):

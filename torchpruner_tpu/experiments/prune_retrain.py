@@ -24,7 +24,7 @@ from torchpruner_tpu.attributions import (
 )
 from torchpruner_tpu.core import layers as L
 from torchpruner_tpu.core.graph import pruning_graph
-from torchpruner_tpu.core.pruner import prune_by_scores, score_drop_indices
+from torchpruner_tpu.core.pruner import prune, score_drop_indices
 from torchpruner_tpu.data import load_dataset
 from torchpruner_tpu.models import (
     bert_base,
@@ -218,6 +218,8 @@ def run_prune_retrain(
     prune (plan, apply_plan) / shard / retrain — and the CSV rows carry
     the active span id for offline joins.
     """
+    obs.annotate_run(experiment=cfg.name, kind="prune_retrain",
+                     model=cfg.model, method=cfg.method, policy=cfg.policy)
     with obs.span("prune_retrain", experiment=cfg.name):
         return _run_prune_retrain(cfg, model=model, datasets=datasets,
                                   verbose=verbose)
@@ -318,6 +320,11 @@ def _run_prune_retrain(
                 trainer.rng = rng_from_list(rng)
             trainer.step_count = int(meta.get("step", 0))
             history = [PruneStepRecord(**r) for r in journal.records()]
+            # ledger continuity across the kill: rounds the manifest
+            # committed are rehydrated into the ledger (deduped — a
+            # reused obs dir already holds them; a fresh one gets them
+            # backfilled), so the resumed run reports ONE run's rounds
+            obs.ledger_backfill(journal.records())
         _configure_mfu(cfg, trainer)
         if verbose:
             print(
@@ -378,6 +385,14 @@ def _run_prune_retrain(
                 )
             with obs.span("eval", target=target, which="pre"):
                 pre_loss, pre_acc = trainer.evaluate(test_batches)
+            # ONE policy evaluation feeds the real prune, the simulated
+            # prune, AND the ledger's decision/margin record, so the
+            # provenance can never disagree with what was removed
+            drop_idx = score_drop_indices(
+                scores, policy=cfg.policy, fraction=cfg.fraction,
+                bucket=cfg.bucket,
+            )
+            score_dist = obs.score_distribution(scores, drop_idx)
             if cfg.simulate:
                 # mask the same slices a real prune would remove — shapes
                 # (and compiled programs) never change across the sweep
@@ -387,10 +402,10 @@ def _run_prune_retrain(
                 )
 
                 with obs.span("prune", target=target, simulate=True):
-                    drop_idx = score_drop_indices(
-                        scores, policy=cfg.policy, fraction=cfg.fraction,
-                        bucket=cfg.bucket,
-                    )
+                    obs.record_prune(
+                        target, drop_idx,
+                        L.n_units(trainer.model.layer(target)),
+                        simulate=True)
                     pm, sm = drop_masks(
                         trainer.model, trainer.params, {target: drop_idx},
                         state=trainer.state,
@@ -402,10 +417,8 @@ def _run_prune_retrain(
                 n_dropped = len(drop_idx)
             else:
                 with obs.span("prune", target=target):
-                    res = prune_by_scores(
-                        trainer.model, trainer.params, target, scores,
-                        policy=cfg.policy, fraction=cfg.fraction,
-                        bucket=cfg.bucket,
+                    res = prune(
+                        trainer.model, trainer.params, target, drop_idx,
                         state=trainer.state, opt_state=trainer.opt_state,
                     )
                     prune_time = time.perf_counter() - t0
@@ -425,16 +438,23 @@ def _run_prune_retrain(
                         "pre_acc": float(pre_acc),
                         "n_dropped": int(n_dropped),
                         "prune_time": float(prune_time),
+                        # the scores die with this process — stage the
+                        # distribution so a kill-then-resume round record
+                        # still carries its decision margins
+                        "score_dist": score_dist,
                     })
             epoch_i = 0
         else:
             # resumed mid-round: the restored checkpoint already holds the
-            # pruned shapes; skip scoring/prune, finish the retrain
+            # pruned shapes; skip scoring/prune, finish the retrain (the
+            # scores are gone with the killed process — the round record
+            # carries the stage's decision stats without a distribution)
             pre_loss = float(stage["pre_loss"])
             pre_acc = float(stage["pre_acc"])
             n_dropped = int(stage["n_dropped"])
             prune_time = float(stage["prune_time"])
             epoch_i = int(stage.get("retrain_epoch", 0))
+            score_dist = stage.get("score_dist")
 
         while True:
             try:
@@ -522,6 +542,16 @@ def _run_prune_retrain(
             widths=trainer.model.widths(),
         )
         history.append(rec)
+        obs.record_round(
+            target=target, round=len(history) - 1, method=cfg.method,
+            policy=cfg.policy, n_dropped=int(n_dropped),
+            simulate=bool(cfg.simulate), score_dist=score_dist,
+            pre={"loss": float(pre_loss), "acc": float(pre_acc)},
+            post={"loss": float(post_loss), "acc": float(post_acc)},
+            params=int(n_params), flops=flops, widths=rec.widths,
+            prune_time=float(prune_time),
+            runtime=obs.runtime_snapshot(),
+        )
         if journal is not None:
             import dataclasses as _dc
 

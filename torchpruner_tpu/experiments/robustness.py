@@ -427,6 +427,20 @@ def layerwise_robustness(
                     "auc": loss_increase_auc(curve),
                     "seconds": score_s + walk_share,
                 })
+            # provenance: one ledger record per finished layer panel —
+            # the sweep's unit of round-level evidence (method AUCs; raw
+            # curves stay in results_path/journal artifacts)
+            obs.record_sweep_layer(layer=layer, eval_layer=eval_layer,
+                                   methods={
+                name: {
+                    "auc_mean": float(np.mean([r["auc"] for r in runs])),
+                    "auc_std": float(np.std([r["auc"] for r in runs])),
+                    "n_runs": len(runs),
+                    "seconds_mean": float(np.mean(
+                        [r["seconds"] for r in runs])),
+                }
+                for name, runs in results[layer].items()
+            })
             if verbose:
                 for name, runs in results[layer].items():
                     aucs = [r["auc"] for r in runs]

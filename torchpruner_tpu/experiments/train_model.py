@@ -166,6 +166,7 @@ def run_train(
             "seconds": dt,
         }
         history.append(rec)
+        obs.record_epoch(**rec)
         logger.log_epoch(
             epoch=epoch, train_loss=rec["train_loss"],
             test_loss=test_loss, test_acc=test_acc, seconds=dt,

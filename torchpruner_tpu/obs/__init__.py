@@ -18,10 +18,23 @@ Typical driver::
     print(obs.shutdown(), file=sys.stderr)    # summary table; writes
                                               # events.jsonl + metrics.prom
 
-Multi-host: only ``process_index == 0`` emits files (every process still
-tracks spans/metrics locally, so in-memory summaries work anywhere).
+Multi-host: only ``process_index == 0`` emits the event stream, the
+ledger, and the merged exports — but every process with an ``obs_dir``
+writes its OWN metric shard (``metrics.shard<i>.json``) at close, and
+process 0 merges the shards (sum counters, max/min gauges, merge
+histograms — ``obs.aggregate``) before exporting ``metrics.prom`` and
+``report.json``, so non-zero processes' counters no longer vanish.
 The index is read lazily from ``jax.process_index()`` on first emission
 and can be overridden for tests via ``configure(process_index=...)``.
+
+On top of the runtime telemetry, the session keeps a **run ledger**
+(``obs.ledger.ProvenanceRecorder`` → ``ledger.jsonl``): per-round prune
+decisions with score distributions and eval/params/FLOPs trajectories,
+written by ``core.pruner`` / ``prune_retrain`` / the robustness sweep
+through the ``record_*`` module functions below.  At close the session
+bundles ledger + derived metrics + phase summary into ``report.json``
+and exports the span stream as a Perfetto/Chrome ``trace.json`` —
+consumed by ``python -m torchpruner_tpu obs report/diff`` (obs.report).
 
 Design refs: JaxPruner's cheap per-step instrumentation argument
 (arXiv:2304.14082) and the TPU structured-pruning study's MFU/step-time
@@ -48,6 +61,7 @@ from torchpruner_tpu.obs.metrics import (
     record_device_memory,
     train_flops_per_step,
 )
+from torchpruner_tpu.obs.ledger import ProvenanceRecorder, score_distribution
 from torchpruner_tpu.obs.spans import SpanRecord, SpanTracer
 
 __all__ = [
@@ -55,13 +69,21 @@ __all__ = [
     "current_span_id", "record_step", "record_grad_norm",
     "configure_step_flops", "record_capture", "capture_counts",
     "inc", "observe", "gauge_set", "counter_value",
+    "record_scores", "record_prune", "record_round", "record_epoch",
+    "record_sweep_layer", "ledger_backfill", "annotate_run",
     "MetricsRegistry", "StepTelemetry",
     "SpanTracer", "SpanRecord", "train_flops_per_step",
+    "ProvenanceRecorder", "score_distribution",
     "prometheus_text", "summary_table",
 ]
 
 EVENTS_FILENAME = "events.jsonl"
 PROM_FILENAME = "metrics.prom"
+
+#: env override for event-stream rotation (bytes; 0 = off).  Kept as an
+#: env rather than a config field so long-running drivers can cap the
+#: stream without a code change.
+ROTATE_ENV = "TORCHPRUNER_OBS_ROTATE_BYTES"
 
 _session: Optional["ObsSession"] = None
 
@@ -72,15 +94,34 @@ class ObsSession:
 
     def __init__(self, obs_dir: Optional[str] = None,
                  process_index: Optional[int] = None,
-                 annotate: bool = True, watch_compiles: bool = True):
+                 annotate: bool = True, watch_compiles: bool = True,
+                 rotate_bytes: Optional[int] = None):
         self.obs_dir = obs_dir
         self._process_index = process_index
         self._closed = False
         self.t_start = time.perf_counter()
         self.metrics = MetricsRegistry()
+        self.run_meta: Dict[str, Any] = {}
         self.events: Optional[JsonlWriter] = None
+        self.ledger: Optional[ProvenanceRecorder] = None
+        if rotate_bytes is None:
+            try:
+                rotate_bytes = int(os.environ.get(ROTATE_ENV, "0") or 0)
+            except ValueError:
+                rotate_bytes = 0
         if obs_dir and self.is_emitter:
-            self.events = JsonlWriter(os.path.join(obs_dir, EVENTS_FILENAME))
+            # a NEW session invalidates any previous session's metric
+            # shards (they are written at close; anything on disk now is
+            # a dead run's — merging it would double-count)
+            from torchpruner_tpu.obs.aggregate import clear_stale_shards
+
+            try:
+                clear_stale_shards(obs_dir)
+            except Exception:
+                pass
+            self.events = JsonlWriter(os.path.join(obs_dir, EVENTS_FILENAME),
+                                      rotate_bytes=rotate_bytes)
+            self.ledger = ProvenanceRecorder(obs_dir)
         self.tracer = SpanTracer(sink=self.events, annotate=annotate)
         self.step = StepTelemetry(self.metrics)
         self.compiles = CompileWatcher(self.metrics, self.tracer)
@@ -144,13 +185,70 @@ class ObsSession:
                 "metrics": self.metrics.snapshot(),
             })
             self.events.close()
-        if self.obs_dir and self.is_emitter:
+        if self.obs_dir:
+            # EVERY process ships its metric shard; process 0 then merges
+            # whatever shards are present into the exported registry —
+            # the cross-host aggregation path (obs.aggregate)
+            from torchpruner_tpu.obs import aggregate
+
             try:
-                write_prometheus(
-                    self.metrics, os.path.join(self.obs_dir, PROM_FILENAME))
+                aggregate.write_shard(self.metrics, self.obs_dir,
+                                      self.process_index)
             except Exception:
                 pass
+        if self.obs_dir and self.is_emitter:
+            try:
+                # every process reaches shutdown at the same program
+                # point, but their shard writes race the merge — give
+                # the peers a bounded window to land theirs (no-op
+                # single-host; tunable for slow shared filesystems)
+                aggregate.wait_for_peer_shards(
+                    self.obs_dir, self.process_index)
+            except Exception:
+                pass
+            try:
+                merged = aggregate.merged_registry(
+                    self.obs_dir, local=self.metrics,
+                    process_index=self.process_index)
+            except Exception:
+                merged = self.metrics
+            try:
+                write_prometheus(
+                    merged, os.path.join(self.obs_dir, PROM_FILENAME))
+            except Exception:
+                pass
+            if not already_closed:
+                self._export_artifacts(merged, derived)
+        if self.ledger is not None and not already_closed:
+            self.ledger.close()
         return text
+
+    def _export_artifacts(self, merged, derived) -> None:
+        """trace.json (Perfetto) + report.json (ledger bundle) — each
+        best-effort; a failing exporter must never fail the run."""
+        from torchpruner_tpu.obs import ledger as ledger_mod
+        from torchpruner_tpu.obs import trace_export
+
+        try:
+            trace_export.write_trace(
+                os.path.join(self.obs_dir, EVENTS_FILENAME))
+        except Exception:
+            pass
+        try:
+            report = ledger_mod.build_report(
+                run_meta=self.run_meta,
+                records=(self.ledger.records() if self.ledger else []),
+                derived=derived,
+                phases=self.tracer.phase_summary(),
+                compiles=self.compiles.counts(),
+                metrics=merged.snapshot(),
+                wall_s=round(time.perf_counter() - self.t_start, 6),
+            )
+            ledger_mod.write_report(
+                report,
+                os.path.join(self.obs_dir, ledger_mod.REPORT_FILENAME))
+        except Exception:
+            pass
 
 
 # -- module-level convenience (the instrumentation surface) -----------------
@@ -158,14 +256,18 @@ class ObsSession:
 
 def configure(obs_dir: Optional[str] = None, *,
               process_index: Optional[int] = None, annotate: bool = True,
-              watch_compiles: bool = True) -> ObsSession:
+              watch_compiles: bool = True,
+              rotate_bytes: Optional[int] = None) -> ObsSession:
     """Install the process-wide session (replacing any previous one).
     The new session is constructed BEFORE the old one is torn down, so a
     failing constructor (e.g. unwritable ``obs_dir``) leaves the previous
-    session installed and intact."""
+    session installed and intact.  ``rotate_bytes`` caps the event
+    stream (size-based rotation to ``events.jsonl.1`` …; default off,
+    env ``TORCHPRUNER_OBS_ROTATE_BYTES``)."""
     global _session
     new = ObsSession(obs_dir, process_index=process_index,
-                     annotate=annotate, watch_compiles=watch_compiles)
+                     annotate=annotate, watch_compiles=watch_compiles,
+                     rotate_bytes=rotate_bytes)
     if _session is not None:
         _session.close()
     _session = new
@@ -298,6 +400,101 @@ def counter_value(name: str) -> float:
         return 0.0
     v = getattr(s.metrics.get(name), "value", None)
     return float(v) if v is not None else 0.0
+
+
+# -- run ledger (provenance) -------------------------------------------------
+# All no-ops without a session or without an obs_dir (the ledger lives on
+# disk; in-memory-only sessions have no recorder).  Emitter-gated like the
+# event stream: in SPMD every process reaches the same decisions, so one
+# ledger per run is the truth, not a shard.
+
+
+def annotate_run(**meta) -> None:
+    """Attach run-level metadata (experiment name, preset, config hash)
+    to the session — lands in ``report.json``'s ``run`` block."""
+    s = _session
+    if s is not None:
+        s.run_meta.update(meta)
+
+
+def record_scores(site: str, scores, *, method: str = "", run: int = 0,
+                  layer: str = "") -> None:
+    """Ledger a per-site attribution score distribution (compact
+    percentiles, not raw scores).  Skipped for non-1-D score arrays
+    (``reduction='none'`` row matrices have no single distribution)."""
+    s = _session
+    if s is None or s.ledger is None:
+        return
+    import numpy as _np
+
+    if _np.ndim(scores) != 1:
+        return
+    s.ledger.record_scores(site, scores, method=method, run=run,
+                           layer=layer)
+
+
+def record_prune(target: str, drop, n_units: int, *,
+                 simulate: bool = False) -> None:
+    """Ledger the concrete prune decision (site + dropped rows)."""
+    s = _session
+    if s is not None and s.ledger is not None:
+        s.ledger.record_prune(target, drop, n_units, simulate=simulate)
+
+
+def record_round(*, target: str, **fields) -> None:
+    """Ledger one prune round's headline record (decision + score
+    distribution + pre/post eval + cost).  Resume-safe: deduped on
+    ``target``."""
+    s = _session
+    if s is not None and s.ledger is not None:
+        s.ledger.record_round(target=target, **fields)
+
+
+def record_epoch(**fields) -> None:
+    s = _session
+    if s is not None and s.ledger is not None and "epoch" in fields:
+        s.ledger.record_epoch(**fields)
+
+
+def record_sweep_layer(*, layer: str, **fields) -> None:
+    s = _session
+    if s is not None and s.ledger is not None:
+        s.ledger.record_sweep_layer(layer=layer, **fields)
+
+
+def ledger_backfill(records, kind: str = "round") -> int:
+    """Rehydrate ledger records from a RunManifest history on resume
+    (``kind`` = "round" | "epoch") — keeps the ledger continuous when a
+    resumed run points at a fresh obs dir.  Returns records written."""
+    s = _session
+    if s is None or s.ledger is None:
+        return 0
+    if kind == "epoch":
+        return s.ledger.backfill_epochs(records)
+    return s.ledger.backfill_rounds(records)
+
+
+def runtime_snapshot() -> Dict[str, Any]:
+    """The cost snapshot a round record embeds: steps/step-time/MFU so
+    far, compile totals, and the HBM high-water gauge — cheap reads of
+    already-accumulated state (no device sync)."""
+    s = _session
+    if s is None:
+        return {}
+    record_device_memory(s.metrics)
+    d = s.step.derive()
+    c = s.compiles.counts()
+    hbm = [m.value for m in s.metrics
+           if getattr(m, "name", "").startswith("hbm_bytes_in_use")
+           and getattr(m, "value", None) is not None]
+    return {
+        "steps": d.get("steps"),
+        "step_time_mean_s": d.get("step_time_mean_s"),
+        "mfu": d.get("mfu"),
+        "compile_s": c.get("compile_s"),
+        "compile_count": c.get("compile_count"),
+        "hbm_bytes_max": (max(hbm) if hbm else None),
+    }
 
 
 def configure_step_flops(flops_per_step: Optional[float] = None,

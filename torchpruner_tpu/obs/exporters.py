@@ -23,16 +23,45 @@ from torchpruner_tpu.obs.metrics import (
 class JsonlWriter:
     """Append JSON objects to ``path``, one per line, flushed per write
     (a crashed run keeps every event up to the crash).  The handle is
-    opened once and held — not reopened per event."""
+    opened once and held — not reopened per event.
 
-    def __init__(self, path: str):
+    ``rotate_bytes > 0`` enables size-based rotation: when the file
+    exceeds the cap after a write, it is renamed to ``path.1`` (existing
+    ``path.1`` → ``path.2``, … up to ``backups``; the oldest falls off)
+    and a fresh ``path`` is opened — long runs stop growing
+    ``events.jsonl`` without bound.  Readers
+    (``utils.profiling.load_span_events``) walk the rotated set oldest-
+    first, so summaries still see the whole stream.  Off by default
+    (0): tests and short runs keep the single-file layout."""
+
+    def __init__(self, path: str, rotate_bytes: int = 0, backups: int = 3):
         self.path = path
+        self.rotate_bytes = int(rotate_bytes or 0)
+        self.backups = max(1, int(backups))
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._f = open(path, "a")
+        self._size = self._f.tell()
 
     def __call__(self, obj: dict):
-        self._f.write(json.dumps(obj) + "\n")
+        line = json.dumps(obj) + "\n"
+        self._f.write(line)
         self._f.flush()
+        self._size += len(line)
+        if self.rotate_bytes and self._size > self.rotate_bytes:
+            self._rotate()
+
+    def _rotate(self):
+        try:
+            self._f.close()
+            for i in range(self.backups - 1, 0, -1):
+                src = f"{self.path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self.path}.{i + 1}")
+            os.replace(self.path, self.path + ".1")
+        except Exception:
+            pass  # rotation failure must never kill the run
+        self._f = open(self.path, "a")
+        self._size = self._f.tell()
 
     def close(self):
         try:
@@ -71,6 +100,13 @@ def prometheus_text(registry: MetricsRegistry) -> str:
             lines.append(f'{m.name}_bucket{{le="+Inf"}} {cum}')
             lines.append(f"{m.name}_sum {_fmt(m.sum)}")
             lines.append(f"{m.name}_count {m.count}")
+            if m.count:
+                # bucket-estimated percentiles as companion gauges (a
+                # textfile collector has no query engine to run
+                # histogram_quantile, so the snapshot ships them)
+                for k, v in m.percentiles().items():
+                    lines.append(f"# TYPE {m.name}_{k} gauge")
+                    lines.append(f"{m.name}_{k} {_fmt(v)}")
     return "\n".join(lines) + "\n"
 
 
@@ -129,6 +165,12 @@ def summary_table(
     if derived and derived.get("steps"):
         parts = [f"steps {derived['steps']}",
                  f"step {1e3 * derived['step_time_mean_s']:.2f} ms"]
+        if derived.get("step_time_p50_s") is not None:
+            parts.append(
+                "p50/p95/p99 "
+                f"{1e3 * derived['step_time_p50_s']:.2f}/"
+                f"{1e3 * derived['step_time_p95_s']:.2f}/"
+                f"{1e3 * derived['step_time_p99_s']:.2f} ms")
         if derived.get("examples_per_s"):
             parts.append(f"{derived['examples_per_s']:.1f} ex/s")
         if derived.get("tokens_per_s"):

@@ -84,6 +84,39 @@ class Histogram:
     def mean(self) -> float:
         return self.sum / self.count if self.count else 0.0
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimated q-quantile from the bucket counts (linear
+        interpolation inside the landing bucket, the standard Prometheus
+        ``histogram_quantile`` estimator) — clamped to the observed
+        min/max so a wide bucket cannot report a value outside what was
+        actually seen.  ``None`` on an empty histogram."""
+        if not self.count:
+            return None
+        target = q * self.count
+        cum = 0
+        prev_bound = min(self.min, self.buckets[0] if self.buckets else
+                         self.min)
+        for i, b in enumerate(self.buckets):
+            c = self.counts[i]
+            if cum + c >= target:
+                if c:
+                    lo = prev_bound if i else min(self.min, b)
+                    frac = (target - cum) / c
+                    v = lo + frac * (b - lo)
+                else:
+                    v = b
+                return float(min(max(v, self.min), self.max))
+            cum += c
+            prev_bound = b
+        # +Inf tail: everything above the last finite bound
+        return float(self.max)
+
+    def percentiles(self) -> Dict[str, Optional[float]]:
+        """The p50/p95/p99 trio every exporter surfaces (snapshot,
+        stderr table, Prometheus gauges)."""
+        return {"p50": self.quantile(0.50), "p95": self.quantile(0.95),
+                "p99": self.quantile(0.99)}
+
 
 class MetricsRegistry:
     """Name → metric store; create-on-first-use accessors."""
@@ -118,12 +151,16 @@ class MetricsRegistry:
         return self._metrics.get(name)
 
     def snapshot(self) -> Dict[str, float]:
-        """Flat scalar view (histograms as ``name_sum``/``name_count``)."""
+        """Flat scalar view (histograms as ``name_sum``/``name_count``
+        plus ``name_p50``/``name_p95``/``name_p99`` when non-empty)."""
         out: Dict[str, float] = {}
         for m in self:
             if isinstance(m, Histogram):
                 out[m.name + "_sum"] = m.sum
                 out[m.name + "_count"] = m.count
+                if m.count:
+                    for k, v in m.percentiles().items():
+                        out[f"{m.name}_{k}"] = v
             elif m.value is not None:
                 out[m.name] = m.value
         return out
@@ -213,11 +250,15 @@ class StepTelemetry:
         them without knowing this class."""
         h = self._hist
         wall = h.sum
+        pcts = h.percentiles() if h.count else {}
         out: Dict[str, Optional[float]] = {
             "steps": h.count,
             "step_time_mean_s": h.mean,
             "step_time_min_s": (h.min if h.count else None),
             "step_time_max_s": (h.max if h.count else None),
+            "step_time_p50_s": pcts.get("p50"),
+            "step_time_p95_s": pcts.get("p95"),
+            "step_time_p99_s": pcts.get("p99"),
             "examples_per_s": (self._examples.value / wall if wall else None),
             "tokens_per_s": (
                 self._tokens.value / wall

@@ -1,0 +1,520 @@
+"""``obs report`` / ``obs diff`` — render, compare, and gate run ledgers.
+
+The CLI the ledger exists for::
+
+    python -m torchpruner_tpu obs report logs/obs
+    python -m torchpruner_tpu obs diff logs/obs_a logs/obs_b \
+        --gate results/obs_gates_ci.json
+
+``report`` renders one run's ledger (round decisions, score margins,
+accuracy/params trajectory, step/MFU/compile summary) as a markdown
+table.  ``diff`` compares two runs — runtime scalars (step time, MFU,
+compile seconds, step count), per-round accuracy matched by target, and
+score-distribution drift — and with ``--gate`` exits non-zero naming
+every violated tolerance, which is what turns a bench/CI run into a
+regression gate instead of a number someone has to eyeball.
+
+Gate file format (JSON)::
+
+    {
+      "step_time_mean_s": {"max_increase_pct": 25},
+      "mfu":              {"max_decrease_pct": 10},
+      "compile_s":        {"max_increase": 30},
+      "steps":            {"max_increase_pct": 50},
+      "round_post_acc":   {"max_decrease": 0.05},
+      "score_p50_drift":  {"max": 0.25},
+      "missing_rounds":   {"max": 0}
+    }
+
+Scalar gates read the run-level diff; ``round_*`` and
+``score_p50_drift`` apply per matched round (worst round reported);
+``missing_rounds`` fires when run B lost rounds run A had.  Unknown
+gate names are themselves violations — a typo must not silently
+disable a gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+from torchpruner_tpu.obs.ledger import (
+    LEDGER_FILENAME,
+    REPORT_FILENAME,
+    build_report,
+    load_ledger,
+)
+
+_EPS = 1e-12
+
+#: run-level scalar metrics a diff compares; ``better`` orients the
+#: pct sign convention in the rendered table ("higher"/"lower")
+_SCALARS = {
+    "step_time_mean_s": "lower",
+    "step_time_p50_s": "lower",
+    "mfu": "higher",
+    "examples_per_s": "higher",
+    "compile_s": "lower",
+    "compile_count": "lower",
+    "steps": "same",
+    "wall_s": "lower",
+}
+
+
+def load_run(run_dir: str) -> Dict[str, Any]:
+    """A run's report dict: ``report.json`` when the session closed
+    cleanly, otherwise reconstructed from whatever survived
+    (``ledger.jsonl`` + ``events.jsonl`` + metric shards) — a SIGKILLed
+    run must still be reportable/diffable.  Also accepts a report FILE
+    directly (a committed golden ``results/obs_report_*.json``)."""
+    if os.path.isfile(run_dir):
+        with open(run_dir) as f:
+            report = json.load(f)
+        report["_dir"] = os.path.dirname(run_dir)
+        return report
+    path = os.path.join(run_dir, REPORT_FILENAME)
+    if os.path.exists(path):
+        with open(path) as f:
+            report = json.load(f)
+        report["_dir"] = run_dir
+        return report
+
+    records = _dedupe_last(
+        load_ledger(os.path.join(run_dir, LEDGER_FILENAME)))
+    phases: Dict[str, Any] = {}
+    events_path = os.path.join(run_dir, "events.jsonl")
+    if os.path.exists(events_path):
+        from torchpruner_tpu.utils.profiling import span_phase_summary
+
+        phases = span_phase_summary(events_path)
+    metrics: Dict[str, float] = {}
+    from torchpruner_tpu.obs.aggregate import load_shards, merge_shards
+
+    shards = load_shards(run_dir)
+    if shards:
+        metrics = merge_shards(shards).snapshot()
+    derived = {
+        "steps": metrics.get("steps_total"),
+        "step_time_mean_s": (
+            metrics["step_time_seconds_sum"] / metrics["step_time_seconds_count"]
+            if metrics.get("step_time_seconds_count") else None),
+        "step_time_p50_s": metrics.get("step_time_seconds_p50"),
+        "step_time_p95_s": metrics.get("step_time_seconds_p95"),
+        "step_time_p99_s": metrics.get("step_time_seconds_p99"),
+        "mfu": metrics.get("mfu"),
+        "examples_per_s": metrics.get("examples_per_s"),
+    }
+    compiles = {
+        "compile_count": metrics.get("compile_count_total"),
+        "compile_s": metrics.get("compile_seconds_total"),
+    }
+    report = build_report(records=records, derived=derived, phases=phases,
+                          compiles=compiles, metrics=metrics)
+    report["run"]["reconstructed"] = True
+    report["_dir"] = run_dir
+    if not records and not phases and not metrics:
+        raise FileNotFoundError(
+            f"{run_dir!r} holds no report.json, ledger.jsonl, "
+            "events.jsonl, or metric shards — not an obs run directory")
+    return report
+
+
+def _dedupe_last(records):
+    """Keyed records (rounds/epochs/sweep layers) deduped keeping the
+    LAST occurrence — a multi-session ledger (kill → resume) can hold a
+    round twice; the reconstruction must count it once.  Un-keyed
+    records pass through."""
+    from torchpruner_tpu.obs.ledger import _dedup_key
+
+    out, by_key = [], {}
+    for rec in records:
+        key = _dedup_key(rec)
+        if key is None:
+            out.append(rec)
+        elif key in by_key:
+            by_key[key].clear()
+            by_key[key].update(rec)  # replace in place, keep position
+        else:
+            by_key[key] = dict(rec)
+            out.append(by_key[key])
+    return out
+
+
+def _scalars_of(report: Dict[str, Any]) -> Dict[str, Optional[float]]:
+    derived = report.get("derived") or {}
+    compiles = report.get("compiles") or {}
+    return {
+        "step_time_mean_s": derived.get("step_time_mean_s"),
+        "step_time_p50_s": derived.get("step_time_p50_s"),
+        "mfu": _finite(derived.get("mfu")),
+        "examples_per_s": derived.get("examples_per_s"),
+        "compile_s": compiles.get("compile_s"),
+        "compile_count": compiles.get("compile_count"),
+        "steps": derived.get("steps"),
+        "wall_s": report.get("wall_s"),
+    }
+
+
+def _finite(v) -> Optional[float]:
+    import math
+
+    if v is None:
+        return None
+    v = float(v)
+    return v if math.isfinite(v) else None
+
+
+# -- report rendering --------------------------------------------------------
+
+
+def format_report(report: Dict[str, Any]) -> str:
+    """Markdown rendering of one run's ledger."""
+    lines: List[str] = []
+    run = report.get("run") or {}
+    title = run.get("experiment") or run.get("name") or \
+        report.get("_dir") or "run"
+    lines.append(f"# obs report — {title}")
+    lines.append("")
+    sc = _scalars_of(report)
+    bits = []
+    if sc["steps"]:
+        bits.append(f"steps {int(sc['steps'])}")
+    if sc["step_time_mean_s"]:
+        bits.append(f"step {1e3 * sc['step_time_mean_s']:.2f} ms mean")
+    d = report.get("derived") or {}
+    if d.get("step_time_p50_s") is not None:
+        bits.append(
+            f"p50/p95/p99 {1e3 * d['step_time_p50_s']:.2f}/"
+            f"{1e3 * d['step_time_p95_s']:.2f}/"
+            f"{1e3 * d['step_time_p99_s']:.2f} ms")
+    if sc["mfu"] is not None:
+        bits.append(f"MFU {100 * sc['mfu']:.1f}%")
+    if sc["examples_per_s"]:
+        bits.append(f"{sc['examples_per_s']:.1f} ex/s")
+    if sc["compile_s"] is not None:
+        bits.append(f"compile {sc['compile_s']:.2f}s"
+                    f"/{int(sc['compile_count'] or 0)}")
+    if sc["wall_s"]:
+        bits.append(f"wall {sc['wall_s']:.1f}s")
+    if bits:
+        lines.append("run: " + ", ".join(bits))
+        lines.append("")
+
+    rounds = report.get("rounds") or []
+    if rounds:
+        lines.append("| round | target | method | dropped | pre acc "
+                     "| post acc | Δacc | params | margin | near ties |")
+        lines.append("|---|---|---|---|---|---|---|---|---|---|")
+        for i, r in enumerate(rounds):
+            pre = (r.get("pre") or {})
+            post = (r.get("post") or {})
+            sd = r.get("score_dist") or {}
+            dacc = (post.get("acc") - pre.get("acc")
+                    if post.get("acc") is not None
+                    and pre.get("acc") is not None else None)
+            lines.append(
+                f"| {r.get('round', i)} | {r.get('target')} "
+                f"| {r.get('method', '')} | {_i(r.get('n_dropped'))} "
+                f"| {_f(pre.get('acc'))} | {_f(post.get('acc'))} "
+                f"| {_f(dacc, '+.4f')} | {_i(r.get('params'))} "
+                f"| {_f(sd.get('margin'))} | {_i(sd.get('near_ties'))} |")
+        lines.append("")
+
+    epochs = report.get("epochs") or []
+    if epochs:
+        last = epochs[-1]
+        lines.append(
+            f"epochs: {len(epochs)} "
+            f"(final test acc {_f(last.get('test_acc'))}, "
+            f"loss {_f(last.get('test_loss'))})")
+        lines.append("")
+
+    sweeps = report.get("sweep_layers") or []
+    if sweeps:
+        lines.append("| sweep layer | methods | best method | best auc |")
+        lines.append("|---|---|---|---|")
+        for s in sweeps:
+            methods = s.get("methods") or {}
+            best = None
+            if methods:
+                best = min(methods.items(),
+                           key=lambda kv: kv[1].get("auc_mean", float("inf")))
+            lines.append(
+                f"| {s.get('layer')} | {len(methods)} "
+                f"| {best[0] if best else ''} "
+                f"| {_f(best[1].get('auc_mean')) if best else ''} |")
+        lines.append("")
+    if not rounds and not epochs and not sweeps:
+        lines.append("(no ledger records)")
+    return "\n".join(lines)
+
+
+def _f(v, fmt: str = ".4f") -> str:
+    if v is None:
+        return ""
+    try:
+        return format(float(v), fmt)
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def _i(v) -> str:
+    return "" if v is None else str(int(v))
+
+
+# -- diff --------------------------------------------------------------------
+
+
+def _rounds_by_label(report: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    """Rounds keyed by a stable label: the target name, with a ``#k``
+    suffix from the second occurrence on (iterative schedules)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    seen: Dict[str, int] = {}
+    for r in (report.get("rounds") or []):
+        target = str(r.get("target"))
+        k = seen.get(target, 0)
+        seen[target] = k + 1
+        out[target if k == 0 else f"{target}#{k}"] = r
+    return out
+
+
+def diff_runs(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+    """Structured diff of two run reports: run-level scalar deltas,
+    per-round deltas matched by target, and round-set changes."""
+    sa, sb = _scalars_of(a), _scalars_of(b)
+    scalars: Dict[str, Any] = {}
+    for name in _SCALARS:
+        va, vb = sa.get(name), sb.get(name)
+        if va is None and vb is None:
+            continue
+        entry: Dict[str, Any] = {"a": va, "b": vb}
+        if va is not None and vb is not None:
+            entry["delta"] = vb - va
+            entry["pct"] = (100.0 * (vb - va) / abs(va)
+                            if abs(va) > _EPS else None)
+        scalars[name] = entry
+
+    # rounds matched by target AND per-target occurrence order, so an
+    # iterative schedule (same layer pruned in several rounds) pairs
+    # round-for-round; labels stay the bare target for the common
+    # one-round-per-layer case and gain a #k suffix on repeats
+    ra = _rounds_by_label(a)
+    rb = _rounds_by_label(b)
+    rounds: Dict[str, Any] = {}
+    for target in ra:
+        if target not in rb:
+            continue
+        pa, pb = ra[target], rb[target]
+        entry = {}
+        for which in ("pre", "post"):
+            aa = (pa.get(which) or {}).get("acc")
+            bb = (pb.get(which) or {}).get("acc")
+            if aa is not None and bb is not None:
+                entry[f"{which}_acc_delta"] = bb - aa
+        for key in ("n_dropped", "params"):
+            if pa.get(key) is not None and pb.get(key) is not None:
+                entry[f"{key}_delta"] = pb[key] - pa[key]
+        da = pa.get("score_dist") or {}
+        db = pb.get("score_dist") or {}
+        if da.get("p50") is not None and db.get("p50") is not None:
+            span = abs(da.get("p99", 0) - da.get("p1", 0))
+            entry["score_p50_drift"] = (
+                abs(db["p50"] - da["p50"]) / (span + _EPS))
+        if da.get("margin") is not None and db.get("margin") is not None:
+            entry["margin_delta"] = db["margin"] - da["margin"]
+        rounds[target] = entry
+    return {
+        "scalars": scalars,
+        "rounds": rounds,
+        "missing_rounds": sorted(t for t in ra if t not in rb),
+        "added_rounds": sorted(t for t in rb if t not in ra),
+    }
+
+
+def format_diff(d: Dict[str, Any]) -> str:
+    lines = ["# obs diff (B vs A)", ""]
+    if d["scalars"]:
+        lines.append("| metric | A | B | Δ | Δ% |")
+        lines.append("|---|---|---|---|---|")
+        for name, e in d["scalars"].items():
+            pct = e.get("pct")
+            lines.append(
+                f"| {name} | {_f(e.get('a'), '.6g')} "
+                f"| {_f(e.get('b'), '.6g')} "
+                f"| {_f(e.get('delta'), '+.6g')} "
+                f"| {_f(pct, '+.1f') + '%' if pct is not None else ''} |")
+        lines.append("")
+    if d["rounds"]:
+        lines.append("| round target | Δpre acc | Δpost acc "
+                     "| Δdropped | p50 drift |")
+        lines.append("|---|---|---|---|---|")
+        for target, e in d["rounds"].items():
+            lines.append(
+                f"| {target} | {_f(e.get('pre_acc_delta'), '+.4f')} "
+                f"| {_f(e.get('post_acc_delta'), '+.4f')} "
+                f"| {_i(e.get('n_dropped_delta')) or '0'} "
+                f"| {_f(e.get('score_p50_drift'), '.3f')} |")
+        lines.append("")
+    if d["missing_rounds"]:
+        lines.append(f"rounds missing in B: {', '.join(d['missing_rounds'])}")
+    if d["added_rounds"]:
+        lines.append(f"rounds only in B: {', '.join(d['added_rounds'])}")
+    return "\n".join(lines)
+
+
+# -- gates -------------------------------------------------------------------
+
+
+def check_gates(d: Dict[str, Any],
+                gates: Dict[str, Dict[str, float]]) -> List[Dict[str, Any]]:
+    """Evaluate a gate file against a diff; returns violation dicts
+    (empty = pass).  See module docstring for the format."""
+    violations: List[Dict[str, Any]] = []
+
+    def fail(gate, detail, value=None, limit=None):
+        violations.append({"gate": gate, "detail": detail,
+                           "value": value, "limit": limit})
+
+    for gate, spec in gates.items():
+        if not isinstance(spec, dict):
+            fail(gate, f"malformed gate spec {spec!r}")
+            continue
+        if gate in _SCALARS:
+            e = d["scalars"].get(gate)
+            if e is None or e.get("delta") is None:
+                # absent on one side: only fail when the gate demands
+                # presence (a CPU run has no MFU; gating it would make
+                # every CPU diff red)
+                if spec.get("require", False):
+                    fail(gate, "metric absent from one or both runs")
+                continue
+            delta, pct = e["delta"], e.get("pct")
+            if "max_increase" in spec and delta > spec["max_increase"]:
+                fail(gate, f"increased by {delta:.6g} "
+                           f"(limit {spec['max_increase']:.6g})",
+                     delta, spec["max_increase"])
+            if "max_decrease" in spec and -delta > spec["max_decrease"]:
+                fail(gate, f"decreased by {-delta:.6g} "
+                           f"(limit {spec['max_decrease']:.6g})",
+                     -delta, spec["max_decrease"])
+            if "max_increase_pct" in spec and pct is not None \
+                    and pct > spec["max_increase_pct"]:
+                fail(gate, f"increased {pct:.1f}% "
+                           f"(limit {spec['max_increase_pct']:.1f}%)",
+                     pct, spec["max_increase_pct"])
+            if "max_decrease_pct" in spec and pct is not None \
+                    and -pct > spec["max_decrease_pct"]:
+                fail(gate, f"decreased {-pct:.1f}% "
+                           f"(limit {spec['max_decrease_pct']:.1f}%)",
+                     -pct, spec["max_decrease_pct"])
+        elif gate in ("round_pre_acc", "round_post_acc"):
+            key = gate.replace("round_", "") + "_delta"
+            lim = spec.get("max_decrease")
+            for target, e in d["rounds"].items():
+                delta = e.get(key)
+                if lim is not None and delta is not None and -delta > lim:
+                    fail(gate, f"{target}: accuracy fell {-delta:.4f} "
+                               f"(limit {lim:.4f})", -delta, lim)
+        elif gate == "score_p50_drift":
+            lim = spec.get("max")
+            for target, e in d["rounds"].items():
+                drift = e.get("score_p50_drift")
+                if lim is not None and drift is not None and drift > lim:
+                    fail(gate, f"{target}: score p50 drifted "
+                               f"{drift:.3f}× the A-run score span "
+                               f"(limit {lim})", drift, lim)
+        elif gate in ("missing_rounds", "added_rounds"):
+            lim = spec.get("max", 0)
+            n = len(d[gate])
+            if n > lim:
+                fail(gate, f"{n} {gate.replace('_', ' ')} "
+                           f"({', '.join(d[gate])}; limit {lim})", n, lim)
+        else:
+            fail(gate, "unknown gate name (typos must not silently "
+                       "disable a gate)")
+    return violations
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def obs_main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="torchpruner_tpu obs",
+        description="render / diff / gate run ledgers (obs report, "
+                    "obs diff)",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pr = sub.add_parser("report", help="render one run's ledger")
+    pr.add_argument("dir", help="obs dir (report.json / ledger.jsonl) "
+                                "or a report.json file")
+    pr.add_argument("--json", action="store_true",
+                    help="emit the raw report JSON instead of markdown")
+    pr.add_argument("--md", metavar="PATH",
+                    help="additionally write the markdown table to PATH")
+    pd = sub.add_parser("diff", help="diff two runs (B vs A)")
+    pd.add_argument("dir_a")
+    pd.add_argument("dir_b")
+    pd.add_argument("--gate", metavar="PATH",
+                    help="tolerances JSON; exit 1 naming each violated "
+                         "gate")
+    pd.add_argument("--json", action="store_true",
+                    help="emit the raw diff JSON instead of markdown")
+    args = p.parse_args(argv)
+
+    if args.cmd == "report":
+        try:
+            report = load_run(args.dir)
+        except FileNotFoundError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        text = format_report(report)
+        if args.json:
+            report.pop("_dir", None)
+            print(json.dumps(report))
+        else:
+            print(text)
+        if args.md:
+            with open(args.md, "w") as f:
+                f.write(text + "\n")
+        return 0
+
+    try:
+        a, b = load_run(args.dir_a), load_run(args.dir_b)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    d = diff_runs(a, b)
+    if args.json:
+        print(json.dumps(d))
+    else:
+        print(format_diff(d))
+    if args.gate:
+        with open(args.gate) as f:
+            gates = json.load(f)
+        violations = check_gates(d, gates)
+        for v in violations:
+            print(f"GATE VIOLATION [{v['gate']}]: {v['detail']}",
+                  file=sys.stderr)
+        if violations:
+            return 1
+        print(f"gates OK ({len(gates)} checked)", file=sys.stderr)
+    return 0
+
+
+def newest_report(results_dir: str, match: str = "") -> Optional[str]:
+    """Newest committed ``obs_report_*<match>*.json`` in ``results_dir``
+    by name order (names embed dates, and mtime is meaningless after a
+    checkout) — what bench auto-diffs a fresh run against."""
+    import glob as _glob
+
+    pattern = os.path.join(results_dir, f"obs_report_*{match}*.json")
+    candidates = sorted(_glob.glob(pattern))
+    return candidates[-1] if candidates else None
+
+
+if __name__ == "__main__":
+    sys.exit(obs_main())

@@ -51,6 +51,7 @@ class SpanRecord:
     t_start: float          # time.time() (wall, for the event stream)
     meta: Dict[str, Any] = field(default_factory=dict)
     t_mono: float = 0.0     # perf_counter() at start (for durations)
+    tid: int = 0            # OS thread id (Perfetto track)
     dur_s: float = 0.0
     compile_count: int = 0
     compile_s: float = 0.0
@@ -113,11 +114,13 @@ class SpanTracer:
             depth=len(self._stack.spans),
             t_start=time.time(), meta=dict(meta),
             t_mono=time.perf_counter(),
+            tid=threading.get_native_id(),
         )
         self._stack.spans.append(rec)
         self._emit({
             "event": "span_begin", "span": rec.id, "name": rec.name,
             "parent": rec.parent, "depth": rec.depth, "ts": rec.t_start,
+            "tid": rec.tid,
             **rec.meta,
         })
         return rec
@@ -147,6 +150,7 @@ class SpanTracer:
         self._emit({
             "event": "span_end", "span": rec.id, "name": rec.name,
             "parent": rec.parent, "depth": rec.depth, "ts": time.time(),
+            "tid": rec.tid,
             "dur_s": round(rec.dur_s, 6),
             "compile_count": rec.compile_count,
             "compile_s": round(rec.compile_s, 6),

@@ -1,0 +1,134 @@
+"""Perfetto / Chrome-trace export of the obs span stream.
+
+Converts ``events.jsonl`` span begin/end events into the Trace Event
+Format (the JSON schema both ``chrome://tracing`` and
+``ui.perfetto.dev`` open natively), so a TPU run's runtime phases can be
+inspected on the same timeline UI as the XLA profiler's device tracks —
+drag ``trace.json`` into Perfetto next to the XProf capture and the
+``retrain`` / ``capture_fill`` / ``checkpoint_write`` spans line up
+against the device stream.
+
+Mapping:
+
+- ``span_begin`` → a ``"ph": "B"`` event, ``span_end`` → ``"ph": "E"``
+  (duration events; nesting reconstructs the flame from B/E pairing).
+- ``ts`` is microseconds.  Begin uses the event's wall-clock ``ts``;
+  end uses ``begin + dur_s`` (the monotonic duration) when available,
+  so NTP steps between begin and end cannot produce a negative slice.
+  Timestamps are additionally clamped monotonic per track — the format
+  requires it, and a torn stream must still open.
+- ``pid`` is the JAX process index (from the session's ``obs_init``
+  marker), ``tid`` the OS thread id the span ran on (span events carry
+  ``tid``; streams from before that field land on tid 0).
+- A ``span_begin`` with no matching ``span_end`` (SIGKILL mid-phase)
+  gets a synthetic ``E`` at the last seen timestamp of its track, so
+  the B/E pairing always balances.
+- span metadata (``target``, ``method``, …) rides in ``args``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+TRACE_FILENAME = "trace.json"
+
+_CORE_KEYS = frozenset({
+    "event", "span", "name", "parent", "depth", "ts", "dur_s", "tid",
+    "compile_count", "compile_s", "trace_count",
+})
+
+
+def trace_events_from_spans(events: List[dict]) -> List[dict]:
+    """Trace Event Format list from parsed obs events (the output of
+    ``utils.profiling.load_span_events``)."""
+    out: List[dict] = []
+    pid = 0
+    host = None
+    for ev in events:
+        if ev.get("event") == "obs_init":
+            pid = int(ev.get("process_index", 0) or 0)
+            host = ev.get("pid")
+            break
+    out.append({
+        "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+        "args": {"name": f"torchpruner process {pid}"
+                         + (f" (os pid {host})" if host else "")},
+    })
+
+    last_ts: Dict[int, float] = {}   # per-tid monotonic clamp (µs)
+    open_spans: Dict[str, dict] = {}  # span id -> emitted B event
+
+    def clamp(tid: int, ts_us: float) -> float:
+        ts_us = max(ts_us, last_ts.get(tid, 0.0))
+        last_ts[tid] = ts_us
+        return ts_us
+
+    def args_of(ev: dict) -> Dict[str, Any]:
+        extra = {k: v for k, v in ev.items() if k not in _CORE_KEYS}
+        for k in ("compile_count", "compile_s", "trace_count"):
+            if ev.get(k):
+                extra[k] = ev[k]
+        extra["span"] = ev.get("span")
+        return extra
+
+    for ev in events:
+        kind = ev.get("event")
+        if kind not in ("span_begin", "span_end"):
+            continue
+        tid = int(ev.get("tid", 0) or 0)
+        name = str(ev.get("name", "?"))
+        sid = ev.get("span")
+        if kind == "span_begin":
+            b = {
+                "ph": "B", "name": name, "cat": "obs",
+                "pid": pid, "tid": tid,
+                "ts": clamp(tid, float(ev.get("ts", 0.0)) * 1e6),
+                "args": args_of(ev),
+            }
+            out.append(b)
+            if sid is not None:
+                open_spans[sid] = b
+        else:
+            b = open_spans.pop(sid, None)
+            if b is None:
+                continue  # end without begin (rotated-away) — skip
+            dur_s = ev.get("dur_s")
+            ts_us = (b["ts"] + float(dur_s) * 1e6 if dur_s is not None
+                     else float(ev.get("ts", 0.0)) * 1e6)
+            out.append({
+                "ph": "E", "name": name, "cat": "obs",
+                "pid": pid, "tid": b["tid"],
+                "ts": clamp(b["tid"], ts_us),
+                "args": args_of(ev),
+            })
+    # close any span the run never closed (kill mid-phase), innermost
+    # first so the B/E nesting stays balanced per track
+    for sid, b in sorted(open_spans.items(), reverse=True):
+        out.append({
+            "ph": "E", "name": b["name"], "cat": "obs",
+            "pid": pid, "tid": b["tid"],
+            "ts": clamp(b["tid"], b["ts"]),
+            "args": {"span": sid, "torn": True},
+        })
+    return out
+
+
+def write_trace(events_jsonl: str, out_path: Optional[str] = None) -> str:
+    """Convert an ``events.jsonl`` (rotation-aware, latest session only —
+    ``load_span_events``'s contract) into ``trace.json`` next to it (or
+    at ``out_path``).  Returns the written path."""
+    from torchpruner_tpu.utils.profiling import load_span_events
+
+    events = load_span_events(events_jsonl)
+    if out_path is None:
+        out_path = os.path.join(os.path.dirname(events_jsonl) or ".",
+                                TRACE_FILENAME)
+    from torchpruner_tpu.resilience.manifest import atomic_write_json
+
+    payload = {
+        "traceEvents": trace_events_from_spans(events),
+        "displayTimeUnit": "ms",
+    }
+    atomic_write_json(out_path, payload, indent=None)
+    return out_path

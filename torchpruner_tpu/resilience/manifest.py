@@ -44,17 +44,21 @@ def fsync_dir(path: str) -> None:
         os.close(fd)
 
 
-def atomic_write_json(path: str, obj: Any) -> None:
+def atomic_write_json(path: str, obj: Any, *, indent: Optional[int] = 2,
+                      default=None) -> None:
     """Write ``obj`` as JSON such that ``path`` is either the old complete
     file or the new complete file — never a truncated hybrid.  The
-    standard tmp-in-same-dir + flush + fsync + ``os.replace`` dance."""
+    standard tmp-in-same-dir + flush + fsync + ``os.replace`` dance.
+    Shared by the manifest, checkpoint specs, and the obs exporters
+    (``default`` hooks non-JSON leaf types; ``indent=None`` for compact
+    payloads like trace.json)."""
     path = os.path.abspath(path)
     d = os.path.dirname(path)
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp.", suffix=".json")
     try:
         with os.fdopen(fd, "w") as f:
-            json.dump(obj, f, indent=2)
+            json.dump(obj, f, indent=indent, default=default)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)

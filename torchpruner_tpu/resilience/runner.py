@@ -285,6 +285,10 @@ def run_resilient_train(cfg, *, model=None, datasets=None,
     logger = CSVLogger(cfg.log_path, experiment=cfg.name)
     test_batches = test.batches(cfg.eval_batch_size)
     history: List[dict] = [dict(r) for r in manifest.records]
+    # ledger continuity: epochs committed before the kill rehydrate from
+    # the manifest (deduped against a reused obs dir's own records)
+    if history:
+        obs.ledger_backfill(history, kind="epoch")
     epoch = manifest.epoch
     cursor = manifest.batch_cursor
     losses: List[Any] = list(manifest.stage.get("losses", []))
@@ -409,6 +413,7 @@ def run_resilient_train(cfg, *, model=None, datasets=None,
                         "seconds": time.perf_counter() - t0,
                     }
                     history.append(rec)
+                    obs.record_epoch(**rec)
                     logger.log_epoch(
                         epoch=epoch, train_loss=rec["train_loss"],
                         test_loss=test_loss, test_acc=test_acc,

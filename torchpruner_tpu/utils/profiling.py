@@ -222,28 +222,47 @@ def load_span_events(path: str, latest_run: bool = True) -> List[dict]:
     """Parse an obs ``events.jsonl`` (one JSON object per line; malformed
     lines — e.g. the torn last line of a killed run — are skipped).
 
-    The file is append-mode across sessions; every session opens with an
-    ``obs_init`` marker.  ``latest_run`` (default) returns only the
+    Rotation-aware: a size-capped session renames the stream to
+    ``events.jsonl.1`` … as it grows (``obs.configure(rotate_bytes=…)``),
+    so the rotated backups are read first, oldest to newest, then the
+    live file — one continuous stream.
+
+    The stream is append-mode across sessions; every session opens with
+    an ``obs_init`` marker.  ``latest_run`` (default) returns only the
     events after the LAST marker, so re-using an ``--obs-dir`` doesn't
     double-count earlier runs in phase summaries (same contract as
     ``trace_analysis.find_trace_files``)."""
+    import glob
     import json
+    import os
+    import re
+
+    rotated = []
+    for p in glob.glob(path + ".*"):
+        m = re.match(re.escape(path) + r"\.(\d+)$", p)
+        if m:
+            rotated.append((int(m.group(1)), p))
+    # highest suffix = oldest; read oldest → newest → live file
+    paths = [p for _, p in sorted(rotated, reverse=True)]
+    if os.path.exists(path) or not paths:
+        paths.append(path)
 
     events: List[dict] = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                ev = json.loads(line)
-            except ValueError:
-                continue
-            if not isinstance(ev, dict):
-                continue
-            if latest_run and ev.get("event") == "obs_init":
-                events = []  # a new session starts: drop the earlier one
-            events.append(ev)
+    for p in paths:
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(ev, dict):
+                    continue
+                if latest_run and ev.get("event") == "obs_init":
+                    events = []  # a new session starts: drop the earlier one
+                events.append(ev)
     return events
 
 
