@@ -116,6 +116,7 @@ _LEG_EST_S = {
     "vgg16_train": (120, 3600),
     "mfu_llama": (180, 3600),
     "llama_decode": (180, 300),
+    "serve": (240, 300),
     "flash_attention": (60, 3600),
     "vgg16_robustness": (1500, 100000),
 }
@@ -864,6 +865,12 @@ def _leg_llama_decode(smoke: bool, progress=None) -> dict:
         from torchpruner_tpu.utils.dtypes import cast_floats
 
         steady_q = {}
+        # bf16-policy variants decode with a bf16 KV CACHE: at bf16/int
+        # weights the f32 cache would double decode HBM reads for no
+        # accuracy reason (generate.init_cache plumbs the dtype); the
+        # f32-weights baselines above keep the f32 cache so the dense
+        # numbers stay comparable with earlier rounds
+        kv16 = {"cache_dtype": jax.numpy.bfloat16}
         # int4 runs with ALL-bf16 float leaves so the Dense/GatedDense
         # matmuls take the fused-unpack kernel path (quant.qdot);
         # attention projections unpack through XLA - the measured number
@@ -872,10 +879,11 @@ def _leg_llama_decode(smoke: bool, progress=None) -> dict:
         # regime - dividing by the f32 dense baseline would conflate the
         # bf16 activation/MXU win with the int4 weight win
         pb16 = cast_floats(params, jax.numpy.bfloat16)
-        hard_fence(generate(model, pb16, prompt, n_new))  # compile
-        steady_bf16w = timed_decode(model, pb16)
+        hard_fence(generate(model, pb16, prompt, n_new, **kv16))  # compile
+        steady_bf16w = timed_decode(model, pb16, **kv16)
         result["gen_tokens_per_s_bf16_weights"] = round(
             B * n_new / steady_bf16w, 1)
+        result["kv_cache_dtype_quant_legs"] = "bfloat16"
         if progress is not None:
             progress(dict(result))
         for tag, (m_, p_, kw) in (
@@ -886,8 +894,8 @@ def _leg_llama_decode(smoke: bool, progress=None) -> dict:
             qp = quantize_params(m_, p_, **kw)
             if kw.get("bits") == 4:
                 qp = cast_floats(qp, jax.numpy.bfloat16)
-            hard_fence(generate(m_, qp, prompt, n_new))  # compile
-            steady_q[tag] = timed_decode(m_, qp)
+            hard_fence(generate(m_, qp, prompt, n_new, **kv16))  # compile
+            steady_q[tag] = timed_decode(m_, qp, **kv16)
             result[f"gen_tokens_per_s_{tag}"] = round(
                 B * n_new / steady_q[tag], 1)
             if progress is not None:
@@ -899,6 +907,113 @@ def _leg_llama_decode(smoke: bool, progress=None) -> dict:
         # bf16-weights dense serving baseline
         result["pruned_int4_decode_speedup_vs_bf16_weights"] = round(
             steady_bf16w / steady_q["pruned_int4"], 3)
+    return result
+
+
+def _leg_serve(smoke: bool, progress=None) -> dict:
+    """Leg: the continuous-batching serving engine (serve/) under
+    open-loop Poisson traffic — the number ROADMAP item 1 asks for:
+    sustained generated tok/s and TTFT / per-token tail latency of the
+    multi-tenant decode path, not the static-batch ceiling.
+
+    Two phases on ONE engine (so the measured phase pays no compiles):
+    a step-staggered warmup that compiles prefill buckets + the decode
+    step and measures the engine's closed-loop token capacity, then the
+    measured open-loop phase at ~70% of that capacity (an arrival rate
+    the engine can sustain — tail latency at a stable operating point;
+    an overloaded open loop measures queue growth, not the engine)."""
+    import jax
+    import numpy as np
+
+    from torchpruner_tpu.core.segment import init_model
+    from torchpruner_tpu.models import llama_tiny, mfu_llama
+    from torchpruner_tpu.serve import (
+        OpenLoopTraffic,
+        ServeEngine,
+        poisson_arrivals,
+        staggered_arrivals,
+        synthetic_requests,
+        vocab_of,
+    )
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if smoke:
+        model, slots, max_len = llama_tiny(), 2, 96
+        n, prompt_lens, max_new = 8, [4, 8], [8, 12]
+    elif on_tpu:
+        # serving-scale (~200M model, decode HBM-bound) — same model as
+        # the llama_decode leg so the two rows are comparable
+        model, slots, max_len = mfu_llama(), 8, 512
+        n, prompt_lens, max_new = 64, [32, 64, 96], [64, 128]
+    else:
+        model, slots, max_len = llama_tiny(), 4, 256
+        n, prompt_lens, max_new = 32, [8, 16, 24], [32, 48]
+    params, _ = init_model(model, seed=0)
+    vocab = vocab_of(model)
+
+    eng = ServeEngine(model, params, n_slots=slots, max_len=max_len,
+                      cache_dtype=jax.numpy.bfloat16 if on_tpu else None)
+    warm_n = slots * 2
+    warm = synthetic_requests(warm_n, vocab=vocab,
+                              prompt_lens=prompt_lens, max_new=max_new,
+                              seed=0)
+    t0 = time.perf_counter()
+    eng.run(OpenLoopTraffic(warm, staggered_arrivals(warm_n, 1),
+                            by_step=True))
+    warm_s = time.perf_counter() - t0
+    # capacity from a SECOND warm pass (same shapes, zero compiles) —
+    # the first pass's wall is dominated by the compile bill
+    cal = synthetic_requests(warm_n, vocab=vocab,
+                             prompt_lens=prompt_lens, max_new=max_new,
+                             seed=3)
+    t0 = time.perf_counter()
+    eng.run(OpenLoopTraffic(cal, staggered_arrivals(warm_n, 1),
+                            by_step=True))
+    capacity = sum(len(r.tokens) for r in cal) \
+        / max(time.perf_counter() - t0, 1e-9)
+    result = {
+        "warmup_requests": warm_n,
+        "compile_and_warmup_s": round(warm_s, 2),
+        "capacity_gen_tok_s": round(capacity, 1),
+        "slots": slots,
+        "model": "mfu_llama (~200M)" if (on_tpu and not smoke)
+                 else "llama_tiny",
+    }
+    if progress is not None:
+        progress(dict(result))
+
+    mean_new = float(np.mean(max_new))
+    rate = 0.7 * capacity / mean_new  # requests/s at 70% utilization
+    reqs = synthetic_requests(n, vocab=vocab, prompt_lens=prompt_lens,
+                              max_new=max_new, seed=1)
+    # measured-phase deltas: the warmup/calibration passes ran on the
+    # SAME engine (shared compiles), so lifetime counters must be
+    # rebased to report this phase alone
+    evict0 = eng.scheduler.allocator.total_evictions
+    steps0 = eng.steps
+    t0 = time.perf_counter()
+    eng.run(OpenLoopTraffic(reqs, poisson_arrivals(n, rate, seed=2)))
+    wall = time.perf_counter() - t0
+    done = [r for r in reqs if r.state == "done"]
+    ttfts = np.asarray([r.ttft_s for r in done if r.ttft_s is not None])
+    gaps = np.asarray([g for r in done for g in r.token_gaps_s])
+    tokens = sum(len(r.tokens) for r in done)
+    result.update({
+        "requests": n,
+        "requests_completed": len(done),
+        "offered_rate_req_s": round(rate, 2),
+        "gen_tokens": tokens,
+        "value": round(tokens / wall, 1),
+        "unit": "sustained_gen_tok_per_s",
+        "ttft_p50_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 2),
+        "ttft_p99_ms": round(float(np.percentile(ttfts, 99)) * 1e3, 2),
+        "token_p50_ms": round(float(np.percentile(gaps, 50)) * 1e3, 3),
+        "token_p99_ms": round(float(np.percentile(gaps, 99)) * 1e3, 3),
+        "evictions": eng.scheduler.allocator.total_evictions - evict0,
+        "decode_steps": eng.steps - steps0,
+    })
+    if progress is not None:
+        progress(dict(result))
     return result
 
 
@@ -1233,12 +1348,15 @@ def main() -> dict:
         run_leg("vgg16_train", _leg_vgg_train)
         run_leg("flash_attention", _leg_flash_attention)
         run_leg("llama_decode", _leg_llama_decode)
+        run_leg("serve", _leg_serve)
         run_leg("vgg16_robustness", _leg_vgg_robustness)
     else:
         # CPU fallback: the VGG legs are TPU-sized, but decode on
         # llama_tiny is CPU-sized — keep it so every round's artifact has
-        # a decode number on SOME platform (round-2 gap)
+        # a decode number on SOME platform (round-2 gap); the serve leg
+        # (continuous batching on the same tiny model) likewise
         run_leg("llama_decode", _leg_llama_decode)
+        run_leg("serve", _leg_serve)
 
     # assemble BEFORE shutdown (it reads the live session's phase
     # summary), then flush the exporters — with BENCH_OBS_DIR set this

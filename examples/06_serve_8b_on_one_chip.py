@@ -1,12 +1,19 @@
-"""Example 06 — serve a Llama-3-8B-class model on ONE 16 GB chip.
+"""Example 06 — SERVE a Llama-3-8B-class model on ONE 16 GB chip.
 
 The deploy pipeline the reference never had (it has no inference path
-at all, SURVEY.md §2): prune 25 % of every block's FFN channels by
-weight-norm, quantize the matmul weights to int4 (two values per byte,
-fused-unpack Pallas kernel on the decode path), and decode with a bf16
-KV cache.  At the full 8B config the bf16 weights alone (~15 GB) do
-not fit one chip's HBM; the int4 tree (~3.8 GB + bf16 embedding) does
-— `experiments/llama8b_decode.py` measures that configuration on real
+at all, SURVEY.md §2), now behind the real serving layer: prune 25 % of
+every block's FFN channels by weight-norm, quantize the matmul weights
+to int4 (two values per byte, fused-unpack Pallas kernel on the decode
+path), and serve the artifact through ``torchpruner_tpu.serve`` — a
+continuous-batching engine (request scheduler + lane-aligned bucketed
+KV allocator + prefill/decode disaggregation) decoding with a bf16 KV
+cache.  Open-loop staggered arrivals exercise mid-run admission and
+slot recycling; per-request TTFT and token gaps come back on the
+request objects.
+
+At the full 8B config the bf16 weights alone (~15 GB) do not fit one
+chip's HBM; the int4 tree (~3.8 GB + bf16 embedding) does —
+`experiments/llama8b_decode.py` measures that configuration on real
 hardware; this example walks the same pipeline end-to-end at a small
 scale so it runs anywhere in seconds.
 
@@ -41,7 +48,6 @@ def main():
     import numpy as np
     import jax.numpy as jnp
 
-    import torchpruner_tpu as tp
     from torchpruner_tpu.attributions import WeightNormAttributionMetric
     from torchpruner_tpu.core.graph import pruning_graph
     from torchpruner_tpu.core.pruner import prune_by_scores
@@ -51,9 +57,15 @@ def main():
         quantized_random_params,
         weight_bytes,
     )
-    from torchpruner_tpu.generate import generate
     from torchpruner_tpu.models import llama
     from torchpruner_tpu.ops.quant import quantize_params
+    from torchpruner_tpu.serve import (
+        OpenLoopTraffic,
+        ServeEngine,
+        staggered_arrivals,
+        synthetic_requests,
+        vocab_of,
+    )
     from torchpruner_tpu.utils.losses import lm_cross_entropy_loss
 
     if args.full:
@@ -92,23 +104,38 @@ def main():
               f"{logical_params(params):,} logical params, "
               f"{weight_bytes(params):,} weight bytes/step")
 
-    B, S, n_new = (8, 64, 64) if args.full else (2, 8, 16)
-    prompt = jnp.zeros((B, S), jnp.int32)
+    # -- serve the pruned+quantized artifact -------------------------------
+    # continuous batching: more requests than slots, staggered open-loop
+    # arrivals -> mid-run admits and slot recycling; bf16 KV cache (half
+    # the cache HBM — the serving config)
+    slots, max_len = (8, 192) if args.full else (2, 64)
+    n_req = slots * 3
+    engine = ServeEngine(model, params, n_slots=slots, max_len=max_len,
+                         cache_dtype=jnp.bfloat16)
+    vocab = vocab_of(model)
+    requests = synthetic_requests(
+        n_req, vocab=vocab,
+        prompt_lens=[8, 16, 12] if args.full else [4, 8, 6],
+        max_new=[48, 64] if args.full else [12, 16], seed=0)
+    traffic = OpenLoopTraffic(
+        requests, staggered_arrivals(n_req, every_steps=4), by_step=True)
+
     t0 = time.perf_counter()
-    toks = generate(model, params, prompt, n_new,
-                    cache_dtype=jnp.bfloat16)
-    jax.block_until_ready(toks)
-    first = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    toks = generate(model, params, prompt, n_new,
-                    cache_dtype=jnp.bfloat16)
-    jax.block_until_ready(toks)
-    steady = time.perf_counter() - t0
-    print(f"decoded {B}×{n_new} tokens: first call {first:.1f}s "
-          f"(compile), steady {steady:.3f}s "
-          f"({B * n_new / steady:.0f} gen tok/s) on "
+    summary = engine.run(traffic)
+    wall = time.perf_counter() - t0
+    print(f"served {summary['requests_completed']} requests "
+          f"({summary['gen_tokens']} tokens) on {slots} slots in "
+          f"{wall:.1f}s (incl. compile): "
+          f"{summary['sustained_gen_tok_s']} gen tok/s steady, "
+          f"TTFT p50 {summary['ttft_p50_ms']} ms / "
+          f"p99 {summary['ttft_p99_ms']} ms, per-token p50 "
+          f"{summary['token_p50_ms']} ms on "
           f"{jax.devices()[0].platform}")
-    print("tokens[0,:8] =", np.asarray(toks)[0, :8].tolist())
+    print(f"admits {summary['admits']}, evictions/slot-reuse "
+          f"{summary['evictions']}")
+    first = requests[0]
+    print("request0 tokens[:8] =",
+          np.asarray(first.tokens[:8], np.int32).tolist())
 
 
 if __name__ == "__main__":

@@ -24,6 +24,10 @@ def bench(monkeypatch, tmp_path):
     # resuming subprocesses (tests/test_resilience.py owns the real leg)
     monkeypatch.setattr(mod, "_leg_resilience",
                         lambda smoke: {"value": 0.1, "unit": "s"})
+    # likewise the serving leg (tests/test_serve.py owns the real engine)
+    monkeypatch.setattr(mod, "_leg_serve",
+                        lambda smoke, progress=None:
+                        {"value": 0.1, "unit": "s"})
     return mod
 
 
@@ -48,12 +52,14 @@ def test_partial_record_written_after_every_leg(bench, monkeypatch):
     monkeypatch.setattr(bench, "_leg_resilience", stub("resilience", 0.5))
     monkeypatch.setattr(bench, "_leg_llama_decode",
                         stub("llama_decode", 2.0))
+    monkeypatch.setattr(bench, "_leg_serve", stub("serve", 3.0))
     monkeypatch.setattr(sys, "argv", ["bench.py", "--run", "--cpu", "--no-cache"])
     out = bench.main()
-    assert calls == ["mnist_prune", "resilience", "llama_decode"]
+    assert calls == ["mnist_prune", "resilience", "llama_decode", "serve"]
     # each later leg saw the earlier legs' records already persisted
     assert disk_at_call == [None, ["mnist_prune"],
-                            ["mnist_prune", "resilience"]]
+                            ["mnist_prune", "resilience"],
+                            ["mnist_prune", "resilience", "llama_decode"]]
     part = json.load(open(bench.PARTIAL_PATH))
     assert list(part["legs"]) == calls
     assert part["platform"] == "cpu"
@@ -67,7 +73,7 @@ def test_partial_record_skipped_in_smoke_mode(bench, monkeypatch):
     monkeypatch.setattr(bench, "_leg_mnist", leg)
     for name in ("_leg_vgg_robustness", "_leg_vgg_train",
                  "_leg_flash_attention", "_leg_llama_decode",
-                 "_leg_mfu_llama"):
+                 "_leg_mfu_llama", "_leg_serve"):
         monkeypatch.setattr(bench, name, leg)
     monkeypatch.setattr(sys, "argv", ["bench.py", "--run", "--cpu",
                                       "--smoke", "--no-cache"])
@@ -89,13 +95,14 @@ def test_snapshot_streamed_after_every_leg(bench, monkeypatch, capsys):
     leg = lambda smoke: {"value": 1.5, "unit": "s", "vs_baseline": 2.0}
     monkeypatch.setattr(bench, "_leg_mnist", leg)
     monkeypatch.setattr(bench, "_leg_llama_decode", leg)
+    monkeypatch.setattr(bench, "_leg_serve", leg)
     monkeypatch.setattr(sys, "argv", ["bench.py", "--run", "--cpu",
                                       "--no-cache"])
     monkeypatch.delenv("BENCH_DEADLINE_TS", raising=False)
     out = bench.main()
     lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
     snaps = [json.loads(ln) for ln in lines]
-    assert len(snaps) == 3  # one per leg (mnist, resilience, decode)
+    assert len(snaps) == 4  # one per leg (mnist, resilience, decode, serve)
     for snap in snaps:
         assert snap["stream"] == "in_progress"
         assert {"metric", "value", "unit", "vs_baseline", "legs"} <= set(snap)
@@ -103,7 +110,7 @@ def test_snapshot_streamed_after_every_leg(bench, monkeypatch, capsys):
     assert snaps[0]["metric"] == "mnist_fc_shapley_prune_wall_clock"
     assert snaps[0]["value"] == 1.5
     assert list(snaps[-1]["legs"]) == ["mnist_prune", "resilience",
-                                       "llama_decode"]
+                                       "llama_decode", "serve"]
     assert out["value"] == 1.5 and "stream" not in out
 
 
@@ -114,6 +121,7 @@ def test_budget_guard_skips_unfinishable_legs(bench, monkeypatch, capsys):
     leg = lambda smoke: ran.append(1) or {"value": 1, "unit": "s"}
     monkeypatch.setattr(bench, "_leg_mnist", leg)
     monkeypatch.setattr(bench, "_leg_llama_decode", leg)
+    monkeypatch.setattr(bench, "_leg_serve", leg)
     monkeypatch.setattr(sys, "argv", ["bench.py", "--run", "--cpu",
                                       "--no-cache"])
     monkeypatch.setenv("BENCH_DEADLINE_TS", str(time.time() + 5.0))
@@ -122,11 +130,12 @@ def test_budget_guard_skips_unfinishable_legs(bench, monkeypatch, capsys):
     assert "budget" in out["legs"]["mnist_prune"]["skipped"]
     assert "budget" in out["legs"]["resilience"]["skipped"]
     assert "budget" in out["legs"]["llama_decode"]["skipped"]
+    assert "budget" in out["legs"]["serve"]["skipped"]
     assert out["value"] is None  # skipped legs never fake a headline
     # ...but the skip decisions themselves were streamed
     snaps = [json.loads(ln)
              for ln in capsys.readouterr().out.splitlines() if ln.strip()]
-    assert len(snaps) == 3
+    assert len(snaps) == 4
 
 
 def test_leg_progress_checkpoints_are_streamed(bench, monkeypatch, capsys):

@@ -1,0 +1,555 @@
+"""Serving-engine tests: lane-aligned KV allocation, continuous-batching
+scheduling, engine-vs-solo token parity (greedy AND seeded sampling),
+SIGTERM-style drain, checkpoint hot-swap, obs/report surfacing, and the
+HTTP front end."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from torchpruner_tpu import obs
+from torchpruner_tpu.core.pruner import prune
+from torchpruner_tpu.core.segment import init_model
+from torchpruner_tpu.generate import generate
+from torchpruner_tpu.models import llama_moe_tiny, llama_tiny
+from torchpruner_tpu.serve import (
+    KVCacheAllocator,
+    OpenLoopTraffic,
+    Request,
+    Sampling,
+    ServeEngine,
+    aligned_len,
+    bucket_for,
+    poisson_arrivals,
+    prefill_buckets,
+    staggered_arrivals,
+    synthetic_requests,
+)
+from torchpruner_tpu.serve.request import DONE, DRAINED
+
+
+@pytest.fixture
+def tiny_engine():
+    model = llama_tiny()
+    params, _ = init_model(model, seed=0)
+    return model, params, ServeEngine(model, params, n_slots=2, max_len=64)
+
+
+# -- allocator ---------------------------------------------------------------
+
+
+def test_aligned_len_follows_lane_ladder():
+    assert aligned_len(1) == 8
+    assert aligned_len(8) == 8
+    assert aligned_len(9) == 16
+    assert aligned_len(128) == 128
+    assert aligned_len(129) == 256
+    # the LAST bucket is capped at max_len itself (possibly unaligned):
+    # a bucket larger than the physical slot could never insert
+    assert prefill_buckets(20) == [8, 16, 20]
+    assert prefill_buckets(160) == [8, 16, 24, 32, 40, 48, 56, 64, 72,
+                                    80, 88, 96, 104, 112, 120, 128, 160]
+    assert max(prefill_buckets(100)) == 100
+    assert bucket_for(13, [8, 16, 24]) == 16
+    with pytest.raises(ValueError, match="exceeds"):
+        bucket_for(100, [8, 16, 24])
+
+
+def test_allocator_pages_and_recycling():
+    a = KVCacheAllocator(n_slots=2, max_len=64, page_len=16)
+    assert a.pages_per_slot == 4
+    l1 = a.allocate(1, 30)  # 2 pages
+    l2 = a.allocate(2, 64)  # 4 pages
+    assert l1.pages == 2 and l2.pages == 4
+    assert a.pages_in_use == 6 and a.active_slots == 2
+    assert a.allocate(3, 8) is None  # no slot free
+    a.release(l1.slot)
+    assert a.pages_in_use == 4 and a.total_evictions == 1
+    l3 = a.allocate(3, 8)
+    assert l3 is not None and l3.slot == l1.slot  # slot recycled
+    assert a.allocate(4, 65) is None  # longer than a slot
+
+
+def test_allocator_page_budget_caps_residency():
+    a = KVCacheAllocator(n_slots=4, max_len=64, page_len=16,
+                         page_budget=6)
+    assert a.allocate(1, 64) is not None  # 4 pages
+    assert a.allocate(2, 64) is None      # would need 4 > 2 remaining
+    assert a.allocate(3, 30) is not None  # 2 pages fits the budget
+
+
+# -- engine: continuous batching ----------------------------------------------
+
+
+def test_continuous_batching_tokens_match_solo_decode(tiny_engine):
+    """More requests than slots with staggered open-loop arrivals —
+    mid-run admissions and slot recycling — and every request's tokens
+    bit-identical to its static solo generate() decode."""
+    model, params, eng = tiny_engine
+    reqs = synthetic_requests(6, vocab=64, prompt_lens=[4, 7, 5],
+                              max_new=[6, 3, 9], seed=1)
+    traffic = OpenLoopTraffic(reqs, staggered_arrivals(6, every_steps=2),
+                              by_step=True)
+    summary = eng.run(traffic)
+    assert summary["requests_completed"] == 6
+    assert summary["evictions"] == 6  # every slot recycled at least once
+    assert eng.scheduler.allocator.active_slots == 0
+    for r in reqs:
+        assert r.state == DONE and len(r.tokens) == r.max_new
+        want = np.asarray(
+            generate(model, params, r.prompt_ids[None], r.max_new))[0]
+        np.testing.assert_array_equal(np.asarray(r.tokens, np.int32),
+                                      want)
+        assert r.ttft_s is not None and r.ttft_s >= 0
+        assert len(r.token_gaps_s) == r.max_new - 1
+
+
+def test_sampled_requests_match_seeded_generate(tiny_engine):
+    """Per-request temperature / top_k / top_p sampling reproduces the
+    solo generate() stream from the same seed — the replayability
+    contract (a served request can be re-decoded offline).  The
+    COMBINED top_k+top_p case pins the truncation ORDER: the nucleus
+    must be measured over the top-k-renormalized distribution, exactly
+    as generate._truncate_logits does."""
+    model, params, eng = tiny_engine
+    cases = [Sampling(temperature=0.8, seed=7),
+             Sampling(temperature=1.2, top_k=5, seed=11),
+             Sampling(temperature=0.9, top_p=0.8, seed=13),
+             Sampling(temperature=1.0, top_k=2, top_p=0.6, seed=17),
+             Sampling(temperature=0.7, top_k=7, top_p=0.5, seed=19)]
+    rng = np.random.default_rng(0)
+    reqs = [eng.submit(Request(
+        prompt_ids=rng.integers(0, 64, size=5).astype(np.int32),
+        max_new=8, sampling=s)) for s in cases]
+    eng.run()
+    for r in reqs:
+        s = r.sampling
+        want = np.asarray(generate(
+            model, params, r.prompt_ids[None], r.max_new,
+            temperature=s.temperature, top_k=s.top_k, top_p=s.top_p,
+            rng=jax.random.PRNGKey(s.seed)))[0]
+        np.testing.assert_array_equal(np.asarray(r.tokens, np.int32),
+                                      want)
+
+
+def test_moe_and_bf16_cache_serving():
+    """The engine rides the MoE decode path and a bf16 KV cache (the
+    serving config) — parity against generate() at the SAME cache
+    dtype."""
+    model = llama_moe_tiny()
+    params, _ = init_model(model, seed=0)
+    eng = ServeEngine(model, params, n_slots=2, max_len=48,
+                      cache_dtype=jnp.bfloat16)
+    reqs = synthetic_requests(3, vocab=64, prompt_lens=[4, 6],
+                              max_new=[5], seed=2)
+    for r in reqs:
+        eng.submit(r)
+    eng.run()
+    for r in reqs:
+        want = np.asarray(generate(model, params, r.prompt_ids[None],
+                                   r.max_new,
+                                   cache_dtype=jnp.bfloat16))[0]
+        np.testing.assert_array_equal(np.asarray(r.tokens, np.int32),
+                                      want)
+
+
+def test_eos_stops_early_and_recycles_slot(tiny_engine):
+    """An eos_id hit ends the request before max_new and frees the slot
+    (early eviction — the other slot-reuse trigger)."""
+    model, params, eng = tiny_engine
+    probe = Request(prompt_ids=np.asarray([5, 9, 2], np.int32), max_new=8)
+    eng.submit(probe)
+    eng.run()
+    eos = probe.tokens[2]  # third greedy token
+    r = Request(prompt_ids=np.asarray([5, 9, 2], np.int32), max_new=8,
+                eos_id=int(eos))
+    eng.submit(r)
+    eng.run()
+    assert r.state == DONE
+    assert len(r.tokens) == 3 and r.tokens[-1] == eos
+    assert eng.scheduler.allocator.active_slots == 0
+
+
+def test_retain_results_false_keeps_memory_bounded():
+    """The HTTP-server configuration: completed requests are NOT
+    accumulated on the engine (each response lives with its waiter), so
+    a long-running server — and, across a hot-swap, the old program set
+    pinned by served_by — can be garbage-collected."""
+    model = llama_tiny()
+    params, _ = init_model(model, seed=0)
+    eng = ServeEngine(model, params, n_slots=2, max_len=64,
+                      retain_results=False)
+    reqs = [eng.submit(r) for r in synthetic_requests(
+        3, vocab=64, prompt_lens=[4], max_new=[4], seed=9)]
+    summary = eng.run()
+    assert eng.results() == []
+    assert summary["requests_completed"] == 3
+    assert all(len(r.tokens) == 4 for r in reqs)  # waiters still served
+    assert summary["ttft_p50_ms"] is None  # read the obs histograms
+
+
+def test_summary_throughput_window_is_per_run():
+    """A warmup run must not dilute the next run's sustained tok/s:
+    summary()'s gen_tokens/wall cover the most recent run() only, while
+    request counts stay lifetime."""
+    model = llama_tiny()
+    params, _ = init_model(model, seed=0)
+    eng = ServeEngine(model, params, n_slots=2, max_len=64)
+    for r in synthetic_requests(2, vocab=64, prompt_lens=[4],
+                                max_new=[6], seed=10):
+        eng.submit(r)
+    eng.run()  # warmup window: 12 tokens
+    for r in synthetic_requests(1, vocab=64, prompt_lens=[4],
+                                max_new=[5], seed=11):
+        eng.submit(r)
+    summary = eng.run()
+    assert summary["gen_tokens"] == 5  # this window, not lifetime 17
+    assert summary["requests_completed"] == 3  # lifetime count
+    assert eng.gen_tokens == 17
+
+
+def test_submit_rejects_oversized_and_bad_sampling(tiny_engine):
+    _model, _params, eng = tiny_engine
+    with pytest.raises(ValueError, match="max_len"):
+        eng.submit(Request(prompt_ids=np.arange(4, dtype=np.int32),
+                           max_new=100))
+    with pytest.raises(ValueError, match="top_k"):
+        eng.submit(Request(prompt_ids=np.arange(4, dtype=np.int32),
+                           max_new=2, sampling=Sampling(top_k=0)))
+    with pytest.raises(ValueError, match="empty"):
+        Request(prompt_ids=np.asarray([], np.int32), max_new=2)
+
+
+# -- drain -------------------------------------------------------------------
+
+
+def test_preemption_drains_in_flight_and_snapshots_queue(tmp_path):
+    """Preemption mid-run: in-flight requests FINISH (never truncated),
+    queued + unsubmitted ones land in the atomic snapshot, and the
+    snapshot round-trips back into submittable requests."""
+    from torchpruner_tpu.resilience.guards import PreemptionHandler
+    from torchpruner_tpu.serve.engine import SNAPSHOT_FILENAME
+
+    model = llama_tiny()
+    params, _ = init_model(model, seed=0)
+    eng = ServeEngine(model, params, n_slots=2, max_len=96,
+                      run_dir=str(tmp_path))
+    reqs = synthetic_requests(6, vocab=64, prompt_lens=[4],
+                              max_new=[20], seed=5)
+    traffic = OpenLoopTraffic(reqs, staggered_arrivals(6, every_steps=1),
+                              by_step=True)
+    pre = PreemptionHandler()
+
+    class FireAt:
+        def __init__(self, inner):
+            self.inner = inner
+
+        @property
+        def exhausted(self):
+            return self.inner.exhausted
+
+        def drain(self):
+            return self.inner.drain()
+
+        def pump(self, engine):
+            n = self.inner.pump(engine)
+            if engine.steps == 6:
+                pre.request()  # the SIGTERM handler path, in-process
+            return n
+
+    summary = eng.run(FireAt(traffic), preemption=pre)
+    done = [r for r in reqs if r.state == DONE]
+    drained = [r for r in reqs if r.state == DRAINED]
+    assert len(done) >= 1 and len(drained) >= 1
+    assert len(done) + len(drained) == 6
+    for r in done:
+        assert len(r.tokens) == r.max_new  # finished, not truncated
+    snap = json.load(open(tmp_path / SNAPSHOT_FILENAME))
+    assert len(snap["requests"]) == len(drained)
+    assert summary["requests_drained"] == len(drained)
+    revived = [Request.from_snapshot(d) for d in snap["requests"]]
+    assert [r.max_new for r in revived] == [r.max_new for r in drained]
+    np.testing.assert_array_equal(revived[0].prompt_ids,
+                                  drained[0].prompt_ids)
+    # a submission racing the drain (e.g. an HTTP client after SIGTERM)
+    # bounces immediately instead of queueing into a loop that will
+    # never admit it
+    late = eng.submit(Request(prompt_ids=np.asarray([1, 2], np.int32),
+                              max_new=4))
+    assert late.state == DRAINED and late._event.is_set()
+
+
+# -- hot-swap ----------------------------------------------------------------
+
+
+def test_hot_swap_switches_at_boundary_after_drain(tmp_path):
+    """A staged pruned checkpoint compiles on a background thread (the
+    engine keeps serving meanwhile) and takes over only once in-flight
+    requests finish; requests stamped ``served_by`` the old programs
+    match the OLD weights' solo decode, later ones the NEW (pruned)
+    weights'."""
+    from torchpruner_tpu.checkpoint import save_checkpoint
+
+    model = llama_tiny()
+    params, _ = init_model(model, seed=0)
+    r = prune(model, params, "block1_ffn/gate", [0, 3, 17])
+    pm, pp = r.model, r.params
+    ck = os.path.join(tmp_path, "ckpt-pruned")
+    save_checkpoint(ck, pm, pp)
+
+    eng = ServeEngine(model, params, n_slots=2, max_len=64)
+    old_programs = eng.programs
+    reqs = synthetic_requests(6, vocab=64, prompt_lens=[4, 6],
+                              max_new=[5, 7], seed=3)
+
+    class SwapTraffic:
+        """3 requests up front (served by the old weights), swap staged
+        at step 2, the last 3 released only AFTER the swap lands."""
+
+        def __init__(self):
+            self.early, self.late = reqs[:3], list(reqs[3:])
+            self.fired = False
+
+        @property
+        def exhausted(self):
+            return not self.early and not self.late
+
+        def drain(self):
+            out = list(self.early) + list(self.late)
+            self.early, self.late = [], []
+            return out
+
+        def pump(self, engine):
+            n = 0
+            while self.early:
+                engine.submit(self.early.pop(0))
+                n += 1
+            if not self.fired and engine.steps >= 2:
+                engine.request_swap(ck)
+                self.fired = True
+            if self.fired and engine.swaps_total >= 1:
+                while self.late:
+                    engine.submit(self.late.pop(0))
+                    n += 1
+            return n
+
+    summary = eng.run(SwapTraffic())
+    assert summary["swaps"] == 1
+    assert summary["requests_completed"] == 6
+    assert eng.model.widths() == pm.widths()  # serving the pruned spec
+    for q in reqs:
+        served_new = q.served_by is not old_programs
+        m_, p_ = (pm, pp) if served_new else (model, params)
+        want = np.asarray(
+            generate(m_, p_, q.prompt_ids[None], q.max_new))[0]
+        np.testing.assert_array_equal(np.asarray(q.tokens, np.int32),
+                                      want)
+    assert sum(q.served_by is not old_programs for q in reqs) == 3
+
+
+def test_failed_swap_keeps_serving(tmp_path, capsys):
+    """A corrupt/missing swap checkpoint must be reported and dropped —
+    the engine keeps serving the current weights and still terminates."""
+    model = llama_tiny()
+    params, _ = init_model(model, seed=0)
+    eng = ServeEngine(model, params, n_slots=2, max_len=64)
+    reqs = synthetic_requests(3, vocab=64, prompt_lens=[4],
+                              max_new=[5], seed=6)
+
+    class BadSwap:
+        def __init__(self):
+            self.inner = OpenLoopTraffic(
+                reqs, staggered_arrivals(3, every_steps=1), by_step=True)
+            self.fired = False
+
+        @property
+        def exhausted(self):
+            return self.inner.exhausted
+
+        def drain(self):
+            return self.inner.drain()
+
+        def pump(self, engine):
+            n = self.inner.pump(engine)
+            if not self.fired and engine.steps >= 1:
+                engine.request_swap(str(tmp_path / "no-such-ckpt"))
+                self.fired = True
+            return n
+
+    summary = eng.run(BadSwap())
+    assert summary["swaps"] == 0
+    assert summary["requests_completed"] == 3
+    assert eng._pending_swap is None  # staging failure cleared
+    assert "hot-swap failed" in capsys.readouterr().err
+
+
+def test_by_step_schedule_survives_idle_gaps():
+    """A step-indexed arrival far beyond the previous request's
+    completion must still be served: the open-loop clock is the
+    engine's loop TICKS, which advance while the slot array idles
+    (engine.steps would freeze and stall the schedule forever)."""
+    model = llama_tiny()
+    params, _ = init_model(model, seed=0)
+    eng = ServeEngine(model, params, n_slots=2, max_len=64)
+    reqs = synthetic_requests(2, vocab=64, prompt_lens=[4],
+                              max_new=[4], seed=8)
+    traffic = OpenLoopTraffic(reqs, [0, 60], by_step=True)
+    summary = eng.run(traffic)
+    assert summary["requests_completed"] == 2
+    assert all(len(r.tokens) == 4 for r in reqs)
+
+
+def test_prefill_bucket_never_exceeds_slot_length():
+    """A prompt landing in the top (unaligned) bucket of a non-ladder
+    max_len must prefill and insert cleanly — the last bucket is capped
+    at max_len, never rounded past the physical cache."""
+    model = llama_tiny()
+    params, _ = init_model(model, seed=0)
+    eng = ServeEngine(model, params, n_slots=2, max_len=100)
+    prompt = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(9), (97,), 0, 64), np.int32)
+    req = eng.submit(Request(prompt_ids=prompt, max_new=3))
+    eng.run()
+    want = np.asarray(generate(model, params, prompt[None], 3))[0]
+    np.testing.assert_array_equal(np.asarray(req.tokens, np.int32), want)
+
+
+# -- obs / report ------------------------------------------------------------
+
+
+def test_serve_obs_histograms_and_report(tmp_path):
+    """A served run under an obs session must emit non-empty TTFT and
+    per-token histograms, serve counters/gauges, a ledger provenance
+    record, and an `obs report` rendering with the serve section."""
+    from torchpruner_tpu.obs.report import format_report, load_run
+
+    obs_dir = str(tmp_path / "obs")
+    session = obs.configure(obs_dir)
+    try:
+        model = llama_tiny()
+        params, _ = init_model(model, seed=0)
+        eng = ServeEngine(model, params, n_slots=2, max_len=64,
+                          checkpoint_meta={"digest": "feedbeef"})
+        reqs = synthetic_requests(5, vocab=64, prompt_lens=[4, 6],
+                                  max_new=[4, 6], seed=4)
+        traffic = OpenLoopTraffic(reqs,
+                                  staggered_arrivals(5, every_steps=2),
+                                  by_step=True)
+        with obs.span("serve"):
+            eng.run(traffic)
+        ttft = session.metrics.get("serve_ttft_seconds")
+        gaps = session.metrics.get("serve_token_seconds")
+        assert ttft is not None and ttft.count == 5
+        assert gaps is not None and gaps.count > 0
+        assert obs.counter_value("serve_completed_total") == 5
+        assert obs.counter_value("serve_admits_total") == 5
+        assert obs.counter_value("serve_evictions_total") == 5
+        assert obs.counter_value("serve_decode_steps_total") > 0
+    finally:
+        obs.shutdown()
+    report = load_run(obs_dir)
+    serve_records = report.get("serve") or []
+    assert any(r.get("kind") == "summary"
+               and r.get("checkpoint_digest") == "feedbeef"
+               for r in serve_records)
+    md = format_report(report)
+    assert "serve:" in md and "TTFT p50/p99" in md
+    m = report["metrics"]
+    assert m.get("serve_ttft_seconds_p50") is not None
+    assert m.get("serve_token_seconds_p99") is not None
+
+
+def test_serve_scalars_diff_and_gates():
+    """serve_* scalars participate in `obs diff` and gate checking —
+    what wires the serve CI smoke into `obs diff --gate`."""
+    from torchpruner_tpu.obs.report import check_gates, diff_runs
+
+    def rep(ttft, tok, completed):
+        return {"metrics": {
+            "serve_ttft_seconds_p50": ttft,
+            "serve_ttft_seconds_p99": ttft * 2,
+            "serve_token_seconds_p50": tok,
+            "serve_token_seconds_p99": tok * 3,
+            "serve_gen_tokens_per_s": 100.0,
+            "serve_completed_total": completed,
+        }}
+
+    d = diff_runs(rep(0.01, 0.001, 16), rep(0.05, 0.001, 14))
+    assert d["scalars"]["serve_ttft_p50_s"]["pct"] == pytest.approx(400.0)
+    gates = {"serve_ttft_p50_s": {"max_increase_pct": 300},
+             "serve_completed": {"max_decrease": 0}}
+    violations = check_gates(d, gates)
+    assert {v["gate"] for v in violations} == {"serve_ttft_p50_s",
+                                              "serve_completed"}
+    assert not check_gates(diff_runs(rep(0.01, 0.001, 16),
+                                     rep(0.01, 0.001, 16)), gates)
+
+
+# -- front ends --------------------------------------------------------------
+
+
+def test_http_endpoint_roundtrip():
+    """POST /v1/generate through the threaded HTTP front end returns the
+    engine's tokens; /healthz and /stats respond."""
+    import urllib.request
+
+    from torchpruner_tpu.serve.frontend import _http_server
+
+    model = llama_tiny()
+    params, _ = init_model(model, seed=0)
+    eng = ServeEngine(model, params, n_slots=2, max_len=64)
+    server = _http_server(eng, 0, request_timeout_s=120.0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    stop = threading.Event()
+    loop = threading.Thread(
+        target=lambda: eng.run(stop_event=stop), daemon=True)
+    loop.start()
+    try:
+        body = json.dumps({"prompt_ids": [5, 9, 2, 14],
+                           "max_new": 6}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        out = json.load(urllib.request.urlopen(req, timeout=120))
+        assert out["state"] == "done" and len(out["tokens"]) == 6
+        want = np.asarray(generate(
+            model, params, np.asarray([[5, 9, 2, 14]], np.int32), 6))[0]
+        np.testing.assert_array_equal(np.asarray(out["tokens"]), want)
+        health = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10))
+        assert health["ok"]
+        stats = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats", timeout=10))
+        assert stats["gen_tokens"] >= 6
+    finally:
+        stop.set()
+        server.shutdown()
+        loop.join(timeout=30)
+
+
+def test_poisson_arrivals_seeded_and_monotone():
+    a = poisson_arrivals(50, rate_per_s=10.0, seed=3)
+    b = poisson_arrivals(50, rate_per_s=10.0, seed=3)
+    assert a == b and all(x < y for x, y in zip(a, a[1:]))
+    mean_gap = a[-1] / 50
+    assert 0.03 < mean_gap < 0.3  # ~1/rate
+
+
+def test_example_06_imports():
+    """The serving example stays import-smoke-tested (its heavy work is
+    inside main(), so import is cheap)."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "06_serve_8b_on_one_chip.py")
+    spec = importlib.util.spec_from_file_location("example_06_serve",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert callable(mod.main)

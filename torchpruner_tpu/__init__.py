@@ -48,6 +48,7 @@ from torchpruner_tpu.generate import (
     generate,
     init_cache,
     make_decode_step,
+    make_slot_decode_step,
 )
 from torchpruner_tpu.ops.quant import (
     QTensor,
@@ -92,6 +93,7 @@ __all__ = [
     "generate",
     "init_cache",
     "make_decode_step",
+    "make_slot_decode_step",
     "QTensor",
     "quantize_params",
     "dequantize_params",

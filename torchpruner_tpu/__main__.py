@@ -11,6 +11,8 @@ Examples::
     python -m torchpruner_tpu --list
     python -m torchpruner_tpu --lint llama3_ffn_taylor
     python -m torchpruner_tpu --lint my_experiment.json --lint-plan plan.json
+    python -m torchpruner_tpu serve llama3_ffn_taylor --smoke --synthetic 16
+    python -m torchpruner_tpu obs report logs/obs
 """
 
 from __future__ import annotations
@@ -29,10 +31,18 @@ def main(argv=None) -> int:
         from torchpruner_tpu.obs.report import obs_main
 
         return obs_main(argv[1:])
+    if argv and argv[0] == "serve":
+        # continuous-batching inference engine on the pruned decode path
+        # (serve.frontend): `python -m torchpruner_tpu serve <preset>
+        # [--synthetic N | --http PORT | --stdin] ...`
+        from torchpruner_tpu.serve.frontend import serve_main
+
+        return serve_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="torchpruner_tpu",
         description="TPU-native structured pruning experiments "
-                    "(subcommand: obs report/diff — run-ledger tooling)",
+                    "(subcommands: obs report/diff — run-ledger tooling; "
+                    "serve — continuous-batching inference engine)",
     )
     p.add_argument("--preset", help="named preset (see --list)")
     p.add_argument("--config", help="path to an ExperimentConfig JSON")

@@ -786,14 +786,24 @@ def apply_seq(
 def _rope(x, theta: float, offset=0):
     """Rotary position embedding on ``(B, S, H, Dh)`` (Su et al., 2021).
     ``offset`` shifts the absolute positions — the KV-cache decode path
-    (generate.py) embeds a length-1 sequence at position ``pos``."""
+    (generate.py) embeds a length-1 sequence at position ``pos``.  A
+    rank-1 ``offset`` of shape ``(B,)`` gives every sequence its OWN
+    shift — the continuous-batching slot array, where each slot sits at
+    a different decode position."""
     S, Dh = x.shape[1], x.shape[-1]
     half = Dh // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    pos = offset + jnp.arange(S, dtype=jnp.float32)
-    ang = pos[:, None] * freqs[None, :]  # (S, half)
-    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
-    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    if jnp.ndim(offset) > 0:  # per-slot offsets: (B,) -> (B, S, half)
+        pos = (jnp.asarray(offset, jnp.float32)[:, None]
+               + jnp.arange(S, dtype=jnp.float32)[None, :])
+        ang = pos[..., None] * freqs  # (B, S, half)
+        cos = jnp.cos(ang)[:, :, None, :].astype(x.dtype)
+        sin = jnp.sin(ang)[:, :, None, :].astype(x.dtype)
+    else:
+        pos = offset + jnp.arange(S, dtype=jnp.float32)
+        ang = pos[:, None] * freqs[None, :]  # (S, half)
+        cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
+        sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 

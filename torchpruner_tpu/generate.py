@@ -78,9 +78,13 @@ def _decode_attention(spec, params, entry, x, pos):
 
     ``x``: (B, s, d) — s = 1 for decode steps, s = prompt length for the
     one-shot prefill; ``entry``: this layer's {"k", "v"} cache buffers;
-    ``pos``: scalar absolute position of the block's FIRST token.  The
-    block's K/V are written at ``pos..pos+s-1`` and attention is causal
-    within the block.  Returns (y, entry').
+    ``pos``: absolute position of the block's FIRST token — a scalar
+    (every sequence at the same position: the static-batch path), or a
+    ``(B,)`` vector giving every batch row its OWN position (the
+    continuous-batching slot array, where concurrently-served requests
+    sit at different decode depths).  The block's K/V are written at
+    ``pos..pos+s-1`` (per row, for the vector form) and attention is
+    causal within the block.  Returns (y, entry').
     """
     # qdot: leading-axis contraction — int4 q/k/v projections ride the
     # fused-unpack kernel (their (d, H, Dh) weights flatten to the
@@ -99,12 +103,22 @@ def _decode_attention(spec, params, entry, x, pos):
         idx = jnp.asarray(spec.head_kv_index())
         k = jnp.take(k, idx, axis=2)
         v = jnp.take(v, idx, axis=2)
-    k_cache = lax.dynamic_update_slice(
-        entry["k"], k.astype(entry["k"].dtype), (0, pos, 0, 0)
-    )
-    v_cache = lax.dynamic_update_slice(
-        entry["v"], v.astype(entry["v"].dtype), (0, pos, 0, 0)
-    )
+    ragged = jnp.ndim(pos) > 0  # per-slot positions (static branch)
+    if ragged:
+        # each row writes its block at its OWN position: vmap the
+        # per-sequence (max_len, H, Dh) update over the slot axis
+        write = jax.vmap(
+            lambda buf, blk, p: lax.dynamic_update_slice(buf, blk, (p, 0, 0))
+        )
+        k_cache = write(entry["k"], k.astype(entry["k"].dtype), pos)
+        v_cache = write(entry["v"], v.astype(entry["v"].dtype), pos)
+    else:
+        k_cache = lax.dynamic_update_slice(
+            entry["k"], k.astype(entry["k"].dtype), (0, pos, 0, 0)
+        )
+        v_cache = lax.dynamic_update_slice(
+            entry["v"], v.astype(entry["v"].dtype), (0, pos, 0, 0)
+        )
     # scores against the whole static buffer; mask the unwritten future
     # (causal per query position within the block)
     scale = 1.0 / np.sqrt(spec.head_dim)
@@ -112,10 +126,13 @@ def _decode_attention(spec, params, entry, x, pos):
         "bqhk,bthk->bhqt", q, k_cache, preferred_element_type=jnp.float32
     ) * scale  # (B, H, s, max_len)
     t = jnp.arange(k_cache.shape[1])
-    q_pos = pos + jnp.arange(q.shape[1])
-    s = jnp.where(
-        (t[None, :] <= q_pos[:, None])[None, None, :, :], s, _NEG_INF
-    )
+    if ragged:
+        q_pos = pos[:, None] + jnp.arange(q.shape[1])[None, :]  # (B, s)
+        mask = (t[None, None, :] <= q_pos[:, :, None])[:, None]  # (B,1,s,T)
+    else:
+        q_pos = pos + jnp.arange(q.shape[1])
+        mask = (t[None, :] <= q_pos[:, None])[None, None]
+    s = jnp.where(mask, s, _NEG_INF)
     w = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
     ctx = jnp.einsum("bhqt,bthk->bqhk", w, v_cache)
     y = oscale(jnp.einsum("bshk,hkd->bsd", ctx,
@@ -144,9 +161,15 @@ def _decode_seq(layers, params, cache, x, pos, prefix=()):
                 sc = x
             x = y + sc
         elif isinstance(spec, L.PosEmbed):
-            x = x + jnp.take(
-                p["emb"], pos + jnp.arange(x.shape[1]), axis=0
-            )[None]
+            if jnp.ndim(pos) > 0:  # per-slot positions: (B, s) gather
+                x = x + jnp.take(
+                    p["emb"],
+                    pos[:, None] + jnp.arange(x.shape[1])[None, :], axis=0,
+                )
+            else:
+                x = x + jnp.take(
+                    p["emb"], pos + jnp.arange(x.shape[1]), axis=0
+                )[None]
         elif isinstance(spec, L.BatchNorm):
             raise NotImplementedError(
                 "BatchNorm in decode mode (LM families use LayerNorm/RMSNorm)"
@@ -163,6 +186,26 @@ def _decode_seq(layers, params, cache, x, pos, prefix=()):
 def make_decode_step(model: SegmentedModel):
     """jit: ``(params, cache, tok (B, 1) int32, pos scalar) ->
     (logits (B, vocab), cache')`` — the single-token decode step."""
+
+    @jax.jit
+    def step(params, cache, tok, pos):
+        x, cache = _decode_seq(model.layers, params, cache, tok, pos)
+        return x[:, 0], cache
+
+    return step
+
+
+def make_slot_decode_step(model: SegmentedModel):
+    """jit: ``(params, cache, tok (B, 1) int32, pos (B,) int32) ->
+    (logits (B, vocab), cache')`` — the CONTINUOUS-BATCHING decode step:
+    one compiled program advances every slot one token at its own
+    absolute position (admitted/evicted requests sit at different
+    depths).  The per-slot correctness contract — each row's logits are
+    bit-identical to decoding that sequence alone — is what
+    tests/test_generate.py's ragged parity tests pin: attention reads
+    only positions ``<= pos[b]`` of row ``b``'s cache, so neighbouring
+    slots (and stale K/V left by a previous occupant of a recycled
+    slot) can never leak into a row's result."""
 
     @jax.jit
     def step(params, cache, tok, pos):

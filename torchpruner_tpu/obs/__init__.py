@@ -70,7 +70,8 @@ __all__ = [
     "configure_step_flops", "record_capture", "capture_counts",
     "inc", "observe", "gauge_set", "counter_value",
     "record_scores", "record_prune", "record_round", "record_epoch",
-    "record_sweep_layer", "ledger_backfill", "annotate_run",
+    "record_sweep_layer", "record_serve", "ledger_backfill",
+    "annotate_run",
     "MetricsRegistry", "StepTelemetry",
     "SpanTracer", "SpanRecord", "train_flops_per_step",
     "ProvenanceRecorder", "score_distribution",
@@ -460,6 +461,16 @@ def record_sweep_layer(*, layer: str, **fields) -> None:
     s = _session
     if s is not None and s.ledger is not None:
         s.ledger.record_sweep_layer(layer=layer, **fields)
+
+
+def record_serve(*, kind: str, **fields) -> None:
+    """Ledger one serving-engine event (``kind`` = "summary" |
+    "hot_swap" | ...): ties served traffic back to the checkpoint's
+    prune provenance (digests, widths) next to the run's latency
+    metrics.  Informational records — never deduped."""
+    s = _session
+    if s is not None and s.ledger is not None:
+        s.ledger.record({"event": "serve", "kind": kind, **fields})
 
 
 def ledger_backfill(records, kind: str = "round") -> int:

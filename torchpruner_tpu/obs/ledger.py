@@ -314,6 +314,7 @@ def build_report(*, run_meta: Optional[Dict[str, Any]] = None,
         "sweep_layers": picked("sweep_layer"),
         "scores": picked("scores"),
         "prunes": picked("prune"),
+        "serve": picked("serve"),
         "derived": dict(derived or {}),
         "phases": dict(phases or {}),
         "compiles": dict(compiles or {}),

@@ -61,6 +61,14 @@ _SCALARS = {
     "compile_count": "lower",
     "steps": "same",
     "wall_s": "lower",
+    # serving-engine latency/throughput (serve/ runs; absent elsewhere,
+    # and gates on absent metrics skip unless they set "require")
+    "serve_ttft_p50_s": "lower",
+    "serve_ttft_p99_s": "lower",
+    "serve_token_p50_s": "lower",
+    "serve_token_p99_s": "lower",
+    "serve_tokens_per_s": "higher",
+    "serve_completed": "same",
 }
 
 
@@ -146,6 +154,7 @@ def _dedupe_last(records):
 def _scalars_of(report: Dict[str, Any]) -> Dict[str, Optional[float]]:
     derived = report.get("derived") or {}
     compiles = report.get("compiles") or {}
+    metrics = report.get("metrics") or {}
     return {
         "step_time_mean_s": derived.get("step_time_mean_s"),
         "step_time_p50_s": derived.get("step_time_p50_s"),
@@ -155,6 +164,14 @@ def _scalars_of(report: Dict[str, Any]) -> Dict[str, Optional[float]]:
         "compile_count": compiles.get("compile_count"),
         "steps": derived.get("steps"),
         "wall_s": report.get("wall_s"),
+        # serving histograms land in the metric snapshot as bucket-
+        # estimated percentiles (metrics.Histogram.percentiles)
+        "serve_ttft_p50_s": metrics.get("serve_ttft_seconds_p50"),
+        "serve_ttft_p99_s": metrics.get("serve_ttft_seconds_p99"),
+        "serve_token_p50_s": metrics.get("serve_token_seconds_p50"),
+        "serve_token_p99_s": metrics.get("serve_token_seconds_p99"),
+        "serve_tokens_per_s": metrics.get("serve_gen_tokens_per_s"),
+        "serve_completed": metrics.get("serve_completed_total"),
     }
 
 
@@ -232,6 +249,42 @@ def format_report(report: Dict[str, Any]) -> str:
             f"loss {_f(last.get('test_loss'))})")
         lines.append("")
 
+    serve = report.get("serve") or []
+    sc_serve = {k: v for k, v in sc.items()
+                if k.startswith("serve_") and v is not None}
+    if serve or sc_serve:
+        bits = []
+        if sc.get("serve_completed") is not None:
+            bits.append(f"requests {int(sc['serve_completed'])}")
+        if sc.get("serve_tokens_per_s") is not None:
+            bits.append(f"{sc['serve_tokens_per_s']:.1f} gen tok/s")
+        if sc.get("serve_ttft_p50_s") is not None:
+            bits.append(
+                f"TTFT p50/p99 {1e3 * sc['serve_ttft_p50_s']:.2f}/"
+                f"{1e3 * (sc.get('serve_ttft_p99_s') or 0):.2f} ms")
+        if sc.get("serve_token_p50_s") is not None:
+            bits.append(
+                f"per-token p50/p99 {1e3 * sc['serve_token_p50_s']:.2f}/"
+                f"{1e3 * (sc.get('serve_token_p99_s') or 0):.2f} ms")
+        lines.append("serve: " + (", ".join(bits) if bits
+                                  else "(no latency metrics)"))
+        swaps = [r for r in serve if r.get("kind") == "hot_swap"]
+        for r in swaps:
+            lines.append(
+                f"- hot-swap at step {_i(r.get('at_step'))}: "
+                f"{r.get('checkpoint') or ''} "
+                f"(digest {str(r.get('new_digest') or '')[:12]})")
+        summaries = [r for r in serve if r.get("kind") == "summary"]
+        if summaries:
+            s = summaries[-1]
+            lines.append(
+                f"- admits {_i(s.get('admits'))}, evictions "
+                f"{_i(s.get('evictions'))}, drained "
+                f"{_i(s.get('requests_drained'))}, swaps "
+                f"{_i(s.get('swaps'))}, checkpoint digest "
+                f"{str(s.get('checkpoint_digest') or '')[:12]}")
+        lines.append("")
+
     sweeps = report.get("sweep_layers") or []
     if sweeps:
         lines.append("| sweep layer | methods | best method | best auc |")
@@ -247,7 +300,8 @@ def format_report(report: Dict[str, Any]) -> str:
                 f"| {best[0] if best else ''} "
                 f"| {_f(best[1].get('auc_mean')) if best else ''} |")
         lines.append("")
-    if not rounds and not epochs and not sweeps:
+    if not rounds and not epochs and not sweeps and not serve \
+            and not sc_serve:
         lines.append("(no ledger records)")
     return "\n".join(lines)
 

@@ -1,0 +1,50 @@
+"""``torchpruner_tpu.serve`` — continuous-batching inference on the
+pruned decode path.
+
+The runtime that turns a pruned checkpoint into sustained tokens/s and
+tail latency instead of smaller params/FLOPs counters (ROADMAP item 1):
+
+- :class:`~torchpruner_tpu.serve.request.Request` /
+  :class:`~torchpruner_tpu.serve.request.Sampling` — one generation job
+  with per-request sampling.
+- :class:`~torchpruner_tpu.serve.allocator.KVCacheAllocator` —
+  lane-aligned bucketed slot/page bookkeeping over the static serving
+  cache (recycling without retrace).
+- :class:`~torchpruner_tpu.serve.scheduler.Scheduler` — FIFO admission
+  / eviction at decode-step boundaries.
+- :class:`~torchpruner_tpu.serve.engine.ServeEngine` — the engine:
+  bucketed prefill → shared slot-array decode (one compiled step for a
+  ragged request mix), checkpoint hot-swap, SIGTERM drain.
+- :mod:`~torchpruner_tpu.serve.traffic` — open-loop Poisson /
+  step-staggered synthetic workloads (bench ``serve`` leg, CI smoke).
+- ``python -m torchpruner_tpu serve <preset>`` — the endpoint
+  (:mod:`~torchpruner_tpu.serve.frontend`): HTTP, stdin, or synthetic
+  traffic modes, obs-instrumented end to end.
+"""
+
+from torchpruner_tpu.serve.allocator import (
+    KVCacheAllocator,
+    aligned_len,
+    bucket_for,
+    prefill_buckets,
+)
+from torchpruner_tpu.serve.engine import (
+    ServeEngine,
+    sample_tokens,
+    vocab_of,
+)
+from torchpruner_tpu.serve.request import Request, Sampling
+from torchpruner_tpu.serve.scheduler import Scheduler
+from torchpruner_tpu.serve.traffic import (
+    OpenLoopTraffic,
+    poisson_arrivals,
+    staggered_arrivals,
+    synthetic_requests,
+)
+
+__all__ = [
+    "Request", "Sampling", "KVCacheAllocator", "Scheduler", "ServeEngine",
+    "OpenLoopTraffic", "poisson_arrivals", "staggered_arrivals",
+    "synthetic_requests", "aligned_len", "bucket_for", "prefill_buckets",
+    "sample_tokens", "vocab_of",
+]

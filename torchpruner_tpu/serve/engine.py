@@ -1,0 +1,617 @@
+"""The continuous-batching inference engine on the pruned decode path.
+
+One :class:`ServeEngine` owns a fixed ``n_slots``-wide decode slot
+array and THREE compiled programs (prefill/decode disaggregation):
+
+- **decode** — ONE jitted step advances every slot one token at its own
+  per-slot position (``generate.make_slot_decode_step`` semantics plus
+  fused per-slot sampling): admissions and evictions at step boundaries
+  only change host-side slot tables, never the executable, so a ragged
+  ever-changing mix of requests rides a single XLA program.
+- **prefill** — per lane-aligned prompt bucket (allocator ladder), a
+  jitted whole-prompt forward fills a length-``bucket`` B=1 cache, takes
+  the last REAL position's logits, and samples the first token.  End
+  padding needs no masking: padded positions only write K/V at
+  ``t >= true_len``, and decode overwrites position ``t`` before it
+  first becomes attendable.
+- **insert** — the hand-off: the bucket-length prefill cache is written
+  into the slot's rows of the big ``(n_slots, max_len, ...)`` serving
+  cache with one ``dynamic_update_slice`` per buffer (no retrace, no
+  host copy of the cache).
+
+Decode shapes ride the pruned model spec exactly like ``generate``:
+pruning FFN channels / heads / experts shrinks the compiled programs and
+the KV buffers with no serving-specific surgery — the runtime exploits
+pruned structure, which is the whole point (PAPERS.md, "Structured Model
+Pruning ... on TPUs").
+
+**Hot-swap**: ``request_swap(ckpt_dir)`` stages a digest-verified
+checkpoint (resilience-layer restore) on a BACKGROUND thread —
+restore + compile + warm never block the engine loop, so in-flight
+requests keep decoding at full cadence while the new programs build
+(the "compiled off the serving path" contract; the span tracer's
+per-thread stack keeps the ``serve_swap_compile`` span clean).  Once
+staged, admissions drain, in-flight requests finish on the old weights
+(their KV holds old-weight K/V — mixing checkpoints mid-sequence would
+corrupt them), and traffic switches at the first empty-slot-array step
+boundary.  The swap is ledgered with both checkpoints' digests.
+
+**Drain** (SIGTERM): the engine polls the resilience layer's
+:class:`~torchpruner_tpu.resilience.guards.PreemptionHandler` at step
+boundaries — preemption stops admissions, finishes in-flight requests,
+snapshots the still-queued ones to ``serve_queue_snapshot.json``
+(atomic), and returns cleanly.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from torchpruner_tpu import obs
+from torchpruner_tpu.serve.allocator import (
+    KVCacheAllocator,
+    bucket_for,
+    prefill_buckets,
+)
+from torchpruner_tpu.serve.request import DONE, DRAINED, Request
+from torchpruner_tpu.serve.scheduler import Scheduler
+
+SNAPSHOT_FILENAME = "serve_queue_snapshot.json"
+
+
+def vocab_of(model) -> int:
+    """The model's token-id space (its Embedding layer's vocab) — what
+    synthetic traffic draws prompt ids from."""
+    from torchpruner_tpu.core import layers as L
+
+    for spec in model.layers:
+        if isinstance(spec, L.Embedding):
+            return int(spec.vocab_size)
+    return 256
+
+
+def sample_tokens(logits, keys, temp, top_k, top_p):
+    """Vectorized per-slot sampling: greedy (exact argmax — the
+    bit-parity contract) where ``temp == 0``, else seeded softmax
+    sampling at ``temp`` truncated per slot to ``top_k`` (``<= 0``
+    disables) and the ``top_p`` nucleus (``>= 1`` disables).  Matches
+    :func:`torchpruner_tpu.generate._truncate_logits` semantics
+    (temperature first, same kth/nucleus thresholds) so a request
+    replayed through ``generate`` with the same seed emits the same
+    tokens."""
+    import jax
+    import jax.numpy as jnp
+
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.where(temp > 0, temp, 1.0)[:, None]
+    neg = jnp.finfo(logits.dtype).min
+    # top-k FIRST, nucleus on the top-k-truncated distribution — the
+    # exact order _truncate_logits applies (the nucleus mass must be
+    # measured over the distribution actually sampled from)
+    k = jnp.where(top_k > 0, top_k, V)
+    kth = jnp.take_along_axis(
+        jnp.sort(scaled, axis=-1)[..., ::-1],
+        jnp.clip(k - 1, 0, V - 1)[:, None], axis=-1)
+    masked = jnp.where(scaled >= kth, scaled, neg)
+    sorted_ = jnp.sort(masked, axis=-1)[..., ::-1]  # descending
+    probs = jax.nn.softmax(sorted_, axis=-1)
+    csum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (csum - probs) < top_p[:, None]
+    thresh = jnp.min(jnp.where(keep_sorted, sorted_, jnp.inf), axis=-1,
+                     keepdims=True)
+    # a disabled nucleus (p >= 1) must keep EVERYTHING top-k kept,
+    # including prob-underflow tails the threshold math could clip
+    trunc = jnp.where((masked >= thresh) | (top_p[:, None] >= 1.0),
+                      masked, neg)
+    sampled = jax.vmap(jax.random.categorical)(keys, trunc)
+    return jnp.where(temp > 0, sampled.astype(jnp.int32), greedy)
+
+
+def make_serve_step(model):
+    """jit: one continuous-batching decode step with fused sampling —
+    ``(params, cache, tok (B,), pos (B,), rngs (B,2), temp (B,),
+    top_k (B,), top_p (B,)) -> (next_tok (B,), rngs', cache')``."""
+    import jax
+
+    from torchpruner_tpu.generate import _decode_seq
+
+    @jax.jit
+    def step(params, cache, tok, pos, rngs, temp, top_k, top_p):
+        x, cache = _decode_seq(model.layers, params, cache, tok[:, None],
+                               pos)
+        logits = x[:, 0]
+        split = jax.vmap(jax.random.split)(rngs)  # (B, 2, 2)
+        carry, sub = split[:, 0], split[:, 1]
+        nxt = sample_tokens(logits, sub, temp, top_k, top_p)
+        return nxt, carry, cache
+
+    return step
+
+
+def make_prefill(model, bucket: int, cache_dtype):
+    """jit: bucketed-length prefill — ``(params, prompt (1, bucket),
+    true_len, rng (2,), temp, top_k, top_p) -> (first_tok, rng',
+    bucket_cache)``.  One compiled program per (model spec, bucket)."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchpruner_tpu.generate import _decode_seq, init_cache
+
+    @jax.jit
+    def prefill(params, prompt, true_len, rng, temp, top_k, top_p):
+        cache = init_cache(model, 1, bucket, cache_dtype)
+        x, cache = _decode_seq(model.layers, params, cache, prompt, 0)
+        logits = jnp.take(x[0], true_len - 1, axis=0)  # last REAL position
+        carry, sub = jax.random.split(rng)
+        tok = sample_tokens(logits[None], sub[None], temp[None],
+                            top_k[None], top_p[None])[0]
+        return tok, carry, cache
+
+    return prefill
+
+
+def make_insert():
+    """jit: write a bucket-length B=1 prefill cache into one slot's rows
+    of the big serving cache (the prefill→decode hand-off)."""
+    import jax
+    from jax import lax
+
+    @jax.jit
+    def insert(big, small, slot):
+        def upd(b, s):
+            return lax.dynamic_update_slice(
+                b, s.astype(b.dtype), (slot, 0, 0, 0))
+
+        return jax.tree_util.tree_map(upd, big, small)
+
+    return insert
+
+
+class _Programs:
+    """One checkpoint's compiled surface: model + params + serving cache
+    + the three program families.  Swappable as a unit — hot-swap builds
+    a fresh ``_Programs`` and warms it before any traffic touches it."""
+
+    def __init__(self, model, params, *, n_slots: int, max_len: int,
+                 cache_dtype, meta: Optional[dict] = None):
+        import jax.numpy as jnp
+
+        from torchpruner_tpu.generate import init_cache
+
+        self.model, self.params, self.meta = model, params, dict(meta or {})
+        self.n_slots, self.max_len = n_slots, max_len
+        self.cache_dtype = cache_dtype
+        self.cache = init_cache(model, n_slots, max_len, cache_dtype)
+        self.decode = make_serve_step(model)
+        self.insert = make_insert()
+        self.buckets = prefill_buckets(max_len)
+        self._prefills: Dict[int, Any] = {}
+        self._jnp = jnp
+
+    def prefill_for(self, bucket: int):
+        fn = self._prefills.get(bucket)
+        if fn is None:
+            fn = self._prefills[bucket] = make_prefill(
+                self.model, bucket, self.cache_dtype)
+        return fn
+
+    def warm(self, buckets: Optional[List[int]] = None) -> None:
+        """Compile the decode step, the insert, and the given prefill
+        buckets on dummy data — the hot-swap contract: every program a
+        request can hit is compiled BEFORE traffic switches."""
+        import jax
+        import jax.numpy as jnp
+
+        B = self.n_slots
+        zero = jnp.zeros((), jnp.float32)
+        key = jax.random.PRNGKey(0)
+        tok, rngs, cache = self.decode(
+            self.params, self.cache, jnp.zeros((B,), jnp.int32),
+            jnp.zeros((B,), jnp.int32), jnp.stack([key] * B),
+            jnp.zeros((B,), jnp.float32), jnp.zeros((B,), jnp.int32),
+            jnp.ones((B,), jnp.float32))
+        jax.block_until_ready(tok)
+        for b in (buckets if buckets is not None else self.buckets[:1]):
+            fn = self.prefill_for(b)
+            t, _, small = fn(self.params, jnp.zeros((1, b), jnp.int32),
+                             jnp.asarray(1), key, zero,
+                             jnp.asarray(0, jnp.int32),
+                             jnp.asarray(1.0, jnp.float32))
+            jax.block_until_ready(
+                self.insert(cache, small, jnp.asarray(0, jnp.int32)))
+
+
+class ServeEngine:
+    """Continuous-batching serving over one model/params bundle (see
+    module docstring).  Construction compiles nothing; the first
+    admission/step does (or call ``programs.warm()`` up front)."""
+
+    def __init__(self, model, params, *, n_slots: int = 4,
+                 max_len: int = 256, cache_dtype=None, page_len: int = 0,
+                 page_budget: int = 0, run_dir: Optional[str] = None,
+                 checkpoint_meta: Optional[dict] = None,
+                 retain_results: bool = True):
+        """``retain_results=False`` (the long-running HTTP server) stops
+        the engine from accumulating completed Request objects — each
+        request (and, across a hot-swap, the old checkpoint's program
+        set its ``served_by`` pins) is released as soon as its waiter
+        collects it, so memory stays bounded by in-flight work.  Batch
+        front ends (synthetic/stdin) keep the default: they need the
+        full result list for verification and percentile reporting."""
+        import jax
+        import jax.numpy as jnp
+
+        if getattr(model, "input_dtype", None) != "int32":
+            raise ValueError(
+                "ServeEngine serves token-sequence (LM) models; "
+                f"got input_dtype={getattr(model, 'input_dtype', None)!r}")
+        cache_dtype = jnp.float32 if cache_dtype is None else cache_dtype
+        self.programs = _Programs(
+            model, params, n_slots=n_slots, max_len=max_len,
+            cache_dtype=cache_dtype, meta=checkpoint_meta)
+        self.scheduler = Scheduler(
+            KVCacheAllocator(n_slots, max_len, page_len=page_len,
+                             page_budget=page_budget))
+        self.run_dir = run_dir
+        self.n_slots, self.max_len = n_slots, max_len
+        # host slot tables (the continuous-batching state the compiled
+        # step is parameterized by)
+        self._pos = np.zeros(n_slots, np.int32)
+        self._tok = np.zeros(n_slots, np.int32)
+        self._temp = np.zeros(n_slots, np.float32)
+        self._topk = np.zeros(n_slots, np.int32)
+        self._topp = np.ones(n_slots, np.float32)
+        self._last_token_s = np.zeros(n_slots, np.float64)
+        self._rngs = jnp.stack([jax.random.PRNGKey(0)] * n_slots)
+        self.steps = 0
+        #: loop-iteration clock — unlike ``steps`` it advances even when
+        #: the slot array is idle, so a step-indexed open-loop schedule
+        #: can never stall waiting for a decode that will never happen
+        self.ticks = 0
+        self.gen_tokens = 0
+        self._t_first: Optional[float] = None
+        self._t_last: Optional[float] = None
+        self._pending_swap: Optional[str] = None
+        self._staged: Optional[_Programs] = None
+        self._swap_error: Optional[BaseException] = None
+        self._swap_thread: Optional[threading.Thread] = None
+        self.swaps_total = 0
+        self.drained: List[Request] = []
+        self.retain_results = retain_results
+        self.completed_count = 0
+        self._results: List[Request] = []
+        #: gen-token count at the start of the current run() window —
+        #: summary()'s throughput covers the LAST run, not the engine's
+        #: lifetime (a warmup pass must not dilute the measured phase)
+        self._window_tokens0 = 0
+        self._eos = np.full(n_slots, -1, np.int64)
+
+    # -- submission ---------------------------------------------------------
+
+    @property
+    def model(self):
+        return self.programs.model
+
+    @property
+    def params(self):
+        return self.programs.params
+
+    def submit(self, request: Request,
+               arrival_s: Optional[float] = None) -> Request:
+        if request.total_len > self.max_len:
+            raise ValueError(
+                f"request needs {request.total_len} cache positions "
+                f"(prompt {request.prompt_ids.size} + max_new "
+                f"{request.max_new}) > engine max_len {self.max_len}")
+        request.sampling.validate(0)
+        return self.scheduler.submit(request, arrival_s=arrival_s)
+
+    # -- the step-boundary machine -----------------------------------------
+
+    def _prefill(self, req: Request) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        P = self.programs
+        slot = req.slot
+        n = int(req.prompt_ids.size)
+        bucket = bucket_for(n, P.buckets)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = req.prompt_ids
+        s = req.sampling
+        with obs.span("serve_prefill", request=req.id, bucket=bucket):
+            tok, carry, small = P.prefill_for(bucket)(
+                P.params, jnp.asarray(padded), jnp.asarray(n),
+                jax.random.PRNGKey(s.seed),
+                jnp.asarray(s.temperature, jnp.float32),
+                jnp.asarray(s.top_k or 0, jnp.int32),
+                jnp.asarray(1.0 if s.top_p is None else s.top_p,
+                            jnp.float32))
+            P.cache = P.insert(P.cache, small,
+                               jnp.asarray(slot, jnp.int32))
+            tok = int(tok)
+        now = time.perf_counter()
+        req.first_token_s = now
+        req.served_by = P  # which checkpoint's programs decoded it
+        req.tokens.append(tok)
+        self.gen_tokens += 1
+        if req.ttft_s is not None:
+            obs.observe("serve_ttft_seconds", req.ttft_s,
+                        help="request arrival -> first token")
+        # slot tables: next write position is the prompt length
+        self._pos[slot] = n
+        self._tok[slot] = tok
+        self._temp[slot] = s.temperature
+        self._topk[slot] = s.top_k or 0
+        self._topp[slot] = 1.0 if s.top_p is None else s.top_p
+        self._eos[slot] = -1 if req.eos_id is None else req.eos_id
+        self._last_token_s[slot] = now
+        self._rngs = self._rngs.at[slot].set(carry)
+        if len(req.tokens) >= req.max_new or tok == self._eos[slot]:
+            self._finish(req)
+
+    def _finish(self, req: Request) -> None:
+        self.completed_count += 1
+        if self.retain_results:
+            self._results.append(req)
+        self.scheduler.evict(req, state=DONE)
+
+    def _decode_once(self) -> None:
+        import jax.numpy as jnp
+
+        P = self.programs
+        # inactive slots decode junk under a clamped position; their
+        # results are discarded and their cache rows are stale-safe
+        pos = np.minimum(self._pos, self.max_len - 1)
+        nxt, self._rngs, P.cache = P.decode(
+            P.params, P.cache, jnp.asarray(self._tok), jnp.asarray(pos),
+            self._rngs, jnp.asarray(self._temp), jnp.asarray(self._topk),
+            jnp.asarray(self._topp))
+        nxt = np.asarray(nxt)
+        now = time.perf_counter()
+        self.steps += 1
+        obs.inc("serve_decode_steps_total",
+                help="batched continuous-batching decode steps")
+        for slot, req in list(self.scheduler.running.items()):
+            tok = int(nxt[slot])
+            req.tokens.append(tok)
+            self.gen_tokens += 1
+            gap = now - self._last_token_s[slot]
+            req.token_gaps_s.append(gap)
+            obs.observe("serve_token_seconds", gap,
+                        help="per-token latency (gap between a "
+                             "request's successive tokens)")
+            self._last_token_s[slot] = now
+            self._pos[slot] += 1
+            self._tok[slot] = tok
+            if len(req.tokens) >= req.max_new or tok == self._eos[slot]:
+                self._finish(req)
+
+    def step(self, admit: bool = True) -> bool:
+        """One engine iteration: (boundary) admit + prefill, then one
+        batched decode step.  Returns whether any work happened."""
+        if self._t_first is None:
+            self._t_first = time.perf_counter()
+        did = False
+        if admit:
+            for req in self.scheduler.admit():
+                self._prefill(req)
+                did = True
+        if self.scheduler.running:
+            self._decode_once()
+            did = True
+        if did:
+            self._t_last = time.perf_counter()
+        return did
+
+    # -- hot-swap -----------------------------------------------------------
+
+    def request_swap(self, checkpoint_dir: str) -> None:
+        """Stage a freshly-pruned checkpoint for a step-boundary swap
+        (see module docstring).  Restore + compile + warm run on a
+        background thread so in-flight decoding never stalls; the
+        switch itself happens inside :meth:`run` (or via
+        :meth:`maybe_swap` between manual :meth:`step` calls)."""
+        if self._pending_swap is not None:
+            raise RuntimeError(
+                f"a swap to {self._pending_swap!r} is already staging")
+        self._pending_swap = checkpoint_dir
+        # snapshot the exercised prefill buckets ON THIS THREAD: the
+        # engine loop keeps admitting (and may insert new buckets)
+        # while the staging thread runs — iterating the live dict there
+        # would race
+        buckets = sorted(self.programs._prefills)
+        self._swap_thread = threading.Thread(
+            target=self._stage_swap, args=(checkpoint_dir, buckets),
+            daemon=True)
+        self._swap_thread.start()
+
+    def _stage_swap(self, path: str, buckets: List[int]) -> None:
+        """Background staging: every program a request can hit is
+        compiled BEFORE traffic switches — the decode step + the prompt
+        buckets traffic had already exercised at stage time."""
+        try:
+            from torchpruner_tpu.checkpoint import restore_checkpoint
+
+            with obs.span("serve_swap_compile", checkpoint=path):
+                model, params, _state, _opt, meta = \
+                    restore_checkpoint(path)
+                staged = _Programs(
+                    model, params, n_slots=self.n_slots,
+                    max_len=self.max_len,
+                    cache_dtype=self.programs.cache_dtype,
+                    meta={**(meta or {}), "checkpoint": path})
+                staged.warm(buckets or None)
+            self._staged = staged
+        except Exception as e:  # surfaced at the next step boundary
+            self._swap_error = e
+            self._pending_swap = None
+
+    def maybe_swap(self) -> bool:
+        """Advance the swap state machine at a step boundary: report a
+        failed staging, or switch once the staged programs are ready
+        AND the slot array is empty.  Returns True when the switch
+        happened this call."""
+        if self._swap_error is not None:
+            err, self._swap_error = self._swap_error, None
+            obs.inc("serve_swap_errors_total",
+                    help="hot-swap stagings that failed (bad/corrupt "
+                         "checkpoint); serving continues on the old one")
+            print(f"[serve] hot-swap failed, keeping current "
+                  f"checkpoint: {type(err).__name__}: {err}",
+                  file=sys.stderr, flush=True)
+        if self._staged is not None and not self.scheduler.running:
+            old, new = self.programs, self._staged
+            self.programs = new
+            self._staged, self._pending_swap = None, None
+            self.swaps_total += 1
+            obs.inc("serve_swaps_total",
+                    help="checkpoint hot-swaps completed")
+            obs.record_serve(
+                kind="hot_swap",
+                old_digest=(old.meta or {}).get("digest"),
+                new_digest=(new.meta or {}).get("digest"),
+                checkpoint=(new.meta or {}).get("checkpoint"),
+                widths=new.model.widths(), at_step=self.steps)
+            return True
+        return False
+
+    # -- drain / loop -------------------------------------------------------
+
+    def _snapshot_queue(self, extra: Optional[List[Request]] = None) -> None:
+        self.scheduler.closed = True  # later submissions bounce
+        queued = self.scheduler.drain_queue() + list(extra or [])
+        for req in queued:
+            req.state = DRAINED
+            req._event.set()
+        self.drained.extend(queued)
+        if queued:
+            obs.inc("serve_drained_total", n=len(queued),
+                    help="queued requests snapshotted at drain")
+        if self.run_dir:
+            import os
+
+            from torchpruner_tpu.resilience.manifest import (
+                atomic_write_json,
+            )
+
+            os.makedirs(self.run_dir, exist_ok=True)
+            atomic_write_json(
+                os.path.join(self.run_dir, SNAPSHOT_FILENAME),
+                {"drained_at": time.time(),
+                 "requests": [r.snapshot() for r in queued]})
+
+    def run(self, traffic=None, *, preemption=None,
+            max_steps: Optional[int] = None, stop_event=None,
+            idle_wait_s: float = 5e-4,
+            stop_when_drained: bool = True) -> dict:
+        """The engine loop: pump open-loop traffic, honor preemption
+        (drain in-flight, snapshot the queue, exit cleanly), advance
+        the hot-swap state machine, and step.  Returns
+        :meth:`summary` (whose throughput window covers THIS run)."""
+        # fresh throughput window: a prior warmup/calibration run must
+        # not dilute this run's sustained tok/s
+        self._t_first = None
+        self._t_last = None
+        self._window_tokens0 = self.gen_tokens
+        draining = False
+        while True:
+            self.ticks += 1
+            if traffic is not None and not draining:
+                traffic.pump(self)
+            want_stop = (
+                (preemption is not None and preemption.requested)
+                or (stop_event is not None and stop_event.is_set()))
+            if want_stop and not draining:
+                draining = True
+                # everything not yet in flight — queued requests AND the
+                # traffic generator's not-yet-submitted arrivals — goes
+                # into the resubmission snapshot; only in-flight work
+                # keeps running
+                extra = traffic.drain() if traffic is not None and \
+                    hasattr(traffic, "drain") else []
+                self._snapshot_queue(extra)
+            if not draining:
+                self.maybe_swap()
+            # admissions keep flowing while a swap STAGES on its thread;
+            # they stop only once the staged programs are ready (the
+            # drain-then-switch boundary)
+            did = self.step(admit=not draining and self._staged is None)
+            if max_steps is not None and self.steps >= max_steps:
+                break
+            if not self.scheduler.has_work():
+                if draining:
+                    break
+                if self._pending_swap is not None:
+                    # a staged/staging swap is outstanding work: stay
+                    # alive so it can land (maybe_swap switches on the
+                    # next iteration once the thread finishes)
+                    time.sleep(idle_wait_s)
+                    continue
+                if traffic is not None and traffic.exhausted:
+                    break
+                if traffic is None and stop_event is None \
+                        and stop_when_drained:
+                    break
+                if not did:
+                    time.sleep(idle_wait_s)
+        if draining:
+            obs.inc("serve_preempt_drains_total",
+                    help="preemption drains completed")
+        return self.summary()
+
+    # -- reporting ----------------------------------------------------------
+
+    def results(self) -> List[Request]:
+        return list(self._results)
+
+    def summary(self) -> dict:
+        """Headline serving stats; also pushes the sustained-throughput
+        gauge and the serve ledger record so ``obs report`` can render
+        the run.  Counts (requests/admits/evictions/swaps) are engine
+        LIFETIME; the throughput window (``gen_tokens`` / ``wall_s`` /
+        ``sustained_gen_tok_s``) covers the most recent :meth:`run`;
+        latency percentiles come from retained results (``None`` with
+        ``retain_results=False`` — read the obs histograms instead)."""
+        done = [r for r in self._results if r.state == DONE]
+        wall = ((self._t_last - self._t_first)
+                if self._t_first is not None and self._t_last is not None
+                else 0.0)
+        window_tokens = self.gen_tokens - self._window_tokens0
+        ttfts = [r.ttft_s for r in done if r.ttft_s is not None]
+        gaps = [g for r in done for g in r.token_gaps_s]
+
+        def pct(xs, q):
+            return round(float(np.percentile(xs, q)) * 1e3, 3) \
+                if xs else None
+
+        out = {
+            "requests_completed": self.completed_count,
+            "requests_drained": len(self.drained),
+            "decode_steps": self.steps,
+            "gen_tokens": window_tokens,
+            "wall_s": round(wall, 4),
+            "sustained_gen_tok_s": (round(window_tokens / wall, 1)
+                                    if wall > 0 else None),
+            "ttft_p50_ms": pct(ttfts, 50),
+            "ttft_p99_ms": pct(ttfts, 99),
+            "token_p50_ms": pct(gaps, 50),
+            "token_p99_ms": pct(gaps, 99),
+            "admits": self.scheduler.admitted_total,
+            "evictions": self.scheduler.allocator.total_evictions,
+            "swaps": self.swaps_total,
+        }
+        if out["sustained_gen_tok_s"] is not None:
+            obs.gauge_set("serve_gen_tokens_per_s",
+                          out["sustained_gen_tok_s"],
+                          help="sustained generated tokens per second")
+        obs.record_serve(
+            kind="summary",
+            checkpoint_digest=self.programs.meta.get("digest"), **out)
+        return out

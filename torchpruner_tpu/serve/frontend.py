@@ -1,0 +1,372 @@
+"""``python -m torchpruner_tpu serve`` — the serving endpoint.
+
+Three front ends over one engine loop, all SIGTERM-drain-safe and obs-
+instrumented (TTFT / per-token histograms, queue-depth / active-slot
+gauges, ledger provenance records):
+
+- ``--synthetic N`` — open-loop synthetic traffic (Poisson at
+  ``--rate``, or deterministic ``--stagger-steps``); prints a JSON
+  summary line.  ``--verify`` re-decodes every request alone through
+  ``generate()`` and asserts token equality — the continuous-batching
+  correctness contract, used by the CI smoke.
+- ``--http PORT`` — a local HTTP endpoint: ``POST /v1/generate`` with
+  ``{"prompt_ids": [...], "max_new": N, "temperature": ..,
+  "top_k": .., "top_p": .., "seed": ..}`` blocks until the engine
+  finishes the request and returns its tokens; ``GET /healthz`` and
+  ``GET /stats`` report liveness and serving gauges.
+- ``--stdin`` — one JSON request per line (same schema), results
+  echoed as JSON lines; EOF drains and exits.
+
+Examples::
+
+    python -m torchpruner_tpu serve llama3_ffn_taylor --smoke --cpu \
+        --synthetic 16 --verify --obs-dir logs/serve_obs
+    python -m torchpruner_tpu serve llama_tiny --cpu --http 8811
+    python -m torchpruner_tpu serve llama3_ffn_taylor \
+        --checkpoint runs/prune/ckpt-000007-s00001200 --kv-dtype bfloat16
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+from typing import Optional
+
+from torchpruner_tpu.serve.request import Request, Sampling
+
+
+def _resolve_model(name: str, *, smoke: bool, seed: int,
+                   checkpoint: Optional[str]):
+    """(model, params, meta): a digest-verified checkpoint when given,
+    else the named preset's model (or a bare MODEL_REGISTRY name) with
+    seeded init params."""
+    if checkpoint:
+        from torchpruner_tpu.checkpoint import restore_checkpoint
+
+        model, params, _state, _opt, meta = restore_checkpoint(checkpoint)
+        meta = dict(meta or {})
+        meta["checkpoint"] = checkpoint
+        return model, params, meta
+    from torchpruner_tpu.core.segment import init_model
+    from torchpruner_tpu.experiments.presets import PRESETS, get_preset
+    from torchpruner_tpu.experiments.prune_retrain import MODEL_REGISTRY
+
+    if name in PRESETS:
+        model_name = get_preset(name, smoke=smoke).model
+    elif name in MODEL_REGISTRY:
+        model_name = name
+    else:
+        raise SystemExit(
+            f"unknown preset/model {name!r}; presets: {list(PRESETS)}; "
+            f"models: {list(MODEL_REGISTRY)}")
+    model = MODEL_REGISTRY[model_name][0]()
+    params, _state = init_model(model, seed=seed)
+    return model, params, {"model": model_name}
+
+
+def _request_from_json(d: dict) -> Request:
+    return Request(
+        prompt_ids=d["prompt_ids"], max_new=int(d.get("max_new", 16)),
+        eos_id=d.get("eos_id"),
+        sampling=Sampling(
+            temperature=float(d.get("temperature", 0.0)),
+            top_k=d.get("top_k"), top_p=d.get("top_p"),
+            seed=int(d.get("seed", 0))))
+
+
+def _http_server(engine, port: int, request_timeout_s: float):
+    """Threaded HTTP front end; handlers submit into the engine loop
+    running on the main thread and block on the request's event."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet access log
+            pass
+
+        def _json(self, code: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._json(200, {"ok": True})
+            elif self.path == "/stats":
+                sched = engine.scheduler
+                self._json(200, {
+                    "queue_depth": sched.queue_depth,
+                    "active_slots": sched.allocator.active_slots,
+                    "kv_pages_in_use": sched.allocator.pages_in_use,
+                    "decode_steps": engine.steps,
+                    "gen_tokens": engine.gen_tokens,
+                    "admits": sched.admitted_total,
+                    "evictions": sched.allocator.total_evictions,
+                })
+            else:
+                self._json(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path != "/v1/generate":
+                self._json(404, {"error": "not found"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = _request_from_json(json.loads(self.rfile.read(n)))
+                engine.submit(req)
+            except (ValueError, KeyError, json.JSONDecodeError) as e:
+                self._json(400, {"error": str(e)})
+                return
+            if not req.wait(timeout=request_timeout_s):
+                self._json(504, {"error": "timed out", "id": req.id})
+                return
+            self._json(200, req.result())
+
+    return ThreadingHTTPServer(("127.0.0.1", port), Handler)
+
+
+def serve_main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="torchpruner_tpu serve",
+        description="continuous-batching inference engine on the pruned "
+                    "decode path (scheduler + bucketed KV allocator + "
+                    "prefill/decode disaggregation + hot-swap)")
+    p.add_argument("preset", help="preset name (its model is served), a "
+                                  "MODEL_REGISTRY model name, or anything "
+                                  "with --checkpoint")
+    p.add_argument("--checkpoint", metavar="DIR",
+                   help="serve this digest-verified checkpoint (restores "
+                        "the PRUNED spec + params) instead of seeded "
+                        "init params")
+    p.add_argument("--smoke", action="store_true",
+                   help="preset's miniature model variant")
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend")
+    p.add_argument("--slots", type=int, default=4,
+                   help="decode slot-array width (compiled batch)")
+    p.add_argument("--max-len", type=int, default=256,
+                   help="KV positions per slot (prompt + max_new cap)")
+    p.add_argument("--kv-dtype", choices=("float32", "bfloat16"),
+                   default="float32",
+                   help="KV-cache dtype; bfloat16 halves cache HBM "
+                        "(the serving config)")
+    p.add_argument("--page-len", type=int, default=0,
+                   help="KV page size (0 = lane-aligned default)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--run-dir", metavar="DIR",
+                   help="where the SIGTERM drain snapshots the queue")
+    p.add_argument("--obs-dir", metavar="DIR",
+                   help="runtime telemetry directory (events/metrics/"
+                        "ledger/report; see `obs report`)")
+    p.add_argument("--no-obs", action="store_true")
+    p.add_argument("--swap-checkpoint", metavar="DIR",
+                   help="hot-swap to this checkpoint mid-run (synthetic "
+                        "mode: staged after --swap-after steps)")
+    p.add_argument("--swap-after", type=int, default=8,
+                   help="engine steps before staging --swap-checkpoint")
+    mode = p.add_mutually_exclusive_group()
+    mode.add_argument("--synthetic", type=int, metavar="N",
+                      help="serve N open-loop synthetic requests, print "
+                           "a JSON summary, exit")
+    mode.add_argument("--http", type=int, metavar="PORT",
+                      help="serve a local HTTP endpoint")
+    mode.add_argument("--stdin", action="store_true",
+                      help="read JSON requests from stdin")
+    p.add_argument("--rate", type=float, default=0.0,
+                   help="synthetic: Poisson arrival rate, requests/s "
+                        "(0 = deterministic step staggering)")
+    p.add_argument("--stagger-steps", type=int, default=2,
+                   help="synthetic: steps between deterministic arrivals")
+    p.add_argument("--prompt-lens", default="4,8,6",
+                   help="synthetic: comma list of prompt lengths (cycled)")
+    p.add_argument("--max-new", default="8,5,12",
+                   help="synthetic: comma list of generation budgets")
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="synthetic: sampling temperature (0 = greedy)")
+    p.add_argument("--verify", action="store_true",
+                   help="synthetic: assert every request's tokens equal "
+                        "its solo generate() decode (the continuous-"
+                        "batching correctness contract)")
+    p.add_argument("--timeout", type=float, default=120.0,
+                   help="http: per-request wait timeout (seconds)")
+    args = p.parse_args(argv)
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from torchpruner_tpu import obs
+    from torchpruner_tpu.resilience.guards import PreemptionHandler
+    from torchpruner_tpu.serve.engine import ServeEngine
+
+    session = None
+    if not args.no_obs:
+        session = obs.configure(args.obs_dir)
+        obs.annotate_run(experiment=f"serve:{args.preset}", kind="serve",
+                         model=args.preset,
+                         checkpoint=args.checkpoint or "")
+
+    model, params, meta = _resolve_model(
+        args.preset, smoke=args.smoke, seed=args.seed,
+        checkpoint=args.checkpoint)
+    engine = ServeEngine(
+        model, params, n_slots=args.slots, max_len=args.max_len,
+        cache_dtype=(jnp.bfloat16 if args.kv_dtype == "bfloat16"
+                     else jnp.float32),
+        page_len=args.page_len, run_dir=args.run_dir,
+        checkpoint_meta=meta,
+        # a long-running HTTP server must not accumulate completed
+        # requests (each pins its prompt/tokens and, across a swap, the
+        # old program set); batch modes need them for verify/reporting
+        retain_results=args.http is None)
+
+    rc = 0
+    try:
+        # obs.span degrades to a nullcontext without a session
+        with PreemptionHandler() as pre, \
+                obs.span("serve", preset=args.preset):
+            if args.http is not None:
+                rc = _run_http(engine, pre, args)
+            elif args.stdin:
+                rc = _run_stdin(engine, pre, args)
+            else:
+                rc = _run_synthetic(engine, pre, args, model, params)
+    finally:
+        if session is not None:
+            obs.shutdown(print_to=sys.stderr)
+            if args.obs_dir:
+                print(f"telemetry written to {args.obs_dir}",
+                      file=sys.stderr)
+    return rc
+
+
+def _run_synthetic(engine, pre, args, model, params) -> int:
+    from torchpruner_tpu.serve.traffic import (
+        OpenLoopTraffic,
+        poisson_arrivals,
+        staggered_arrivals,
+        synthetic_requests,
+    )
+
+    from torchpruner_tpu.serve.engine import vocab_of
+
+    n = args.synthetic or 8
+    vocab = vocab_of(model)
+    prompt_lens = [int(x) for x in args.prompt_lens.split(",") if x]
+    max_new = [int(x) for x in args.max_new.split(",") if x]
+    reqs = synthetic_requests(
+        n, vocab=vocab, prompt_lens=prompt_lens, max_new=max_new,
+        seed=args.seed, temperature=args.temperature)
+    if args.rate > 0:
+        traffic = OpenLoopTraffic(
+            reqs, poisson_arrivals(n, args.rate, seed=args.seed))
+    else:
+        traffic = OpenLoopTraffic(
+            reqs, staggered_arrivals(n, every_steps=args.stagger_steps),
+            by_step=True)
+    if args.swap_checkpoint:
+        traffic = _SwapAt(traffic, args.swap_checkpoint, args.swap_after)
+    # sync line for wrappers (the CI SIGTERM drill keys off it): printed
+    # BEFORE the first admission, i.e. before any compile
+    print(f"serve: engine loop starting ({n} synthetic requests, "
+          f"{engine.n_slots} slots)", file=sys.stderr, flush=True)
+    summary = engine.run(traffic, preemption=pre)
+    summary["drained_snapshot"] = len(engine.drained)
+    if args.verify:
+        import jax
+        import numpy as np
+
+        from torchpruner_tpu.generate import generate
+
+        mismatches = 0
+        for r in engine.results():
+            s = r.sampling
+            # replay against the program set that actually served the
+            # request (a hot-swap mid-run changes engine.params; the
+            # request carries its own)
+            P = r.served_by or engine.programs
+            want = generate(
+                P.model, P.params, r.prompt_ids[None],
+                r.max_new, temperature=s.temperature, top_k=s.top_k,
+                top_p=s.top_p, rng=jax.random.PRNGKey(s.seed),
+                cache_dtype=P.cache_dtype)
+            if not np.array_equal(np.asarray(r.tokens, np.int32),
+                                  np.asarray(want)[0][:len(r.tokens)]):
+                mismatches += 1
+        summary["verify_mismatches"] = mismatches
+        if mismatches:
+            print(json.dumps(summary))
+            print(f"VERIFY FAILED: {mismatches} requests diverged from "
+                  "solo decode", file=sys.stderr)
+            return 1
+    print(json.dumps(summary))
+    return 0
+
+
+class _SwapAt:
+    """Traffic wrapper staging a hot-swap after N engine steps."""
+
+    def __init__(self, inner, checkpoint: str, after_steps: int):
+        self.inner, self.checkpoint = inner, checkpoint
+        self.after_steps, self.fired = after_steps, False
+
+    @property
+    def exhausted(self):
+        return self.inner.exhausted
+
+    def drain(self):
+        return self.inner.drain()
+
+    def pump(self, engine):
+        n = self.inner.pump(engine)
+        if not self.fired and engine.steps >= self.after_steps:
+            engine.request_swap(self.checkpoint)
+            self.fired = True
+        return n
+
+
+def _run_stdin(engine, pre, args) -> int:
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            engine.submit(_request_from_json(json.loads(line)))
+        except (ValueError, KeyError, json.JSONDecodeError) as e:
+            print(json.dumps({"error": str(e)}), flush=True)
+    summary = engine.run(preemption=pre)
+    for r in engine.results():
+        print(json.dumps(r.result()), flush=True)
+    print(json.dumps(summary), file=sys.stderr)
+    return 0
+
+
+def _run_http(engine, pre, args) -> int:
+    server = _http_server(engine, args.http, args.timeout)
+    stop = threading.Event()
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    print(f"serving on http://127.0.0.1:{args.http} "
+          f"(POST /v1/generate, GET /healthz /stats)", file=sys.stderr,
+          flush=True)
+    summary = None
+    try:
+        # the engine loop owns the main thread; SIGTERM drains in-flight
+        # requests, snapshots the queue, and returns
+        summary = engine.run(preemption=pre, stop_event=stop)
+    finally:
+        server.shutdown()
+        t.join(timeout=5)
+    print(json.dumps(summary if summary is not None
+                     else engine.summary()), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(serve_main())

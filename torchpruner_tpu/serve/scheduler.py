@@ -1,0 +1,144 @@
+"""Continuous-batching request scheduler.
+
+Open-loop admission control over the fixed slot array: requests queue
+FIFO; at every DECODE-STEP BOUNDARY the engine asks the scheduler to
+(1) admit queued requests into free slots (prefill hand-off) and
+(2) evict finished ones (slot + page recycling).  Mid-sequence the
+compiled step is never perturbed — admission changes only the host-side
+slot tables (positions, current tokens, sampling vectors) that are
+passed into the SAME compiled program each step, which is what makes
+the batching "continuous": one XLA executable serves a ragged,
+ever-changing mix of requests.
+
+Thread-safety: ``submit`` may be called from frontend threads (HTTP
+handlers) while the engine loop runs; the queue is guarded by a lock.
+Everything else is engine-loop-only.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+from torchpruner_tpu import obs
+from torchpruner_tpu.serve.allocator import KVCacheAllocator
+from torchpruner_tpu.serve.request import (
+    ACTIVE,
+    DONE,
+    DRAINED,
+    QUEUED,
+    Request,
+)
+
+
+class Scheduler:
+    """FIFO queue + slot-table bookkeeping (see module docstring)."""
+
+    def __init__(self, allocator: KVCacheAllocator):
+        self.allocator = allocator
+        self._queue: Deque[Request] = deque()
+        self._lock = threading.Lock()
+        #: slot -> active request
+        self.running: Dict[int, Request] = {}
+        self.admitted_total = 0
+        self.completed_total = 0
+        #: set when a drain begins: later submissions are REJECTED
+        #: (marked drained, event set) instead of queueing forever —
+        #: an HTTP client racing a SIGTERM gets an immediate "resubmit
+        #: elsewhere" answer, and the drain loop can still terminate
+        self.closed = False
+
+    # -- frontend side ------------------------------------------------------
+
+    def submit(self, request: Request,
+               arrival_s: Optional[float] = None) -> Request:
+        """Enqueue a request (thread-safe).  ``arrival_s`` lets an
+        open-loop traffic generator backdate the arrival to its
+        SCHEDULED time, so queueing delay counts into TTFT the way it
+        would for a real caller."""
+        request.arrival_s = (time.perf_counter() if arrival_s is None
+                             else arrival_s)
+        if self.closed:
+            request.state = DRAINED
+            request._event.set()
+            obs.inc("serve_rejected_total",
+                    help="submissions rejected after a drain began")
+            return request
+        request.state = QUEUED
+        with self._lock:
+            self._queue.append(request)
+        obs.inc("serve_requests_total", help="requests submitted")
+        return request
+
+    # -- engine side (step boundaries only) ---------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def has_work(self) -> bool:
+        return bool(self.running) or self.queue_depth > 0
+
+    def admit(self) -> List[Request]:
+        """Pop queued requests while a slot (and KV pages) are free;
+        returns the newly-admitted batch for the engine to prefill.
+        FIFO head-of-line: a too-long request at the head blocks the
+        queue rather than being overtaken (no starvation)."""
+        out: List[Request] = []
+        while True:
+            with self._lock:
+                if not self._queue:
+                    break
+                head = self._queue[0]
+                lease = self.allocator.allocate(head.id, head.total_len)
+                if lease is None:
+                    break
+                self._queue.popleft()
+            head.slot = lease.slot
+            head.state = ACTIVE
+            self.running[lease.slot] = head
+            self.admitted_total += 1
+            out.append(head)
+        if out:
+            obs.inc("serve_admits_total", n=len(out),
+                    help="requests admitted into a decode slot")
+        self._gauges()
+        return out
+
+    def evict(self, request: Request, state: str = DONE) -> None:
+        """Release a finished request's slot + pages (step boundary)."""
+        slot = request.slot
+        request.state = state
+        request.done_s = time.perf_counter()
+        if slot is not None and self.running.get(slot) is request:
+            del self.running[slot]
+            self.allocator.release(slot)
+        request.slot = None
+        self.completed_total += 1
+        obs.inc("serve_evictions_total",
+                help="slot evictions (request completion or early stop)")
+        if state == DONE:
+            obs.inc("serve_completed_total", help="requests completed")
+        request._event.set()
+        self._gauges()
+
+    def drain_queue(self) -> List[Request]:
+        """Remove and return every not-yet-started request — the
+        preemption path: in-flight requests finish, queued ones are
+        snapshotted for resubmission."""
+        with self._lock:
+            out = list(self._queue)
+            self._queue.clear()
+        self._gauges()
+        return out
+
+    def _gauges(self) -> None:
+        obs.gauge_set("serve_queue_depth", self.queue_depth,
+                      help="requests waiting for a slot")
+        obs.gauge_set("serve_active_slots", self.allocator.active_slots,
+                      help="slots currently decoding")
+        obs.gauge_set("serve_kv_pages_in_use", self.allocator.pages_in_use,
+                      help="KV-cache pages leased to active requests")
