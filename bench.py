@@ -151,6 +151,20 @@ def _peak_flops(device) -> float | None:
     return peak_bf16_flops(device)
 
 
+def _kernel_window(row: dict, steps: int = 1,
+                   flops_per_step: float | None = None):
+    """One profiler capture window around a leg's already-measured
+    workload: the top-5 per-kernel rows (obs.profile.OneShotCapture)
+    land in ``row["kernels"]`` — op-level evidence next to every
+    headline number (the flash 0.983x and int4 staleness questions are
+    exactly "which kernel", ROADMAP item 2).  Runs AFTER the timed
+    section so trace overhead never pollutes the timing; failures
+    degrade to no row, never a leg error."""
+    from torchpruner_tpu.obs.profile import OneShotCapture
+
+    return OneShotCapture(row, steps=steps, flops_per_step=flops_per_step)
+
+
 def _leg_mnist(smoke: bool) -> dict:
     """Leg 1: untrained-MNIST Shapley prune, timed end to end."""
     import jax
@@ -485,7 +499,8 @@ def _leg_vgg_train(smoke: bool) -> dict:
         rng.integers(0, 10, size=(batch,)).astype("int32"))
     peak = _peak_flops(jax.devices()[0])
 
-    def measure(compute_dtype, with_mfu=True, with_dispatch=True):
+    def measure(compute_dtype, with_mfu=True, with_dispatch=True,
+                with_kernels=False):
         trainer = Trainer.create(model, optax.sgd(0.05, momentum=0.9),
                                  cross_entropy_loss, seed=0,
                                  compute_dtype=compute_dtype)
@@ -511,6 +526,7 @@ def _leg_vgg_train(smoke: bool) -> dict:
             "img_per_s_per_chip": round(batch / step_s, 1),
             "compile_s": round(compile_s + mstats["compile_s"], 2),
         }
+        fwd_flops = None
         if with_mfu:
             _, fwd_flops = model_cost(model, trainer.params, trainer.state,
                                       batch_size=batch)
@@ -521,12 +537,21 @@ def _leg_vgg_train(smoke: bool) -> dict:
                 _flag_implausible_mfu(out)
             else:
                 out["mfu"] = None
+        if with_kernels:
+            # one post-measurement capture window over a representative
+            # multi-step dispatch: top-5 kernel rows ride the leg row
+            from torchpruner_tpu.utils.profiling import hard_fence
+
+            with _kernel_window(out, steps=K,
+                                flops_per_step=(3.0 * fwd_flops
+                                                if fwd_flops else None)):
+                hard_fence(trainer.multi_step(xs, ys)[-1])
         return out
 
     # bf16 compute is the TPU-native training config (the MFU denominator
     # is the chip's bf16 peak); f32 step time recorded alongside for
     # reference, without an MFU (its peak differs)
-    bf16 = measure(jax.numpy.bfloat16)
+    bf16 = measure(jax.numpy.bfloat16, with_kernels=True)
     f32 = measure(None, with_mfu=False)
     out = {
         "value": bf16["ms"],
@@ -539,6 +564,7 @@ def _leg_vgg_train(smoke: bool) -> dict:
         "mfu": bf16["mfu"],
         "compile_s": bf16["compile_s"],
         "f32": f32,
+        **({"kernels": bf16["kernels"]} if "kernels" in bf16 else {}),
     }
     if not smoke and jax.devices()[0].platform == "tpu":
         # batch scaling: small 32x32 convs underfill the MXU at b256, so
@@ -718,9 +744,10 @@ def _leg_flash_attention(smoke: bool) -> dict:
             return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
 
         r = {}
+        gs = {}
         for name, fn in (("flash", flash_attention),
                          ("xla", _xla_attention)):
-            g = make(fn)
+            g = gs[name] = make(fn)
             stats = time_fn(g, q, k, v, iters=5, warmup=2, chained=True)
             r[f"{name}_ms"] = round(steady_s(stats) * 1e3, 3)
             r[f"{name}_ms_fenced_p50"] = round(stats["p50_s"] * 1e3, 3)
@@ -733,6 +760,10 @@ def _leg_flash_attention(smoke: bool) -> dict:
         if r.get("xla_ms") and r.get("flash_ms"):
             r["speedup"] = round(r["xla_ms"] / r["flash_ms"], 3)
         r["shape"] = f"B{B} S{S} H{H} Dh{Dh} bf16 causal"
+        # which ops the flash grad step actually spends its ms in — the
+        # evidence the 0.983x-vs-XLA retune needs (ROADMAP item 2)
+        with _kernel_window(r, steps=1):
+            jax.block_until_ready(gs["flash"](q, k, v))
         return r
 
     if smoke:
@@ -810,6 +841,10 @@ def _leg_llama_decode(smoke: bool, progress=None) -> dict:
                   else "llama_tiny"),
         "shape": f"B{B} prompt{S} new{n_new}",
     }
+    # one capture window over a dense decode: per-token kernel table
+    # (steps = generated tokens, so ms_per_step reads as ms/token)
+    with _kernel_window(result, steps=n_new):
+        hard_fence(generate(model, params, prompt, n_new))
     if progress is not None:
         progress(dict(result))
     if not smoke and on_tpu:
@@ -979,6 +1014,17 @@ def _leg_serve(smoke: bool, progress=None) -> dict:
         "model": "mfu_llama (~200M)" if (on_tpu and not smoke)
                  else "llama_tiny",
     }
+    # one capture window over a short warm pass (same compiled
+    # programs, zero compiles): the continuous-batching step's kernel
+    # mix, BEFORE the measured phase so trace overhead stays out of it
+    cap_reqs = synthetic_requests(slots, vocab=vocab,
+                                  prompt_lens=prompt_lens,
+                                  max_new=max_new, seed=7)
+    steps0 = eng.steps
+    with _kernel_window(result) as win:
+        eng.run(OpenLoopTraffic(cap_reqs, staggered_arrivals(slots, 1),
+                                by_step=True))
+        win.steps = max(1, eng.steps - steps0)
     if progress is not None:
         progress(dict(result))
 

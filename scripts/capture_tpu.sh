@@ -70,4 +70,17 @@ timeout 1800 python -m torchpruner_tpu.experiments.step_trace \
     --out "results/steptrace_mfullama_tpu_${stamp}_${commit}.json" \
     2> "logs/steptrace_llama_${stamp}.err" && echo "[capture] mfu_llama trace done"
 
+# 5. kernel-level profile leg (obs.profile): continuous capture windows
+#    over a short mfu_llama train run — the on-chip per-kernel table +
+#    roofline positions ROADMAP item 2's retune reads, plus a fresh
+#    kernel-scalar report to gate future captures against
+timeout 1800 python -m torchpruner_tpu --preset llama3_ffn_taylor --smoke \
+    --obs-dir "logs/profile_tpu_${stamp}" --profile-every 20 \
+    --profile-steps 4 2> "logs/profile_${stamp}.err" \
+    && python -m torchpruner_tpu obs profile "logs/profile_tpu_${stamp}" \
+        > "results/kernel_profile_tpu_${stamp}_${commit}.md" \
+    && cp "logs/profile_tpu_${stamp}/profile.json" \
+        "results/kernel_profile_tpu_${stamp}_${commit}.json" \
+    && echo "[capture] kernel profile leg done"
+
 echo "[capture] done — review results/, update PERF.md, commit"

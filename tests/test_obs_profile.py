@@ -1,0 +1,460 @@
+"""Kernel-level continuous profiling (torchpruner_tpu.obs.profile):
+capture-window cadence and on-demand arming, per-kernel attribution with
+roofline positions, kernel gate scalars tripping `obs diff --gate` while
+the total-step gate stays green, not-comparable degradation against a
+pre-kernel-era report, the Perfetto merge of profiler op events with the
+span stream, per-executable compile attribution, the HBM timeline, and
+the serve SLO monitor."""
+
+import gzip
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchpruner_tpu import obs
+from torchpruner_tpu.obs.profile import (
+    HbmSampler,
+    base_kernel_name,
+    build_profile,
+    format_profile,
+    kernel_scalar_name,
+    load_profile,
+    scan_windows,
+)
+from torchpruner_tpu.obs.report import (
+    check_gates,
+    diff_runs,
+    format_report,
+    load_run,
+    obs_main,
+)
+from torchpruner_tpu.utils.flops import roofline_position
+
+GOLDEN_DIGITS = os.path.join(
+    os.path.dirname(__file__), "..", "results",
+    "obs_report_golden_digits_smoke.json")
+
+
+@pytest.fixture(autouse=True)
+def _clean_session():
+    obs.shutdown()
+    yield
+    obs.shutdown()
+
+
+@jax.jit
+def _matmul_step(a, b):
+    return jnp.tanh(a @ b).sum()
+
+
+def _run_profiled(obs_dir, *, every=3, window=2, steps=8, n=256,
+                  flops=True):
+    """A matmul-dominated step loop under a profiling session; returns
+    the closed session's dir artifacts for assertions."""
+    session = obs.configure(str(obs_dir), profile_every=every,
+                            profile_steps=window)
+    if flops:
+        obs.configure_step_flops(flops_per_step=3 * 2 * n**3,
+                                 param_bytes=4.0 * n * n)
+    a = jnp.ones((n, n))
+    b = jnp.ones((n, n))
+    _matmul_step(a, b).block_until_ready()  # compile outside the loop
+    with obs.span("run"):
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            _matmul_step(a, b).block_until_ready()
+            obs.record_step(time.perf_counter() - t0, examples=n)
+    obs.shutdown()
+    return session
+
+
+# -- capture + attribution ---------------------------------------------------
+
+
+def test_cadence_windows_kernel_table_and_gauges(tmp_path):
+    """The tentpole end to end: cadence windows open without pausing the
+    step loop, the ranked kernel table attributes the step's ms to real
+    op names, every ranked kernel carries a roofline position, and the
+    kernel_* gate scalars land in report.json's metric snapshot."""
+    d = tmp_path / "obs"
+    _run_profiled(d, every=3, window=2, steps=8)
+
+    prof = json.load(open(d / "profile.json"))
+    assert len(prof["windows"]) >= 1
+    assert prof["steps_profiled"] >= 2
+    kernels = prof["kernels"]
+    assert kernels, "empty kernel table"
+    names = [k["kernel"] for k in kernels]
+    assert "dot" in names, names
+    for k in kernels:
+        assert k["ms_per_step"] >= 0
+        rf = k["roofline"]
+        assert rf["bound"] in ("compute", "memory", "unknown")
+    # the dominant matmul got the step-FLOPs attribution -> an intensity
+    dot = next(k for k in kernels if k["kernel"] == "dot")
+    assert dot["category"] == "matmul"
+    assert dot["roofline"]["intensity_flops_per_byte"] is not None
+    assert dot["roofline"]["flops_est"] > 0
+
+    # summed op ms vs the telemetry-measured step span: the coverage
+    # sanity the acceptance reads (matmul-dominated loop -> the trace
+    # must explain a meaningful share of the step, and cross-thread
+    # overlap must not inflate it absurdly)
+    assert prof["coverage"] is not None
+    assert 0.15 < prof["coverage"] < 3.0, prof["coverage"]
+
+    rep = json.load(open(d / "report.json"))
+    assert rep["metrics"][kernel_scalar_name("dot", "ms")] > 0
+    assert rep["metrics"]["profile_windows_total"] >= 1
+    assert rep["profile"]["kernels"], "profile block missing from report"
+    assert "timeline" not in rep["profile"]["hbm"]  # bulky raw stays out
+    md = format_report(load_run(str(d)))
+    assert "profile:" in md and "`dot`" in md
+
+
+def test_window_sidecars_and_offline_scan(tmp_path):
+    d = tmp_path / "obs"
+    _run_profiled(d, every=4, window=2, steps=8)
+    windows = scan_windows(str(d / "profile"))
+    assert windows and all(os.path.isdir(w["dir"]) for w in windows)
+    assert any(w["steps"] > 0 for w in windows)
+    # offline re-parse (SIGKILLed-run path): profile.json deleted, the
+    # windows alone must still produce a table
+    os.remove(d / "profile.json")
+    os.remove(d / "report.json")
+    prof = load_profile(str(d))
+    assert prof and prof["kernels"]
+
+
+def test_on_demand_window(tmp_path):
+    d = tmp_path / "obs"
+    session = obs.configure(str(d), profile_every=0, profile_steps=2)
+    assert obs.request_profile_window()
+    assert not obs.request_profile_window()  # already armed
+    a = jnp.ones((64, 64))
+    _matmul_step(a, a).block_until_ready()
+    for _ in range(4):
+        t0 = time.perf_counter()
+        _matmul_step(a, a).block_until_ready()
+        obs.record_step(time.perf_counter() - t0, examples=64)
+    assert session.profiler.windows, "on-demand window never closed"
+    assert session.profiler.windows[0]["on_demand"]
+    obs.shutdown()
+    assert json.load(open(d / "profile.json"))["windows"]
+
+
+def test_profile_cli_renders(tmp_path, capsys):
+    d = tmp_path / "obs"
+    _run_profiled(d, every=3, window=2, steps=7)
+    assert obs_main(["profile", str(d)]) == 0
+    out = capsys.readouterr().out
+    assert "kernel profile" in out and "| kernel |" in out
+    assert "dot" in out
+    assert obs_main(["profile", str(tmp_path / "nope")]) == 2
+
+
+def test_base_kernel_name_normalization():
+    assert base_kernel_name("dot.4") == "dot"
+    assert base_kernel_name("dot.17.clone") == "dot"
+    assert base_kernel_name("tanh.5.clone") == "tanh"
+    assert base_kernel_name("fusion.1234") == "fusion"
+    assert base_kernel_name("loop_convolution_fusion.2") == \
+        "loop_convolution_fusion"
+    assert base_kernel_name("all-reduce.1") == "all_reduce"
+
+
+def test_roofline_position_bounds():
+    # intensity 100 FLOP/B vs ridge 10 -> compute-bound
+    r = roofline_position(1e9, 1e7, 1e-3, peak_flops=1e12, peak_bw=1e11)
+    assert r["bound"] == "compute"
+    assert r["achieved_flops_per_s"] == pytest.approx(1e12)
+    assert r["pct_peak_flops"] == pytest.approx(100.0)
+    # intensity 1 vs ridge 10 -> memory-bound
+    r = roofline_position(1e7, 1e7, 1e-3, peak_flops=1e12, peak_bw=1e11)
+    assert r["bound"] == "memory"
+    # nothing known -> unknown, never a guess
+    r = roofline_position(None, None, 1e-3)
+    assert r["bound"] == "unknown" and r["pct_peak_flops"] is None
+
+
+# -- gates -------------------------------------------------------------------
+
+
+def _report_with_kernels(dot_ms, step_ms, steps=100):
+    return {"metrics": {
+        kernel_scalar_name("dot", "ms"): dot_ms,
+        kernel_scalar_name("fusion", "ms"): 0.1,
+        "profile_coverage": 0.8,
+    }, "derived": {"step_time_mean_s": step_ms / 1e3, "steps": steps}}
+
+
+def test_planted_kernel_slowdown_trips_gate_step_gate_green():
+    """The acceptance scenario: a kernel triples (a forced f32 matmul
+    under the bf16 policy) while its share of the total step is small
+    enough that the step-time gate stays green — the per-kernel gate
+    must fail, naming the kernel."""
+    base = _report_with_kernels(dot_ms=0.30, step_ms=3.0)
+    # dot 0.30 -> 0.95 ms (+217%); total step 3.0 -> 3.6 ms (+20%)
+    slow = _report_with_kernels(dot_ms=0.95, step_ms=3.6)
+    gates = {"step_time_mean_s": {"max_increase_pct": 25},
+             "kernel_dot_ms": {"max_increase_pct": 60}}
+    violations = check_gates(diff_runs(base, slow), gates)
+    assert [v["gate"] for v in violations] == ["kernel_dot_ms"]
+    assert "increased" in violations[0]["detail"]
+    # and a healthy run passes both
+    assert not check_gates(diff_runs(base, base), gates)
+
+
+def test_typoed_kernel_gate_is_a_violation():
+    """The unknown-gate invariant extends to dynamic names: a kernel
+    gate naming a metric NEITHER run has (a typo) must fail loudly, not
+    silently disable itself; \"optional\": true opts out."""
+    a, b = _report_with_kernels(0.2, 3.0), _report_with_kernels(0.3, 3.0)
+    d = diff_runs(a, b)
+    bad = {"kernel_dto_ms": {"max_increase_pct": 60}}
+    violations = check_gates(d, bad)
+    assert [v["gate"] for v in violations] == ["kernel_dto_ms"]
+    assert "absent from both" in violations[0]["detail"]
+    assert not check_gates(d, {"kernel_dto_ms": {
+        "max_increase_pct": 60, "optional": True}})
+    # known static scalars keep the existing skip semantics (mfu is
+    # legitimately absent on CPU runs)
+    assert not check_gates(d, {"mfu": {"max_decrease_pct": 10}})
+
+
+def test_request_window_refused_at_cap(tmp_path):
+    from torchpruner_tpu.obs.profile import ContinuousProfiler
+
+    prof = ContinuousProfiler(str(tmp_path / "p"), max_windows=1)
+    prof.windows.append({"index": 0, "dir": "x", "on_demand": False})
+    assert prof.request_window() is False  # a True must mean a capture
+
+
+def test_new_session_clears_stale_windows(tmp_path):
+    """A session reusing an obs dir must not merge a dead run's capture
+    windows into its own trace/kernel table (same invalidation the
+    metric shards get)."""
+    d = tmp_path / "obs"
+    _run_profiled(d, every=3, window=2, steps=7)
+    assert scan_windows(str(d / "profile"))
+    obs.configure(str(d), annotate=False, watch_compiles=False)
+    assert not scan_windows(str(d / "profile"))
+    assert not os.path.exists(d / "profile.json")
+    obs.shutdown()
+
+
+def test_kernel_scalars_diff_dynamically():
+    d = diff_runs(_report_with_kernels(0.2, 3.0),
+                  _report_with_kernels(0.4, 3.0))
+    e = d["scalars"]["kernel_dot_ms"]
+    assert e["pct"] == pytest.approx(100.0)
+    assert d["scalars"]["profile_coverage"]["delta"] == 0
+
+
+def test_pre_kernel_era_report_degrades_to_not_comparable():
+    """Satellite: diffing against a committed baseline from before the
+    kernel scalars existed must NOT error — kernel rows render as
+    informational 'not comparable' and gates skip them unless required."""
+    golden = load_run(GOLDEN_DIGITS)
+    assert not any(k.startswith("kernel_") for k in golden["metrics"])
+    fresh = _report_with_kernels(0.3, 3.0)
+    d = diff_runs(golden, fresh)
+    e = d["scalars"]["kernel_dot_ms"]
+    assert "not comparable" in e["note"] and "delta" not in e
+    from torchpruner_tpu.obs.report import format_diff
+
+    assert "not comparable" in format_diff(d)
+    gates = {"kernel_dot_ms": {"max_increase_pct": 60}}
+    assert not check_gates(d, gates)  # absent baseline -> skip
+    gates = {"kernel_dot_ms": {"max_increase_pct": 60, "require": True}}
+    assert [v["gate"] for v in check_gates(d, gates)] == ["kernel_dot_ms"]
+    # the reverse direction (fresh A, old B) is symmetric
+    assert "note" in diff_runs(fresh, golden)["scalars"]["kernel_dot_ms"]
+
+
+# -- Perfetto merge ----------------------------------------------------------
+
+
+def test_trace_merges_profiler_ops_with_spans(tmp_path):
+    """Satellite: trace.json holds the span B/E stream AND the capture
+    windows' op events — stable dedicated tids, monotonic ts per track,
+    balanced B/E (the Perfetto schema lint)."""
+    from torchpruner_tpu.obs.trace_export import PROFILE_TID_BASE
+
+    d = tmp_path / "obs"
+    _run_profiled(d, every=3, window=2, steps=7)
+    trace = json.load(open(d / "trace.json"))
+    evs = trace["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert xs, "no profiler op events merged"
+    assert all(e["tid"] >= PROFILE_TID_BASE for e in xs)
+    assert all(e["cat"] == "xla_op" for e in xs)
+    assert {"dot.4"} & {e["name"] for e in xs} or \
+        any(e["name"].startswith("dot") for e in xs)
+    # schema lint: B/E balanced per track, ts monotonic per track
+    stacks, last_ts = {}, {}
+    for e in evs:
+        if e["ph"] == "M":
+            continue
+        key = (e["pid"], e["tid"])
+        assert e["ts"] >= last_ts.get(key, 0), "ts regression"
+        last_ts[key] = e["ts"]
+        if e["ph"] == "B":
+            stacks.setdefault(key, []).append(e["name"])
+        elif e["ph"] == "E":
+            assert stacks[key].pop() == e["name"]
+        else:
+            assert e["ph"] == "X"
+    assert all(not s for s in stacks.values()), "unbalanced B/E"
+    # each profile track announces itself (thread_name metadata)
+    tids = {e["tid"] for e in xs}
+    named = {e["tid"] for e in evs if e["ph"] == "M"
+             and e["name"] == "thread_name"
+             and "profile window" in (e.get("args") or {}).get("name", "")}
+    assert tids <= named
+
+
+def test_trace_without_windows_unchanged(tmp_path):
+    """No capture windows -> the exporter emits the span-only trace
+    (and never invents X events)."""
+    d = tmp_path / "obs"
+    obs.configure(str(d), annotate=False, watch_compiles=False)
+    with obs.span("run"):
+        pass
+    obs.shutdown()
+    evs = json.load(open(d / "trace.json"))["traceEvents"]
+    assert not [e for e in evs if e["ph"] == "X"]
+
+
+# -- compile attribution -----------------------------------------------------
+
+
+def test_compile_seconds_attributed_per_executable(tmp_path):
+    """Satellite: the watcher names the executables that paid the
+    compile bill, and `obs report` renders the top-compilers table."""
+    d = tmp_path / "obs"
+    session = obs.configure(str(d), annotate=False)
+
+    @jax.jit
+    def costly_train_step(x):
+        return jnp.tanh(x @ x).sum()
+
+    with obs.span("run"):
+        costly_train_step(jnp.ones((128, 128))).block_until_ready()
+    by_exe = dict(session.compiles.by_executable)
+    counts = session.compiles.counts()
+    obs.shutdown()
+    assert any("costly_train_step" in name for name in by_exe), by_exe
+    name = next(n for n in by_exe if "costly_train_step" in n)
+    assert by_exe[name]["count"] >= 1 and by_exe[name]["seconds"] > 0
+    top = counts["by_executable"]
+    assert top and top[0]["seconds"] >= top[-1]["seconds"]
+    md = format_report(load_run(str(d)))
+    assert "top compilers" in md and "costly_train_step" in md
+
+
+def test_compile_log_level_restored():
+    import logging
+
+    logger = logging.getLogger("jax._src.dispatch")
+    prior_level, prior_prop = logger.level, logger.propagate
+    obs.configure(None)
+    obs.shutdown()
+    assert logger.level == prior_level
+    assert logger.propagate == prior_prop
+
+
+# -- HBM timeline ------------------------------------------------------------
+
+
+def test_hbm_sampler_timeline_and_phase_watermarks(tmp_path):
+    """Span edges sample memory; off-accelerator the host-RSS fallback
+    keeps the timeline non-empty so the same assertions run in CI."""
+    sampler = HbmSampler()
+    sampler.on_event({"event": "span_begin", "name": "retrain", "ts": 1.0})
+    sampler._t_last = 0.0  # bypass throttle for the second edge
+    sampler.on_event({"event": "span_end", "name": "retrain", "ts": 2.0})
+    assert sampler.timeline, "no samples (host fallback failed)"
+    s = sampler.summary()
+    assert s["phases"]["retrain"]["peak_bytes"] > 0
+    assert s["phases"]["retrain"]["samples"] >= 1
+    assert s["source"] in ("device", "host_rss")
+    assert s["peak_bytes"] and s["peak_bytes"] >= \
+        s["phases"]["retrain"]["peak_bytes"] - 1
+
+
+def test_hbm_lands_in_profile_json(tmp_path):
+    d = tmp_path / "obs"
+    _run_profiled(d, every=3, window=2, steps=7)
+    hbm = json.load(open(d / "profile.json"))["hbm"]
+    assert hbm["phases"], "no per-phase watermarks"
+    assert hbm["peak_bytes"] > 0
+    md = format_profile(json.load(open(d / "profile.json")))
+    assert "HBM watermark" in md
+
+
+def test_hbm_sampler_throttles():
+    sampler = HbmSampler()
+    for i in range(50):
+        sampler.on_event({"event": "span_begin", "name": "x", "ts": i})
+    assert len(sampler.timeline) <= 2  # min-interval throttle
+
+
+# -- serve SLO monitor -------------------------------------------------------
+
+
+def test_slo_monitor_counts_breach_episodes(tmp_path):
+    from torchpruner_tpu.serve.slo import SLOMonitor
+
+    d = tmp_path / "obs"
+    obs.configure(str(d), annotate=False, watch_compiles=False)
+    mon = SLOMonitor(ttft_p99_s=0.010, token_p99_s=None, window=64,
+                     check_every_steps=1, min_samples=4)
+    for _ in range(8):
+        mon.on_ttft(0.002)
+    mon.maybe_check(1)
+    assert mon.breaches_total == 0
+    for _ in range(8):
+        mon.on_ttft(0.050)  # sustained breach
+    mon.maybe_check(2)
+    mon.maybe_check(3)  # still in breach: same episode, not a new count
+    assert mon.breaches_total == 1
+    assert obs.counter_value("serve_slo_breach_total") == 1
+    assert obs.counter_value("serve_slo_breach_ttft_total") == 1
+    assert mon.rolling["ttft"] > 0.010
+    for _ in range(64):
+        mon.on_ttft(0.001)  # recovery refills the window
+    mon.maybe_check(4)
+    assert not mon._in_breach["ttft"]
+    for _ in range(64):
+        mon.on_ttft(0.050)
+    mon.maybe_check(5)
+    assert mon.breaches_total == 2  # re-armed -> new episode
+    snap = mon.snapshot()
+    assert snap["breaches_total"] == 2
+    assert snap["thresholds_ms"]["ttft"] == 10.0
+    obs.shutdown()
+    # the breach is ledgered as serve provenance
+    rep = load_run(str(d))
+    breaches = [r for r in rep.get("serve", [])
+                if r.get("kind") == "slo_breach"]
+    assert breaches and breaches[0]["metric"] == "ttft"
+    assert breaches[0]["threshold_s"] == pytest.approx(0.010)
+
+
+def test_slo_monitor_gauges_exported():
+    from torchpruner_tpu.serve.slo import SLOMonitor
+
+    session = obs.configure(None)
+    mon = SLOMonitor(window=32, check_every_steps=1)
+    for _ in range(4):
+        mon.on_token(0.003)
+    mon.check(1)
+    g = session.metrics.get("serve_token_p99_rolling_s")
+    assert g is not None and g.value == pytest.approx(0.003, rel=0.2)
+    obs.shutdown()

@@ -6,6 +6,7 @@ HTTP front end."""
 import json
 import os
 import threading
+import time
 
 import numpy as np
 import jax
@@ -527,10 +528,96 @@ def test_http_endpoint_roundtrip():
         stats = json.load(urllib.request.urlopen(
             f"http://127.0.0.1:{port}/stats", timeout=10))
         assert stats["gen_tokens"] >= 6
+        # occupancy/utilization gauges ride /stats (idle engine -> 0)
+        assert 0.0 <= stats["kv_page_occupancy"] <= 1.0
+        assert 0.0 <= stats["slot_utilization"] <= 1.0
+        assert stats["kv_page_budget"] > 0
+        # no obs session in this test: /metrics degrades to 503, and
+        # POST /profile reports it cannot arm a window
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10)
+            raise AssertionError("expected 503 without a session")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        try:
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/profile", data=b"",
+                method="POST"), timeout=10)
+            raise AssertionError("expected 409 without a profiler")
+        except urllib.error.HTTPError as e:
+            assert e.code == 409
+            assert json.load(e)["armed"] is False
     finally:
         stop.set()
         server.shutdown()
         loop.join(timeout=30)
+
+
+def test_http_metrics_endpoint_with_session(tmp_path):
+    """GET /metrics serves the live Prometheus exposition (same format
+    as metrics.prom) and POST /profile arms an on-demand capture window
+    when a session with a profiler is active."""
+    import urllib.request
+
+    from torchpruner_tpu.serve.frontend import _http_server
+    from torchpruner_tpu.serve.slo import SLOMonitor
+
+    session = obs.configure(str(tmp_path / "obs"))
+    try:
+        model = llama_tiny()
+        params, _ = init_model(model, seed=0)
+        eng = ServeEngine(model, params, n_slots=2, max_len=64)
+        eng.slo = SLOMonitor(ttft_p99_s=1.0, window=32,
+                             check_every_steps=1)
+        server = _http_server(eng, 0, request_timeout_s=120.0)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        stop = threading.Event()
+        loop = threading.Thread(
+            target=lambda: eng.run(stop_event=stop), daemon=True)
+        loop.start()
+        try:
+            body = json.dumps({"prompt_ids": [3, 1, 4],
+                               "max_new": 4}).encode()
+            out = json.load(urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/generate", data=body,
+                headers={"Content-Type": "application/json"}),
+                timeout=120))
+            assert out["state"] == "done"
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10).read()
+            text = text.decode()
+            assert "# TYPE serve_ttft_seconds histogram" in text
+            assert "serve_slot_utilization" in text
+            assert "serve_kv_page_occupancy" in text
+            assert "serve_ttft_p99_rolling_s" in text  # SLO gauge live
+            armed = json.load(urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://127.0.0.1:{port}/profile", data=b"",
+                    method="POST"), timeout=10))
+            assert armed["armed"] is True
+            # the engine thread opens the window; start_trace's first
+            # call can take seconds (profiler session init) — poll
+            # until it becomes observable (armed -> opening -> open)
+            prof = session.profiler
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if prof.active or prof.windows or prof._failed:
+                    break
+                time.sleep(0.05)
+            assert not prof._failed
+            assert prof.active or prof.windows
+            stats = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/stats", timeout=10))
+            assert stats["slo"]["breaches_total"] == 0
+            assert stats["slo"]["thresholds_ms"]["ttft"] == 1000.0
+        finally:
+            stop.set()
+            server.shutdown()
+            loop.join(timeout=30)
+    finally:
+        obs.shutdown()
 
 
 def test_poisson_arrivals_seeded_and_monotone():

@@ -13,6 +13,9 @@ Examples::
     python -m torchpruner_tpu --lint my_experiment.json --lint-plan plan.json
     python -m torchpruner_tpu serve llama3_ffn_taylor --smoke --synthetic 16
     python -m torchpruner_tpu obs report logs/obs
+    python -m torchpruner_tpu --preset mnist_mlp_shapley --smoke \\
+        --obs-dir logs/obs --profile-every 20
+    python -m torchpruner_tpu obs profile logs/obs
 """
 
 from __future__ import annotations
@@ -94,6 +97,18 @@ def main(argv=None) -> int:
              "metrics, no compile accounting, no summary)",
     )
     p.add_argument(
+        "--profile-every", metavar="N", type=int, default=None,
+        help="with --obs-dir: continuous kernel profiling — open a "
+             "jax.profiler capture window every N recorded steps; the "
+             "windows land in <obs-dir>/profile/ and render with "
+             "`obs profile <obs-dir>` (ranked per-kernel step-time "
+             "table, roofline positions, HBM watermarks)",
+    )
+    p.add_argument(
+        "--profile-steps", metavar="K", type=int, default=None,
+        help="steps per capture window (default 3)",
+    )
+    p.add_argument(
         "--dump-config", metavar="PATH",
         help="write the resolved config JSON to PATH and exit",
     )
@@ -124,6 +139,9 @@ def main(argv=None) -> int:
         p.error("--lint-plan only makes sense together with --lint")
     if args.obs_dir and args.no_obs:
         p.error("--obs-dir and --no-obs are mutually exclusive")
+    if args.profile_every is not None and not args.obs_dir:
+        p.error("--profile-every needs --obs-dir (the capture windows "
+                "live under it)")
 
     if args.list:
         from torchpruner_tpu.experiments.presets import PRESETS
@@ -216,7 +234,8 @@ def main(argv=None) -> int:
     if not args.no_obs:
         from torchpruner_tpu import obs
 
-        obs.configure(args.obs_dir)
+        obs.configure(args.obs_dir, profile_every=args.profile_every,
+                      profile_steps=args.profile_steps)
         obs.annotate_run(experiment=cfg.name, kind=cfg.experiment,
                          model=cfg.model, method=cfg.method,
                          resumed=bool(args.resume))

@@ -72,6 +72,13 @@ def _measure(fn, q, k, v, *, iters: int = 5, warmup: int = 2,
                         chained=True)
         out["ms"] = round(steady_s(stats) * 1e3, 3)
         out["ms_fenced_p50"] = round(stats["p50_s"] * 1e3, 3)
+        # one post-timing capture window: top-5 kernel rows per sweep
+        # point — op-level evidence for the block-size retune (ROADMAP
+        # item 2); degrades to no row on failure
+        from torchpruner_tpu.obs.profile import OneShotCapture
+
+        with OneShotCapture(out, steps=1):
+            jax.block_until_ready(compiled(q, k, v))
     except Exception as e:  # noqa: BLE001 - runtime OOM IS data
         out["error"] = f"{type(e).__name__}: {e}"[:300]
     return out

@@ -69,6 +69,7 @@ __all__ = [
     "current_span_id", "record_step", "record_grad_norm",
     "configure_step_flops", "record_capture", "capture_counts",
     "inc", "observe", "gauge_set", "counter_value",
+    "request_profile_window", "profile_tick", "profile_step",
     "record_scores", "record_prune", "record_round", "record_epoch",
     "record_sweep_layer", "record_serve", "ledger_backfill",
     "annotate_run",
@@ -80,13 +81,29 @@ __all__ = [
 
 EVENTS_FILENAME = "events.jsonl"
 PROM_FILENAME = "metrics.prom"
+PROFILE_DIRNAME = "profile"
+PROFILE_FILENAME = "profile.json"
 
 #: env override for event-stream rotation (bytes; 0 = off).  Kept as an
 #: env rather than a config field so long-running drivers can cap the
 #: stream without a code change.
 ROTATE_ENV = "TORCHPRUNER_OBS_ROTATE_BYTES"
 
+#: env defaults for the continuous profiler (capture a window every N
+#: recorded steps / steps per window) — the knobs also exposed as
+#: ``configure(profile_every=..., profile_steps=...)`` and the CLI's
+#: ``--profile-every`` / ``--profile-steps``.
+PROFILE_EVERY_ENV = "TORCHPRUNER_PROFILE_EVERY"
+PROFILE_STEPS_ENV = "TORCHPRUNER_PROFILE_STEPS"
+
 _session: Optional["ObsSession"] = None
+
+
+def _env_int(name: str, default: int = 0) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
 
 
 class ObsSession:
@@ -96,7 +113,9 @@ class ObsSession:
     def __init__(self, obs_dir: Optional[str] = None,
                  process_index: Optional[int] = None,
                  annotate: bool = True, watch_compiles: bool = True,
-                 rotate_bytes: Optional[int] = None):
+                 rotate_bytes: Optional[int] = None,
+                 profile_every: Optional[int] = None,
+                 profile_steps: Optional[int] = None):
         self.obs_dir = obs_dir
         self._process_index = process_index
         self._closed = False
@@ -105,11 +124,16 @@ class ObsSession:
         self.run_meta: Dict[str, Any] = {}
         self.events: Optional[JsonlWriter] = None
         self.ledger: Optional[ProvenanceRecorder] = None
+        self.profiler = None
+        self.hbm = None
+        self.profile: Optional[Dict[str, Any]] = None
+        self.param_bytes: Optional[float] = None
         if rotate_bytes is None:
-            try:
-                rotate_bytes = int(os.environ.get(ROTATE_ENV, "0") or 0)
-            except ValueError:
-                rotate_bytes = 0
+            rotate_bytes = _env_int(ROTATE_ENV, 0)
+        if profile_every is None:
+            profile_every = _env_int(PROFILE_EVERY_ENV, 0)
+        if profile_steps is None:
+            profile_steps = _env_int(PROFILE_STEPS_ENV, 0) or 3
         if obs_dir and self.is_emitter:
             # a NEW session invalidates any previous session's metric
             # shards (they are written at close; anything on disk now is
@@ -124,6 +148,24 @@ class ObsSession:
                                       rotate_bytes=rotate_bytes)
             self.ledger = ProvenanceRecorder(obs_dir)
         self.tracer = SpanTracer(sink=self.events, annotate=annotate)
+        if obs_dir and self.is_emitter:
+            # continuous profiling: the profiler exists whenever the
+            # session has a dir (on-demand windows via
+            # request_profile_window / the serve endpoint need it even
+            # at cadence 0); the HBM sampler rides the span stream
+            from torchpruner_tpu.obs.profile import (
+                ContinuousProfiler,
+                HbmSampler,
+            )
+
+            self.profiler = ContinuousProfiler(
+                os.path.join(obs_dir, PROFILE_DIRNAME),
+                every_steps=profile_every, window_steps=profile_steps,
+                emit=self.events, tracer=self.tracer)
+            # samples stay in memory (-> profile.json's hbm timeline);
+            # the span stream keeps its span_begin/span_end-only schema
+            self.hbm = HbmSampler(emit=None)
+            self.tracer.extra_sink = self.hbm.on_event
         self.step = StepTelemetry(self.metrics)
         self.compiles = CompileWatcher(self.metrics, self.tracer)
         if watch_compiles:
@@ -133,6 +175,27 @@ class ObsSession:
                 "event": "obs_init", "ts": time.time(), "pid": os.getpid(),
                 "process_index": self.process_index,
             })
+
+    def clear_stale_profile(self) -> None:
+        """Invalidate a previous run's capture windows in a reused obs
+        dir (the same new-session semantics the metric shards get) —
+        called by :func:`configure` AFTER the old session closed, never
+        from the constructor: windows live on disk DURING a run, so a
+        wipe-before-close would destroy the outgoing session's evidence
+        right before its ``_finalize_profile`` parses it."""
+        if self.profiler is None or self.profiler.windows \
+                or self.profiler.active:
+            return
+        import shutil
+
+        try:
+            shutil.rmtree(os.path.join(self.obs_dir, PROFILE_DIRNAME),
+                          ignore_errors=True)
+            path = os.path.join(self.obs_dir, PROFILE_FILENAME)
+            if os.path.exists(path):
+                os.remove(path)
+        except OSError:
+            pass
 
     # -- multi-host gate ---------------------------------------------------
 
@@ -170,6 +233,8 @@ class ObsSession:
         (already closed) event file."""
         self.compiles.stop()
         already_closed, self._closed = self._closed, True
+        if not already_closed:
+            self._finalize_profile()      # kernel gauges BEFORE export
         derived = self.derived()          # writes derived gauges
         record_device_memory(self.metrics)
         text = summary_table(
@@ -224,6 +289,56 @@ class ObsSession:
             self.ledger.close()
         return text
 
+    def _finalize_profile(self) -> None:
+        """Close any open capture window, parse the windows into the
+        ranked kernel table, install the ``kernel_*`` gate gauges, and
+        write ``profile.json`` — all best-effort, all BEFORE the metric
+        shard ships (the gauges must ride the merge into report.json)."""
+        if self.profiler is None:
+            return
+        try:
+            from torchpruner_tpu.obs.profile import (
+                build_profile,
+                kernel_gauges,
+            )
+
+            windows = self.profiler.close()
+            if not windows and self.hbm is not None \
+                    and not self.hbm.timeline:
+                return
+            peak_flops = peak_bw = None
+            try:
+                import jax
+
+                from torchpruner_tpu.utils import flops as _flops
+
+                dev = jax.local_devices()[0]
+                peak_flops = self.step.peak_flops \
+                    or _flops.peak_bf16_flops(dev)
+                peak_bw = _flops.peak_hbm_bw(dev)
+            except Exception:
+                peak_flops = self.step.peak_flops
+            self.profile = build_profile(
+                windows,
+                flops_per_step=self.step.flops_per_step,
+                param_bytes=self.param_bytes,
+                peak_flops=peak_flops, peak_bw=peak_bw,
+                hbm=(self.hbm.summary() if self.hbm is not None
+                     else None),
+                telemetry_step_s=self.step.derive().get(
+                    "step_time_p50_s"))
+            kernel_gauges(self.profile, self.metrics)
+            from torchpruner_tpu.obs.ledger import sanitize
+            from torchpruner_tpu.resilience.manifest import (
+                atomic_write_json,
+            )
+
+            atomic_write_json(
+                os.path.join(self.obs_dir, PROFILE_FILENAME),
+                sanitize(self.profile), indent=1)
+        except Exception:
+            self.profile = self.profile or None
+
     def _export_artifacts(self, merged, derived) -> None:
         """trace.json (Perfetto) + report.json (ledger bundle) — each
         best-effort; a failing exporter must never fail the run."""
@@ -232,7 +347,8 @@ class ObsSession:
 
         try:
             trace_export.write_trace(
-                os.path.join(self.obs_dir, EVENTS_FILENAME))
+                os.path.join(self.obs_dir, EVENTS_FILENAME),
+                profile_dir=os.path.join(self.obs_dir, PROFILE_DIRNAME))
         except Exception:
             pass
         try:
@@ -244,6 +360,7 @@ class ObsSession:
                 compiles=self.compiles.counts(),
                 metrics=merged.snapshot(),
                 wall_s=round(time.perf_counter() - self.t_start, 6),
+                profile=self.profile,
             )
             ledger_mod.write_report(
                 report,
@@ -258,19 +375,29 @@ class ObsSession:
 def configure(obs_dir: Optional[str] = None, *,
               process_index: Optional[int] = None, annotate: bool = True,
               watch_compiles: bool = True,
-              rotate_bytes: Optional[int] = None) -> ObsSession:
+              rotate_bytes: Optional[int] = None,
+              profile_every: Optional[int] = None,
+              profile_steps: Optional[int] = None) -> ObsSession:
     """Install the process-wide session (replacing any previous one).
     The new session is constructed BEFORE the old one is torn down, so a
     failing constructor (e.g. unwritable ``obs_dir``) leaves the previous
     session installed and intact.  ``rotate_bytes`` caps the event
     stream (size-based rotation to ``events.jsonl.1`` …; default off,
-    env ``TORCHPRUNER_OBS_ROTATE_BYTES``)."""
+    env ``TORCHPRUNER_OBS_ROTATE_BYTES``).  ``profile_every`` opens a
+    ``profile_steps``-step ``jax.profiler`` capture window every N
+    recorded steps (0/None = on-demand only; envs
+    ``TORCHPRUNER_PROFILE_EVERY`` / ``TORCHPRUNER_PROFILE_STEPS``) —
+    see ``obs.profile``."""
     global _session
     new = ObsSession(obs_dir, process_index=process_index,
                      annotate=annotate, watch_compiles=watch_compiles,
-                     rotate_bytes=rotate_bytes)
+                     rotate_bytes=rotate_bytes,
+                     profile_every=profile_every,
+                     profile_steps=profile_steps)
     if _session is not None:
         _session.close()
+    # only after the old session exported its own windows/profile.json
+    new.clear_stale_profile()
     _session = new
     return new
 
@@ -312,6 +439,39 @@ def record_step(dt_s: float, examples: int, tokens: Optional[int] = None,
     s = _session
     if s is not None:
         s.step.on_step(dt_s, examples, tokens, steps)
+        if s.profiler is not None:
+            # capture-window state machine: one increment + compare when
+            # no window is open or armed (obs.profile.capture)
+            s.profiler.on_step(dt_s)
+
+
+def request_profile_window() -> bool:
+    """Arm one on-demand profiler capture window (the serve frontend's
+    ``POST /profile``, manual driver hooks); it opens at the next step
+    boundary.  False without a session/profiler or when a window is
+    already open/armed."""
+    s = _session
+    if s is None or s.profiler is None:
+        return False
+    return s.profiler.request_window()
+
+
+def profile_tick() -> None:
+    """A non-step loop boundary for the profiler (an idle serving
+    engine's loop) — lets on-demand windows open and stale windows
+    close when no training steps are flowing.  No-op otherwise."""
+    s = _session
+    if s is not None and s.profiler is not None:
+        s.profiler.tick()
+
+
+def profile_step(dt_s: float = 0.0) -> None:
+    """Drive the profiler's capture cadence from a non-training step
+    (a serving engine's decode step) WITHOUT recording it into the
+    train step telemetry.  No-op without a session/profiler."""
+    s = _session
+    if s is not None and s.profiler is not None:
+        s.profiler.on_step(dt_s)
 
 
 def record_grad_norm(gnorm) -> None:
@@ -509,14 +669,19 @@ def runtime_snapshot() -> Dict[str, Any]:
 
 
 def configure_step_flops(flops_per_step: Optional[float] = None,
-                         peak_flops: Optional[float] = None):
+                         peak_flops: Optional[float] = None,
+                         param_bytes: Optional[float] = None):
     """Give the step telemetry its MFU denominators (training FLOPs per
     step and the chip's spec-sheet peak).  When ``peak_flops`` is omitted,
     the first local device's bf16 peak is looked up (None off-TPU —
-    MFU then stays unreported rather than guessed)."""
+    MFU then stays unreported rather than guessed).  ``param_bytes``
+    (live parameter bytes) feeds the profile subsystem's per-kernel
+    weight-traffic byte estimates (obs.profile.kernels)."""
     s = _session
     if s is None:
         return
+    if param_bytes is not None:
+        s.param_bytes = float(param_bytes)
     if peak_flops is None:
         try:
             import jax

@@ -13,11 +13,56 @@ keeps exactly one listener registered between :meth:`start` and
 :meth:`stop` and guards double-starts; the monitoring module is private
 (``jax._src.monitoring``), so every touch is wrapped — on a JAX version
 without it the watcher degrades to inert counters instead of failing.
+
+**Per-executable attribution**: the monitoring events carry no function
+name, but the dispatch logger's companion message ("Finished XLA
+compilation of {fun_name} in {t} sec") does — so the watcher also
+attaches a logging handler to ``jax._src.dispatch`` (lowering its level
+to DEBUG for the session, restored on :meth:`stop`; the root handler's
+WARNING threshold keeps the records off the console) and parses the
+name out.  That turns "18 s went to the compiler" into "14 s of it was
+``jit(train_step)``, recompiled 3×" — surfaced as the top-compilers
+table in ``obs report``.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+import logging
+import re
+from typing import Callable, Dict, Optional
+
+#: the jax logger whose messages name the compiled executable
+_DISPATCH_LOGGER = "jax._src.dispatch"
+
+_COMPILE_MSG = re.compile(
+    r"Finished XLA compilation of (.+?) in ([0-9.eE+-]+) sec")
+
+
+class _CompileLogHandler(logging.Handler):
+    """Parses executable names + compile seconds out of the dispatch
+    logger's messages into ``watcher.by_executable``."""
+
+    def __init__(self, sink: Dict[str, Dict[str, float]]):
+        super().__init__(level=logging.DEBUG)
+        self.sink = sink
+
+    def emit(self, record: logging.LogRecord) -> None:  # never raises
+        try:
+            m = _COMPILE_MSG.match(record.getMessage())
+            if m:
+                name, secs = m.group(1), float(m.group(2))
+                agg = self.sink.setdefault(name,
+                                           {"count": 0, "seconds": 0.0})
+                agg["count"] += 1
+                agg["seconds"] += secs
+            # propagation is off while attached (the DEBUG level we
+            # forced would spam the console) — records that were visible
+            # BEFORE (jax_log_compiles logs at WARNING) still reach the
+            # root handlers
+            if record.levelno >= logging.WARNING:
+                logging.getLogger().handle(record)
+        except Exception:
+            pass
 
 #: monitoring event key → (kind charged to spans, counter name)
 _EVENTS = {
@@ -38,6 +83,11 @@ class CompileWatcher:
         self.registry = registry
         self.tracer = tracer
         self._listener: Optional[Callable] = None
+        #: executable name -> {"count", "seconds"} (dispatch-logger
+        #: attribution; empty when the logger path is unavailable)
+        self.by_executable: Dict[str, Dict[str, float]] = {}
+        self._log_handler: Optional[_CompileLogHandler] = None
+        self._log_prior_level: Optional[int] = None
         for _, cname, sname in _EVENTS.values():
             registry.counter(cname)
             registry.counter(sname)
@@ -45,6 +95,7 @@ class CompileWatcher:
     def start(self):
         if self._listener is not None:
             return
+        self._start_log_attribution()
         try:
             from jax._src import monitoring
         except Exception:
@@ -66,7 +117,33 @@ class CompileWatcher:
         except Exception:
             self._listener = None
 
+    def _start_log_attribution(self):
+        if self._log_handler is not None:
+            return
+        try:
+            logger = logging.getLogger(_DISPATCH_LOGGER)
+            self._log_handler = _CompileLogHandler(self.by_executable)
+            self._log_prior_level = logger.level
+            self._log_prior_propagate = logger.propagate
+            if not logger.isEnabledFor(logging.DEBUG):
+                logger.setLevel(logging.DEBUG)
+            logger.propagate = False  # handler forwards WARNING+ itself
+            logger.addHandler(self._log_handler)
+        except Exception:
+            self._log_handler = None
+
     def stop(self):
+        if self._log_handler is not None:
+            try:
+                logger = logging.getLogger(_DISPATCH_LOGGER)
+                logger.removeHandler(self._log_handler)
+                if self._log_prior_level is not None:
+                    logger.setLevel(self._log_prior_level)
+                logger.propagate = getattr(
+                    self, "_log_prior_propagate", True)
+            except Exception:
+                pass
+            self._log_handler = None
         if self._listener is None:
             return
         try:
@@ -79,10 +156,19 @@ class CompileWatcher:
             pass
         self._listener = None
 
+    def top_compilers(self, n: int = 5) -> list:
+        """The executables that paid the most compile seconds:
+        ``[{"name", "count", "seconds"}, ...]``, most expensive first."""
+        rows = [{"name": name, "count": int(v["count"]),
+                 "seconds": round(v["seconds"], 3)}
+                for name, v in self.by_executable.items()]
+        rows.sort(key=lambda r: -r["seconds"])
+        return rows[:n]
+
     def counts(self) -> dict:
         """Current totals, rounded for reporting."""
         g = self.registry.counter
-        return {
+        out = {
             "compile_count": int(g("compile_count_total").value),
             "compile_s": round(g("compile_seconds_total").value, 3),
             "trace_count": int(g("trace_count_total").value),
@@ -90,3 +176,6 @@ class CompileWatcher:
             "lower_count": int(g("lower_count_total").value),
             "lower_s": round(g("lower_seconds_total").value, 3),
         }
+        if self.by_executable:
+            out["by_executable"] = self.top_compilers()
+        return out

@@ -296,15 +296,24 @@ def build_report(*, run_meta: Optional[Dict[str, Any]] = None,
                  phases: Optional[Dict[str, Any]] = None,
                  compiles: Optional[Dict[str, Any]] = None,
                  metrics: Optional[Dict[str, float]] = None,
-                 wall_s: Optional[float] = None) -> Dict[str, Any]:
+                 wall_s: Optional[float] = None,
+                 profile: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
     """Assemble the ``report.json`` payload — ONE schema whether built
     live at session close or reconstructed offline by ``obs report``
-    from ``ledger.jsonl`` + ``events.jsonl``."""
+    from ``ledger.jsonl`` + ``events.jsonl``.  ``profile`` is the
+    kernel-profiling payload (obs.profile) minus its bulky raw timeline
+    — profile.json keeps the full record."""
     records = records or []
 
     def picked(ev):
         return [r for r in records if r.get("event") == ev]
 
+    prof = None
+    if profile:
+        prof = {k: v for k, v in profile.items() if k != "hbm"}
+        hbm = profile.get("hbm") or {}
+        prof["hbm"] = {k: v for k, v in hbm.items() if k != "timeline"}
     return {
         "version": REPORT_VERSION,
         "written_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
@@ -320,6 +329,7 @@ def build_report(*, run_meta: Optional[Dict[str, Any]] = None,
         "compiles": dict(compiles or {}),
         "metrics": dict(metrics or {}),
         "wall_s": wall_s,
+        **({"profile": prof} if prof else {}),
     }
 
 

@@ -1,0 +1,278 @@
+"""Programmatic ``jax.profiler`` capture windows on a step cadence.
+
+:class:`ContinuousProfiler` owns the capture state machine of one obs
+session.  Two ways a window opens:
+
+- **cadence** — ``every_steps > 0``: every N recorded steps, the next
+  step boundary starts a ``window_steps``-step capture (the
+  ``obs.record_step`` hot path ticks the profiler: one int compare when
+  idle, so instrumented loops pay nothing between windows);
+- **on-demand** — :meth:`request_window` (the ``obs profile``-era CLI
+  flag, the serve frontend's ``POST /profile``): the next step boundary
+  opens one window regardless of cadence.  With no step loop running
+  (an idle serving engine), :meth:`tick` from any loop boundary works
+  the same.
+
+The capture itself is ``jax.profiler.start_trace`` /``stop_trace`` —
+start is cheap (enables the collector); stop serializes the trace to
+the window dir.  Both run at a step boundary on the caller's thread:
+the stop cost is real but bounded by the window length, charged to a
+``profile_capture`` span so it shows up attributed instead of smearing
+into the next step's time.  The step loop itself is never paused —
+steps inside a window run exactly as outside it.
+
+Each window lands in ``<dir>/window_<k>/`` with a ``window.json``
+sidecar (steps covered, their summed step-seconds from the telemetry
+stopwatch, wall timestamps) — what joins the trace's op table back to
+the span stream and lets ``kernel_table`` normalize per step.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+WINDOW_META = "window.json"
+
+#: hard cap on windows per session — continuous profiling must bound
+#: its disk/parse cost even on week-long runs (oldest evidence wins;
+#: raise via ContinuousProfiler(max_windows=...))
+DEFAULT_MAX_WINDOWS = 16
+
+
+class ContinuousProfiler:
+    """See module docstring.  ``emit`` is an optional ``callable(dict)``
+    (the session's JSONL event writer) that receives
+    ``profile_window_begin`` / ``profile_window_end`` markers."""
+
+    def __init__(self, profile_dir: str, *, every_steps: int = 0,
+                 window_steps: int = 3,
+                 max_windows: int = DEFAULT_MAX_WINDOWS,
+                 emit=None, tracer=None):
+        self.profile_dir = profile_dir
+        self.every_steps = max(0, int(every_steps))
+        self.window_steps = max(1, int(window_steps))
+        self.max_windows = max(1, int(max_windows))
+        self.emit = emit
+        self.tracer = tracer
+        #: closed windows: {"index","dir","steps","step_seconds",
+        #: "t_start_unix","wall_s","on_demand"}
+        self.windows: List[Dict[str, Any]] = []
+        self._steps_seen = 0
+        self._want_window = False
+        self._open: Optional[Dict[str, Any]] = None
+        self._failed = False  # a start_trace failure disables profiling
+
+    # -- the step hook (hot path) -------------------------------------------
+
+    def on_step(self, dt_s: float = 0.0) -> None:
+        """One recorded step.  Opens/advances/closes windows at step
+        boundaries; between windows it is one increment + compare."""
+        self._steps_seen += 1
+        if self._open is not None:
+            self._open["steps"] += 1
+            self._open["step_seconds"] += float(dt_s or 0.0)
+            self._open["step_times"].append(round(float(dt_s or 0.0), 9))
+            if self._open["steps"] >= self._open["target_steps"]:
+                self._stop_window()
+            return
+        if self._want_window:
+            self._start_window(on_demand=True)
+            return
+        if self.every_steps and len(self.windows) < self.max_windows \
+                and self._steps_seen % self.every_steps == 0:
+            self._start_window(on_demand=False)
+
+    def tick(self) -> None:
+        """A loop boundary that is not a step (an idle serving engine):
+        lets an on-demand request open — and a stale window close — even
+        when no steps are flowing."""
+        if self._open is not None:
+            # no steps arrived; close once the wall budget is well past
+            # (window_steps at 1 s/step is a generous idle bound)
+            if time.perf_counter() - self._open["t_mono"] \
+                    > max(1.0, self.window_steps):
+                self._stop_window()
+        elif self._want_window:
+            self._start_window(on_demand=True)
+
+    def request_window(self) -> bool:
+        """Arm one on-demand window (CLI / serve endpoint).  Returns
+        False when a window is already open/armed, the session's
+        window cap is reached, or profiling is disabled by an earlier
+        failure — a True MUST mean a capture will actually happen."""
+        if self._failed or self._open is not None or self._want_window \
+                or len(self.windows) >= self.max_windows:
+            return False
+        self._want_window = True
+        return True
+
+    @property
+    def active(self) -> bool:
+        return self._open is not None
+
+    # -- window lifecycle ---------------------------------------------------
+
+    def _span(self, name, **meta):
+        import contextlib
+
+        if self.tracer is None:
+            return contextlib.nullcontext()
+        return self.tracer.span(name, **meta)
+
+    def _start_window(self, on_demand: bool) -> None:
+        self._want_window = False
+        if self._failed or len(self.windows) >= self.max_windows:
+            return
+        index = len(self.windows)
+        wdir = os.path.join(self.profile_dir, f"window_{index:03d}")
+        try:
+            import jax
+
+            os.makedirs(wdir, exist_ok=True)
+            with self._span("profile_capture", window=index, edge="start"):
+                jax.profiler.start_trace(wdir)
+        except Exception:
+            # another trace already active (--profile), or an unwritable
+            # dir: disable rather than retry-fail every N steps
+            self._failed = True
+            return
+        self._open = {
+            "index": index, "dir": wdir, "steps": 0, "step_seconds": 0.0,
+            "step_times": [], "target_steps": self.window_steps,
+            "t_start_unix": time.time(), "t_mono": time.perf_counter(),
+            "on_demand": on_demand,
+        }
+        self._emit_marker("profile_window_begin", self._open)
+
+    def _stop_window(self) -> None:
+        w, self._open = self._open, None
+        if w is None:
+            return
+        try:
+            import jax
+
+            with self._span("profile_capture", window=w["index"],
+                            edge="stop"):
+                jax.profiler.stop_trace()
+        except Exception:
+            pass  # keep whatever the collector already flushed
+        w["wall_s"] = round(time.perf_counter() - w.pop("t_mono"), 6)
+        w.pop("target_steps", None)
+        self.windows.append(w)
+        try:
+            with open(os.path.join(w["dir"], WINDOW_META), "w") as f:
+                json.dump({k: v for k, v in w.items() if k != "dir"}, f)
+        except OSError:
+            pass
+        self._emit_marker("profile_window_end", w)
+
+    def _emit_marker(self, event: str, w: Dict[str, Any]) -> None:
+        if self.emit is None:
+            return
+        try:
+            self.emit({
+                "event": event, "ts": time.time(), "window": w["index"],
+                "steps": w.get("steps", 0), "on_demand": w["on_demand"],
+            })
+        except Exception:
+            pass
+
+    def close(self) -> List[Dict[str, Any]]:
+        """Stop any open window; returns the closed-window records."""
+        if self._open is not None:
+            self._stop_window()
+        return self.windows
+
+
+class OneShotCapture:
+    """One profiler capture window around an already-measured workload,
+    writing the top-N per-kernel rows (``kernels.top_rows``) into
+    ``row["kernels"]`` — how the bench legs and ``flash_sweep`` attach
+    op-level evidence next to their headline timings.  Runs AFTER the
+    timed section so trace overhead never pollutes the timing; any
+    failure (a trace already active under ``--profile``, parse errors)
+    degrades to no row, never an error.  ``steps`` may be reassigned
+    inside the block (``win.steps = engine.steps - steps0``) when the
+    step count is only known afterwards::
+
+        with OneShotCapture(result, steps=K):
+            fn()          # one representative dispatch, fenced
+    """
+
+    def __init__(self, row: Dict[str, Any], steps: int = 1, top: int = 5,
+                 flops_per_step: Optional[float] = None,
+                 key: str = "kernels"):
+        self.row, self.steps, self.top = row, max(1, steps), top
+        self.flops_per_step = flops_per_step
+        self.key = key
+        self._dir: Optional[str] = None
+
+    def __enter__(self) -> "OneShotCapture":
+        import shutil
+        import tempfile
+
+        try:
+            import jax
+
+            self._dir = tempfile.mkdtemp(prefix="kernel_capture_")
+            jax.profiler.start_trace(self._dir)
+        except Exception:
+            # start failed (another trace active under --profile): the
+            # tmpdir must not leak — one per bench leg / sweep point
+            if self._dir is not None:
+                shutil.rmtree(self._dir, ignore_errors=True)
+            self._dir = None
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        import shutil
+
+        if self._dir is None:
+            return False
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        try:
+            if exc_type is None:
+                from torchpruner_tpu.obs.profile.kernels import top_rows
+
+                rows = top_rows(self._dir, steps=max(1, int(self.steps)),
+                                top=self.top,
+                                flops_per_step=self.flops_per_step)
+                if rows:
+                    self.row[self.key] = rows
+        except Exception:  # profiling must never fail the measurement
+            pass
+        finally:
+            shutil.rmtree(self._dir, ignore_errors=True)
+        return False
+
+
+def scan_windows(profile_dir: str) -> List[Dict[str, Any]]:
+    """Rebuild window records from ``window_*/window.json`` sidecars (or
+    bare window dirs, for a run killed before the sidecar landed) — the
+    offline path ``obs profile`` uses when the session never closed."""
+    out: List[Dict[str, Any]] = []
+    for wdir in sorted(glob.glob(os.path.join(profile_dir, "window_*"))):
+        if not os.path.isdir(wdir):
+            continue
+        rec: Dict[str, Any] = {"dir": wdir, "steps": 0,
+                               "step_seconds": 0.0, "step_times": [],
+                               "on_demand": False, "index": len(out)}
+        meta = os.path.join(wdir, WINDOW_META)
+        if os.path.exists(meta):
+            try:
+                with open(meta) as f:
+                    rec.update(json.load(f))
+            except (OSError, ValueError):
+                pass
+        rec["dir"] = wdir
+        out.append(rec)
+    return out

@@ -31,6 +31,15 @@ Scalar gates read the run-level diff; ``round_*`` and
 ``missing_rounds`` fires when run B lost rounds run A had.  Unknown
 gate names are themselves violations — a typo must not silently
 disable a gate.
+
+Per-kernel gates (the profile subsystem, ``obs.profile``) are scalar
+gates over dynamic names: ``kernel_<base>_ms`` (ms per step) and
+``kernel_<base>_pct`` (share of attributed op time), e.g.
+``"kernel_dot_ms": {"max_increase_pct": 60}`` — which fails a run whose
+matmul kernel regressed even when the total-step gate stays green.  A
+scalar present in only one run renders as an informational "not
+comparable" row and is skipped by gates unless the spec sets
+``"require": true``.
 """
 
 from __future__ import annotations
@@ -70,6 +79,21 @@ _SCALARS = {
     "serve_tokens_per_s": "higher",
     "serve_completed": "same",
 }
+
+#: dynamic scalar families: any metric matching one of these prefixes
+#: participates in diff/gating even though its exact name depends on
+#: the run (per-kernel scalars are named after the compiled ops)
+_DYNAMIC_SCALAR_PREFIXES = ("kernel_", "serve_slo_breach")
+_DYNAMIC_EXTRA = ("profile_coverage", "profile_windows_total",
+                  "profile_steps_total")
+
+
+def _dynamic_scalars(metrics: Dict[str, Any]) -> Dict[str, Optional[float]]:
+    out: Dict[str, Optional[float]] = {}
+    for k, v in (metrics or {}).items():
+        if k.startswith(_DYNAMIC_SCALAR_PREFIXES) or k in _DYNAMIC_EXTRA:
+            out[k] = _finite(v)
+    return out
 
 
 def load_run(run_dir: str) -> Dict[str, Any]:
@@ -119,8 +143,16 @@ def load_run(run_dir: str) -> Dict[str, Any]:
         "compile_count": metrics.get("compile_count_total"),
         "compile_s": metrics.get("compile_seconds_total"),
     }
+    profile = None
+    try:
+        from torchpruner_tpu.obs.profile import load_profile
+
+        profile = load_profile(run_dir)
+    except Exception:
+        profile = None
     report = build_report(records=records, derived=derived, phases=phases,
-                          compiles=compiles, metrics=metrics)
+                          compiles=compiles, metrics=metrics,
+                          profile=profile)
     report["run"]["reconstructed"] = True
     report["_dir"] = run_dir
     if not records and not phases and not metrics:
@@ -172,6 +204,9 @@ def _scalars_of(report: Dict[str, Any]) -> Dict[str, Optional[float]]:
         "serve_token_p99_s": metrics.get("serve_token_seconds_p99"),
         "serve_tokens_per_s": metrics.get("serve_gen_tokens_per_s"),
         "serve_completed": metrics.get("serve_completed_total"),
+        # per-kernel profile scalars (kernel_<base>_ms / _pct) ride in
+        # dynamically — their names depend on the compiled program
+        **_dynamic_scalars(metrics),
     }
 
 
@@ -285,6 +320,35 @@ def format_report(report: Dict[str, Any]) -> str:
                 f"{str(s.get('checkpoint_digest') or '')[:12]}")
         lines.append("")
 
+    profile = report.get("profile") or {}
+    kernels = profile.get("kernels") or []
+    if kernels:
+        lines.append(
+            f"profile: {len(profile.get('windows') or [])} capture "
+            f"window(s), {profile.get('steps_profiled') or 0} steps"
+            + (f", coverage {100 * profile['coverage']:.0f}%"
+               if profile.get("coverage") is not None else ""))
+        lines.append("")
+        lines.append("| kernel | category | ms/step | % step | bound |")
+        lines.append("|---|---|---|---|---|")
+        for k in kernels[:8]:
+            rf = k.get("roofline") or {}
+            lines.append(
+                f"| `{k.get('kernel')}` | {k.get('category')} "
+                f"| {_f(k.get('ms_per_step'))} "
+                f"| {_f(k.get('pct_of_step'), '.1f')} "
+                f"| {rf.get('bound', '')} |")
+        lines.append("")
+
+    top_compilers = (report.get("compiles") or {}).get("by_executable")
+    if top_compilers:
+        lines.append("| top compilers (executable) | compiles | s |")
+        lines.append("|---|---|---|")
+        for c in top_compilers:
+            lines.append(f"| `{c.get('name')}` | {_i(c.get('count'))} "
+                         f"| {_f(c.get('seconds'), '.3f')} |")
+        lines.append("")
+
     sweeps = report.get("sweep_layers") or []
     if sweeps:
         lines.append("| sweep layer | methods | best method | best auc |")
@@ -301,7 +365,7 @@ def format_report(report: Dict[str, Any]) -> str:
                 f"| {_f(best[1].get('auc_mean')) if best else ''} |")
         lines.append("")
     if not rounds and not epochs and not sweeps and not serve \
-            and not sc_serve:
+            and not sc_serve and not kernels:
         lines.append("(no ledger records)")
     return "\n".join(lines)
 
@@ -340,7 +404,8 @@ def diff_runs(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
     per-round deltas matched by target, and round-set changes."""
     sa, sb = _scalars_of(a), _scalars_of(b)
     scalars: Dict[str, Any] = {}
-    for name in _SCALARS:
+    dynamic = [k for k in {**sa, **sb} if k not in _SCALARS]
+    for name in list(_SCALARS) + sorted(dynamic):
         va, vb = sa.get(name), sb.get(name)
         if va is None and vb is None:
             continue
@@ -349,6 +414,12 @@ def diff_runs(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
             entry["delta"] = vb - va
             entry["pct"] = (100.0 * (vb - va) / abs(va)
                             if abs(va) > _EPS else None)
+        else:
+            # present in only one run (a pre-kernel-era baseline, a
+            # train run diffed against a serve run): informational, not
+            # an error — gates on it skip unless they set "require"
+            entry["note"] = ("not comparable (only in "
+                             + ("A" if vb is None else "B") + ")")
         scalars[name] = entry
 
     # rounds matched by target AND per-target occurrence order, so an
@@ -395,10 +466,12 @@ def format_diff(d: Dict[str, Any]) -> str:
         lines.append("|---|---|---|---|---|")
         for name, e in d["scalars"].items():
             pct = e.get("pct")
+            delta = _f(e.get("delta"), "+.6g") \
+                if e.get("delta") is not None else (e.get("note") or "")
             lines.append(
                 f"| {name} | {_f(e.get('a'), '.6g')} "
                 f"| {_f(e.get('b'), '.6g')} "
-                f"| {_f(e.get('delta'), '+.6g')} "
+                f"| {delta} "
                 f"| {_f(pct, '+.1f') + '%' if pct is not None else ''} |")
         lines.append("")
     if d["rounds"]:
@@ -436,7 +509,8 @@ def check_gates(d: Dict[str, Any],
         if not isinstance(spec, dict):
             fail(gate, f"malformed gate spec {spec!r}")
             continue
-        if gate in _SCALARS:
+        if gate in _SCALARS or gate.startswith(_DYNAMIC_SCALAR_PREFIXES) \
+                or gate in _DYNAMIC_EXTRA:
             e = d["scalars"].get(gate)
             if e is None or e.get("delta") is None:
                 # absent on one side: only fail when the gate demands
@@ -444,6 +518,15 @@ def check_gates(d: Dict[str, Any],
                 # every CPU diff red)
                 if spec.get("require", False):
                     fail(gate, "metric absent from one or both runs")
+                elif e is None and gate not in _SCALARS \
+                        and not spec.get("optional", False):
+                    # a DYNAMIC gate naming a metric NEITHER run has is
+                    # almost certainly a typo (kernel_dto_ms) — the
+                    # unknown-gate invariant must hold for these too;
+                    # "optional": true opts a speculative gate out
+                    fail(gate, "names a metric absent from both runs "
+                               "(typo? set \"optional\": true if this "
+                               "kernel may legitimately be missing)")
                 continue
             delta, pct = e["delta"], e.get("pct")
             if "max_increase" in spec and delta > spec["max_increase"]:
@@ -517,7 +600,34 @@ def obs_main(argv=None) -> int:
                          "gate")
     pd.add_argument("--json", action="store_true",
                     help="emit the raw diff JSON instead of markdown")
+    pp = sub.add_parser(
+        "profile",
+        help="render a run's per-kernel profile (capture windows -> "
+             "ranked op table, roofline positions, HBM watermarks)")
+    pp.add_argument("dir", help="obs dir (profile.json / profile/ "
+                                "windows) or a profile.json/report.json "
+                                "file")
+    pp.add_argument("--top", type=int, default=25)
+    pp.add_argument("--json", action="store_true",
+                    help="emit the raw profile JSON instead of markdown")
     args = p.parse_args(argv)
+
+    if args.cmd == "profile":
+        from torchpruner_tpu.obs.profile import format_profile, load_profile
+
+        profile = load_profile(args.dir)
+        if profile is None:
+            print(f"{args.dir!r} holds no profile.json and no "
+                  "profile/window_* captures — run with "
+                  "--profile-every/--profile-steps (or POST /profile "
+                  "on the serve frontend) to capture one",
+                  file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(profile))
+        else:
+            print(format_profile(profile, top=args.top))
+        return 0
 
     if args.cmd == "report":
         try:

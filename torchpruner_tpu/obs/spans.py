@@ -76,6 +76,9 @@ class SpanTracer:
     def __init__(self, sink: Optional[Callable[[dict], None]] = None,
                  annotate: bool = True):
         self.sink = sink
+        #: optional second consumer of the event stream (the HBM sampler
+        #: hooks span edges here) — same never-raise contract as sink
+        self.extra_sink: Optional[Callable[[dict], None]] = None
         self.annotate = annotate
         self._lock = threading.Lock()
         self._counter = 0
@@ -163,6 +166,11 @@ class SpanTracer:
             try:
                 self.sink(event)
             except Exception:  # an exporter failure must never kill the run
+                pass
+        if self.extra_sink is not None:
+            try:
+                self.extra_sink(event)
+            except Exception:
                 pass
 
     # -- compile attribution ----------------------------------------------

@@ -24,6 +24,15 @@ Mapping:
   gets a synthetic ``E`` at the last seen timestamp of its track, so
   the B/E pairing always balances.
 - span metadata (``target``, ``method``, …) rides in ``args``.
+
+**Profiler merge**: when the run holds capture windows
+(``obs.profile``), their per-op events are merged onto dedicated
+tracks (one tid per window, offset at :data:`PROFILE_TID_BASE` so span
+tids can never collide) as complete ``X`` events.  The profiler's
+internal clock is unrelated to wall time, so each window's ops are
+shifted onto the window's recorded wall start — the kernel slices line
+up under the runtime span that contained the window, one timeline for
+"which phase" and "which op".
 """
 
 from __future__ import annotations
@@ -32,6 +41,10 @@ import os
 from typing import Any, Dict, List, Optional
 
 TRACE_FILENAME = "trace.json"
+
+#: profiler-derived op tracks start here (span tids are OS thread ids,
+#: which Linux caps well below this)
+PROFILE_TID_BASE = 1 << 30
 
 _CORE_KEYS = frozenset({
     "event", "span", "name", "parent", "depth", "ts", "dur_s", "tid",
@@ -114,10 +127,63 @@ def trace_events_from_spans(events: List[dict]) -> List[dict]:
     return out
 
 
-def write_trace(events_jsonl: str, out_path: Optional[str] = None) -> str:
+def profile_trace_events(profile_dir: str, pid: int = 0) -> List[dict]:
+    """Profiler-derived op events for the Perfetto merge: each capture
+    window's filtered op events (``trace_analysis.file_op_events``) as
+    complete ``X`` events on its own stable tid
+    (``PROFILE_TID_BASE + window index``), time-shifted so the window's
+    first op lands at the window's recorded wall start — aligning the
+    profiler's internal clock with the span stream's wall clock.
+    Timestamps are clamped monotonic per track (the schema contract).
+    Empty (never raises) without windows."""
+    from torchpruner_tpu.obs.profile.capture import scan_windows
+    from torchpruner_tpu.utils.trace_analysis import (
+        file_op_events,
+        find_trace_files,
+    )
+
+    out: List[dict] = []
+    try:
+        windows = scan_windows(profile_dir)
+    except Exception:
+        return out
+    for w in windows:
+        try:
+            files = find_trace_files(w["dir"], latest_run=True)
+            ops: List[dict] = []
+            for f in files:
+                ops.extend(file_op_events(f))
+        except Exception:
+            continue
+        if not ops:
+            continue
+        tid = PROFILE_TID_BASE + int(w.get("index", 0))
+        out.append({
+            "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+            "args": {"name": f"XLA ops (profile window "
+                             f"{int(w.get('index', 0))})"},
+        })
+        t0_trace = min(op["ts"] for op in ops)
+        t0_wall_us = float(w.get("t_start_unix") or 0.0) * 1e6
+        last = 0.0
+        for op in sorted(ops, key=lambda o: o["ts"]):
+            ts = t0_wall_us + (op["ts"] - t0_trace)
+            ts = max(ts, last)
+            last = ts
+            out.append({
+                "ph": "X", "name": op["name"], "cat": "xla_op",
+                "pid": pid, "tid": tid, "ts": ts, "dur": op["dur"],
+                "args": {"window": int(w.get("index", 0))},
+            })
+    return out
+
+
+def write_trace(events_jsonl: str, out_path: Optional[str] = None,
+                profile_dir: Optional[str] = None) -> str:
     """Convert an ``events.jsonl`` (rotation-aware, latest session only —
     ``load_span_events``'s contract) into ``trace.json`` next to it (or
-    at ``out_path``).  Returns the written path."""
+    at ``out_path``), merging profiler capture windows from
+    ``profile_dir`` when present.  Returns the written path."""
     from torchpruner_tpu.utils.profiling import load_span_events
 
     events = load_span_events(events_jsonl)
@@ -126,8 +192,16 @@ def write_trace(events_jsonl: str, out_path: Optional[str] = None) -> str:
                                 TRACE_FILENAME)
     from torchpruner_tpu.resilience.manifest import atomic_write_json
 
+    trace_events = trace_events_from_spans(events)
+    if profile_dir and os.path.isdir(profile_dir):
+        pid = 0
+        for ev in events:
+            if ev.get("event") == "obs_init":
+                pid = int(ev.get("process_index", 0) or 0)
+                break
+        trace_events.extend(profile_trace_events(profile_dir, pid=pid))
     payload = {
-        "traceEvents": trace_events_from_spans(events),
+        "traceEvents": trace_events,
         "displayTimeUnit": "ms",
     }
     atomic_write_json(out_path, payload, indent=None)

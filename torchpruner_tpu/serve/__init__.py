@@ -17,6 +17,8 @@ tail latency instead of smaller params/FLOPs counters (ROADMAP item 1):
   ragged request mix), checkpoint hot-swap, SIGTERM drain.
 - :mod:`~torchpruner_tpu.serve.traffic` — open-loop Poisson /
   step-staggered synthetic workloads (bench ``serve`` leg, CI smoke).
+- :class:`~torchpruner_tpu.serve.slo.SLOMonitor` — live rolling-p99
+  TTFT / per-token SLO gates (breach episodes counted + ledgered).
 - ``python -m torchpruner_tpu serve <preset>`` — the endpoint
   (:mod:`~torchpruner_tpu.serve.frontend`): HTTP, stdin, or synthetic
   traffic modes, obs-instrumented end to end.
@@ -35,6 +37,7 @@ from torchpruner_tpu.serve.engine import (
 )
 from torchpruner_tpu.serve.request import Request, Sampling
 from torchpruner_tpu.serve.scheduler import Scheduler
+from torchpruner_tpu.serve.slo import SLOMonitor
 from torchpruner_tpu.serve.traffic import (
     OpenLoopTraffic,
     poisson_arrivals,
@@ -46,5 +49,5 @@ __all__ = [
     "Request", "Sampling", "KVCacheAllocator", "Scheduler", "ServeEngine",
     "OpenLoopTraffic", "poisson_arrivals", "staggered_arrivals",
     "synthetic_requests", "aligned_len", "bucket_for", "prefill_buckets",
-    "sample_tokens", "vocab_of",
+    "sample_tokens", "vocab_of", "SLOMonitor",
 ]

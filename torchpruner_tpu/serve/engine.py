@@ -291,6 +291,9 @@ class ServeEngine:
         #: lifetime (a warmup pass must not dilute the measured phase)
         self._window_tokens0 = 0
         self._eos = np.full(n_slots, -1, np.int64)
+        #: optional live SLO monitor (serve.slo.SLOMonitor) — fed TTFT /
+        #: per-token observations and checked at step boundaries
+        self.slo = None
 
     # -- submission ---------------------------------------------------------
 
@@ -344,6 +347,8 @@ class ServeEngine:
         if req.ttft_s is not None:
             obs.observe("serve_ttft_seconds", req.ttft_s,
                         help="request arrival -> first token")
+            if self.slo is not None:
+                self.slo.on_ttft(req.ttft_s)
         # slot tables: next write position is the prompt length
         self._pos[slot] = n
         self._tok[slot] = tok
@@ -366,6 +371,7 @@ class ServeEngine:
         import jax.numpy as jnp
 
         P = self.programs
+        t0 = time.perf_counter()
         # inactive slots decode junk under a clamped position; their
         # results are discarded and their cache rows are stale-safe
         pos = np.minimum(self._pos, self.max_len - 1)
@@ -378,6 +384,9 @@ class ServeEngine:
         self.steps += 1
         obs.inc("serve_decode_steps_total",
                 help="batched continuous-batching decode steps")
+        # capture-cadence hook only — decode steps stay out of the
+        # train step telemetry (obs.profile)
+        obs.profile_step(now - t0)
         for slot, req in list(self.scheduler.running.items()):
             tok = int(nxt[slot])
             req.tokens.append(tok)
@@ -387,6 +396,8 @@ class ServeEngine:
             obs.observe("serve_token_seconds", gap,
                         help="per-token latency (gap between a "
                              "request's successive tokens)")
+            if self.slo is not None:
+                self.slo.on_token(gap)
             self._last_token_s[slot] = now
             self._pos[slot] += 1
             self._tok[slot] = tok
@@ -543,6 +554,11 @@ class ServeEngine:
             # they stop only once the staged programs are ready (the
             # drain-then-switch boundary)
             did = self.step(admit=not draining and self._staged is None)
+            if self.slo is not None:
+                self.slo.maybe_check(self.steps)
+            # on-demand profiler windows (POST /profile) must open/close
+            # even when the slot array sits idle between requests
+            obs.profile_tick()
             if max_steps is not None and self.steps >= max_steps:
                 break
             if not self.scheduler.has_work():

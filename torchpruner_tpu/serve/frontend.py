@@ -13,7 +13,11 @@ gauges, ledger provenance records):
   ``{"prompt_ids": [...], "max_new": N, "temperature": ..,
   "top_k": .., "top_p": .., "seed": ..}`` blocks until the engine
   finishes the request and returns its tokens; ``GET /healthz`` and
-  ``GET /stats`` report liveness and serving gauges.
+  ``GET /stats`` report liveness and serving gauges (KV-page
+  occupancy, slot utilization, rolling SLO state); ``GET /metrics``
+  exposes the session's Prometheus text (scrapeable live, the same
+  exposition ``metrics.prom`` holds at close); ``POST /profile`` arms
+  one on-demand kernel-profiling capture window (``obs.profile``).
 - ``--stdin`` — one JSON request per line (same schema), results
   echoed as JSON lines; EOF drains and exits.
 
@@ -93,24 +97,68 @@ def _http_server(engine, port: int, request_timeout_s: float):
             self.end_headers()
             self.wfile.write(body)
 
+        def _text(self, code: int, body: str,
+                  content_type: str = "text/plain; version=0.0.4"):
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         def do_GET(self):
             if self.path == "/healthz":
                 self._json(200, {"ok": True})
             elif self.path == "/stats":
                 sched = engine.scheduler
-                self._json(200, {
+                alloc = sched.allocator
+                stats = {
                     "queue_depth": sched.queue_depth,
-                    "active_slots": sched.allocator.active_slots,
-                    "kv_pages_in_use": sched.allocator.pages_in_use,
+                    "active_slots": alloc.active_slots,
+                    "kv_pages_in_use": alloc.pages_in_use,
+                    "kv_page_budget": alloc.page_budget,
+                    "kv_page_occupancy": round(
+                        alloc.pages_in_use / max(1, alloc.page_budget),
+                        4),
+                    "slot_utilization": round(
+                        alloc.active_slots / max(1, alloc.n_slots), 4),
                     "decode_steps": engine.steps,
                     "gen_tokens": engine.gen_tokens,
                     "admits": sched.admitted_total,
-                    "evictions": sched.allocator.total_evictions,
-                })
+                    "evictions": alloc.total_evictions,
+                }
+                if engine.slo is not None:
+                    stats["slo"] = engine.slo.snapshot()
+                self._json(200, stats)
+            elif self.path == "/metrics":
+                # live Prometheus exposition of the obs session's
+                # registry (obs/exporters.py) — the scrape target a real
+                # deployment points at; 503 without a session
+                from torchpruner_tpu import obs
+                from torchpruner_tpu.obs.exporters import prometheus_text
+
+                session = obs.get()
+                if session is None:
+                    self._text(503, "# no obs session (run with "
+                                    "--obs-dir or without --no-obs)\n")
+                    return
+                if engine.slo is not None:
+                    engine.slo.check(engine.steps)  # fresh rolling p99s
+                self._text(200, prometheus_text(session.metrics))
             else:
                 self._json(404, {"error": "not found"})
 
         def do_POST(self):
+            if self.path == "/profile":
+                from torchpruner_tpu import obs
+
+                armed = obs.request_profile_window()
+                self._json(202 if armed else 409, {
+                    "armed": armed,
+                    **({} if armed else
+                       {"error": "no obs session/profiler, or a window "
+                                 "is already open/armed"})})
+                return
             if self.path != "/v1/generate":
                 self._json(404, {"error": "not found"})
                 return
@@ -163,6 +211,22 @@ def serve_main(argv=None) -> int:
                    help="runtime telemetry directory (events/metrics/"
                         "ledger/report; see `obs report`)")
     p.add_argument("--no-obs", action="store_true")
+    p.add_argument("--profile-every", type=int, default=None,
+                   metavar="N",
+                   help="with --obs-dir: kernel-profiling capture window "
+                        "every N decode steps (obs.profile; `obs profile "
+                        "<obs-dir>` renders the table; the HTTP frontend "
+                        "can also arm one via POST /profile)")
+    p.add_argument("--profile-steps", type=int, default=None, metavar="K",
+                   help="decode steps per capture window (default 3)")
+    p.add_argument("--slo-ttft-p99-ms", type=float, default=None,
+                   help="live SLO threshold: rolling TTFT p99 above this "
+                        "counts a breach episode (serve_slo_breach_total"
+                        ", ledgered)")
+    p.add_argument("--slo-token-p99-ms", type=float, default=None,
+                   help="live SLO threshold: rolling per-token p99 (ms)")
+    p.add_argument("--slo-window", type=int, default=256,
+                   help="observations in the rolling SLO window")
     p.add_argument("--swap-checkpoint", metavar="DIR",
                    help="hot-swap to this checkpoint mid-run (synthetic "
                         "mode: staged after --swap-after steps)")
@@ -195,6 +259,10 @@ def serve_main(argv=None) -> int:
                    help="http: per-request wait timeout (seconds)")
     args = p.parse_args(argv)
 
+    if args.profile_every is not None and not args.obs_dir:
+        p.error("--profile-every needs --obs-dir (the capture windows "
+                "live under it)")
+
     if args.cpu:
         import jax
 
@@ -207,7 +275,9 @@ def serve_main(argv=None) -> int:
 
     session = None
     if not args.no_obs:
-        session = obs.configure(args.obs_dir)
+        session = obs.configure(args.obs_dir,
+                                profile_every=args.profile_every,
+                                profile_steps=args.profile_steps)
         obs.annotate_run(experiment=f"serve:{args.preset}", kind="serve",
                          model=args.preset,
                          checkpoint=args.checkpoint or "")
@@ -225,6 +295,16 @@ def serve_main(argv=None) -> int:
         # requests (each pins its prompt/tokens and, across a swap, the
         # old program set); batch modes need them for verify/reporting
         retain_results=args.http is None)
+    if args.slo_ttft_p99_ms is not None \
+            or args.slo_token_p99_ms is not None:
+        from torchpruner_tpu.serve.slo import SLOMonitor
+
+        engine.slo = SLOMonitor(
+            ttft_p99_s=(args.slo_ttft_p99_ms / 1e3
+                        if args.slo_ttft_p99_ms is not None else None),
+            token_p99_s=(args.slo_token_p99_ms / 1e3
+                         if args.slo_token_p99_ms is not None else None),
+            window=args.slo_window)
 
     rc = 0
     try:

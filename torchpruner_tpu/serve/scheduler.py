@@ -136,9 +136,17 @@ class Scheduler:
         return out
 
     def _gauges(self) -> None:
+        alloc = self.allocator
         obs.gauge_set("serve_queue_depth", self.queue_depth,
                       help="requests waiting for a slot")
-        obs.gauge_set("serve_active_slots", self.allocator.active_slots,
+        obs.gauge_set("serve_active_slots", alloc.active_slots,
                       help="slots currently decoding")
-        obs.gauge_set("serve_kv_pages_in_use", self.allocator.pages_in_use,
+        obs.gauge_set("serve_kv_pages_in_use", alloc.pages_in_use,
                       help="KV-cache pages leased to active requests")
+        obs.gauge_set("serve_kv_page_occupancy",
+                      alloc.pages_in_use / max(1, alloc.page_budget),
+                      help="leased KV pages / page budget (0..1)")
+        obs.gauge_set("serve_slot_utilization",
+                      alloc.active_slots / max(1, alloc.n_slots),
+                      help="active decode slots / slot-array width "
+                           "(0..1)")

@@ -1,0 +1,132 @@
+"""Live SLO monitoring for the serving engine.
+
+The obs histograms answer "what were the latency percentiles of this
+run" — after the run.  A serving endpoint needs the live version:
+"is the p99 over threshold *right now*".  :class:`SLOMonitor` keeps
+bounded rolling windows of the engine's TTFT and per-token latency
+observations, re-computes the rolling p99s every ``check_every_steps``
+step boundaries, and on a threshold crossing:
+
+- bumps ``serve_slo_breach_total`` (plus the per-metric
+  ``serve_slo_breach_<metric>_total``) — the Prometheus counter an
+  alert fires on;
+- ledgers a ``serve``/``slo_breach`` record (threshold, observed value,
+  window size, engine step) so the breach is provenance, joined to the
+  checkpoint digests serving at the time;
+- keeps ``serve_ttft_p99_rolling_s`` / ``serve_token_p99_rolling_s``
+  gauges current either way, so ``GET /metrics`` always shows the live
+  tail.
+
+Breaches count *episodes*, not checks: a sustained breach increments
+once on entry and re-arms only after the metric recovers below
+threshold — a 10-minute incident is one breach, not 600.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+import numpy as np
+
+from torchpruner_tpu import obs
+
+
+class SLOMonitor:
+    """See module docstring.  Thresholds are seconds; ``None`` disables
+    that metric's gate (the rolling gauges still export)."""
+
+    def __init__(self, ttft_p99_s: Optional[float] = None,
+                 token_p99_s: Optional[float] = None,
+                 window: int = 256, check_every_steps: int = 8,
+                 min_samples: int = 8):
+        self.thresholds: Dict[str, Optional[float]] = {
+            "ttft": ttft_p99_s, "token": token_p99_s}
+        self.window = int(window)
+        self.check_every_steps = max(1, int(check_every_steps))
+        self.min_samples = max(1, int(min_samples))
+        self._obs: Dict[str, deque] = {
+            "ttft": deque(maxlen=self.window),
+            "token": deque(maxlen=self.window)}
+        self._in_breach: Dict[str, bool] = {"ttft": False, "token": False}
+        self._last_check_step = -1
+        #: check() runs on the engine thread (maybe_check) AND on
+        #: /metrics scrape threads, while on_ttft/on_token append from
+        #: the engine thread — the lock covers BOTH the episode
+        #: accounting (an incident double-counted, a recovery consumed)
+        #: and the deque iteration (append mid-iteration raises)
+        self._lock = threading.Lock()
+        self.breaches_total = 0
+        self.rolling: Dict[str, Optional[float]] = {"ttft": None,
+                                                    "token": None}
+
+    # -- engine hooks -------------------------------------------------------
+
+    def on_ttft(self, seconds: float) -> None:
+        with self._lock:
+            self._obs["ttft"].append(float(seconds))
+
+    def on_token(self, seconds: float) -> None:
+        with self._lock:
+            self._obs["token"].append(float(seconds))
+
+    def maybe_check(self, step: int) -> None:
+        """Called at engine step boundaries; cheap no-op between check
+        intervals."""
+        if step - self._last_check_step < self.check_every_steps:
+            return
+        self._last_check_step = step
+        self.check(step)
+
+    # -- the check ----------------------------------------------------------
+
+    def check(self, step: int = 0) -> Dict[str, Optional[float]]:
+        """Recompute rolling p99s, export gauges, count breach episodes
+        (thread-safe).  Returns the rolling values."""
+        with self._lock:
+            return self._check_locked(step)
+
+    def _check_locked(self, step: int) -> Dict[str, Optional[float]]:
+        for metric, samples in self._obs.items():
+            if not samples:
+                continue
+            p99 = float(np.percentile(np.asarray(samples), 99))
+            self.rolling[metric] = p99
+            obs.gauge_set(
+                f"serve_{metric}_p99_rolling_s", p99,
+                help=f"rolling p99 of serve {metric} latency over the "
+                     f"last {self.window} observations")
+            limit = self.thresholds.get(metric)
+            if limit is None or len(samples) < self.min_samples:
+                continue
+            if p99 > limit and not self._in_breach[metric]:
+                self._in_breach[metric] = True
+                self.breaches_total += 1
+                obs.inc("serve_slo_breach_total",
+                        help="SLO breach episodes (rolling p99 crossed "
+                             "its threshold; re-arms on recovery)")
+                obs.inc(f"serve_slo_breach_{metric}_total")
+                obs.record_serve(
+                    kind="slo_breach", metric=metric, p99_s=p99,
+                    threshold_s=limit, window=len(samples), step=step)
+            elif p99 <= limit:
+                self._in_breach[metric] = False
+        return dict(self.rolling)
+
+    def snapshot(self) -> Dict[str, object]:
+        """The ``/stats`` block: rolling values, thresholds, breach
+        count, in-breach flags."""
+        return {
+            "ttft_p99_rolling_ms": (round(self.rolling["ttft"] * 1e3, 3)
+                                    if self.rolling["ttft"] is not None
+                                    else None),
+            "token_p99_rolling_ms": (round(self.rolling["token"] * 1e3, 3)
+                                     if self.rolling["token"] is not None
+                                     else None),
+            "thresholds_ms": {
+                k: (round(v * 1e3, 3) if v is not None else None)
+                for k, v in self.thresholds.items()},
+            "breaches_total": self.breaches_total,
+            "in_breach": dict(self._in_breach),
+        }

@@ -106,13 +106,81 @@ PEAK_BF16_FLOPS = {
 }
 
 
+#: HBM bandwidth per chip by ``device_kind`` prefix (public spec sheets,
+#: bytes/s) — the roofline's memory ceiling, paired with PEAK_BF16_FLOPS
+#: so the ridge intensity (peak FLOP/s ÷ peak bytes/s) uses one source.
+PEAK_HBM_BYTES_PER_S = {
+    "TPU v3": 900e9,
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5p": 2765e9,
+    "TPU v5": 2765e9,
+    "TPU v6 lite": 1640e9,
+    "TPU v6e": 1640e9,
+    "TPU7": 7370e9,
+}
+
+
+def _by_kind_prefix(table: dict, device) -> float | None:
+    kind = getattr(device, "device_kind", "") or ""
+    for prefix in sorted(table, key=len, reverse=True):
+        if kind.startswith(prefix):
+            return table[prefix]
+    return None
+
+
 def peak_bf16_flops(device) -> float | None:
     """Spec-sheet bf16 peak for ``device`` (None when unknown)."""
-    kind = getattr(device, "device_kind", "") or ""
-    for prefix in sorted(PEAK_BF16_FLOPS, key=len, reverse=True):
-        if kind.startswith(prefix):
-            return PEAK_BF16_FLOPS[prefix]
-    return None
+    return _by_kind_prefix(PEAK_BF16_FLOPS, device)
+
+
+def peak_hbm_bw(device) -> float | None:
+    """Spec-sheet HBM bandwidth (bytes/s) for ``device`` (None when
+    unknown — e.g. the CPU backend, where DRAM bandwidth is not a chip
+    constant worth pretending to know)."""
+    return _by_kind_prefix(PEAK_HBM_BYTES_PER_S, device)
+
+
+def roofline_position(flops: float | None, bytes_moved: float | None,
+                      time_s: float | None,
+                      peak_flops: float | None = None,
+                      peak_bw: float | None = None) -> dict:
+    """One kernel's roofline coordinates from *estimates* (the profile
+    subsystem's per-op FLOPs/bytes attributions — see
+    ``obs.profile.kernels``): achieved FLOP/s and bytes/s, arithmetic
+    intensity, fraction of each peak, and a compute/memory-bound
+    classification against the ridge intensity ``peak_flops/peak_bw``.
+    Every field degrades to ``None`` when its inputs are unknown rather
+    than guessing — a position with ``bound: "unknown"`` is still a
+    position, it just says the estimator had nothing to stand on."""
+    t = float(time_s) if time_s else None
+    f = float(flops) if flops else None
+    b = float(bytes_moved) if bytes_moved else None
+    achieved_f = (f / t) if f and t else None
+    achieved_b = (b / t) if b and t else None
+    intensity = (f / b) if f and b else None
+    ridge = (peak_flops / peak_bw) if peak_flops and peak_bw else None
+    bound = "unknown"
+    if intensity is not None and ridge is not None:
+        bound = "compute" if intensity >= ridge else "memory"
+    elif f and not b:
+        bound = "compute"
+    elif b and not f:
+        bound = "memory"
+    return {
+        "flops_est": f,
+        "bytes_est": b,
+        "achieved_flops_per_s": achieved_f,
+        "achieved_bytes_per_s": achieved_b,
+        "intensity_flops_per_byte": intensity,
+        "ridge_intensity": ridge,
+        "pct_peak_flops": (100.0 * achieved_f / peak_flops
+                           if achieved_f and peak_flops else None),
+        "pct_peak_bw": (100.0 * achieved_b / peak_bw
+                        if achieved_b and peak_bw else None),
+        "bound": bound,
+    }
 
 
 def flag_implausible_mfu(r: dict, *keys) -> dict:

@@ -29,7 +29,8 @@ from typing import Dict, List, Optional
 _RUNTIME_NOISE = (
     "PjRt", "PjitFunction", "Handle inputs", "ParseArguments",
     "CommonPjRtBuffer", "copy_to_host", "TransferFromDevice", "Await",
-    "thread_", "process_", "ThunkExecutor",
+    "thread_", "process_", "ThunkExecutor", "ThreadpoolListener",
+    "TfrtCpu", "ExecuteHelper", "BufferFromHostBuffer",
 )
 
 #: (category, name-prefix) in match order
@@ -74,6 +75,82 @@ def find_trace_files(log_dir: str, latest_run: bool = True) -> List[str]:
     ))
 
 
+def file_op_events(path: str) -> List[dict]:
+    """The FILTERED per-op complete events of one ``*.trace.json.gz``:
+    ``[{"name", "ts", "dur", "pid", "tid"}, ...]`` (µs), with runtime
+    noise, Python frames, and non-op tracks excluded — ONE filtering
+    rule shared by :func:`summarize_trace` and the Perfetto merge
+    (``obs.trace_export``).
+
+    A device process carries several stacked tracks: "Steps" (one span
+    per step number), "XLA Modules" (one span per program execution,
+    duplicating its ops' time), and "XLA Ops" (the per-op events this
+    is about).  Counting all three triple-counts; restrict to the op
+    tracks when they exist.  Host-only traces (CPU backend) have no
+    device tracks — there the XLA thunk events ARE the op events, but
+    they live on the runtime's executor threads (``tf_XLAEigen`` /
+    ``tf_XLATfrtCpuClient``); the ``python`` thread carries tracing /
+    lowering / span-annotation events that are NOT ops (a capture
+    window spanning a recompile would otherwise report
+    ``trace_to_jaxpr`` as the hottest "kernel"), so when thread names
+    are present, host-only filtering keeps only the ``tf_*`` threads.
+    """
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    proc_names = {
+        e["pid"]: (e.get("args") or {}).get("name", "")
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+        and "pid" in e
+    }
+    device_pids = {
+        pid for pid, name in proc_names.items()
+        if "device:" in name.lower() or "tpu" in name.lower()
+    }
+    thread_names = {
+        (e["pid"], e["tid"]): (e.get("args") or {}).get("name", "")
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+        and "pid" in e and "tid" in e
+    }
+    op_tids = {
+        key for key, name in thread_names.items()
+        if key[0] in device_pids and name in ("XLA Ops", "Async XLA Ops")
+    }
+    host_exec_tids = {
+        key for key, name in thread_names.items()
+        if name.startswith("tf_")
+    }
+    out: List[dict] = []
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        key = (e.get("pid"), e.get("tid"))
+        if op_tids:
+            if key not in op_tids:
+                continue
+        elif device_pids:
+            if e.get("pid") not in device_pids:
+                continue
+        elif host_exec_tids and key not in host_exec_tids:
+            continue
+        name = e.get("name") or ""
+        if not name:  # nameless events can't be categorized — skip
+            continue
+        # '$...' = Python frames; 'end: <op>' = nested completion
+        # markers on host-only traces (counting them double-counts
+        # the enclosing op)
+        if name.startswith(("$", "end: ")) or any(
+            tok in name for tok in _RUNTIME_NOISE
+        ):
+            continue
+        out.append({"name": name, "ts": float(e.get("ts", 0.0)),
+                    "dur": float(e["dur"]), "pid": e.get("pid", 0),
+                    "tid": e.get("tid", 0)})
+    return out
+
+
 def summarize_trace(log_dir: str, top: int = 25,
                     latest_run: bool = True,
                     spans_jsonl: Optional[str] = None) -> Dict:
@@ -100,52 +177,8 @@ def summarize_trace(log_dir: str, top: int = 25,
     durs: Dict[str, float] = {}
     counts: Dict[str, int] = {}
     for path in files:
-        with gzip.open(path, "rt") as f:
-            data = json.load(f)
-        events = data.get("traceEvents", [])
-        proc_names = {
-            e["pid"]: (e.get("args") or {}).get("name", "")
-            for e in events
-            if e.get("ph") == "M" and e.get("name") == "process_name"
-            and "pid" in e
-        }
-        device_pids = {
-            pid for pid, name in proc_names.items()
-            if "device:" in name.lower() or "tpu" in name.lower()
-        }
-        # a device process carries several stacked tracks: "Steps" (one
-        # span per step number — these dominated early summaries as huge
-        # numerically-named 'other' ops), "XLA Modules" (one span per
-        # program execution, duplicating its ops' time), and "XLA Ops"
-        # (the per-op events this table is about).  Counting all three
-        # triple-counts; restrict to the op tracks when they exist.
-        op_tids = {
-            (e["pid"], e["tid"])
-            for e in events
-            if e.get("ph") == "M" and e.get("name") == "thread_name"
-            and "pid" in e and "tid" in e
-            and e["pid"] in device_pids
-            and (e.get("args") or {}).get("name", "") in (
-                "XLA Ops", "Async XLA Ops")
-        }
-        for e in events:
-            if e.get("ph") != "X" or "dur" not in e:
-                continue
-            if op_tids:
-                if (e.get("pid"), e.get("tid")) not in op_tids:
-                    continue
-            elif device_pids and e.get("pid") not in device_pids:
-                continue
-            name = e.get("name") or ""
-            if not name:  # nameless events can't be categorized — skip
-                continue
-            # '$...' = Python frames; 'end: <op>' = nested completion
-            # markers on host-only traces (counting them double-counts
-            # the enclosing op)
-            if name.startswith(("$", "end: ")) or any(
-                tok in name for tok in _RUNTIME_NOISE
-            ):
-                continue
+        for e in file_op_events(path):
+            name = e["name"]
             durs[name] = durs.get(name, 0.0) + e["dur"]  # microseconds
             counts[name] = counts.get(name, 0) + 1
     total_us = sum(durs.values()) or 1.0
