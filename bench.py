@@ -31,6 +31,10 @@ Six legs (baselines from BASELINE.md where the reference has one):
 6. ``mfu_llama`` — train-step MFU on a ~200M-param Llama whose FLOPs are
    large MXU-shaped matmuls: the machinery's MFU ceiling, next to the
    conv-bound VGG16 number.
+7. ``blocksparse`` — the block-sparse matmul (ops/blocksparse.py) at 50%
+   structured sparsity vs the same-machinery dense matmul AND a full
+   Dense-MLP train step masked-dense vs kernel-dispatched: the ms/step
+   the pruned structure actually buys (not just the FLOPs gauge).
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
@@ -117,7 +121,8 @@ _LEG_EST_S = {
     "mfu_llama": (180, 3600),
     "llama_decode": (180, 300),
     "serve": (240, 300),
-    "flash_attention": (60, 3600),
+    "flash_attention": (60, 600),
+    "blocksparse": (90, 300),
     "vgg16_robustness": (1500, 100000),
 }
 
@@ -720,30 +725,48 @@ def _leg_mfu_llama(smoke: bool) -> dict:
 
 
 def _leg_flash_attention(smoke: bool) -> dict:
-    """Flash (Pallas fwd+bwd kernels) vs XLA einsum attention: steady-state
-    grad-step time and compiled temp memory at long sequence length — the
-    O(S*Dh) vs O(S^2) backward-memory claim, measured."""
+    """Flash (Pallas fwd+bwd kernels on TPU, the blocked lax form
+    elsewhere) vs XLA einsum attention: steady-state grad-step time and
+    compiled temp memory at long sequence length — the O(S*Dh) vs
+    O(S^2) backward-memory claim, measured.  On TPU the headline shape
+    is AUTOTUNED first (ops/autotune.py: a quick block-size sweep whose
+    winner persists in the tuning cache), so the measured row is the
+    tuned kernel — the ≥1.3x @ S≥8k target ROADMAP item 2 sets."""
     import jax
     import jax.numpy as jnp
 
+    from torchpruner_tpu.ops import autotune
     from torchpruner_tpu.ops.flash_attention import (
         _xla_attention,
         flash_attention,
     )
     from torchpruner_tpu.utils.profiling import steady_s, time_fn
 
-    def measure(B, S, H, Dh):
+    on_tpu = jax.devices()[0].platform == "tpu"
+
+    def make(fn, **fkw):
+        def loss(q_, k_, v_):
+            return jnp.sum(
+                fn(q_, k_, v_, causal=True, **fkw).astype(jnp.float32))
+        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    def measure(B, S, H, Dh, tune=False):
         ks = jax.random.split(jax.random.PRNGKey(0), 3)
         q, k, v = (jax.random.normal(kk, (B, S, H, Dh), jnp.bfloat16)
                    for kk in ks)
+        r = {"impl": "pallas" if on_tpu else "lax"}
+        if tune and on_tpu:
+            def run(blocks):
+                g = make(flash_attention, block_q=blocks[0],
+                         block_k=blocks[1])
+                return lambda: g(q, k, v)
 
-        def make(fn):
-            def loss(q_, k_, v_):
-                return jnp.sum(
-                    fn(q_, k_, v_, causal=True).astype(jnp.float32))
-            return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-
-        r = {}
+            tuned = autotune.autotune(
+                autotune.KIND_FLASH, Dh, S, q.dtype, run=run,
+                candidates=((128, 128), (128, 256), (256, 128),
+                            (128, 512), (256, 256)),
+                defaults=(128, 256 if S >= 8192 else 128), iters=3)
+            r["tuned_blocks"] = list(tuned)
         gs = {}
         for name, fn in (("flash", flash_attention),
                          ("xla", _xla_attention)):
@@ -767,18 +790,126 @@ def _leg_flash_attention(smoke: bool) -> dict:
         return r
 
     if smoke:
-        return measure(1, 512, 2, 32)
-    if jax.devices()[0].platform != "tpu":
-        return measure(4, 2048, 8, 64)  # CPU fallback: 8k is minutes/iter
+        # S=1024/Dh64: past the CPU cache cliff where the einsum's S^2
+        # scores stop fitting — the blocked path's win is decisive
+        # (smaller S measures allocator noise, not the algorithm)
+        return measure(1, 1024, 4, 64)
+    if not on_tpu:
+        return measure(4, 2048, 8, 64)  # CPU fallback (lax path)
     # headline at S=8192 — a shape where impl="auto" actually dispatches
     # the kernel (S >= FLASH_AUTO_MIN_S) and its linear backward memory
     # matters; the old S=2048 headline showcased the XLA fallback the
     # auto dispatch deliberately picks there (round-4 verdict).  The
     # crossover point stays measured as the secondary row; the full S
     # curve lives in results/flash_sweep_tpu_*.
-    out = measure(4, 8192, 8, 64)
+    out = measure(4, 8192, 8, 64, tune=True)
     out["crossover_s2048"] = measure(4, 2048, 8, 64)
     return out
+
+
+def _leg_blocksparse(smoke: bool) -> dict:
+    """Leg: structured sparsity the kernel inner loop can SEE.  A
+    50%-block-dropped weight (the ``score_drop_indices(granularity=128)``
+    mask shape) is multiplied three ways on the SAME shapes: the
+    block-sparse Pallas kernel (skips dropped blocks), the same kernel
+    dense (all blocks — the apples-to-apples machinery baseline), and
+    the dense XLA matmul; plus a FULL train step on a Dense MLP, masked-
+    dense vs block-sparse-dispatched (train.loop ``param_transform``) —
+    the ms/step number that used to move only in the FLOPs gauge."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from torchpruner_tpu.core import layers as L
+    from torchpruner_tpu.core import masking
+    from torchpruner_tpu.core.pruner import score_drop_indices
+    from torchpruner_tpu.core.segment import SegmentedModel, init_model
+    from torchpruner_tpu.ops.blocksparse import blocksparse_matmul
+    from torchpruner_tpu.train.loop import make_train_step
+    from torchpruner_tpu.utils.losses import cross_entropy_loss
+    from torchpruner_tpu.utils.profiling import steady_s, time_fn
+
+    block = 128
+    R, D, F = (256, 1024, 1024) if smoke else (1024, 4096, 4096)
+    x = jax.random.normal(jax.random.PRNGKey(0), (R, D), jnp.bfloat16)
+    w = np.array(jax.random.normal(jax.random.PRNGKey(1), (D, F)),
+                 np.float32)
+    in_keep = tuple(range(0, D // block, 2))   # 50% of input blocks
+    out_keep = tuple(range(0, F // block, 2))  # 50% of output blocks
+    for b in range(D // block):
+        if b not in in_keep:
+            w[b * block:(b + 1) * block] = 0
+    for b in range(F // block):
+        if b not in out_keep:
+            w[:, b * block:(b + 1) * block] = 0
+    wb = jnp.asarray(w, jnp.bfloat16)
+    variants = {
+        "sparse_kernel": jax.jit(lambda a, b: blocksparse_matmul(
+            a, b, in_keep=in_keep, out_keep=out_keep, block=block)),
+        "dense_kernel": jax.jit(lambda a, b: blocksparse_matmul(
+            a, b, block=block)),
+        "dense_xla": jax.jit(lambda a, b: a @ b),
+    }
+    r = {"block": block, "shape": f"R{R} D{D} F{F}", "sparsity": 0.5}
+    for name, fn in variants.items():
+        stats = time_fn(fn, x, wb, iters=5, warmup=2, chained=True)
+        r[f"{name}_ms"] = round(steady_s(stats) * 1e3, 3)
+    r["sparse_vs_dense_kernel"] = round(
+        r["dense_kernel_ms"] / r["sparse_kernel_ms"], 3)
+    r["sparse_vs_dense_xla"] = round(
+        r["dense_xla_ms"] / r["sparse_kernel_ms"], 3)
+
+    # full-train-step integration: masked-dense vs kernel-dispatched on
+    # the same masked params (identical numerics — tests pin it)
+    width = 512 if smoke else 2048
+    model = SegmentedModel([
+        L.Dense("fc1", 64, width), L.Activation("a1", "relu"),
+        L.Dense("fc2", width, width), L.Activation("a2", "relu"),
+        L.Dense("out", width, 10),
+    ], input_shape=(64,))
+    params, state = init_model(model, seed=0)
+    scores = np.asarray(jax.random.normal(jax.random.PRNGKey(2), (width,)))
+    drop = score_drop_indices(scores, policy="fraction", fraction=0.5,
+                              granularity=block)
+    drops = {"fc2": drop}
+    masks, _ = masking.drop_masks(model, params, drops, state=state)
+    mp = masking.apply_masks(params, masks)
+    tx = optax.chain(optax.sgd(0.05), masking.masked_update(masks))
+    xb = jax.random.normal(jax.random.PRNGKey(3), (R, 64))
+    yb = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(4), (R,), 0, 10))
+    rng = jax.random.PRNGKey(5)
+
+    def step_ms(param_transform):
+        step = make_train_step(model, tx, cross_entropy_loss,
+                               donate=False,
+                               param_transform=param_transform)
+        o = tx.init(mp)
+        stats = time_fn(step, mp, state, o, xb, yb, rng, iters=5,
+                        warmup=2, chained=True)
+        return round(steady_s(stats) * 1e3, 3)
+
+    r["train_step_masked_dense_ms"] = step_ms(None)
+    r["train_step_blocksparse_ms"] = step_ms(
+        lambda p: masking.blocksparse_params(model, p, drops, block=block))
+    r["train_step_ms_saved"] = round(
+        r["train_step_masked_dense_ms"]
+        - r["train_step_blocksparse_ms"], 3)
+    r["train_step_speedup"] = round(
+        r["train_step_masked_dense_ms"]
+        / max(r["train_step_blocksparse_ms"], 1e-9), 3)
+    # headline: the measured ms reduction 50% structured sparsity buys
+    # through the SAME kernel machinery on the same shapes — positive on
+    # every backend.  The vs-XLA train-step comparison is only
+    # meaningful on chip (the CPU interpreter pays a per-block python
+    # dispatch the MXU pipeline doesn't); scripts/capture_tpu.sh's
+    # staged assertion holds that line when the tunnel returns.
+    r["value"] = r["sparse_vs_dense_kernel"]
+    r["unit"] = "x_vs_dense_same_kernel_at_50pct_sparsity"
+    with _kernel_window(r, steps=1):
+        jax.block_until_ready(variants["sparse_kernel"](x, wb))
+    return r
 
 
 def _leg_llama_decode(smoke: bool, progress=None) -> dict:
@@ -1393,6 +1524,7 @@ def main() -> dict:
         run_leg("mfu_llama", _leg_mfu_llama)
         run_leg("vgg16_train", _leg_vgg_train)
         run_leg("flash_attention", _leg_flash_attention)
+        run_leg("blocksparse", _leg_blocksparse)
         run_leg("llama_decode", _leg_llama_decode)
         run_leg("serve", _leg_serve)
         run_leg("vgg16_robustness", _leg_vgg_robustness)

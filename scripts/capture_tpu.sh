@@ -50,10 +50,62 @@ if ok:
 sys.exit(0 if ok else 1)
 EOF
 
-# 2. flash-attention S-sweep (+ block tuning): the time-crossover table
+# 1b. STAGED ASSERTION (ROADMAP item 2 acceptance): the retuned flash
+#     kernel must be >= 1.3x XLA at S >= 8k on the bench leg.  Parse the
+#     fresh on-chip bench result's flash row; a miss is loud (nonzero
+#     step status in the log) but does not abort the capture — the
+#     remaining artifacts are the evidence needed to diagnose it.
+python - "results/bench_tpu_${stamp}_${commit}.json" <<'EOF' \
+    && echo "[capture] flash >=1.3x @ S>=8k HOLDS" \
+    || echo "[capture] flash >=1.3x @ S>=8k FAILED — retune before merging PERF claims"
+import json, sys
+leg = json.load(open(sys.argv[1])).get("legs", {}).get("flash_attention", {})
+sp = leg.get("speedup")
+assert sp is not None and "S8192" in str(leg.get("shape", "")), leg
+assert sp >= 1.3, f"flash speedup {sp} < 1.3 at {leg.get('shape')} (blocks {leg.get('tuned_blocks')})"
+EOF
+
+# 1c. STAGED ASSERTION (FLASH_BWD_XLA_MIN_S retirement): the re-blocked
+#     backward (O(block) VMEM, 4D grids) must now COMPILE AND RUN at
+#     S=32k — the shape whose whole-sequence VMEM specs made the old
+#     backward 500 on remote compile.  Pass = the retirement stands;
+#     fail = re-arm via TORCHPRUNER_FLASH_BWD_XLA_MIN_S=32768 and file
+#     the Mosaic error.
+timeout 1800 python - <<'EOF' \
+    && echo "[capture] 32k flash backward compiles+runs — retirement stands" \
+    || echo "[capture] 32k flash backward STILL fails — re-arm TORCHPRUNER_FLASH_BWD_XLA_MIN_S=32768"
+import jax, jax.numpy as jnp
+from torchpruner_tpu.ops.flash_attention import flash_attention
+ks = jax.random.split(jax.random.PRNGKey(0), 3)
+q, k, v = (jax.random.normal(kk, (1, 32768, 4, 64), jnp.bfloat16) for kk in ks)
+g = jax.jit(jax.grad(lambda a, b, c: jnp.sum(
+    flash_attention(a, b, c, causal=True).astype(jnp.float32)),
+    argnums=(0, 1, 2)))
+jax.block_until_ready(g(q, k, v))
+print("32k backward ok")
+EOF
+
+# 2. flash-attention S-sweep (+ block tuning, persisted into the
+#    autotune cache the dispatch path reads): the time-crossover table
 timeout 3600 python -m torchpruner_tpu.experiments.flash_sweep --tune \
     --out "results/flash_sweep_tpu_${stamp}_${commit}.json" \
     2> "logs/flash_sweep_${stamp}.err" && echo "[capture] flash sweep done"
+
+# 2b. kernel micro-bench on chip: autotune + parity + kernel_* gauges
+#     for the new kernels (decode attention, block-sparse, fused
+#     dequant) — the numbers the CPU-smoke gates are placeholders for
+timeout 1800 python -m torchpruner_tpu.ops.kernel_bench \
+    --obs-dir "logs/kernel_bench_tpu_${stamp}" \
+    > "results/kernel_bench_tpu_${stamp}_${commit}.json" \
+    2> "logs/kernel_bench_${stamp}.err" \
+    && echo "[capture] kernel bench done"
+
+# 2c. int4_bench refresh (PERF.md capture checklist): the decode-matmul
+#     bandwidth table, now with the XLA-int8 vs kernel-int8 split that
+#     answers the "did the convert fuse" question directly
+timeout 1800 python -m torchpruner_tpu.experiments.int4_bench \
+    --out "results/int4_bench_tpu_${stamp}_${commit}.json" \
+    2> "logs/int4_bench_${stamp}.err" && echo "[capture] int4 bench done"
 
 # 3. compile economics (bucketing x persistent cache) on the real backend
 timeout 3600 python -m torchpruner_tpu.experiments.compile_economics \

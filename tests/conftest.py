@@ -103,7 +103,6 @@ SLOW_TESTS = {
     "test_pp_spmd.py::test_pp_spmd_interleaved_forward_matches_sequential",
     "test_pp_spmd.py::test_pp_spmd_interleaved_train_step_matches_gpipe",
     "test_pp_spmd.py::test_pp_spmd_interleaved_ragged_wave_still_matches",
-    "test_flash_attention.py::test_bwd_xla_fallback_above_threshold",
     "test_quant.py::test_quantized_random_params_build_and_serve",
     "test_train.py::test_multi_step_matches_sequential_steps",
     "test_torch_import.py::test_vgg16_bn_import_from_saved_checkpoint_file",

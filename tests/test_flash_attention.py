@@ -218,12 +218,41 @@ def test_causal_cross_attention_bottom_right_aligned():
                                    np.asarray(want), rtol=1e-5, atol=1e-5)
 
 
-def test_bwd_xla_fallback_above_threshold(monkeypatch):
-    """At S >= FLASH_BWD_XLA_MIN_S (32k on chip) the vjp recomputes
-    gradients through the XLA path while the forward stays flash; both
-    must match the pure-XLA computation."""
+def test_pallas_kernels_on_cpu_via_force_flag(monkeypatch):
+    """Tier-1's guarantee that the real Pallas kernels (interpret mode)
+    still run on CPU now that the production non-TPU path is the
+    blocked lax formulation: FORCE_PALLAS routes dispatch through the
+    kernels, and fwd+bwd must match XLA."""
     from torchpruner_tpu.ops import flash_attention as F
 
+    monkeypatch.setattr(F, "FORCE_PALLAS", True)
+    q, k, v = qkv(S=256, dtype=jnp.float32)
+    g = jax.random.normal(jax.random.PRNGKey(11), q.shape)
+
+    def grads(fn):
+        def f(q_, k_, v_):
+            return jnp.sum(fn(q_, k_, v_, causal=True) * g)
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    np.testing.assert_allclose(
+        np.asarray(F.flash_attention(q, k, v, causal=True)),
+        np.asarray(_xla_attention(q, k, v, causal=True)), atol=1e-5)
+    for ga, gw in zip(grads(F.flash_attention),
+                      grads(lambda a, b, c, causal: _xla_attention(
+                          a, b, c, causal=causal))):
+        np.testing.assert_allclose(np.asarray(ga), np.asarray(gw),
+                                   atol=2e-4, rtol=1e-3)
+
+
+def test_bwd_xla_fallback_when_env_armed(monkeypatch):
+    """The RETIRED 32k fallback stays env-armable: with
+    FLASH_BWD_XLA_MIN_S set, the vjp recomputes gradients through the
+    XLA path while the forward stays flash; both must match the
+    pure-XLA computation."""
+    from torchpruner_tpu.ops import flash_attention as F
+
+    assert F.FLASH_BWD_XLA_MIN_S is None  # retired by default
+    monkeypatch.setattr(F, "FORCE_PALLAS", True)
     monkeypatch.setattr(F, "FLASH_BWD_XLA_MIN_S", 32)
     q, k, v = qkv(S=64)
 

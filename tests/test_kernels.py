@@ -1,0 +1,450 @@
+"""Kernel-subsystem tests: autotune cache round-trip, decode-shaped
+attention parity (incl. the bit-stability contract the serve --verify
+path hangs on), block-sparse matmul fwd/bwd + training equivalence, and
+the fused int8/int4 dequant matmul — all through the real kernel code in
+interpreter mode on CPU."""
+
+import json
+import os
+
+import numpy as np
+import jax
+import numpy as onp
+import jax.numpy as jnp
+import pytest
+
+from torchpruner_tpu.ops import autotune
+
+
+@pytest.fixture
+def tune_cache(tmp_path, monkeypatch):
+    path = str(tmp_path / "tune.json")
+    monkeypatch.setenv(autotune.ENV_VAR, path)
+    autotune.reset()
+    yield path
+    autotune.reset()
+
+
+# -- autotune ----------------------------------------------------------------
+
+
+def test_autotune_record_persist_reload(tune_cache):
+    key = autotune.record(autotune.KIND_FLASH, 64, 4096, jnp.bfloat16,
+                          (128, 256), ms=1.5)
+    assert os.path.exists(tune_cache)
+    autotune.reset()  # drop memory: must reload from disk
+    assert autotune.lookup(autotune.KIND_FLASH, 64, 4096,
+                           jnp.bfloat16) == (128, 256)
+    # same seq bucket -> same entry; different head dim -> miss
+    assert autotune.lookup(autotune.KIND_FLASH, 64, 3000,
+                           jnp.bfloat16) == (128, 256)
+    assert autotune.lookup(autotune.KIND_FLASH, 32, 4096,
+                           jnp.bfloat16) is None
+    entries = json.load(open(tune_cache))
+    assert key in entries and entries[key]["blocks"] == [128, 256]
+
+
+def test_autotune_non_tpu_records_defaults(tune_cache):
+    calls = []
+
+    def run(blocks):
+        calls.append(blocks)
+        return lambda: None
+
+    blocks = autotune.autotune(
+        autotune.KIND_FLASH, 16, 256, jnp.float32, run=run,
+        candidates=((8, 8), (16, 16)), defaults=(128, 128))
+    assert blocks == (128, 128)
+    assert calls == []  # interpreter timing is meaningless: no timing ran
+    assert autotune.lookup(autotune.KIND_FLASH, 16, 256,
+                           jnp.float32) == (128, 128)
+
+
+def test_autotune_force_times_candidates_and_roundtrips(tune_cache):
+    from torchpruner_tpu.ops import flash_attention as F
+
+    S, Dh = 128, 8
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (1, S, 2, Dh)) for kk in ks)
+
+    def run(blocks):
+        fn = jax.jit(lambda a, b, c: F.flash_attention(
+            a, b, c, causal=True, block_q=blocks[0], block_k=blocks[1]))
+        return lambda: fn(q, k, v)
+
+    best = autotune.autotune(
+        autotune.KIND_FLASH, Dh, S, q.dtype, run=run,
+        candidates=((32, 32), (64, 64)), defaults=(128, 128),
+        force=True, iters=1, warmup=1)
+    assert best in ((32, 32), (64, 64))
+    autotune.reset()
+    assert autotune.lookup(autotune.KIND_FLASH, Dh, S, q.dtype) == best
+
+
+def test_flash_dispatch_consults_tuned_blocks(tune_cache, monkeypatch):
+    from torchpruner_tpu.ops import flash_attention as F
+
+    seen = {}
+    orig = F._lax_flash
+
+    def spy(q, k, v, causal, bq, bk):
+        seen["blocks"] = (bq, bk)
+        return orig(q, k, v, causal, bq, bk)
+
+    monkeypatch.setattr(F, "_lax_flash", spy)
+    S, Dh = 256, 16
+    autotune.record(autotune.KIND_FLASH, Dh, S, jnp.float32, (64, 32))
+    q, k, v = (jax.random.normal(kk, (1, S, 2, Dh))
+               for kk in jax.random.split(jax.random.PRNGKey(1), 3))
+    F.flash_attention(q, k, v, causal=True)
+    assert seen["blocks"] == (64, 32)
+
+
+# -- decode attention --------------------------------------------------------
+
+
+def _decode_case(B=3, T=128, H=2, Dh=16, cache_dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(ks[0], (B, 1, H, Dh))
+    kc = jax.random.normal(ks[1], (B, T, H, Dh), cache_dtype)
+    vc = jax.random.normal(ks[2], (B, T, H, Dh), cache_dtype)
+    pos = jnp.asarray([3, T // 2, T - 1][:B], jnp.int32)
+    return q, kc, vc, pos
+
+
+def test_decode_kernel_matches_einsum():
+    from torchpruner_tpu.ops import decode_attention as DA
+
+    q, kc, vc, pos = _decode_case()
+    got = DA.decode_attention(q, kc, vc, pos)
+    want = DA.xla_decode_attention(q, kc, vc, pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5)
+
+
+def test_decode_kernel_masks_poisoned_future():
+    """Garbage (huge values) past each row's pos — recycled-slot stale
+    K/V — must not perturb the result at all."""
+    from torchpruner_tpu.ops import decode_attention as DA
+
+    q, kc, vc, pos = _decode_case()
+    clean = DA.decode_attention(q, kc, vc, pos)
+    kc_p, vc_p = onp.array(kc), onp.array(vc)
+    for b, p in enumerate(np.asarray(pos)):
+        kc_p[b, p + 1:] = 1e6
+        vc_p[b, p + 1:] = -1e6
+    poisoned = DA.decode_attention(q, jnp.asarray(kc_p), jnp.asarray(vc_p),
+                                   pos)
+    np.testing.assert_array_equal(np.asarray(clean), np.asarray(poisoned))
+
+
+def test_decode_scalar_pos_bit_identical_to_vector():
+    """A scalar pos broadcast across the batch (generate's scan) and the
+    per-slot vector form (the serve step) must agree BIT-identically —
+    the --verify replay contract."""
+    from torchpruner_tpu.ops import decode_attention as DA
+
+    q, kc, vc, _ = _decode_case(B=2, T=64)
+    p = 37
+    vec = DA.decode_attention(q, kc, vc, jnp.asarray([p, p], jnp.int32))
+    sca = DA.decode_attention(q, kc, vc, jnp.asarray(p, jnp.int32))
+    np.testing.assert_array_equal(np.asarray(vec), np.asarray(sca))
+
+
+def test_decode_row_independent_of_batch_neighbours():
+    """Row b's output depends only on row b's q/cache/pos — solo decode
+    (B=1) must reproduce the batched row bit-identically."""
+    from torchpruner_tpu.ops import decode_attention as DA
+
+    q, kc, vc, pos = _decode_case(B=3, T=128)
+    batched = np.asarray(DA.decode_attention(q, kc, vc, pos))
+    for b in range(3):
+        solo = DA.decode_attention(q[b:b + 1], kc[b:b + 1], vc[b:b + 1],
+                                   pos[b:b + 1])
+        np.testing.assert_array_equal(np.asarray(solo)[0], batched[b])
+
+
+def test_decode_block_is_deterministic_in_T_only():
+    from torchpruner_tpu.ops.decode_attention import decode_block
+
+    assert decode_block(64) == 64
+    assert decode_block(96) == 32
+    assert decode_block(24) == 8
+    assert decode_block(512) == 128  # capped at the lane width
+    assert decode_block(20) is None  # largest pow2 divisor (4) < 8
+    assert decode_block(100) is None
+
+
+def test_decode_non_blocking_T_falls_back_consistently():
+    """T with no clean blocking routes BOTH the batched and the solo
+    call to the einsum path — fallback choice is a function of T, so
+    bit-identity survives."""
+    from torchpruner_tpu.ops import decode_attention as DA
+
+    q, kc, vc, pos = _decode_case(B=2, T=20)
+    got = DA.decode_attention(q, kc, vc, pos[:2])
+    want = DA.xla_decode_attention(q, kc, vc, pos[:2])
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_generate_slot_vs_solo_bit_identity_kernel_blocks():
+    """End-to-end ragged parity at a cache length where the KERNEL (not
+    the einsum fallback) serves decode: T=32 -> block 32."""
+    from torchpruner_tpu.core.segment import init_model
+    from torchpruner_tpu.models import llama_tiny
+    from torchpruner_tpu.ops.decode_attention import decode_block
+    from test_generate import ragged_parity_case
+
+    assert decode_block(24) is not None  # ragged_parity_case uses T=24
+    model = llama_tiny()
+    params, _ = init_model(model, seed=0)
+    ragged_parity_case(model, params)
+
+
+# -- block-sparse matmul -----------------------------------------------------
+
+
+def _sparse_w(D, F, block, seed=3):
+    w = onp.array(jax.random.normal(jax.random.PRNGKey(seed), (D, F)),
+                  onp.float32)
+    in_keep = tuple(range(0, D // block, 2))
+    out_keep = tuple(b for b in range(F // block) if b % 3 != 1)
+    for b in range(D // block):
+        if b not in in_keep:
+            w[b * block:(b + 1) * block] = 0
+    for b in range(F // block):
+        if b not in out_keep:
+            w[:, b * block:(b + 1) * block] = 0
+    return jnp.asarray(w), in_keep, out_keep
+
+
+def test_blocksparse_forward_matches_masked_dense():
+    from torchpruner_tpu.ops.blocksparse import blocksparse_matmul
+
+    block = 32
+    w, ik, ok = _sparse_w(128, 96, block)
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 8, 128))
+    got = blocksparse_matmul(x, w, in_keep=ik, out_keep=ok, block=block)
+    want = x @ w
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-4)
+    # dropped output columns are EXACT zeros (mask semantics)
+    dropped_cols = [c for b in range(96 // block) if b not in ok
+                    for c in range(b * block, (b + 1) * block)]
+    assert (np.asarray(got)[..., dropped_cols] == 0).all()
+
+
+def test_blocksparse_gradients_match_dense_on_kept_blocks():
+    from torchpruner_tpu.ops.blocksparse import blocksparse_matmul
+
+    block = 32
+    w, ik, ok = _sparse_w(64, 64, block)
+    x = jax.random.normal(jax.random.PRNGKey(5), (16, 64))
+
+    def f_sparse(x_, w_):
+        return jnp.sum(blocksparse_matmul(
+            x_, w_, in_keep=ik, out_keep=ok, block=block) ** 2)
+
+    def f_dense(x_, w_):
+        return jnp.sum((x_ @ w_) ** 2)
+
+    gx, gw = jax.grad(f_sparse, argnums=(0, 1))(x, w)
+    gx_d, gw_d = jax.grad(f_dense, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_d),
+                               atol=1e-3, rtol=1e-4)
+    mask = onp.zeros((64, 64), bool)
+    for bi in ik:
+        for bj in ok:
+            mask[bi * block:(bi + 1) * block,
+                 bj * block:(bj + 1) * block] = True
+    np.testing.assert_allclose(np.asarray(gw)[mask],
+                               np.asarray(gw_d)[mask],
+                               atol=1e-3, rtol=1e-4)
+    # dropped blocks receive EXACTLY zero gradient (they are pruned)
+    assert (np.asarray(gw)[~mask] == 0).all()
+
+
+def test_keep_block_helpers():
+    from torchpruner_tpu.ops.blocksparse import (
+        keep_blocks_from_drop,
+        keep_blocks_from_mask,
+    )
+
+    assert keep_blocks_from_drop(128, range(32, 64), 32) == (0, 2, 3)
+    assert keep_blocks_from_drop(128, [5], 32) is None  # partial block
+    assert keep_blocks_from_drop(100, [], 32) is None   # doesn't tile
+    m = onp.ones(96)
+    m[64:] = 0
+    assert keep_blocks_from_mask(m, 32) == (0, 1)
+
+
+def test_score_drop_indices_granularity():
+    from torchpruner_tpu.core.pruner import score_drop_indices
+
+    scores = onp.arange(256, dtype=onp.float64)  # ascending: low first
+    drop = score_drop_indices(scores, policy="fraction", fraction=0.5,
+                              granularity=128)
+    np.testing.assert_array_equal(drop, onp.arange(128))
+    neg = -onp.ones(256)
+    neg[128:] = 1.0
+    drop2 = score_drop_indices(neg, policy="negative", granularity=64)
+    np.testing.assert_array_equal(drop2, onp.arange(128))
+    with pytest.raises(ValueError, match="granularity"):
+        score_drop_indices(scores[:100], granularity=64)
+
+
+def test_blocksparse_training_matches_masked_dense():
+    """The full integration: drop 50% of a layer's units at 128-block
+    granularity, train masked-dense vs block-sparse-dispatched
+    (train.loop param_transform) — identical loss/param trajectories,
+    masked units pinned at zero."""
+    import optax
+
+    from torchpruner_tpu.core import layers as L
+    from torchpruner_tpu.core import masking
+    from torchpruner_tpu.core.pruner import score_drop_indices
+    from torchpruner_tpu.core.segment import SegmentedModel, init_model
+    from torchpruner_tpu.train.loop import make_train_step
+    from torchpruner_tpu.utils.losses import cross_entropy_loss
+
+    model = SegmentedModel([
+        L.Dense("fc1", 32, 256), L.Activation("a1", "relu"),
+        L.Dense("fc2", 256, 256), L.Activation("a2", "relu"),
+        L.Dense("out", 256, 10),
+    ], input_shape=(32,))
+    params, state = init_model(model, seed=0)
+    scores = onp.asarray(
+        jax.random.normal(jax.random.PRNGKey(6), (256,)))
+    drop = score_drop_indices(scores, policy="fraction", fraction=0.5,
+                              granularity=128)
+    drops = {"fc2": drop}
+    masks, _ = masking.drop_masks(model, params, drops, state=state)
+    mp = masking.apply_masks(params, masks)
+    tx = optax.chain(optax.sgd(0.05), masking.masked_update(masks))
+    x = jax.random.normal(jax.random.PRNGKey(7), (16, 32))
+    y = onp.asarray(jax.random.randint(jax.random.PRNGKey(8), (16,), 0, 10))
+
+    def run(param_transform):
+        step = make_train_step(model, tx, cross_entropy_loss,
+                               donate=False,
+                               param_transform=param_transform)
+        p, s, o = mp, state, tx.init(mp)
+        for i in range(3):
+            p, s, o, l = step(p, s, o, x, y, jax.random.PRNGKey(i))
+        return p, float(l)
+
+    p_dense, l_dense = run(None)
+    p_sparse, l_sparse = run(lambda p: masking.blocksparse_params(
+        model, p, drops, block=128))
+    assert l_dense == pytest.approx(l_sparse, rel=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(p_dense),
+                    jax.tree_util.tree_leaves(p_sparse)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=1e-3)
+    assert (np.asarray(p_sparse["fc2"]["w"])[:, drop] == 0).all()
+
+
+def test_qdot_dispatches_blocksparse_weight():
+    from torchpruner_tpu.ops.blocksparse import BlockSparseWeight
+    from torchpruner_tpu.ops.quant import qdot
+
+    block = 32
+    w, ik, ok = _sparse_w(64, 64, block)
+    bsw = BlockSparseWeight(w, ik, ok, block)
+    x = jax.random.normal(jax.random.PRNGKey(9), (8, 64))
+    np.testing.assert_allclose(np.asarray(qdot(x, bsw)),
+                               np.asarray(x @ w), atol=1e-4)
+    # pytree: wrapping survives jit boundaries with static keep lists
+    y = jax.jit(lambda x_, w_: qdot(x_, w_))(x, bsw)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x @ w),
+                               atol=1e-4)
+
+
+# -- fused dequant matmul ----------------------------------------------------
+
+
+def test_dequant_matmul_int8_fused_scale_parity():
+    from torchpruner_tpu.ops.fused_matmul import dequant_matmul
+    from torchpruner_tpu.ops.quant import quantize_tensor
+
+    rng = onp.random.default_rng(1)
+    w = jnp.asarray(rng.normal(size=(256, 384)).astype(onp.float32))
+    x = jnp.asarray(rng.normal(size=(8, 256)).astype(onp.float32))
+    qt = quantize_tensor(w, in_axes=1)
+    got = dequant_matmul(x, qt.q, qt.out_scale(), bits=8)
+    want = jnp.dot(x.astype(jnp.bfloat16), qt.q.astype(jnp.bfloat16),
+                   preferred_element_type=jnp.float32) \
+        * qt.out_scale()[None]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-3)
+
+
+@pytest.mark.parametrize("shape", [(8, 256, 384), (8, 250, 100)])
+def test_dequant_matmul_int4_matches_unpack_path(shape):
+    """Tiled kernel and non-tiling XLA fallback agree with the
+    reference unpack-then-matmul at fused scale."""
+    from torchpruner_tpu.ops.fused_matmul import dequant_matmul
+    from torchpruner_tpu.ops.int4_matmul import quantize_int4, unpack_int4
+
+    B, D, F = shape
+    rng = onp.random.default_rng(2)
+    w = jnp.asarray(rng.normal(size=(D, F)).astype(onp.float32))
+    x = jnp.asarray(rng.normal(size=(B, D)).astype(onp.float32))
+    p4, s4 = quantize_int4(w)
+    got = dequant_matmul(x, p4, s4, bits=4)
+    want = jnp.dot(x.astype(jnp.bfloat16),
+                   unpack_int4(p4).astype(jnp.bfloat16),
+                   preferred_element_type=jnp.float32) * s4[None]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_qdot_int8_kernel_routing_forced():
+    """With INT8_KERNEL forced on, qdot serves int8 QTensors through the
+    fused kernel — same result as the XLA convert path within bf16
+    accumulation tolerance."""
+    from torchpruner_tpu.ops import fused_matmul as FM
+    from torchpruner_tpu.ops.quant import oscale, qdot, quantize_tensor
+
+    rng = onp.random.default_rng(3)
+    w = jnp.asarray(rng.normal(size=(256, 128)).astype(onp.float32))
+    x = jnp.asarray(rng.normal(size=(4, 256)).astype(onp.float32)
+                    ).astype(jnp.bfloat16)
+    qt = quantize_tensor(w, in_axes=1)
+    prev = FM.INT8_KERNEL
+    try:
+        FM.INT8_KERNEL = True
+        got = oscale(qdot(x, qt), qt)
+    finally:
+        FM.INT8_KERNEL = prev
+    want = oscale(x @ qt.q.astype(jnp.bfloat16), qt)
+    np.testing.assert_allclose(np.asarray(got, onp.float32),
+                               np.asarray(want, onp.float32),
+                               rtol=2e-2, atol=2e-2)
+    assert not FM.int8_kernel_active()  # auto: off on the CPU backend
+
+
+# -- lint exemption ----------------------------------------------------------
+
+
+def test_jaxpr_lint_exempts_kernel_internals():
+    """A kernel-bearing bf16 program must not trip promoted-matmul or
+    dtype-drift on the kernel's deliberate f32 MXU accumulation."""
+    from torchpruner_tpu.analysis.jaxpr_lint import lint_jaxpr
+    from torchpruner_tpu.ops import flash_attention as F
+
+    prev = F.FORCE_PALLAS
+    try:
+        F.FORCE_PALLAS = True
+
+        def f(q, k, v):
+            return jnp.sum(F.flash_attention(q, k, v, causal=True))
+
+        q = jnp.zeros((1, 64, 2, 16), jnp.bfloat16)
+        closed = jax.make_jaxpr(jax.grad(f))(q, q, q)
+    finally:
+        F.FORCE_PALLAS = prev
+    findings = lint_jaxpr(closed, compute_dtype=jnp.bfloat16)
+    bad = [x for x in findings
+           if "matmul" in x.check or "drift" in x.check]
+    assert not bad, [x.message for x in bad]

@@ -92,6 +92,16 @@ def test_continuous_batching_tokens_match_solo_decode(tiny_engine):
     mid-run admissions and slot recycling — and every request's tokens
     bit-identical to its static solo generate() decode."""
     model, params, eng = tiny_engine
+    # the gauge must agree with the ONE dispatch predicate — max_len=64
+    # blocks cleanly, so the decode-shaped kernel serves this engine and
+    # the parity below exercises it (not the einsum fallback)
+    from torchpruner_tpu.generate import _attn_layers
+    from torchpruner_tpu.ops import decode_attention as _da
+
+    head_dim = next(spec.head_dim for _, spec in _attn_layers(model.layers))
+    assert eng.decode_kernel
+    assert eng.decode_kernel == _da.kernel_active(
+        eng.max_len, head_dim, jnp.float32)
     reqs = synthetic_requests(6, vocab=64, prompt_lens=[4, 7, 5],
                               max_new=[6, 3, 9], seed=1)
     traffic = OpenLoopTraffic(reqs, staggered_arrivals(6, every_steps=2),
@@ -102,8 +112,11 @@ def test_continuous_batching_tokens_match_solo_decode(tiny_engine):
     assert eng.scheduler.allocator.active_slots == 0
     for r in reqs:
         assert r.state == DONE and len(r.tokens) == r.max_new
-        want = np.asarray(
-            generate(model, params, r.prompt_ids[None], r.max_new))[0]
+        # replay at the ENGINE's cache length: the decode kernel's block
+        # partition is a function of max_len (ops/decode_attention.py),
+        # so bit-identity pins the replay to the serving geometry
+        want = np.asarray(generate(model, params, r.prompt_ids[None],
+                                   r.max_new, max_len=eng.max_len))[0]
         np.testing.assert_array_equal(np.asarray(r.tokens, np.int32),
                                       want)
         assert r.ttft_s is not None and r.ttft_s >= 0
@@ -133,7 +146,7 @@ def test_sampled_requests_match_seeded_generate(tiny_engine):
         want = np.asarray(generate(
             model, params, r.prompt_ids[None], r.max_new,
             temperature=s.temperature, top_k=s.top_k, top_p=s.top_p,
-            rng=jax.random.PRNGKey(s.seed)))[0]
+            rng=jax.random.PRNGKey(s.seed), max_len=eng.max_len))[0]
         np.testing.assert_array_equal(np.asarray(r.tokens, np.int32),
                                       want)
 
@@ -153,8 +166,8 @@ def test_moe_and_bf16_cache_serving():
     eng.run()
     for r in reqs:
         want = np.asarray(generate(model, params, r.prompt_ids[None],
-                                   r.max_new,
-                                   cache_dtype=jnp.bfloat16))[0]
+                                   r.max_new, cache_dtype=jnp.bfloat16,
+                                   max_len=eng.max_len))[0]
         np.testing.assert_array_equal(np.asarray(r.tokens, np.int32),
                                       want)
 
@@ -346,8 +359,8 @@ def test_hot_swap_switches_at_boundary_after_drain(tmp_path):
     for q in reqs:
         served_new = q.served_by is not old_programs
         m_, p_ = (pm, pp) if served_new else (model, params)
-        want = np.asarray(
-            generate(m_, p_, q.prompt_ids[None], q.max_new))[0]
+        want = np.asarray(generate(m_, p_, q.prompt_ids[None],
+                                   q.max_new, max_len=eng.max_len))[0]
         np.testing.assert_array_equal(np.asarray(q.tokens, np.int32),
                                       want)
     assert sum(q.served_by is not old_programs for q in reqs) == 3
@@ -416,7 +429,8 @@ def test_prefill_bucket_never_exceeds_slot_length():
         jax.random.randint(jax.random.PRNGKey(9), (97,), 0, 64), np.int32)
     req = eng.submit(Request(prompt_ids=prompt, max_new=3))
     eng.run()
-    want = np.asarray(generate(model, params, prompt[None], 3))[0]
+    want = np.asarray(generate(model, params, prompt[None], 3,
+                               max_len=eng.max_len))[0]
     np.testing.assert_array_equal(np.asarray(req.tokens, np.int32), want)
 
 
@@ -520,7 +534,8 @@ def test_http_endpoint_roundtrip():
         out = json.load(urllib.request.urlopen(req, timeout=120))
         assert out["state"] == "done" and len(out["tokens"]) == 6
         want = np.asarray(generate(
-            model, params, np.asarray([[5, 9, 2, 14]], np.int32), 6))[0]
+            model, params, np.asarray([[5, 9, 2, 14]], np.int32), 6,
+            max_len=eng.max_len))[0]
         np.testing.assert_array_equal(np.asarray(out["tokens"]), want)
         health = json.load(urllib.request.urlopen(
             f"http://127.0.0.1:{port}/healthz", timeout=10))

@@ -57,11 +57,22 @@ def _sub_jaxprs(eqn):
                     yield x
 
 
-def _walk_eqns(jaxpr):
+#: primitives whose sub-jaxprs ARE kernel bodies: inside them f32
+#: contractions are the DESIGN (Pallas kernels upcast bf16 operands in
+#: VMEM and accumulate on the MXU in f32 — ops/flash_attention.py,
+#: ops/blocksparse.py, ops/fused_matmul.py), and integer payloads widen
+#: to whatever the in-register unpack needs — so the promoted-matmul /
+#: mixed-precision / quant-drift checks do not apply.  float64 stays
+#: flagged everywhere (no TPU kernel should ever see it).
+_KERNEL_PRIMS = {"pallas_call", "tpu_custom_call", "mosaic"}
+
+
+def _walk_eqns(jaxpr, in_kernel: bool = False):
     for eqn in jaxpr.eqns:
-        yield eqn
+        yield eqn, in_kernel
+        sub_kernel = in_kernel or eqn.primitive.name in _KERNEL_PRIMS
         for sub in _sub_jaxprs(eqn):
-            yield from _walk_eqns(sub)
+            yield from _walk_eqns(sub, sub_kernel)
 
 
 def _aval(v):
@@ -102,7 +113,7 @@ def lint_jaxpr(
                 f"retrace/recompile; pass it as an argument instead",
             )
 
-    for eqn in _walk_eqns(closed.jaxpr):
+    for eqn, in_kernel in _walk_eqns(closed.jaxpr):
         prim = eqn.primitive.name
         out_avals = [a for a in map(_aval, eqn.outvars) if a is not None]
         in_avals = [a for a in map(_aval, eqn.invars) if a is not None]
@@ -115,6 +126,9 @@ def lint_jaxpr(
                     f"have no f64 fast path (check jax_enable_x64 and "
                     f"np.float64 constants)",
                 )
+
+        if in_kernel:
+            continue  # kernel internals: see _KERNEL_PRIMS
 
         bf16_policy = (
             compute_dtype is not None

@@ -110,6 +110,63 @@ def apply_masks(tree, masks):
                                   tree, masks)
 
 
+def blocksparse_params(
+    model: SegmentedModel,
+    params,
+    drops: Dict[Union[str, PruneGroup], Sequence[int]],
+    *,
+    block: int = 128,
+):
+    """Wrap the 2-D matmul weights a masked prune of ``drops`` zeroes in
+    :class:`~torchpruner_tpu.ops.blocksparse.BlockSparseWeight`, so the
+    Dense/GatedDense apply sites (``quant.qdot``) run the block-sparse
+    kernel — dropped 128-blocks neither fetched nor multiplied, forward
+    and backward — instead of dense-multiplying zeros.
+
+    Call on ALREADY-MASKED params (``apply_masks`` first; the wrapped
+    buffer is the masked one, so the XLA fallback stays numerically
+    equivalent).  Slices whose drop pattern is not block-aligned (use
+    ``score_drop_indices(granularity=block)`` to make it so), non-2-D
+    weights (attention/conv), and fan-out slices keep plain mask
+    semantics — correct, just not faster.  Returns new params; the
+    wrapping is metadata only (same buffers), so re-wrapping inside a
+    jitted step costs nothing per step.
+    """
+    from torchpruner_tpu.ops.blocksparse import (
+        BlockSparseWeight,
+        keep_blocks_from_drop,
+    )
+
+    sites: Dict[Tuple[str, ...], Dict[str, Tuple[int, ...]]] = {}
+    for layer, drop in drops.items():
+        group = layer if isinstance(layer, PruneGroup) else G.group_for(
+            model, layer
+        )
+        plan = plan_for_group(model, group)
+        drop = np.unique(np.asarray(drop, dtype=np.int64).reshape(-1))
+        keep = keep_blocks_from_drop(plan.n_units, drop, block)
+        if keep is None or len(keep) * block == plan.n_units:
+            continue  # unaligned pattern or nothing dropped
+        for s in plan.slices:
+            if s.collection != "params" or s.fan_out > 1:
+                continue
+            leaf = _get_path(params, s.path)
+            if leaf is None or getattr(leaf, "ndim", 0) != 2 \
+                    or s.axis > 1 \
+                    or leaf.shape[s.axis] != plan.n_units:
+                continue
+            entry = sites.setdefault(s.path, {})
+            entry["out_keep" if s.axis == 1 else "in_keep"] = keep
+    out = params
+    for path, kw in sites.items():
+        leaf = _get_path(out, path)
+        if isinstance(leaf, BlockSparseWeight):
+            continue
+        out = _set_path(out, path, BlockSparseWeight(
+            leaf, kw.get("in_keep"), kw.get("out_keep"), block))
+    return out
+
+
 def masked_update(param_masks) -> optax.GradientTransformation:
     """Optax transform pinning masked parameters at zero through training
     (the JaxPruner-style sparsity-in-the-optimizer integration): chain it

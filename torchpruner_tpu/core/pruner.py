@@ -277,11 +277,41 @@ def score_drop_indices(
     policy: Union[str, Callable[[np.ndarray], np.ndarray]] = "negative",
     fraction: float = 0.5,
     bucket: int = 1,
+    granularity: int = 1,
 ) -> np.ndarray:
     """The scores→drop-indices policy of :func:`prune_by_scores` alone —
     shared with mask-based simulated pruning so both modes drop the exact
-    same units."""
+    same units.
+
+    ``granularity > 1`` makes the decision BLOCK-structured: scores are
+    pooled (mean) into consecutive blocks of that many units, the policy
+    ranks blocks, and whole blocks drop together.  At 128 (the vector-
+    lane width) the resulting masks are exactly the shape the block-
+    sparse matmul (ops/blocksparse.py) can skip — structured sparsity
+    the kernel turns into step time, per "Structured Model Pruning of
+    Convolutional Networks on TPUs" (PAPERS.md).  The kept width is a
+    multiple of ``granularity`` by construction, so ``bucket`` is
+    implied (and ignored) for buckets dividing the granularity."""
     scores = np.asarray(scores)
+    if granularity > 1:
+        n = len(scores)
+        if n % granularity:
+            raise ValueError(
+                f"granularity {granularity} does not divide the "
+                f"{n}-unit axis")
+        if bucket > 1 and granularity % bucket:
+            raise ValueError(
+                f"bucket {bucket} does not divide granularity "
+                f"{granularity}: block-structured drops keep widths in "
+                f"multiples of the granularity, which cannot honor "
+                f"this bucket")
+        block_scores = scores.reshape(-1, granularity).mean(axis=1)
+        bdrop = score_drop_indices(block_scores, policy=policy,
+                                   fraction=fraction, bucket=1)
+        return np.sort(
+            (bdrop[:, None] * granularity
+             + np.arange(granularity)[None, :]).reshape(-1)
+        ).astype(np.int64)
     if callable(policy):
         # np.unique: a callable may return duplicates, which would make
         # bucket_drop miscount the kept width (keep_n = n - len(drop)).

@@ -154,6 +154,16 @@ def run_sweep(tune: bool = False, smoke: bool = False) -> dict:
                     best = cell
         if best:
             tuning.append({"best": best})
+            # persist the winner where the dispatch path reads it
+            # (ops/autotune.py): the sweep's tuning becomes every later
+            # run's default for this (head-dim, seq-bucket, dtype)
+            from torchpruner_tpu.ops import autotune
+
+            key = autotune.record(
+                autotune.KIND_FLASH, DH, best["S"], jnp.bfloat16,
+                (best["block_q"], best["block_k"]), ms=best.get("ms"))
+            tuning.append({"recorded": key,
+                           "cache": autotune.cache_path()})
 
     out = {
         "device": str(jax.devices()[0].device_kind),

@@ -1,4 +1,5 @@
-"""Weight-precision decode-matmul bandwidth: bf16 vs int8 vs fused int4.
+"""Weight-precision decode-matmul bandwidth: bf16 vs int8 (XLA-fused
+and kernel-fused) vs fused int4.
 
 The serving lever is BYTES READ per decoded token (PERF.md); this
 experiment measures the three weight formats' per-iteration DEVICE time
@@ -8,10 +9,15 @@ profiler's XLA-Ops track, because on the tunnelled single chip both
 per-call stopwatches (≥ one RTT per call) and loop wall-clock (one RTT
 per fence, ~500 µs/iter at N=200) drown microsecond kernels.
 
-Writes ``{"paths": {bf16|int8|int4_kernel: {device_us, eff_GB_s}}}``;
-``eff_GB_s`` = weight bytes that format reads per iteration / device
-time — the bandwidth actually saved, if the int4 kernel's fused unpack
-works as designed (ops/int4_matmul.py).
+Writes ``{"paths": {bf16|int8|int8_kernel|int4_kernel: {device_us,
+eff_GB_s}}}``; ``eff_GB_s`` = weight bytes that format reads per
+iteration / device time — the bandwidth actually saved.  ``int8`` is
+the XLA convert-into-dot formulation (fusion hoped for), ``int8_kernel``
+and ``int4_kernel`` the fused dequant Pallas kernel
+(ops/fused_matmul.py: integer bytes to VMEM, widen/unpack in-register,
+scale fused onto the output block — fusion guaranteed); the XLA-vs-
+kernel int8 delta is exactly the "did the convert fuse" question the
+old stale-evidence note left open.
 
 Run: ``python -m torchpruner_tpu.experiments.int4_bench
 [--out results/...json] [--cpu --smoke]``.
@@ -28,7 +34,8 @@ def run(smoke: bool = False) -> dict:
     import jax.numpy as jnp
     import numpy as np
 
-    from torchpruner_tpu.ops.int4_matmul import int4_matmul, quantize_int4
+    from torchpruner_tpu.ops.fused_matmul import dequant_matmul
+    from torchpruner_tpu.ops.int4_matmul import quantize_int4
     from torchpruner_tpu.ops.quant import quantize_tensor
     from torchpruner_tpu.utils import profiling
     from torchpruner_tpu.utils.trace_analysis import summarize_trace
@@ -59,8 +66,12 @@ def run(smoke: bool = False) -> dict:
         "int8": (looped(lambda c, q, s: jnp.dot(
             c.astype(jnp.bfloat16), q.astype(jnp.bfloat16),
             preferred_element_type=jnp.float32) * s[None], q8, s8), D * F),
+        "int8_kernel": (looped(
+            lambda c, q, s: dequant_matmul(c, q, s, bits=8), q8, s8),
+            D * F),
         "int4_kernel": (looped(
-            lambda c, p, s: int4_matmul(c, p, s), p4, s4), D * F // 2),
+            lambda c, p, s: dequant_matmul(c, p, s, bits=4), p4, s4),
+            D * F // 2),
     }
 
     out: dict = {"B": B, "D": D, "F": F, "iters": N,

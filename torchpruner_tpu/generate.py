@@ -38,6 +38,7 @@ from jax import lax
 
 from torchpruner_tpu.core import layers as L
 from torchpruner_tpu.core.segment import SegmentedModel
+from torchpruner_tpu.ops.decode_attention import decode_attention
 from torchpruner_tpu.ops.quant import oscale, qdot, wval
 
 _NEG_INF = -1e30
@@ -119,22 +120,16 @@ def _decode_attention(spec, params, entry, x, pos):
         v_cache = lax.dynamic_update_slice(
             entry["v"], v.astype(entry["v"].dtype), (0, pos, 0, 0)
         )
-    # scores against the whole static buffer; mask the unwritten future
-    # (causal per query position within the block)
-    scale = 1.0 / np.sqrt(spec.head_dim)
-    s = jnp.einsum(
-        "bqhk,bthk->bhqt", q, k_cache, preferred_element_type=jnp.float32
-    ) * scale  # (B, H, s, max_len)
-    t = jnp.arange(k_cache.shape[1])
-    if ragged:
-        q_pos = pos[:, None] + jnp.arange(q.shape[1])[None, :]  # (B, s)
-        mask = (t[None, None, :] <= q_pos[:, :, None])[:, None]  # (B,1,s,T)
-    else:
-        q_pos = pos + jnp.arange(q.shape[1])
-        mask = (t[None, :] <= q_pos[:, None])[None, None]
-    s = jnp.where(mask, s, _NEG_INF)
-    w = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
-    ctx = jnp.einsum("bhqt,bthk->bqhk", w, v_cache)
+    # attention against the static buffer: single-token steps (s == 1,
+    # both the scalar-pos generate scan and the vector-pos slot array)
+    # dispatch the decode-shaped Pallas kernel, which streams KV blocks
+    # up to each row's own pos instead of scoring-then-masking the whole
+    # cache; prefill blocks (s > 1) and non-blocking cache lengths take
+    # the masked-einsum path inside the same dispatcher.  Both paths are
+    # deterministic functions of the cache length, which is what keeps
+    # slot-array decode bit-identical to solo decode (the serve
+    # --verify contract; see ops/decode_attention.py).
+    ctx = decode_attention(q, k_cache, v_cache, pos)
     y = oscale(jnp.einsum("bshk,hkd->bsd", ctx,
                           wval(params["wo"], ctx.dtype)), params["wo"])
     if "bo" in params:

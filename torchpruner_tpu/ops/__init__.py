@@ -1,1 +1,10 @@
-"""TPU kernels and fused ops (Pallas where warranted, XLA otherwise)."""
+"""TPU kernels and fused ops (Pallas where warranted, XLA otherwise).
+
+- flash_attention: train-shaped fused attention (Pallas fwd+bwd on TPU,
+  blocked lax elsewhere), block sizes from the autotune cache
+- decode_attention: q_len=1 paged-KV decode kernel (serve/generate)
+- blocksparse: MXU-aligned block-sparse matmul over pruned-block masks
+- fused_matmul: int8/int4 dequant-in-VMEM matmul with fused scale
+- quant: weight-only QTensor quantization + the qdot dispatch hub
+- autotune: per-(kind, head-dim, seq-bucket, dtype) persisted tuning
+"""

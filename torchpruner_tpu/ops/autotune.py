@@ -1,0 +1,205 @@
+"""Kernel block-size autotuner with a persisted tuning cache.
+
+The flash/decode/matmul kernels' block sizes trade per-block loop
+overhead against VMEM residency, and the optimum moves with head dim,
+sequence length, and dtype (results/flash_sweep_tpu_*: S=16384 grad
+step 184.5 ms at 128/128 vs 165.9 ms at 128/256).  Hand-pinned
+constants lose that fight one shape at a time — the round-4 bench had
+the flash kernel at 0.983x XLA precisely because its tiles were tuned
+for a different S.  This module makes the choice a *measured* one:
+
+- ``lookup(kind, head_dim, S, dtype)`` consults a JSON tuning cache
+  keyed per ``(kind, head_dim, seq bucket, dtype, platform)``; a miss
+  returns None and the caller's heuristic defaults apply.
+- ``autotune(...)`` times a candidate grid through the caller's real
+  dispatch path (the same jitted fn the workload runs), records the
+  winner, and persists the cache.
+- The cache file lives NEXT TO the jax persistent compile cache
+  (``<compile-cache-dir>/pallas_autotune.json``; override with
+  ``TORCHPRUNER_TUNE_CACHE``) — the two caches share a lifecycle: both
+  are per-machine measured artifacts that make repeated shapes cheap.
+
+On non-TPU backends the kernels run in interpreter mode, where block
+timing measures the interpreter, not the hardware — so ``autotune``
+records the interpreter-mode DEFAULTS instead of timing unless
+``force=True`` (tests force it to exercise the full tune→persist→load
+round trip on a tiny shape set).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+ENV_VAR = "TORCHPRUNER_TUNE_CACHE"
+
+#: kernel families the cache distinguishes (callers may add their own)
+KIND_FLASH = "flash"        # fused train attention, fwd+bwd grad step
+KIND_FLASH_FWD = "flash_fwd"  # inference-only forward
+KIND_DECODE = "decode"      # q_len=1 paged-KV decode attention
+KIND_MATMUL = "matmul"      # block-sparse / dequant matmul tiles
+
+_lock = threading.Lock()
+_cache: Optional[Dict[str, dict]] = None
+_cache_file: Optional[str] = None
+
+
+def cache_path() -> str:
+    """The tuning-cache JSON location: ``$TORCHPRUNER_TUNE_CACHE`` if
+    set, else ``pallas_autotune.json`` next to the jax persistent
+    compile cache (falling back to the compile cache's own default
+    directory when jax has no cache dir configured)."""
+    env = os.environ.get(ENV_VAR)
+    if env:
+        return env
+    cache_dir = None
+    try:
+        import jax
+
+        cache_dir = jax.config.jax_compilation_cache_dir
+    except Exception:  # noqa: BLE001 - config shape varies across jax
+        cache_dir = None
+    if not cache_dir:
+        from torchpruner_tpu.utils.compilation_cache import _DEFAULT
+
+        cache_dir = _DEFAULT
+    return os.path.join(cache_dir, "pallas_autotune.json")
+
+
+def seq_bucket(S: int) -> int:
+    """Power-of-two sequence bucket in [256, 65536] — shapes inside one
+    bucket share a tuning entry (and, with width bucketing, a bounded
+    compile bill)."""
+    b = 256
+    while b < S and b < 65536:
+        b *= 2
+    return b
+
+
+def _key(kind: str, head_dim: int, S: int, dtype, platform: str) -> str:
+    import jax.numpy as jnp
+
+    return (f"{kind}:dh{int(head_dim)}:s{seq_bucket(int(S))}"
+            f":{jnp.dtype(dtype).name}:{platform}")
+
+
+def _platform() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
+def _load(path: str) -> Dict[str, dict]:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        return data if isinstance(data, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def _entries() -> Dict[str, dict]:
+    """The in-memory cache, loaded once per (process, cache file)."""
+    global _cache, _cache_file
+    path = cache_path()
+    with _lock:
+        if _cache is None or _cache_file != path:
+            _cache = _load(path)
+            _cache_file = path
+        return _cache
+
+
+def reset() -> None:
+    """Drop the in-memory view (tests switch cache files via env)."""
+    global _cache, _cache_file
+    with _lock:
+        _cache, _cache_file = None, None
+
+
+def lookup(kind: str, head_dim: int, S: int, dtype,
+           platform: Optional[str] = None) -> Optional[Tuple[int, ...]]:
+    """The tuned block sizes for this shape family, or None (caller
+    defaults apply)."""
+    entry = _entries().get(
+        _key(kind, head_dim, S, dtype, platform or _platform()))
+    if not entry:
+        return None
+    blocks = entry.get("blocks")
+    return tuple(int(b) for b in blocks) if blocks else None
+
+
+def record(kind: str, head_dim: int, S: int, dtype,
+           blocks: Sequence[int], *, ms: Optional[float] = None,
+           platform: Optional[str] = None, persist: bool = True) -> str:
+    """Store (and by default persist) a tuning decision; returns the
+    cache key.  Writes are atomic (tmp + replace) so a killed tune run
+    cannot tear the file for later readers."""
+    key = _key(kind, head_dim, S, dtype, platform or _platform())
+    entries = _entries()
+    with _lock:
+        entries[key] = {
+            "blocks": [int(b) for b in blocks],
+            "ms": None if ms is None else round(float(ms), 4),
+            "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+        if persist:
+            path = cache_path()
+            try:
+                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+                tmp = f"{path}.tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump(entries, f, indent=1, sort_keys=True)
+                os.replace(tmp, path)
+            except OSError:
+                pass  # the cache is an optimization, never a failure
+    return key
+
+
+def _time_ms(fn: Callable, *args, iters: int = 5, warmup: int = 2) -> float:
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def autotune(kind: str, head_dim: int, S: int, dtype, *,
+             run: Callable[[Tuple[int, ...]], Callable],
+             candidates: Sequence[Tuple[int, ...]],
+             defaults: Tuple[int, ...],
+             force: bool = False, iters: int = 5,
+             warmup: int = 2) -> Tuple[int, ...]:
+    """Measure ``run(blocks)()`` for each candidate, record the winner.
+
+    ``run`` maps a block tuple to a zero-arg (pre-bound) callable that
+    executes the kernel-bearing computation; a candidate that raises is
+    skipped (e.g. tiles that overflow VMEM fail at compile time — that
+    is the tuner's job to discover, not the caller's to predict).  On
+    non-TPU backends without ``force``, records and returns
+    ``defaults`` (interpreter timing is meaningless).
+    """
+    if _platform() != "tpu" and not force:
+        record(kind, head_dim, S, dtype, defaults)
+        return defaults
+    best: Optional[Tuple[int, ...]] = None
+    best_ms = float("inf")
+    for cand in candidates:
+        try:
+            fn = run(tuple(int(c) for c in cand))
+            ms = _time_ms(fn, iters=iters, warmup=warmup)
+        except Exception:  # noqa: BLE001 - un-lowerable candidate
+            continue
+        if ms < best_ms:
+            best, best_ms = tuple(int(c) for c in cand), ms
+    if best is None:
+        record(kind, head_dim, S, dtype, defaults)
+        return defaults
+    record(kind, head_dim, S, dtype, best, ms=best_ms)
+    return best

@@ -1,0 +1,318 @@
+"""MXU-aligned block-sparse matmul — structured sparsity the kernel can
+actually skip.
+
+Mask-based (simulated) pruning holds dropped units at zero without
+changing shapes (core/masking.py), which keeps the compile bill bounded
+— but a dense matmul over a half-zero weight still pays full FLOPs and
+full HBM traffic, so the FLOPs gauge drops while ms/step doesn't (the
+exact gap ROADMAP item 2 names).  Per "Structured Model Pruning of
+Convolutional Networks on TPUs" (PAPERS.md), sparsity only pays when it
+is aligned to the hardware tiles.  This kernel consumes sparsity at
+128-lane block granularity:
+
+- the weight's kept input-row blocks and kept output-column blocks are
+  STATIC index lists (``in_keep`` / ``out_keep``, derived from the same
+  drop indices as ``prune``/``drop_masks`` via
+  :func:`keep_blocks_from_drop` — or from block-granular scoring,
+  ``score_drop_indices(granularity=128)``);
+- the grid runs over kept blocks ONLY — the block index lists ride the
+  TPU scalar-prefetch path (``PrefetchScalarGridSpec``) into the block
+  index maps, so dropped blocks are neither fetched from HBM nor fed to
+  the MXU.  50% structured sparsity halves both the weight traffic and
+  the matmul FLOPs, not just the counters;
+- dropped output columns are never written by the grid; a trailing
+  ``where`` pins them to exact 0.0 (the mask-semantics contract).
+
+The custom VJP keeps the sparsity through training: dx contracts only
+kept output blocks and emits only kept input blocks; dw computes only
+the kept (in x out) blocks (dropped-block gradients are exactly zero,
+which is also what ``masked_update`` would enforce).  A pattern change
+(a new prune round) changes the static lists and recompiles — the same
+bounded-shape economics as bucketed structural pruning.
+
+``BlockSparseWeight`` wraps a (D, F) weight with its keep lists as a
+pytree node (the QTensor pattern): ``quant.qdot`` dispatches it, so a
+Dense/GatedDense apply — training forward AND backward — rides the
+kernel with no layer-code changes.  Interpreter mode on CPU; shapes or
+masks that don't block-align fall back to the dense XLA matmul (the
+weight's zeros make that numerically equivalent, just not faster).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = [
+    "BlockSparseWeight", "blocksparse_matmul", "keep_blocks_from_drop",
+    "keep_blocks_from_mask", "DEFAULT_BLOCK",
+]
+
+#: weight-block edge: 128 matches the vector-lane width (and the
+#: ``bucket_drop`` lane bucket), so kept blocks tile the MXU cleanly
+DEFAULT_BLOCK = 128
+MAX_ROW_BLOCK = 256
+MIN_ROW_BLOCK = 8
+
+
+def keep_blocks_from_drop(n: int, drop: Sequence[int],
+                          block: int = DEFAULT_BLOCK
+                          ) -> Optional[Tuple[int, ...]]:
+    """Kept-block indices for a width-``n`` axis with ``drop``ped units,
+    or None when the pattern is not block-aligned (some block is only
+    partially dropped) or the axis doesn't tile."""
+    if n % block:
+        return None
+    dropped = np.zeros(n, bool)
+    dropped[np.asarray(list(drop), np.int64)] = True
+    per = dropped.reshape(n // block, block)
+    full = per.all(axis=1)
+    if not np.array_equal(per.any(axis=1), full):
+        return None  # partially-dropped block: mask-only semantics
+    return tuple(int(i) for i in np.flatnonzero(~full))
+
+
+def keep_blocks_from_mask(unit_mask, block: int = DEFAULT_BLOCK
+                          ) -> Optional[Tuple[int, ...]]:
+    """Kept-block indices from a 0/1 keep mask over one axis (None when
+    not block-aligned)."""
+    m = np.asarray(unit_mask).astype(bool)
+    if m.ndim != 1 or m.size % block:
+        return None
+    per = m.reshape(m.size // block, block)
+    kept = per.all(axis=1)
+    if not np.array_equal(per.any(axis=1), kept):
+        return None
+    return tuple(int(i) for i in np.flatnonzero(kept))
+
+
+def _row_block(R: int) -> int:
+    """Largest row-block <= MAX_ROW_BLOCK dividing R (0: no clean
+    blocking — XLA fallback)."""
+    for bb in range(min(MAX_ROW_BLOCK, R), MIN_ROW_BLOCK - 1, -1):
+        if R % bb == 0:
+            return bb
+    return 0
+
+
+def _unit_mask(n: int, keep: Tuple[int, ...], block: int):
+    blk = jnp.arange(n, dtype=jnp.int32) // block
+    return jnp.isin(blk, jnp.asarray(keep, jnp.int32))
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# --------------------------------------------------------------------------
+# kernels: one shared accumulate-over-t body, three grid layouts
+# --------------------------------------------------------------------------
+
+
+def _mm_kernel(ii_ref, oo_ref, a_ref, b_ref, o_ref, acc, *, nt, dims):
+    """Grid (i, j, t): accumulate ``dot_general(a, b, dims)`` over the
+    contraction stream t into f32 scratch; write at the last step."""
+    t = pl.program_id(2)
+
+    @pl.when(t == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+
+    acc[...] += jax.lax.dot_general(
+        a_ref[...], b_ref[...], (dims, ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(t == nt - 1)
+    def _out():
+        o_ref[...] = acc[...].astype(o_ref.dtype)
+
+
+def _call(a, b, out_shape, out_dtype, grid, amap, bmap, omap, ablk, bblk,
+          oblk, ii, oo, dims):
+    nt = grid[2]
+    return pl.pallas_call(
+        functools.partial(_mm_kernel, nt=nt, dims=dims),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[pl.BlockSpec(ablk, amap), pl.BlockSpec(bblk, bmap)],
+            out_specs=pl.BlockSpec(oblk, omap),
+            scratch_shapes=[pltpu.VMEM(oblk, jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct(out_shape, out_dtype),
+        interpret=_interpret(),
+    )(jnp.asarray(ii, jnp.int32), jnp.asarray(oo, jnp.int32), a, b)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("in_keep", "out_keep", "block", "bb"))
+def _bs_fwd(x, w, in_keep, out_keep, block, bb):
+    """(R, D) @ (D, F) over kept blocks -> (R, F); dropped output
+    columns pinned to 0."""
+    R, D = x.shape
+    F = w.shape[1]
+    out_dtype = jnp.result_type(x.dtype, w.dtype)
+    y = _call(
+        x, w, (R, F), out_dtype,
+        grid=(R // bb, len(out_keep), len(in_keep)),
+        amap=lambda i, j, t, ii, oo: (i, ii[t]),
+        bmap=lambda i, j, t, ii, oo: (ii[t], oo[j]),
+        omap=lambda i, j, t, ii, oo: (i, oo[j]),
+        ablk=(bb, block), bblk=(block, block), oblk=(bb, block),
+        ii=in_keep, oo=out_keep, dims=((1,), (0,)))
+    nF = len(out_keep) * block
+    if nF == F:
+        return y
+    return jnp.where(_unit_mask(F, out_keep, block)[None, :], y,
+                     jnp.zeros((), out_dtype))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("in_keep", "out_keep", "block", "bb"))
+def _bs_dx(g, w, in_keep, out_keep, block, bb):
+    """(R, F) @ (D, F)^T over kept blocks -> (R, D), contracting F
+    in-kernel (no materialized transpose); dropped input columns 0."""
+    R, F = g.shape
+    D = w.shape[0]
+    dx = _call(
+        g, w, (R, D), g.dtype,
+        grid=(R // bb, len(in_keep), len(out_keep)),
+        amap=lambda i, j, t, ii, oo: (i, oo[t]),
+        bmap=lambda i, j, t, ii, oo: (ii[j], oo[t]),
+        omap=lambda i, j, t, ii, oo: (i, ii[j]),
+        ablk=(bb, block), bblk=(block, block), oblk=(bb, block),
+        ii=in_keep, oo=out_keep, dims=((1,), (1,)))
+    if len(in_keep) * block == D:
+        return dx
+    return jnp.where(_unit_mask(D, in_keep, block)[None, :], dx,
+                     jnp.zeros((), g.dtype))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("in_keep", "out_keep", "block", "bb",
+                                    "w_dtype"))
+def _bs_dw(x, g, in_keep, out_keep, block, bb, w_dtype):
+    """x^T (R, D) x g (R, F) -> (D, F), only kept (in x out) blocks
+    computed, the rest exactly 0 (dropped weights receive no update)."""
+    R, D = x.shape
+    F = g.shape[1]
+    dw = _call(
+        x, g, (D, F), jnp.dtype(w_dtype),
+        grid=(len(in_keep), len(out_keep), R // bb),
+        amap=lambda i, j, t, ii, oo: (t, ii[i]),
+        bmap=lambda i, j, t, ii, oo: (t, oo[j]),
+        omap=lambda i, j, t, ii, oo: (ii[i], oo[j]),
+        ablk=(bb, block), bblk=(bb, block), oblk=(block, block),
+        ii=in_keep, oo=out_keep, dims=((0,), (0,)))
+    if len(in_keep) * block == D and len(out_keep) * block == F:
+        return dw
+    mask = (_unit_mask(D, in_keep, block)[:, None]
+            & _unit_mask(F, out_keep, block)[None, :])
+    return jnp.where(mask, dw, jnp.zeros((), jnp.dtype(w_dtype)))
+
+
+# --------------------------------------------------------------------------
+# custom-vjp core + public API
+# --------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _bs_mm(x, w, in_keep, out_keep, block, bb):
+    return _bs_fwd(x, w, in_keep, out_keep, block, bb)
+
+
+def _bs_mm_fwd(x, w, in_keep, out_keep, block, bb):
+    return _bs_fwd(x, w, in_keep, out_keep, block, bb), (x, w)
+
+
+def _bs_mm_bwd(in_keep, out_keep, block, bb, res, g):
+    x, w = res
+    dx = _bs_dx(g.astype(x.dtype), w, in_keep, out_keep, block, bb)
+    dw = _bs_dw(x, g.astype(x.dtype), in_keep, out_keep, block, bb,
+                w.dtype)
+    return dx, dw
+
+
+_bs_mm.defvjp(_bs_mm_fwd, _bs_mm_bwd)
+
+
+def blocksparse_matmul(x, w, *, in_keep: Optional[Sequence[int]] = None,
+                       out_keep: Optional[Sequence[int]] = None,
+                       block: int = DEFAULT_BLOCK):
+    """``x (..., D) @ w (D, F) -> (..., F)`` computing only the kept
+    ``block x block`` weight blocks (None = all blocks on that axis — a
+    dense blocked matmul on the same machinery, the bench's
+    apples-to-apples dense baseline).  Differentiable; dropped blocks
+    contribute (and receive) exactly zero.  Falls back to the dense XLA
+    matmul when the shapes or row count don't block cleanly — callers
+    keep the weight's dropped blocks zeroed, so the fallback is
+    numerically equivalent."""
+    D = x.shape[-1]
+    F = w.shape[1]
+    lead = x.shape[:-1]
+    R = int(np.prod(lead)) if lead else 1
+    bb = _row_block(R)
+    ok = (D % block == 0 and F % block == 0 and bb > 0
+          and w.ndim == 2)
+    if not ok:
+        return x @ w
+    ik = tuple(range(D // block)) if in_keep is None \
+        else tuple(int(i) for i in in_keep)
+    ok2 = tuple(range(F // block)) if out_keep is None \
+        else tuple(int(i) for i in out_keep)
+    if not ik or not ok2:
+        # everything dropped on one axis: the result is exactly zero
+        return jnp.zeros(lead + (F,), jnp.result_type(x.dtype, w.dtype))
+    y = _bs_mm(x.reshape(R, D), w, ik, ok2, int(block), bb)
+    return y.reshape(lead + (F,))
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class BlockSparseWeight:
+    """A (D, F) matmul weight carrying its block-sparsity pattern.
+
+    ``w`` holds the DENSE buffer with dropped blocks at zero (the same
+    tensor masked training updates); ``in_keep``/``out_keep`` are the
+    kept-block index tuples (None = dense on that axis) and are STATIC —
+    pattern changes retrace, value changes don't.  ``quant.qdot``
+    dispatches instances through :func:`blocksparse_matmul`, so any
+    Dense/GatedDense apply site picks the kernel up from the params
+    pytree alone (see ``masking.blocksparse_params``)."""
+
+    w: jnp.ndarray
+    in_keep: Optional[Tuple[int, ...]] = None
+    out_keep: Optional[Tuple[int, ...]] = None
+    block: int = DEFAULT_BLOCK
+
+    def tree_flatten(self):
+        return ((self.w,), (self.in_keep, self.out_keep, self.block))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        in_keep, out_keep, block = aux
+        return cls(children[0], in_keep, out_keep, block)
+
+    @property
+    def shape(self):
+        return self.w.shape
+
+    @property
+    def dtype(self):
+        return self.w.dtype
+
+    def dense(self) -> jnp.ndarray:
+        """The dense (masked) buffer — the reference-path view."""
+        return self.w
+
+    def matmul(self, x):
+        return blocksparse_matmul(
+            x, self.w, in_keep=self.in_keep, out_keep=self.out_keep,
+            block=self.block)

@@ -1,0 +1,224 @@
+"""Decode-shaped attention: q_len=1 against a long static KV cache.
+
+The continuous-batching decode step (serve/engine.py) and ``generate``'s
+scanned sampling loop both attend ONE query per sequence against the
+whole ``(B, max_len, H, Dh)`` cache.  The einsum path scores every
+cache position — including the unwritten future — then masks: for a
+slot sitting at position ``pos`` that reads ``max_len / (pos+1)`` times
+the bytes it needs, and decode is HBM-bandwidth-bound.  This kernel
+streams the cache in KV-position blocks and STOPS at each row's own
+``pos``:
+
+- grid ``(B, H, T // block)``, scalar-prefetched per-row positions: the
+  KV block index maps clamp past-``pos`` steps to the last live block,
+  so skipped steps re-address the previous block and fetch nothing —
+  bytes read scale with ``pos``, not ``max_len``;
+- online-softmax scratch carried across the block dimension (the grid
+  iterates it innermost), f32 accumulation, one output write per
+  ``(batch, head)``.
+
+**Bit-stability contract** (the serve ``--verify`` path): a row's
+result depends only on its real positions ``0..pos`` and the block
+partition.  The block size is a deterministic function of the CACHE
+length alone (``decode_block``), so two programs over the same
+``max_len`` — the engine's slot step and a solo ``generate`` replay —
+produce bit-identical rows regardless of batch size, neighbouring
+slots, or stale K/V left by a previous slot occupant (masked positions
+contribute exactly 0.0).  Replays must therefore share the serving
+cache length (``generate(..., max_len=engine.max_len)``), exactly as
+the frontend's ``--verify`` does.
+
+Shapes that don't block (cache length with no power-of-two factor >= 8)
+fall back to the masked-einsum path — also a deterministic function of
+the cache length, so the contract holds there too.  Interpreter mode on
+CPU keeps tier-1 on the real kernel code.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from torchpruner_tpu.ops import autotune
+
+_NEG_INF = -1e30
+
+#: decode block cap: positions per KV block (sublane axis of the block)
+MAX_DECODE_BLOCK = 128
+MIN_DECODE_BLOCK = 8
+
+#: kill switch (and test hook): None = auto (kernel wherever it blocks),
+#: False = always einsum
+ENABLE = True
+
+
+def decode_block(T: int) -> int | None:
+    """The KV block size for a cache of length ``T`` — the largest
+    power-of-two divisor of ``T`` in [8, 128], or a tuned override that
+    divides ``T``.  A function of T ALONE (never of batch or pos): the
+    bit-stability contract above hangs on every program over the same
+    cache length choosing the same block boundaries."""
+    bk = 1
+    while T % (bk * 2) == 0 and bk * 2 <= MAX_DECODE_BLOCK:
+        bk *= 2
+    if bk < MIN_DECODE_BLOCK:
+        return None
+    return bk
+
+
+def _tuned_block(T: int, Dh: int, dtype) -> int | None:
+    """Tuned block if one is recorded AND divides T, else the default."""
+    bk = decode_block(T)
+    tuned = autotune.lookup(autotune.KIND_DECODE, Dh, T, dtype)
+    if tuned and T % tuned[0] == 0 and tuned[0] >= MIN_DECODE_BLOCK:
+        return int(tuned[0])
+    return bk
+
+
+def kernel_active(T: int, Dh: int, dtype) -> bool:
+    """True when :func:`decode_attention` would run the Pallas kernel
+    for a q_len=1 step at this cache geometry — the ONE dispatch
+    predicate, shared with ``serve.engine``'s
+    ``serve_decode_kernel_active`` gauge so the reported path can never
+    diverge from the executed one (incl. tuned-block overrides)."""
+    return bool(ENABLE and _tuned_block(T, Dh, dtype) is not None
+                and not _multichip_tpu())
+
+
+def xla_decode_attention(q, k_cache, v_cache, pos):
+    """The masked-einsum reference (and non-blocking fallback): scores
+    against the whole cache, positions ``> pos`` masked.  ``q`` is
+    ``(B, s, H, Dh)`` (s >= 1 — also the prefill path), ``pos`` scalar
+    or ``(B,)``."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum(
+        "bqhk,bthk->bhqt", q, k_cache, preferred_element_type=jnp.float32
+    ) * scale
+    t = jnp.arange(k_cache.shape[1])
+    if jnp.ndim(pos) > 0:
+        q_pos = pos[:, None] + jnp.arange(q.shape[1])[None, :]  # (B, s)
+        mask = (t[None, None, :] <= q_pos[:, :, None])[:, None]
+    else:
+        q_pos = pos + jnp.arange(q.shape[1])
+        mask = (t[None, :] <= q_pos[:, None])[None, None]
+    s = jnp.where(mask, s, _NEG_INF)
+    w = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
+    return jnp.einsum("bhqt,bthk->bqhk", w, v_cache)
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s,
+                   *, block, n_blocks):
+    b = pl.program_id(0)
+    kb = pl.program_id(2)
+    pos = pos_ref[b]
+    n_run = lax.div(pos, block) + 1  # blocks holding positions <= pos
+
+    @pl.when(kb == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, _NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    @pl.when(kb < n_run)
+    def _accumulate():
+        q = q_ref[0, 0].astype(jnp.float32)        # (1, Dh)
+        k = k_ref[0, :, 0].astype(jnp.float32)     # (block, Dh)
+        v = v_ref[0, :, 0].astype(jnp.float32)
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * (1.0 / math.sqrt(q.shape[-1]))         # (1, block)
+        t = kb * block + lax.broadcasted_iota(jnp.int32, (1, block), 1)
+        s = jnp.where(t <= pos, s, _NEG_INF)
+        m, l, acc = m_s[...], l_s[...], acc_s[...]
+        m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        m_s[...] = m_new
+        l_s[...] = alpha * l + p.sum(axis=1, keepdims=True)
+        acc_s[...] = acc * alpha + lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kb == n_blocks - 1)
+    def _out():
+        o_ref[0, 0] = (acc_s[...] / l_s[...]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def _decode_call(q, k_cache, v_cache, pos, block, interpret):
+    B, _, H, Dh = q.shape
+    T = k_cache.shape[1]
+    n_blocks = T // block
+
+    def q_map(b, h, kb, pos_ref):
+        return (b, 0, h, 0)
+
+    def kv_map(b, h, kb, pos_ref):
+        # clamp past-pos steps to the last live block: same index as the
+        # previous step -> the pipeline fetches nothing for them
+        n_run = lax.div(pos_ref[b], block) + 1
+        return (b, jnp.minimum(kb, n_run - 1), h, 0)
+
+    return pl.pallas_call(
+        functools.partial(_decode_kernel, block=block, n_blocks=n_blocks),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H, n_blocks),
+            in_specs=[
+                pl.BlockSpec((1, 1, 1, Dh), q_map),
+                pl.BlockSpec((1, block, 1, Dh), kv_map),
+                pl.BlockSpec((1, block, 1, Dh), kv_map),
+            ],
+            out_specs=pl.BlockSpec((1, 1, 1, Dh), q_map),
+            scratch_shapes=[
+                pltpu.VMEM((1, 1), jnp.float32),
+                pltpu.VMEM((1, 1), jnp.float32),
+                pltpu.VMEM((1, Dh), jnp.float32),
+            ],
+        ),
+        # the einsum path's context dtype is the CACHE dtype (softmax
+        # weights cast to it before the value contraction); match it so
+        # the kernel is a drop-in for the scan-carried logits dtype
+        out_shape=jax.ShapeDtypeStruct(q.shape, v_cache.dtype),
+        interpret=interpret,
+    )(pos, q, k_cache, v_cache)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _multichip_tpu() -> bool:
+    # under multi-chip GSPMD the Mosaic custom call has no partitioning
+    # rule — TP/sharded decode keeps the einsum path until a shard_map
+    # wrapper lands (single-chip serving, the common case, takes the
+    # kernel; on CPU the interpreter lowers to partitionable lax ops)
+    return jax.default_backend() == "tpu" and len(jax.devices()) > 1
+
+
+def decode_attention(q, k_cache, v_cache, pos):
+    """One decode step's attention: ``q (B, 1, H, Dh)`` against
+    ``k_cache/v_cache (B, T, H, Dh)`` at per-row positions ``pos``
+    (``(B,)`` int32, or a scalar applied to every row).  Returns
+    ``(B, 1, H, Dh)`` in the cache dtype.
+
+    Dispatches the Pallas kernel when the cache length blocks cleanly
+    (see :func:`decode_block`); otherwise — and under multi-chip GSPMD
+    or ``ENABLE=False`` — the masked-einsum path."""
+    B, s, H, Dh = q.shape
+    T = k_cache.shape[1]
+    if s != 1 or not kernel_active(T, Dh, k_cache.dtype):
+        return xla_decode_attention(q, k_cache, v_cache, pos)
+    block = _tuned_block(T, Dh, k_cache.dtype)
+    if jnp.ndim(pos) == 0:
+        pos = jnp.full((B,), pos, jnp.int32)
+    return _decode_call(q, k_cache, v_cache, pos.astype(jnp.int32),
+                        block, _interpret())

@@ -1,55 +1,79 @@
-"""Flash attention for TPU — Pallas forward AND backward kernels.
+"""Flash attention for TPU — Pallas forward AND backward kernels, plus a
+blocked lax formulation for non-TPU backends.
 
 The hot op of the transformer families (ViT/BERT/Llama head pruning,
-BASELINE.json configs 3-5).  Neither direction ever materializes the
-``(S, S)`` score matrix:
+BASELINE.json configs 3-5).  No path ever materializes the ``(S, S)``
+score matrix:
 
 - **Forward** (Dao et al., 2022): the grid runs over ``(batch, heads,
   query blocks)``; each program streams KV blocks through VMEM with the
   numerically-stable running ``(max, sum, acc)`` update, and additionally
-  writes the per-query log-sum-exp (LSE) used by the backward.
-- **Backward** (FlashAttention-2): two kernels sharing the forward's LSE
-  and the precomputed ``delta = rowsum(dO * O)``.  The dQ kernel runs over
-  query blocks streaming KV; the dK/dV kernel runs over KV blocks streaming
-  queries.  Probabilities are *recomputed* blockwise from LSE — O(S * Dh)
-  memory total, vs the O(S^2) score tensor a recompute-through-XLA backward
-  materializes.
+  writes the per-query log-sum-exp (LSE) used by the backward.  Causal
+  masking is TWO-PHASE: KV blocks entirely below the diagonal run an
+  unmasked body, only diagonal-straddling blocks pay the mask compare —
+  and blocks entirely above the diagonal are skipped outright.
+- **Backward** (FlashAttention-2): two kernels sharing the forward's LSE,
+  with ``delta = rowsum(dO * O)`` recomputed in-kernel per query block
+  (no host-visible (B, H, S) delta tensor).  Both kernels stream their
+  inner operand through a 4th GRID dimension with an f32 VMEM scratch
+  accumulator, so VMEM residency is O(block), independent of S — the
+  round-4 whole-sequence VMEM specs (K/V + the lane-broadcast LSE/delta
+  rows at 32k = 40 MB in one kernel) are what made the 32k backward fail
+  remote compilation, and why ``FLASH_BWD_XLA_MIN_S`` existed.  With the
+  re-blocking that fallback is RETIRED (default None); set
+  ``TORCHPRUNER_FLASH_BWD_XLA_MIN_S`` to re-arm it if a backend still
+  refuses (scripts/capture_tpu.sh's staged flash leg re-validates the
+  32k backward at the next tunnel window).
+- **Non-TPU backends** run the SAME blocked online-softmax algorithm as
+  straight lax ops (``_lax_flash``) instead of the Pallas interpreter:
+  the interpreter exists to test kernel code, not to win benchmarks,
+  while the blocked lax form beats the quadratic einsum on CPU caches
+  (measured 1.2-4x on the bench shapes).  Tests force the interpreter
+  path via ``FORCE_PALLAS`` so tier-1 still exercises the real kernels.
 
-Matmuls are ``preferred_element_type=float32`` so bf16 inputs still
-accumulate in f32 on the MXU.  Causal masking skips whole blocks strictly
-above (dQ) / below (dK/dV) the diagonal.  Inputs whose sequence length
-doesn't block cleanly (min block 8) fall back to the XLA einsum path in
-both directions; on CPU the kernels run in interpreter mode so tests
-exercise the same code path as TPU.
+Block sizes come from the caller, else the persisted autotune cache
+(ops/autotune.py), else measured defaults.  Matmuls are
+``preferred_element_type=float32`` so bf16 inputs still accumulate in
+f32 on the MXU.  Inputs whose sequence length doesn't block cleanly
+(min block 8) fall back to the XLA einsum path in both directions.
 """
 
 from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
+from torchpruner_tpu.ops import autotune
+
 _NEG_INF = -1e30
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
+#: the lax (non-TPU) path favors bigger tiles: block overhead is loop
+#: trips, not VMEM, and 512 measured best on the CPU bench shapes
+LAX_DEFAULT_BLOCK = 512
 MIN_BLOCK = 8  # below this the kernel degrades to tiny-tile scalar work
-_LANE = 128  # TPU lane width: minor dim of the LSE/delta row layout
+_LANE = 128  # TPU lane width: minor dim of the LSE row layout
 
-#: at/above this sequence length the flash BACKWARD kernel's remote
-#: compilation fails on the tunnelled single-chip backend (HTTP 500 —
-#: PERF.md flash S-sweep; the forward compiles and runs at 32k).  The
-#: vjp then recomputes gradients through the XLA path instead, keeping
-#: 32k-token training WORKING at quadratic temp cost in the backward
-#: only.  Set to None to always use the flash backward (e.g. on a
-#: directly-attached chip); multi-device 32k training should prefer
-#: ring/Ulysses sequence parallelism (parallel/sp.py), which shards S
-#: before attention ever sees the full length.
-FLASH_BWD_XLA_MIN_S: int | None = 32768
+#: tests set True to route non-TPU calls through the Pallas kernels in
+#: interpreter mode (the parity suite's job); the production non-TPU
+#: path is the blocked lax formulation
+FORCE_PALLAS = False
+
+#: RETIRED fallback, kept as an env-armed escape hatch: the 32k remote-
+#: compile failure (PERF.md flash S-sweep, HTTP 500) traced to the old
+#: backward's whole-sequence VMEM block specs; the re-blocked backward
+#: bounds VMEM at O(block).  Arm via TORCHPRUNER_FLASH_BWD_XLA_MIN_S=N
+#: to make the vjp recompute gradients through the XLA path at S >= N
+#: again (quadratic temp memory in the backward only).
+_env_min_s = os.environ.get("TORCHPRUNER_FLASH_BWD_XLA_MIN_S", "")
+FLASH_BWD_XLA_MIN_S: int | None = int(_env_min_s) if _env_min_s else None
 
 
 def _xla_attention(q, k, v, *, causal: bool):
@@ -82,20 +106,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None,
     """One (batch, head, query-block) program: stream KV blocks with the
     online-softmax running state carried through ``fori_loop``; emit the
     normalized output block and (when a backward will follow) its LSE
-    row.  Inference calls omit ``lse_ref`` — no wasted HBM writes."""
+    row.  Inference calls omit ``lse_ref`` — no wasted HBM writes.
+
+    Causal runs two phases: an unmasked loop over the KV blocks whose
+    every key is visible to every query row of this block, then a
+    masked loop over the (at most ``block_q // block_k + 1``) blocks
+    straddling the diagonal.  Blocks above the diagonal never run."""
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)  # (block_q, Dh)
     dh = q.shape[-1]
     S = k_ref.shape[2]
     n_kv = S // block_k
-    if causal:
-        # skip KV blocks entirely above the diagonal
-        n_run = lax.div((qi + 1) * block_q + block_k - 1, block_k)
-        n_run = jnp.minimum(n_run, n_kv)
-    else:
-        n_run = n_kv
 
-    def body(j, carry):
+    def body(j, carry, masked):
         m, l, acc = carry
         k = k_ref[0, 0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, 0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
@@ -103,7 +126,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # (block_q, block_k)
-        if causal:
+        if masked:
             qpos = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
@@ -124,7 +147,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None,
     m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, dh), jnp.float32)
-    m, l, acc = lax.fori_loop(0, n_run, body, (m0, l0, acc0))
+    if causal:
+        # blocks whose LAST key position <= this q block's FIRST query
+        # position need no mask; blocks past the diagonal are skipped
+        n_run = jnp.minimum(
+            lax.div((qi + 1) * block_q + block_k - 1, block_k), n_kv)
+        n_full = jnp.minimum(lax.div(qi * block_q + 1, block_k), n_run)
+        carry = lax.fori_loop(
+            0, n_full, functools.partial(body, masked=False),
+            (m0, l0, acc0))
+        m, l, acc = lax.fori_loop(
+            n_full, n_run, functools.partial(body, masked=True), carry)
+    else:
+        m, l, acc = lax.fori_loop(
+            0, n_kv, functools.partial(body, masked=False), (m0, l0, acc0))
     o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
     if lse_ref is not None:
         # LSE row broadcast across the 128-lane minor dim: TPU block shapes
@@ -141,7 +177,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None,
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, with_lse=True):
     """(B, H, S, Dh) layout in; returns (out, lse) with lse (B, H, S, 128)
     f32 (the per-query LSE broadcast across the minor lane dim), or
-    (out, None) when ``with_lse=False`` (inference: skip the LSE writes)."""
+    (out, None) when ``with_lse=False`` (inference: skip the LSE writes).
+
+    K/V ride whole-sequence VMEM blocks (fetched ONCE per (batch, head)
+    — the index map is q-block-invariant, so the pipeline never
+    refetches); chip-proven to S=32k bf16 (8 MB)."""
     B, H, S, Dh = q.shape
     scale = 1.0 / math.sqrt(Dh)
     grid = (B, H, S // block_q)
@@ -176,31 +216,38 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, with_lse=True):
 
 
 # --------------------------------------------------------------------------
-# backward kernels
+# backward kernels — 4D grids, O(block) VMEM
 # --------------------------------------------------------------------------
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               *, scale, causal, block_q, block_k):
-    """One (batch, head, query-block) program: stream KV blocks,
-    recompute P from LSE, accumulate dQ = sum_j dS_j K_j * scale."""
+def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
+               dq_acc, delta_s, *, scale, causal, block_q, block_k, n_kv):
+    """Grid (B, H, q blocks, KV blocks): the KV stream is the 4th grid
+    dimension; dQ accumulates in f32 VMEM scratch and is written once at
+    the last KV step.  ``delta = rowsum(dO * O)`` is computed in-kernel
+    at the first step — no precomputed (B, H, S, lane) delta tensor."""
     qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32)       # (block_q, Dh)
-    do = do_ref[0, 0].astype(jnp.float32)     # (block_q, Dh)
-    lse = lse_ref[0, 0, :, 0:1]               # (block_q, 1)
-    delta = delta_ref[0, 0, :, 0:1]           # (block_q, 1)
-    dh = q.shape[-1]
-    S = k_ref.shape[2]
-    n_kv = S // block_k
+    j = pl.program_id(3)
     if causal:
-        n_run = lax.div((qi + 1) * block_q + block_k - 1, block_k)
-        n_run = jnp.minimum(n_run, n_kv)
+        n_run = jnp.minimum(
+            lax.div((qi + 1) * block_q + block_k - 1, block_k), n_kv)
     else:
         n_run = n_kv
 
-    def body(j, dq):
-        k = k_ref[0, 0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+        delta_s[...] = jnp.sum(
+            do_ref[0, 0].astype(jnp.float32) * o_ref[0, 0].astype(jnp.float32),
+            axis=-1, keepdims=True)
+
+    @pl.when(j < n_run)
+    def _accumulate():
+        q = q_ref[0, 0].astype(jnp.float32)       # (block_q, Dh)
+        do = do_ref[0, 0].astype(jnp.float32)     # (block_q, Dh)
+        lse = lse_ref[0, 0, :, 0:1]               # (block_q, 1)
+        k = k_ref[0, 0].astype(jnp.float32)       # (block_k, Dh)
+        v = v_ref[0, 0].astype(jnp.float32)
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -218,43 +265,49 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta) * scale
-        return dq + lax.dot_general(
+        ds = p * (dp - delta_s[...]) * scale
+        dq_acc[...] += lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
-    dq = lax.fori_loop(0, n_run, body, jnp.zeros((block_q, dh), jnp.float32))
-    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+    @pl.when(j == n_kv - 1)
+    def _out():
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, scale, causal, block_q, block_k):
-    """One (batch, head, KV-block) program: stream query blocks,
-    recompute P from LSE, accumulate dV = sum_i P_i^T dO_i and
-    dK = sum_i dS_i^T Q_i * scale."""
+def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc,
+                *, scale, causal, block_q, block_k, n_q):
+    """Grid (B, H, KV blocks, q blocks): the query stream is the 4th
+    grid dimension; dK/dV accumulate in f32 VMEM scratch.  Causal skips
+    query blocks entirely above this KV block's diagonal (their index
+    maps clamp to the first contributing block, so skipped steps fetch
+    nothing new)."""
     ki = pl.program_id(2)
-    k = k_ref[0, 0].astype(jnp.float32)       # (block_k, Dh)
-    v = v_ref[0, 0].astype(jnp.float32)       # (block_k, Dh)
-    dh = k.shape[-1]
-    S = q_ref.shape[2]
-    n_q = S // block_q
-    # causal: the first query block whose last position reaches this KV
-    # block's first position; earlier blocks are entirely masked
+    qi = pl.program_id(3)
     i_start = lax.div(ki * block_k, block_q) if causal else 0
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, 0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, 0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.dslice(i * block_q, block_q), 0:1]  # (bq, 1)
-        delta = delta_ref[0, 0, pl.dslice(i * block_q, block_q), 0:1]
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    @pl.when(qi >= i_start)
+    def _accumulate():
+        k = k_ref[0, 0].astype(jnp.float32)       # (block_k, Dh)
+        v = v_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0].astype(jnp.float32)       # (block_q, Dh)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0:1]               # (block_q, 1)
+        delta = jnp.sum(
+            do * o_ref[0, 0].astype(jnp.float32), axis=-1, keepdims=True)
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # (block_q, block_k)
         if causal:
-            qpos = i * block_q + lax.broadcasted_iota(
+            qpos = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
             kpos = ki * block_k + lax.broadcasted_iota(
@@ -262,7 +315,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             )
             s = jnp.where(qpos >= kpos, s, _NEG_INF)
         p = jnp.exp(s - lse)
-        dv = dv + lax.dot_general(
+        dv_acc[...] += lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -271,62 +324,150 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta) * scale
-        dk = dk + lax.dot_general(
+        dk_acc[...] += lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return dk, dv
 
-    z = jnp.zeros((block_k, dh), jnp.float32)
-    dk, dv = lax.fori_loop(i_start, n_q, body, (z, z))
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == n_q - 1)
+    def _out():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
 def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
-    """(B, H, S, Dh) layout; returns (dq, dk, dv)."""
+    """(B, H, S, Dh) layout; returns (dq, dk, dv).  ``lse`` may arrive
+    single-lane (the vjp residual) — it is re-broadcast to the 128-lane
+    kernel layout here (one (B, H, S, 128) f32 temp; the per-kernel
+    VMEM cost stays one (block, 128) tile)."""
+    from jax.experimental.pallas import tpu as pltpu
+
     B, H, S, Dh = q.shape
     scale = 1.0 / math.sqrt(Dh)
-    # LSE arrives as the single-lane residual; restore the lane layout
+    n_q, n_kv = S // block_q, S // block_k
     lse = jnp.broadcast_to(lse, (B, H, S, _LANE))
-    # delta rows live in the same broadcast-across-lanes layout as LSE
-    delta = jnp.broadcast_to(
-        jnp.sum(
-            do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
-        )[..., None],
-        (B, H, S, _LANE),
-    )
 
-    seq_spec = pl.BlockSpec((1, 1, S, Dh), lambda b, h, i: (b, h, 0, 0))
-    row_full = pl.BlockSpec((1, 1, S, _LANE), lambda b, h, i: (b, h, 0, 0))
-    qblk = pl.BlockSpec((1, 1, block_q, Dh), lambda b, h, i: (b, h, i, 0))
-    qrow = pl.BlockSpec((1, 1, block_q, _LANE), lambda b, h, i: (b, h, i, 0))
-    kblk = pl.BlockSpec((1, 1, block_k, Dh), lambda b, h, i: (b, h, i, 0))
+    qblk = pl.BlockSpec((1, 1, block_q, Dh), lambda b, h, i, j: (b, h, i, 0))
+    qrow = pl.BlockSpec((1, 1, block_q, _LANE),
+                        lambda b, h, i, j: (b, h, i, 0))
+
+    def kv_j(b, h, i, j):
+        # clamp the KV stream index to the causal range so skipped steps
+        # re-address the previous block (no DMA) instead of fetching
+        # blocks the kernel will never read
+        if causal:
+            n_run = jnp.minimum(
+                lax.div((i + 1) * block_q + block_k - 1, block_k), n_kv)
+            return (b, h, jnp.minimum(j, n_run - 1), 0)
+        return (b, h, j, 0)
+
+    kblk_j = pl.BlockSpec((1, 1, block_k, Dh), kv_j)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
-        grid=(B, H, S // block_q),
-        in_specs=[qblk, seq_spec, seq_spec, qblk, qrow, qrow],
+                          block_q=block_q, block_k=block_k, n_kv=n_kv),
+        grid=(B, H, n_q, n_kv),
+        in_specs=[qblk, kblk_j, kblk_j, qblk, qblk, qrow],
         out_specs=qblk,
         out_shape=jax.ShapeDtypeStruct((B, H, S, Dh), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, Dh), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, o, do, lse)
+
+    def q_i(b, h, i, j):
+        if causal:
+            return (b, h, jnp.maximum(j, lax.div(i * block_k, block_q)), 0)
+        return (b, h, j, 0)
+
+    qblk_i = pl.BlockSpec((1, 1, block_q, Dh), q_i)
+    qrow_i = pl.BlockSpec((1, 1, block_q, _LANE),
+                          lambda b, h, i, j, _m=q_i: _m(b, h, i, j))
+    kblk = pl.BlockSpec((1, 1, block_k, Dh), lambda b, h, i, j: (b, h, i, 0))
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
-        grid=(B, H, S // block_k),
-        in_specs=[seq_spec, kblk, kblk, seq_spec, row_full, row_full],
+                          block_q=block_q, block_k=block_k, n_q=n_q),
+        grid=(B, H, n_kv, n_q),
+        in_specs=[qblk_i, kblk, kblk, qblk_i, qblk_i, qrow_i],
         out_specs=[kblk, kblk],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, S, Dh), k.dtype),
             jax.ShapeDtypeStruct((B, H, S, Dh), v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, Dh), jnp.float32),
+            pltpu.VMEM((block_k, Dh), jnp.float32),
+        ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, o, do, lse)
     return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# blocked lax path (non-TPU backends)
+# --------------------------------------------------------------------------
+
+
+def _lax_flash(q, k, v, causal: bool, block_q: int, block_k: int):
+    """The SAME blocked online-softmax algorithm as the Pallas forward,
+    written in plain lax ops — the production non-TPU execution.  The
+    backward differentiates through the scan (memory O(S^2 x Dh /
+    block_k) — bounded by the block count, not linear like the Pallas
+    kernel, but far below the einsum's O(S^2) score tensor and measured
+    1.2-4x faster than the einsum grad step on CPU bench shapes).
+    Operates on (B, S, H, Dh)."""
+    B, S, H, Dh = q.shape
+    scale = 1.0 / math.sqrt(Dh)
+    nq, nk = S // block_q, S // block_k
+    # (B, H, nblocks, block, Dh) f32 working layout
+    qf = jnp.moveaxis(q, 2, 1).astype(jnp.float32).reshape(
+        B, H, nq, block_q, Dh)
+    kf = jnp.moveaxis(k, 2, 1).astype(jnp.float32).reshape(
+        B, H, nk, block_k, Dh)
+    vf = jnp.moveaxis(v, 2, 1).astype(jnp.float32).reshape(
+        B, H, nk, block_k, Dh)
+    # scan operand layout: KV block index leading
+    ks = jnp.moveaxis(kf, 2, 0)  # (nk, B, H, block_k, Dh)
+    vs = jnp.moveaxis(vf, 2, 0)
+
+    def per_qblock(qi: int):
+        qblk = qf[:, :, qi]  # (B, H, block_q, Dh)
+
+        def body(carry, inp):
+            m, l, acc = carry
+            j, kblk, vblk = inp
+            s = jnp.einsum("bhqd,bhkd->bhqk", qblk, kblk,
+                           preferred_element_type=jnp.float32) * scale
+            if causal:
+                qpos = qi * block_q + jnp.arange(block_q)[:, None]
+                kpos = j * block_k + jnp.arange(block_k)[None, :]
+                s = jnp.where((qpos >= kpos)[None, None], s, _NEG_INF)
+            m_new = jnp.maximum(m, s.max(-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m - m_new)
+            l_new = alpha * l + p.sum(-1, keepdims=True)
+            acc_new = acc * alpha + jnp.einsum(
+                "bhqk,bhkd->bhqd", p, vblk,
+                preferred_element_type=jnp.float32)
+            return (m_new, l_new, acc_new), None
+
+        # causal: blocks entirely above the diagonal are not scanned
+        n_run = nk if not causal else min(
+            nk, ((qi + 1) * block_q + block_k - 1) // block_k)
+        m0 = jnp.full((B, H, block_q, 1), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((B, H, block_q, 1), jnp.float32)
+        a0 = jnp.zeros((B, H, block_q, Dh), jnp.float32)
+        (m, l, acc), _ = lax.scan(
+            body, (m0, l0, a0),
+            (jnp.arange(n_run), ks[:n_run], vs[:n_run]))
+        return acc / l
+
+    out = jnp.stack([per_qblock(i) for i in range(nq)], axis=2)
+    return jnp.moveaxis(out.reshape(B, H, S, Dh), 1, 2).astype(q.dtype)
 
 
 # --------------------------------------------------------------------------
@@ -379,12 +520,8 @@ def _flash_vjp_fwd(q, k, v, causal, block_q, block_k):
         return _xla_attention(q, k, v, causal=causal), (q, k, v, None, None)
     if FLASH_BWD_XLA_MIN_S is not None \
             and q.shape[1] >= FLASH_BWD_XLA_MIN_S:
-        # flash FORWARD (compiles and runs at 32k — 58.4 ms, 0 MB temp,
-        # PERF.md S-sweep), but the backward kernel's remote compilation
-        # 500s on the tunnelled backend at this length; hand the vjp the
-        # lse=None residual so the backward recomputes through the XLA
-        # path — 32k-token training works at XLA's quadratic temp cost
-        # in the backward only (measured viable: 121.7 ms / 13.3 GB).
+        # env-armed escape hatch (see FLASH_BWD_XLA_MIN_S): flash
+        # forward, gradients recomputed through the XLA path
         out = _flash_attention(q, k, v, causal, block_q, block_k)
         return out, (q, k, v, None, None)
     bq, bk = blocks
@@ -422,19 +559,44 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     block_q: int = None, block_k: int = None):
     """Attention on ``(B, S, H, Dh)`` q/k/v (K/V already at H heads).
 
-    ``block_q``/``block_k`` override the tile sizes — larger KV blocks
-    amortize per-block loop overhead when S is long and VMEM allows
-    (q/k/v blocks + f32 accumulators must fit in ~16 MB).  Defaults:
-    (128, 128), except ``block_k`` rises to 256 at S >= 8192 — the
-    measured on-chip optimum (results/flash_sweep_tpu_*: S=16384 grad
-    step 184.5 ms at 128/128 vs 165.9 ms at 128/256)."""
+    ``block_q``/``block_k`` override the tile sizes; otherwise the
+    persisted autotune cache (ops/autotune.py, keyed per head-dim /
+    seq-bucket / dtype / platform) is consulted, falling back to the
+    measured defaults: (128, 128), with ``block_k`` rising to 256 at
+    S >= 8192 (results/flash_sweep_tpu_*: S=16384 grad step 184.5 ms at
+    128/128 vs 165.9 ms at 128/256).  Larger KV blocks amortize
+    per-block loop overhead when S is long and VMEM allows (q/k/v
+    blocks + f32 accumulators must fit in ~16 MB).
+
+    Dispatch: TPU runs the Pallas kernels; other backends run the same
+    blocked algorithm as lax ops (``FORCE_PALLAS`` routes them through
+    the kernels in interpreter mode — the parity-test configuration)."""
     # the kernel's grid is built from q's sequence length, so it only
     # supports self-attention shapes; differing K/V length (cross
     # attention) computes through the XLA path instead of silently
     # truncating keys past q.shape[1]
     if k.shape[1] != q.shape[1]:
         return _xla_attention(q, k, v, causal=causal)
-    # block_k tiles the K/V sequence axis (== q's here)
-    if block_k is None and k.shape[1] >= 8192 and k.shape[1] % 256 == 0:
-        block_k = 256
-    return _flash_attention(q, k, v, causal, block_q, block_k)
+    S, Dh = q.shape[1], q.shape[-1]
+    if block_q is None and block_k is None:
+        tuned = autotune.lookup(autotune.KIND_FLASH, Dh, S, q.dtype)
+        if tuned:
+            block_q, block_k = tuned
+    if jax.default_backend() == "tpu" or FORCE_PALLAS:
+        # block_k tiles the K/V sequence axis (== q's here)
+        if block_k is None and S >= 8192 and S % 256 == 0:
+            block_k = 256
+        return _flash_attention(q, k, v, causal, block_q, block_k)
+    blocks = _pick_blocks(S, block_q or LAX_DEFAULT_BLOCK,
+                          block_k or LAX_DEFAULT_BLOCK)
+    if blocks is None:
+        return _xla_attention(q, k, v, causal=causal)
+    bq, bk = blocks
+    if block_q is None:
+        # bound the unrolled q-block programs (trace/compile size):
+        # double the q block while it still divides S — but never
+        # second-guess a caller- or cache-pinned block_q, or the tuner
+        # would record winners it didn't actually run
+        while S // bq > 32 and S % (bq * 2) == 0:
+            bq *= 2
+    return _lax_flash(q, k, v, causal, bq, bk)

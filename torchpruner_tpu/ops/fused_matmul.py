@@ -1,0 +1,160 @@
+"""Fused dequant matmul — int8 AND packed-int4 weights unpacked in VMEM.
+
+Decode is HBM-bound on weight bytes (PERF.md serving table), so the
+lever is bytes READ per token.  The int8 XLA formulation (ops/quant.py)
+RELIES on fusion: the weight's only producer is a unary convert, and
+XLA *usually* fuses it into the dot's operand read — but "usually" is
+not a contract, and the round-4/5 decode rows carry exactly that
+uncertainty (the int4_bench stale-evidence note).  This kernel makes
+the fusion structural for both widths: the packed/int8 block is DMA'd
+to VMEM as integer bytes, widened (and for int4, nibble-unpacked)
+in-register, and fed to the MXU — HBM traffic is the integer bytes,
+guaranteed, with the per-output-channel scale optionally fused onto the
+output block's last accumulation step.
+
+Layouts follow ops/int4_matmul.py: int4 packs value pairs along the
+contracted axis (byte ``k`` of column ``f`` = ``w[2k, f]`` low nibble,
+``w[2k+1, f]`` high); int8 is the plain (D, F) payload.  Scales are
+symmetric per-output-channel (ops/quant.py), applied to the matmul
+output — exact, since only input axes contract.  Interpreter mode on
+CPU; shapes that don't tile fall back to an unpack-then-matmul XLA path
+that is numerically identical (just not bandwidth-saving).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from torchpruner_tpu.ops.int4_matmul import (
+    DEFAULT_BLOCK_D,
+    DEFAULT_BLOCK_F,
+    _fit_block,
+    _pick_row_block,
+    unpack_int4,
+)
+
+__all__ = ["dequant_matmul", "int8_kernel_active", "INT8_KERNEL"]
+
+#: int8 routing policy for quant.qdot: None = auto (kernel on TPU, the
+#: convert-fusion XLA path elsewhere — the interpreter would only slow
+#: CPU decode), True/False force.  Parity tests force True so tier-1
+#: exercises the kernel.
+INT8_KERNEL: Optional[bool] = None
+
+#: scale rows are tiled to 8 sublanes so the scale block is a clean
+#: (8, lane) TPU tile; the kernel reads row 0
+_SCALE_SUBLANES = 8
+
+
+def int8_kernel_active() -> bool:
+    if INT8_KERNEL is not None:
+        return INT8_KERNEL
+    return jax.default_backend() == "tpu"
+
+
+def _kernel(x_ref, w_ref, o_ref, s_ref=None, *, bits, nk):
+    k = pl.program_id(2)                              # contraction step
+    wp = w_ref[...]                                   # int8 block
+    if bits == 4:
+        # Mosaic has no int8 vector shifts — widen to i32 in-register
+        # (VMEM already paid the packed bytes) and sign-extend the
+        # nibbles with i32 shifts
+        wi = wp.astype(jnp.int32)
+        lo = (wi << 28) >> 28
+        hi = wi >> 4
+        wv = (jnp.stack([lo, hi], axis=1)
+              .reshape(wp.shape[0] * 2, wp.shape[1])
+              .astype(jnp.bfloat16))
+    else:
+        wv = wp.astype(jnp.bfloat16)
+    part = jnp.dot(x_ref[...].astype(jnp.bfloat16), wv,
+                   preferred_element_type=jnp.float32)
+
+    @pl.when(k == 0)
+    def _init():
+        o_ref[...] = part
+
+    @pl.when(k != 0)
+    def _acc():
+        o_ref[...] += part
+
+    if s_ref is not None:
+        @pl.when(k == nk - 1)
+        def _scale():
+            o_ref[...] *= s_ref[0:1, :]
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("bits", "block_d", "block_f"))
+def dequant_matmul(x, q, scale=None, *, bits: int = 8,
+                   block_d: int = DEFAULT_BLOCK_D,
+                   block_f: int = DEFAULT_BLOCK_F):
+    """``x (B, D) @ dequant(q) (D, F) [* scale (F,)] -> (B, F)`` f32.
+
+    ``q`` is the int8 payload — ``(D, F)`` for ``bits=8``, the
+    pack_int4 ``(D//2, F)`` layout for ``bits=4``.  ``scale`` (per
+    output channel, float32) is fused onto the output block inside the
+    kernel when given.  Falls back to the XLA unpack-then-matmul path
+    when the shapes don't tile (numerics identical; no bandwidth win).
+    """
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+    B, D = x.shape
+    F = q.shape[1]
+    pack = 2 if bits == 4 else 1
+    if q.shape[0] * pack != D:
+        raise ValueError(
+            f"payload rows {q.shape[0]} != D/{pack} = {D // pack}")
+    block_b = _pick_row_block(B)
+    block_d = _fit_block(D, block_d, even=(bits == 4))
+    block_f = _fit_block(F, block_f)
+    ok = block_b > 0 and block_d > 0 and block_f > 0
+    if not ok:
+        wv = unpack_int4(q) if bits == 4 else q
+        y = jnp.dot(x.astype(jnp.bfloat16), wv.astype(jnp.bfloat16),
+                    preferred_element_type=jnp.float32)
+        if scale is not None:
+            y = y * scale[None, :]
+        return y
+    in_specs = [
+        pl.BlockSpec((block_b, block_d), lambda i, j, k: (i, k)),
+        pl.BlockSpec((block_d // pack, block_f), lambda i, j, k: (k, j)),
+    ]
+    args = [x, q]
+    nk = D // block_d
+    if scale is not None:
+        in_specs.append(
+            pl.BlockSpec((_SCALE_SUBLANES, block_f),
+                         lambda i, j, k: (0, j)))
+        args.append(jnp.broadcast_to(
+            scale.astype(jnp.float32)[None, :], (_SCALE_SUBLANES, F)))
+
+    # pallas_call passes refs as (inputs..., outputs...): build the
+    # positional adapter for the optional scale operand
+    if scale is not None:
+        def body(x_ref, w_ref, s_ref, o_ref):
+            _kernel(x_ref, w_ref, o_ref, s_ref, bits=bits, nk=nk)
+    else:
+        def body(x_ref, w_ref, o_ref):
+            _kernel(x_ref, w_ref, o_ref, None, bits=bits, nk=nk)
+
+    return pl.pallas_call(
+        body,
+        # contraction (k) innermost so the (i, j) output block stays
+        # resident across its accumulation steps
+        grid=(B // block_b, F // block_f, nk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((block_b, block_f),
+                               lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((B, F), jnp.float32),
+        interpret=_interpret(),
+    )(*args)

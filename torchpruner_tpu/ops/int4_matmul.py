@@ -1,4 +1,8 @@
-"""Fused int4 weight-only matmul — a Pallas kernel that unpacks in VMEM.
+"""int4 pack/unpack/quantize helpers + the int4 matmul entry point.
+
+The Pallas kernel itself was generalized to int8 AND int4 with a fused
+output scale — it lives in ops/fused_matmul.py; :func:`int4_matmul`
+stays as the packed-int4 entry point over it.
 
 Why a kernel: decode is HBM-bound on weight bytes (PERF.md serving
 table — int8 already buys 1.33×), and int4 halves the bytes again, but
@@ -25,11 +29,7 @@ identical (just not bandwidth-saving).
 
 from __future__ import annotations
 
-import functools
-
-import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
 
 __all__ = ["pack_int4", "unpack_int4", "quantize_int4", "int4_matmul"]
 
@@ -75,34 +75,6 @@ def quantize_int4(w, *, sym_max: int = 7):
     return pack_int4(q), scale
 
 
-def _kernel(x_ref, w_ref, o_ref):
-    k = pl.program_id(2)                              # contraction step
-    wp = w_ref[...]                                   # (bd//2, bf) int8
-    # Mosaic has no int8 vector shifts — widen to i32 in-register (VMEM
-    # already paid the packed bytes; this costs no HBM traffic) and
-    # sign-extend the nibbles with i32 shifts
-    wi = wp.astype(jnp.int32)
-    lo = (wi << 28) >> 28
-    hi = wi >> 4
-    w = (jnp.stack([lo, hi], axis=1)
-         .reshape(wp.shape[0] * 2, wp.shape[1])
-         .astype(jnp.bfloat16))
-    part = jnp.dot(x_ref[...].astype(jnp.bfloat16), w,
-                   preferred_element_type=jnp.float32)
-
-    @pl.when(k == 0)
-    def _init():
-        o_ref[...] = part
-
-    @pl.when(k != 0)
-    def _acc():
-        o_ref[...] += part
-
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
-
-
 def _pick_row_block(B: int) -> int:
     """Largest row-block <= MAX_UNTILED_ROWS that divides ``B`` (the
     whole count for decode-sized B); 0 when only degenerate tilings
@@ -129,45 +101,16 @@ def _fit_block(n: int, preferred: int, *, lane_multiple: int = 128,
     return 0
 
 
-@functools.partial(jax.jit, static_argnames=("block_d", "block_f"))
 def int4_matmul(x, packed, scale=None, *, block_d: int = DEFAULT_BLOCK_D,
                 block_f: int = DEFAULT_BLOCK_F):
     """``x (B, D) @ (unpack(packed) (D, F) * scale (F,)) -> (B, F)`` f32.
 
-    ``packed`` is :func:`pack_int4`'s ``(D//2, F)`` int8.  Falls back to
-    the XLA unpack-then-matmul path when the shapes don't tile (numerics
-    identical; no bandwidth win)."""
-    B, D = x.shape
-    F = packed.shape[1]
-    if packed.shape[0] * 2 != D:
-        raise ValueError(f"packed rows {packed.shape[0]} != D/2 = {D // 2}")
-    # decode-sized row counts ride whole; prefill-sized ones tile so the
-    # x-block and the f32 accumulator stay inside VMEM; the D/F blocks
-    # shrink to fit axes the defaults don't divide (vocab-sized F)
-    block_b = _pick_row_block(B)
-    block_d = _fit_block(D, block_d, even=True)
-    block_f = _fit_block(F, block_f)
-    ok = block_b > 0 and block_d > 0 and block_f > 0
-    if not ok:
-        y = jnp.dot(x.astype(jnp.bfloat16),
-                    unpack_int4(packed).astype(jnp.bfloat16),
-                    preferred_element_type=jnp.float32)
-    else:
-        y = pl.pallas_call(
-            _kernel,
-            # contraction (k) innermost so the (i, j) output block stays
-            # resident across its accumulation steps
-            grid=(B // block_b, F // block_f, D // block_d),
-            in_specs=[
-                pl.BlockSpec((block_b, block_d), lambda i, j, k: (i, k)),
-                pl.BlockSpec((block_d // 2, block_f),
-                             lambda i, j, k: (k, j)),
-            ],
-            out_specs=pl.BlockSpec((block_b, block_f),
-                                   lambda i, j, k: (i, j)),
-            out_shape=jax.ShapeDtypeStruct((B, F), jnp.float32),
-            interpret=_interpret(),
-        )(x, packed)
-    if scale is not None:
-        y = y * scale[None, :]
-    return y
+    ``packed`` is :func:`pack_int4`'s ``(D//2, F)`` int8.  Thin wrapper
+    over the generalized int4/int8 kernel (ops/fused_matmul.py), which
+    also FUSES the per-output-channel scale onto the output block; the
+    XLA unpack-then-matmul fallback for non-tiling shapes is numerically
+    identical (no bandwidth win)."""
+    from torchpruner_tpu.ops.fused_matmul import dequant_matmul
+
+    return dequant_matmul(x, packed, scale, bits=4, block_d=block_d,
+                          block_f=block_f)

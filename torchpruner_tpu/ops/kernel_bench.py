@@ -1,0 +1,269 @@
+"""Kernel smoke bench: parity asserts + timed micro-measurements that
+export ``kernel_*`` gate scalars.
+
+CI's per-kernel regression gate needs numbers that exist on every run,
+on CPU, in seconds — the profiler's per-op tables cover workloads, but
+the NEW kernels (flash retune, decode attention, block-sparse, fused
+dequant) deserve a direct harness: each kernel is timed around its
+jitted call on a small fixed shape set, asserted against its reference
+path, and exported as ``kernel_<name>_ms`` / ``kernel_<name>_speedup_*``
+gauges into the obs session — which land in ``report.json`` and ride
+``obs diff --gate`` exactly like the profiler's dynamic kernel scalars
+(results/obs_gates_profile_ci.json, golden
+results/obs_report_golden_kernels_cpu.json).
+
+Also the autotune round-trip check: a tune is recorded, the in-memory
+cache dropped, and the persisted JSON must serve the same blocks back
+(the tune→persist→reload contract that makes tuning a one-time cost).
+
+Test hook: ``TORCHPRUNER_KERNEL_PLANT_BLOCK=<n>`` forces the
+block-sparse measurement onto that block edge — planting a REAL
+regression (pathological tiling) that the kernel gate must catch; CI
+drills it.
+
+Run: ``python -m torchpruner_tpu.ops.kernel_bench [--smoke]
+[--obs-dir DIR]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from torchpruner_tpu.ops.autotune import _time_ms
+
+
+def _flash_rows(smoke: bool, iters: int) -> dict:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from torchpruner_tpu.ops import flash_attention as F
+
+    # S=1024/Dh64 even for smoke: the einsum's S^2 f32 scores fall out
+    # of cache there, so the blocked path's win is decisive (~4x) and
+    # the speedup gauge is stable enough to gate; smaller S is noise
+    B, S, H, Dh = (1, 1024, 4, 64) if smoke else (2, 2048, 4, 64)
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H, Dh), jnp.bfloat16)
+               for kk in ks)
+
+    def grad_of(fn):
+        def loss(q_, k_, v_):
+            return jnp.sum(fn(q_, k_, v_, causal=True).astype(jnp.float32))
+        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    flash_ms = _time_ms(grad_of(F.flash_attention), q, k, v, iters=iters)
+    xla_ms = _time_ms(grad_of(F._xla_attention), q, k, v, iters=iters)
+    # parity through the interpret-mode Pallas kernels (tiny shape):
+    # tier-1's guarantee that the real kernel code ran today
+    qs, ks_, vs = (t[:, :64] for t in (q, k, v))
+    prev, F.FORCE_PALLAS = F.FORCE_PALLAS, True
+    try:
+        got = F.flash_attention(qs, ks_, vs, causal=True)
+    finally:
+        F.FORCE_PALLAS = prev
+    ref = F._xla_attention(qs, ks_, vs, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32),
+        atol=3e-2)
+    return {
+        "flash_grad_ms": round(flash_ms, 3),
+        "flash_xla_grad_ms": round(xla_ms, 3),
+        "flash_speedup_vs_xla": round(xla_ms / flash_ms, 3),
+        "shape": f"B{B} S{S} H{H} Dh{Dh} bf16 causal",
+    }
+
+
+def _decode_rows(smoke: bool, iters: int) -> dict:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from torchpruner_tpu.ops import decode_attention as DA
+
+    B, T, H, Dh = (2, 128, 2, 16) if smoke else (8, 1024, 8, 64)
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (B, 1, H, Dh))
+    kc = jax.random.normal(ks[1], (B, T, H, Dh))
+    vc = jax.random.normal(ks[2], (B, T, H, Dh))
+    pos = jnp.asarray([(i * T) // (B + 1) + 3 for i in range(B)], jnp.int32)
+    kern = jax.jit(DA.decode_attention)
+    ref = jax.jit(DA.xla_decode_attention)
+    got, want = kern(q, kc, vc, pos), ref(q, kc, vc, pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5)
+    return {
+        "decode_ms": round(_time_ms(kern, q, kc, vc, pos, iters=iters), 3),
+        "decode_xla_ms": round(
+            _time_ms(ref, q, kc, vc, pos, iters=iters), 3),
+        "decode_block": DA.decode_block(T),
+        "shape": f"B{B} T{T} H{H} Dh{Dh}",
+    }
+
+
+def _blocksparse_rows(smoke: bool, iters: int) -> dict:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from torchpruner_tpu.ops import blocksparse as BS
+
+    block = int(os.environ.get("TORCHPRUNER_KERNEL_PLANT_BLOCK", 0)) \
+        or (64 if smoke else 128)
+    R, D, F = (128, 512, 512) if smoke else (512, 2048, 2048)
+    x = jax.random.normal(jax.random.PRNGKey(2), (R, D), jnp.bfloat16)
+    w = np.array(
+        jax.random.normal(jax.random.PRNGKey(3), (D, F)), np.float32)
+    # 50% structured sparsity on both axes, block-aligned
+    in_keep = tuple(range(0, D // block, 2))
+    out_keep = tuple(range(0, F // block, 2))
+    for b in range(D // block):
+        if b not in in_keep:
+            w[b * block:(b + 1) * block] = 0
+    for b in range(F // block):
+        if b not in out_keep:
+            w[:, b * block:(b + 1) * block] = 0
+    wb = jnp.asarray(w, jnp.bfloat16)
+
+    sparse = jax.jit(lambda x_, w_: BS.blocksparse_matmul(
+        x_, w_, in_keep=in_keep, out_keep=out_keep, block=block))
+    dense_kernel = jax.jit(lambda x_, w_: BS.blocksparse_matmul(
+        x_, w_, block=block))  # all blocks: same machinery, no skipping
+    dense_xla = jax.jit(lambda x_, w_: x_ @ w_)
+    got, want = sparse(x, wb), dense_xla(x, wb)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               atol=0.5, rtol=0.05)  # bf16 sums
+    s_ms = _time_ms(sparse, x, wb, iters=iters)
+    d_ms = _time_ms(dense_kernel, x, wb, iters=iters)
+    return {
+        "blocksparse_ms": round(s_ms, 3),
+        "blocksparse_dense_ms": round(d_ms, 3),
+        "blocksparse_speedup_vs_dense": round(d_ms / s_ms, 3),
+        "blocksparse_xla_dense_ms": round(
+            _time_ms(dense_xla, x, wb, iters=iters), 3),
+        "block": block,
+        "shape": f"R{R} D{D} F{F} 50% blocks",
+    }
+
+
+def _dequant_rows(smoke: bool, iters: int) -> dict:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from torchpruner_tpu.ops.fused_matmul import dequant_matmul
+    from torchpruner_tpu.ops.int4_matmul import quantize_int4, unpack_int4
+    from torchpruner_tpu.ops.quant import quantize_tensor
+
+    B, D, F = (4, 256, 256) if smoke else (8, 2048, 2048)
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(D, F)).astype(np.float32))
+    x = jnp.asarray(rng.normal(size=(B, D)).astype(np.float32))
+    qt = quantize_tensor(w, in_axes=1)
+    p4, s4 = quantize_int4(w)
+    k8 = jax.jit(lambda x_, q_, s_: dequant_matmul(x_, q_, s_, bits=8))
+    k4 = jax.jit(lambda x_, q_, s_: dequant_matmul(x_, q_, s_, bits=4))
+    got8 = k8(x, qt.q, qt.out_scale())
+    ref8 = jnp.dot(x.astype(jnp.bfloat16), qt.q.astype(jnp.bfloat16),
+                   preferred_element_type=jnp.float32) \
+        * qt.out_scale()[None]
+    np.testing.assert_allclose(np.asarray(got8), np.asarray(ref8),
+                               rtol=1e-4, atol=1e-3)
+    got4 = k4(x, p4, s4)
+    ref4 = jnp.dot(x.astype(jnp.bfloat16),
+                   unpack_int4(p4).astype(jnp.bfloat16),
+                   preferred_element_type=jnp.float32) * s4[None]
+    np.testing.assert_allclose(np.asarray(got4), np.asarray(ref4),
+                               rtol=1e-4, atol=1e-3)
+    return {
+        "dequant_int8_ms": round(
+            _time_ms(k8, x, qt.q, qt.out_scale(), iters=iters), 3),
+        "dequant_int4_ms": round(_time_ms(k4, x, p4, s4, iters=iters), 3),
+        "shape": f"B{B} D{D} F{F}",
+    }
+
+
+def _autotune_roundtrip(smoke: bool) -> dict:
+    """Tune a tiny flash shape, drop the in-memory cache, and require
+    the persisted JSON to serve the same blocks back."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchpruner_tpu.ops import autotune
+    from torchpruner_tpu.ops import flash_attention as F
+
+    S, Dh = 256, 16
+    ks = jax.random.split(jax.random.PRNGKey(4), 3)
+    q, k, v = (jax.random.normal(kk, (1, S, 2, Dh)) for kk in ks)
+
+    def run(blocks):
+        bq, bk = blocks
+        fn = jax.jit(lambda a, b, c: F.flash_attention(
+            a, b, c, causal=True, block_q=bq, block_k=bk))
+        return lambda: fn(q, k, v)
+
+    blocks = autotune.autotune(
+        autotune.KIND_FLASH, Dh, S, q.dtype, run=run,
+        candidates=((64, 64), (128, 128), (64, 128)),
+        defaults=(128, 128), force=True, iters=2, warmup=1)
+    autotune.reset()  # force a reload from the persisted JSON
+    reloaded = autotune.lookup(autotune.KIND_FLASH, Dh, S, q.dtype)
+    assert reloaded == tuple(blocks), (reloaded, blocks)
+    path = autotune.cache_path()
+    assert os.path.exists(path), path
+    with open(path) as f:
+        entries = json.load(f)
+    return {"tuned_blocks": list(blocks), "cache_path": path,
+            "cache_entries": len(entries)}
+
+
+def run(smoke: bool = False, obs_dir: str | None = None,
+        iters: int | None = None) -> dict:
+    from torchpruner_tpu import obs
+
+    iters = iters or (3 if smoke else 5)
+    session = obs.configure(obs_dir) if obs_dir else None
+    out = {"smoke": smoke}
+    try:
+        with obs.span("kernel_bench"):
+            out["autotune"] = _autotune_roundtrip(smoke)
+            out["flash"] = _flash_rows(smoke, iters)
+            out["decode"] = _decode_rows(smoke, iters)
+            out["blocksparse"] = _blocksparse_rows(smoke, iters)
+            out["dequant"] = _dequant_rows(smoke, iters)
+        for section in ("flash", "decode", "blocksparse", "dequant"):
+            for key, val in out[section].items():
+                if (isinstance(val, (int, float))
+                        and not key.endswith("block")):
+                    obs.gauge_set(
+                        f"kernel_{key}", float(val),
+                        help="ops/kernel_bench micro-measurement")
+    finally:
+        if session is not None:
+            session.close()
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--obs-dir", default="")
+    ap.add_argument("--iters", type=int, default=0)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args(argv)
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    out = run(smoke=args.smoke, obs_dir=args.obs_dir or None,
+              iters=args.iters or None)
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

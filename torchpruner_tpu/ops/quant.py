@@ -163,27 +163,51 @@ def qdot(x, w):
     """``x ·₀ w``: contract ``x``'s trailing axis with ``w``'s LEADING
     axis — the Dense/GatedDense matmul site, and (for 3-D weights like
     attention's ``wq (d, H, Dh)``) the einsum ``...d,dhk->...hk``.
-    bits=4 weights packed along that leading axis route through the
-    fused-unpack Pallas kernel (ops/int4_matmul.py) with the output
-    axes flattened for the kernel and restored after — packing pairs
-    along axis 0 stay adjacent under a trailing-axes flatten, so the
-    kernel's nibble layout is unchanged.  The packed bytes are what HBM
-    reads; other cases consume :func:`wval` (bits=4 there unpacks
-    through XLA — correct everywhere, capacity-not-bandwidth).  The
-    caller applies :func:`oscale` as usual."""
-    if (isinstance(w, QTensor) and w.bits == 4 and w.in_axes == (0,)
-            and w.pack_axis == 0 and x.dtype == jnp.bfloat16):
-        from torchpruner_tpu.ops.int4_matmul import int4_matmul
+
+    Kernel dispatch by the weight's pytree type:
+
+    - :class:`~torchpruner_tpu.ops.blocksparse.BlockSparseWeight` rides
+      the block-sparse Pallas matmul — only kept 128-blocks are fetched
+      and multiplied, forward and backward (custom VJP);
+    - bits=4 :class:`QTensor` packed along the leading axis routes
+      through the fused dequant kernel (ops/fused_matmul.py) with the
+      output axes flattened for the kernel and restored after — packing
+      pairs along axis 0 stay adjacent under a trailing-axes flatten,
+      so the nibble layout is unchanged;
+    - bits=8 :class:`QTensor` takes the same fused kernel when
+      ``fused_matmul.int8_kernel_active()`` (default: on TPU) — the
+      structural version of the convert-into-dot fusion the XLA
+      formulation merely hopes for; elsewhere it consumes
+      :func:`wval`'s convert-only producer.
+
+    The caller applies :func:`oscale` as usual (the kernels run
+    unscaled here; scale fusion is for direct ``dequant_matmul`` use).
+    """
+    from torchpruner_tpu.ops.blocksparse import BlockSparseWeight
+
+    if isinstance(w, BlockSparseWeight):
+        return w.matmul(x)
+    if (isinstance(w, QTensor) and w.in_axes == (0,)
+            and x.dtype == jnp.bfloat16
+            and (w.bits == 4 and w.pack_axis == 0
+                 or w.bits == 8 and _int8_kernel_active())):
+        from torchpruner_tpu.ops.fused_matmul import dequant_matmul
 
         lead = x.shape[:-1]
         rest = w.shape[1:]  # logical output axes (possibly > 1 of them)
-        y = int4_matmul(x.reshape((-1, x.shape[-1])),
-                        w.q.reshape((w.q.shape[0], -1)))
+        y = dequant_matmul(x.reshape((-1, x.shape[-1])),
+                           w.q.reshape((w.q.shape[0], -1)), bits=w.bits)
         return y.reshape(lead + rest).astype(x.dtype)
     wv = wval(w, x.dtype)
     if wv.ndim > 2:
         return jnp.tensordot(x, wv, axes=(x.ndim - 1, 0))
     return x @ wv
+
+
+def _int8_kernel_active() -> bool:
+    from torchpruner_tpu.ops.fused_matmul import int8_kernel_active
+
+    return int8_kernel_active()
 
 
 def oscale(y, w):

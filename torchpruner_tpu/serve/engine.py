@@ -255,6 +255,20 @@ class ServeEngine:
         self.programs = _Programs(
             model, params, n_slots=n_slots, max_len=max_len,
             cache_dtype=cache_dtype, meta=checkpoint_meta)
+        # whether the decode step runs the decode-shaped Pallas kernel
+        # (ops/decode_attention.py) at this cache geometry — surfaced as
+        # a gauge so obs report / bench rows name the attention path
+        from torchpruner_tpu.generate import _attn_layers
+        from torchpruner_tpu.ops import decode_attention as _da
+
+        head_dim = next((int(spec.head_dim)
+                         for _, spec in _attn_layers(model.layers)), 0)
+        self.decode_kernel = bool(
+            head_dim and _da.kernel_active(max_len, head_dim, cache_dtype))
+        obs.gauge_set(
+            "serve_decode_kernel_active", float(self.decode_kernel),
+            help="1 when the decode-shaped Pallas attention kernel "
+                 "serves this engine's cache geometry")
         self.scheduler = Scheduler(
             KVCacheAllocator(n_slots, max_len, page_len=page_len,
                              page_budget=page_budget))
@@ -622,6 +636,7 @@ class ServeEngine:
             "admits": self.scheduler.admitted_total,
             "evictions": self.scheduler.allocator.total_evictions,
             "swaps": self.swaps_total,
+            "decode_kernel": self.decode_kernel,
         }
         if out["sustained_gen_tok_s"] is not None:
             obs.gauge_set("serve_gen_tokens_per_s",

@@ -371,11 +371,15 @@ def _run_synthetic(engine, pre, args, model, params) -> int:
             # request (a hot-swap mid-run changes engine.params; the
             # request carries its own)
             P = r.served_by or engine.programs
+            # max_len pins the replay to the SERVING cache geometry:
+            # the decode kernel's block partition is a function of the
+            # cache length (ops/decode_attention.py), so bit-identity
+            # requires replaying at the engine's max_len
             want = generate(
                 P.model, P.params, r.prompt_ids[None],
                 r.max_new, temperature=s.temperature, top_k=s.top_k,
                 top_p=s.top_p, rng=jax.random.PRNGKey(s.seed),
-                cache_dtype=P.cache_dtype)
+                cache_dtype=P.cache_dtype, max_len=P.max_len)
             if not np.array_equal(np.asarray(r.tokens, np.int32),
                                   np.asarray(want)[0][:len(r.tokens)]):
                 mismatches += 1

@@ -32,7 +32,8 @@ from torchpruner_tpu.utils.dtypes import cast_floats as _cast_floats
 
 
 def make_loss_closure(model: SegmentedModel, loss_fn, compute_dtype=None,
-                      remat: bool = False, moe_aux_weight: float = 0.0):
+                      remat: bool = False, moe_aux_weight: float = 0.0,
+                      param_transform: Optional[Callable] = None):
     """``(params, state, x, y, rng) -> (mean loss, new_state)`` — the ONE
     definition of the training forward policy, shared by the local and the
     SPMD train steps.
@@ -47,12 +48,21 @@ def make_loss_closure(model: SegmentedModel, loss_fn, compute_dtype=None,
     checkpoints composite blocks (recompute-in-backward).
     ``moe_aux_weight`` > 0 adds that multiple of the MoE load-balancing
     loss (Switch-style; collected from every MoE layer, 1.0 when expert
-    dispatch is perfectly balanced)."""
+    dispatch is perfectly balanced).
+
+    ``param_transform`` rewrites the params INSIDE the traced step,
+    after the compute-dtype cast — the kernel-dispatch hook: e.g.
+    ``masking.blocksparse_params`` wraps masked Dense weights in
+    :class:`~torchpruner_tpu.ops.blocksparse.BlockSparseWeight` so the
+    forward/backward matmuls skip dropped 128-blocks (gradients flow to
+    the PLAIN param leaves — the optimizer never sees the wrappers)."""
 
     def loss(params, state, x, y, rng):
         if compute_dtype is not None:
             params = _cast_floats(params, compute_dtype)
             x = _cast_floats(x, compute_dtype)
+        if param_transform is not None:
+            params = param_transform(params)
         if moe_aux_weight:
             out, new_state, aux = model.apply(
                 params, x, state=state, train=True, rng=rng, remat=remat,
@@ -76,7 +86,8 @@ def make_loss_closure(model: SegmentedModel, loss_fn, compute_dtype=None,
 def make_train_step(model: SegmentedModel, tx, loss_fn, donate: bool = True,
                     compute_dtype=None, remat: bool = False,
                     accum_steps: int = 1, moe_aux_weight: float = 0.0,
-                    grad_norm: bool = False, guard: bool = False):
+                    grad_norm: bool = False, guard: bool = False,
+                    param_transform: Optional[Callable] = None):
     """(params, state, opt_state, x, y, rng) -> (params, state, opt_state,
     loss).  Donation reuses the input buffers for the outputs.  Mixed
     precision / remat per :func:`make_loss_closure`.  ``grad_norm=True``
@@ -94,7 +105,8 @@ def make_train_step(model: SegmentedModel, tx, loss_fn, donate: bool = True,
     to float summation order; mutable state (BN statistics) threads through
     the microbatches sequentially."""
     loss_c = make_loss_closure(model, loss_fn, compute_dtype, remat,
-                               moe_aux_weight)
+                               moe_aux_weight,
+                               param_transform=param_transform)
     donate_argnums = (0, 2) if donate else ()
     return jax.jit(make_step_body(loss_c, tx, accum_steps, grad_norm, guard),
                    donate_argnums=donate_argnums)
