@@ -35,6 +35,12 @@ Six legs (baselines from BASELINE.md where the reference has one):
    structured sparsity vs the same-machinery dense matmul AND a full
    Dense-MLP train step masked-dense vs kernel-dispatched: the ms/step
    the pruned structure actually buys (not just the FLOPs gauge).
+8. ``zero`` — ZeRO-style cross-replica weight-update sharding A/B
+   (``ShardedTrainer(zero=True)`` vs replicated updates) on the
+   vgg16/llama train shapes: ms/step, planned optimizer bytes/chip both
+   ways (the 1/data-axis drop, asserted), and on TPU the batch sweep one
+   bucket past the r05 MFU plateau using the freed HBM
+   (experiments/zero_bench.py; ``zero_*`` gauges ride obs diff).
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
@@ -71,6 +77,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 #: last successful TPU measurement, refreshed by the orchestrator on every
@@ -117,6 +124,7 @@ _LEG_EST_S = {
     # decode 63 s, flash 10 s, sweep 928 s), with 2-6x cold margin
     "mnist_prune": (150, 520),
     "resilience": (150, 240),
+    "zero": (300, 420),
     "vgg16_train": (120, 3600),
     "mfu_llama": (180, 3600),
     "llama_decode": (180, 300),
@@ -1314,6 +1322,55 @@ def _leg_resilience(smoke: bool) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _leg_zero(smoke: bool) -> dict:
+    """Leg: ZeRO-style cross-replica weight-update sharding A/B
+    (``ShardedTrainer(zero=True)`` vs replicated) on the vgg16/llama
+    train shapes, plus the widened batch sweep the freed optimizer HBM
+    buys (experiments/zero_bench.py).  Needs >= 2 devices for a data
+    axis; a single-device run (the CPU fallback box) delegates to a
+    subprocess with 8 virtual host devices so the transform is still
+    exercised and parity-checked — clearly labelled, because virtual-
+    device collectives share one core and the ms numbers are not a
+    speedup claim (the HBM ratio IS meaningful there)."""
+    import jax
+
+    if jax.device_count() >= 2:
+        from torchpruner_tpu.experiments import zero_bench
+
+        out = zero_bench.run(smoke=smoke)
+        out["value"] = out.get("vgg", {}).get("ms")
+        out["unit"] = "ms/step"
+        return out
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                   + " --xla_force_host_platform_device_count=8").strip(),
+    )
+    # CPU is always smoke-sized: the full vgg16/mfu_llama A/B is TPU work
+    with tempfile.TemporaryDirectory() as td:
+        out_path = os.path.join(td, "zero_bench.json")
+        proc = subprocess.run(
+            [sys.executable, "-m", "torchpruner_tpu.experiments.zero_bench",
+             "--smoke", "--cpu", "--devices", "8", "--out", out_path],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        # read the --out file, not stdout: a stray warning line from the
+        # child would break a whole-stdout json.loads
+        if proc.returncode != 0 or not os.path.exists(out_path):
+            raise RuntimeError(
+                f"zero_bench child failed rc={proc.returncode}: "
+                f"{proc.stderr[-400:]}"
+            )
+        with open(out_path) as f:
+            out = json.load(f)
+    out["platform"] = "cpu_virtual8"
+    out["value"] = out.get("vgg", {}).get("ms")
+    out["unit"] = "ms/step"
+    return out
+
+
 def _leg_ok(legs: dict, name: str) -> bool:
     return (name in legs and "error" not in legs[name]
             and "skipped" not in legs[name]
@@ -1523,6 +1580,7 @@ def main() -> dict:
         # measurements per minute spent
         run_leg("mfu_llama", _leg_mfu_llama)
         run_leg("vgg16_train", _leg_vgg_train)
+        run_leg("zero", _leg_zero)
         run_leg("flash_attention", _leg_flash_attention)
         run_leg("blocksparse", _leg_blocksparse)
         run_leg("llama_decode", _leg_llama_decode)

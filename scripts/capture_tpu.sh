@@ -107,6 +107,41 @@ timeout 1800 python -m torchpruner_tpu.experiments.int4_bench \
     --out "results/int4_bench_tpu_${stamp}_${commit}.json" \
     2> "logs/int4_bench_${stamp}.err" && echo "[capture] int4 bench done"
 
+# 2d. ZeRO weight-update sharding A/B on chip (needs >= 2 devices for a
+#     data axis; a 1-chip tunnel window records the skip loudly): the
+#     zero-vs-replicated ms/step + planned-opt-bytes rows and the batch
+#     sweep one bucket past the r05 MFU plateau, using the freed HBM
+timeout 2400 python -m torchpruner_tpu.experiments.zero_bench \
+    --out "results/zero_bench_tpu_${stamp}_${commit}.json" \
+    2> "logs/zero_bench_${stamp}.err" \
+    && echo "[capture] zero bench done" \
+    || echo "[capture] zero bench FAILED/skipped (1-chip window? see logs/zero_bench_${stamp}.err)"
+
+# 2e. STAGED ASSERTIONS (ISSUE 9 acceptance): zero-mode planned HBM
+#     strictly below replicated at equal batch, and the widened vgg16
+#     batch sweep past the r05 MFU plateau (0.25).  A miss is loud but
+#     does not abort the capture.
+python - "results/zero_bench_tpu_${stamp}_${commit}.json" <<'EOF' \
+    && echo "[capture] zero HBM watermark < replicated HOLDS" \
+    || echo "[capture] zero HBM assertion FAILED/unavailable — diagnose before merging PERF claims"
+import json, sys
+z = json.load(open(sys.argv[1]))
+for leg in ("vgg", "llama"):
+    r = z[leg]
+    assert r["opt_bytes"] < r["rep_opt_bytes"], (leg, r)
+    data_ax = z["mesh"]["data"]
+    assert r["opt_bytes"] <= r["rep_opt_bytes"] / data_ax + (1 << 16), (leg, r)
+print("zero opt bytes:", {k: z[k]["opt_ratio"] for k in ("vgg", "llama")})
+EOF
+python - "results/zero_bench_tpu_${stamp}_${commit}.json" <<'EOF' \
+    && echo "[capture] vgg16 batch sweep past MFU 0.25 HOLDS" \
+    || echo "[capture] vgg16 zero batch sweep did NOT clear MFU 0.25 — investigate before merging PERF claims"
+import json, sys
+z = json.load(open(sys.argv[1]))
+best = z.get("vgg", {}).get("best_mfu")
+assert best is not None and best > 0.25, f"best vgg MFU {best} (sweep: {z.get('vgg', {}).get('batch_sweep')})"
+EOF
+
 # 3. compile economics (bucketing x persistent cache) on the real backend
 timeout 3600 python -m torchpruner_tpu.experiments.compile_economics \
     --steps 5 --out "results/compile_economics_tpu_${stamp}_${commit}.json" \

@@ -1,12 +1,16 @@
 """Worker for the crash-resume tests (not collected by pytest).
 
-Run as ``python _resilience_worker.py <run_dir> [chaos_json]``: trains
-the digits smoke preset (``digits_fc_tiny``) resiliently into
+Run as ``python _resilience_worker.py <run_dir> [chaos_json] [mode]``:
+trains the digits smoke preset (``digits_fc_tiny``) resiliently into
 ``run_dir``, optionally under a chaos config (e.g. a deterministic
-SIGKILL at a step boundary).  On a COMPLETED run prints one JSON line
-with the final eval metrics; a chaos-killed run prints nothing (SIGKILL
-allows no goodbye) — the parent detects death by exit code and re-runs
-without chaos to exercise the resume path.
+SIGKILL at a step boundary).  ``mode="zero"`` trains the same preset as
+an SPMD run over a ``{"data": 2, "model": 2}`` mesh with ZeRO
+weight-update sharding (``cfg.mesh`` + ``cfg.zero`` — the parent sets
+``XLA_FLAGS=--xla_force_host_platform_device_count=4``), exercising the
+sharded-checkpoint → re-placed-restore path.  On a COMPLETED run prints
+one JSON line with the final eval metrics; a chaos-killed run prints
+nothing (SIGKILL allows no goodbye) — the parent detects death by exit
+code and re-runs without chaos to exercise the resume path.
 """
 
 import json
@@ -21,9 +25,12 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 
-def smoke_config(run_dir: str, chaos: dict):
+def smoke_config(run_dir: str, chaos: dict, mode: str = ""):
     from torchpruner_tpu.utils.config import ExperimentConfig
 
+    kw = {}
+    if mode == "zero":
+        kw = {"mesh": {"data": 2, "model": 2}, "zero": True}
     return ExperimentConfig(
         name="resilience_smoke",
         model="digits_fc_tiny",
@@ -38,13 +45,16 @@ def smoke_config(run_dir: str, chaos: dict):
         guard_nonfinite=True,
         chaos=chaos,
         log_path=os.path.join(run_dir, "log.csv"),
+        **kw,
     )
 
 
 def main() -> None:
     run_dir = sys.argv[1]
-    chaos = json.loads(sys.argv[2]) if len(sys.argv) > 2 else {}
-    cfg = smoke_config(run_dir, chaos)
+    chaos = json.loads(sys.argv[2]) if len(sys.argv) > 2 and sys.argv[2] \
+        else {}
+    mode = sys.argv[3] if len(sys.argv) > 3 else ""
+    cfg = smoke_config(run_dir, chaos, mode)
     trainer, history = __import__(
         "torchpruner_tpu.experiments.train_model",
         fromlist=["run_train"],
@@ -59,6 +69,7 @@ def main() -> None:
         "final_test_acc": last["test_acc"],
         "steps": int(trainer.step_count),
         "w_abs_sum": float(np.abs(w).sum()),
+        "devices": jax.device_count(),
     }), flush=True)
 
 

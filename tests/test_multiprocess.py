@@ -19,6 +19,12 @@ import optax
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+#: generous wall per attempt: two gloo workers + cold SPMD compiles on a
+#: box that may be running other suites.  The old 420 s budget was the
+#: slow-lane flake (PR-4/7/8 postmortems): under load the second worker's
+#: backend init starved past the deadline and communicate() raised.
+WORKER_TIMEOUT_S = 900
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -26,38 +32,89 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_dp_matches_single_process():
+def _run_workers(extra_args=(), n=2, tries=2):
+    """Spawn ``n`` workers joined through one distributed runtime and
+    return their parsed JSON results sorted by pid.
+
+    Retries once on the two LOAD-dependent failure modes — a timeout
+    (backend init starved) and a distributed-init/connect error on the
+    shared port — with a fresh port, so the tests pin the parity
+    invariant instead of the box's scheduler.  Real assertion failures
+    (bad exit with output, wrong math) are never retried."""
     worker = os.path.join(REPO, "tests", "_mp_worker.py")
     env = os.environ.copy()
-    # each worker gets 2 virtual CPU devices -> a 4-device global mesh
+    # each worker gets 2 virtual CPU devices -> a 2n-device global mesh
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     # python puts the SCRIPT's dir on sys.path, not the cwd — the worker
     # needs the repo root to import torchpruner_tpu
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    port = _free_port()
-    procs = [
-        subprocess.Popen(
-            [sys.executable, worker, str(i), "2", str(port)],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            cwd=REPO, env=env,
+    last_err = None
+    for attempt in range(tries):
+        port = _free_port()
+        procs = [
+            subprocess.Popen(
+                [sys.executable, worker, str(i), str(n), str(port),
+                 *extra_args],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                cwd=REPO, env=env,
+            )
+            for i in range(n)
+        ]
+        outs, timed_out, init_err = [], False, False
+        try:
+            for p in procs:
+                try:
+                    out, err = p.communicate(timeout=WORKER_TIMEOUT_S)
+                except subprocess.TimeoutExpired:
+                    # kill the whole gang at the FIRST timeout: peers
+                    # blocked on the hung worker's collective would each
+                    # burn a full WORKER_TIMEOUT_S of their own otherwise
+                    timed_out = True
+                    for q in procs:
+                        q.kill()
+                    out, err = p.communicate()
+                    err = (err or "") + "\n[worker timeout]"
+                outs.append((p.returncode, out, err))
+        finally:
+            for p in procs:
+                p.kill()
+        if not timed_out and all(rc == 0 for rc, _, _ in outs):
+            results = []
+            for _, out, err in outs:
+                lines = [ln for ln in out.splitlines()
+                         if ln.startswith("{")]
+                assert lines, f"no JSON from worker:\n{out}\n{err[-1000:]}"
+                results.append(json.loads(lines[-1]))
+            results.sort(key=lambda r: r["pid"])
+            return results
+        if any("Multiprocess computations aren't implemented"
+               in (err or "") for _, _, err in outs):
+            # this jaxlib's CPU client was built WITHOUT cross-process
+            # collectives: every multiprocess CPU computation is
+            # impossible here, regardless of our code.  Skip — loudly,
+            # so the slow lane reads as environment-limited rather than
+            # red — while CI's jax[cpu] (gloo collectives) still runs
+            # the full parity assertion.  (This was the "load-flaky"
+            # slow-lane failure of the PR-4/7/8 postmortems: a constant
+            # environment limitation, not a race.)
+            import pytest
+
+            pytest.skip("jaxlib CPU backend lacks cross-process "
+                        "collectives on this machine")
+        init_err = any(
+            ("distributed" in err.lower() or "connect" in err.lower()
+             or "barrier" in err.lower() or "timed out" in err.lower())
+            for rc, _, err in outs if rc not in (0, None)
         )
-        for i in range(2)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, err = p.communicate(timeout=420)
-            assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
-            outs.append((out, err))
-    finally:
-        for p in procs:
-            p.kill()
-    results = []
-    for out, err in outs:
-        lines = [ln for ln in out.splitlines() if ln.startswith("{")]
-        assert lines, f"no JSON from worker:\n{out}\n{err[-1000:]}"
-        results.append(json.loads(lines[-1]))
-    results.sort(key=lambda r: r["pid"])
+        last_err = "\n---\n".join(
+            f"rc={rc}:\n{err[-2000:]}" for rc, _, err in outs)
+        if not (timed_out or init_err) or attempt + 1 == tries:
+            raise AssertionError(f"workers failed:\n{last_err}")
+    raise AssertionError(f"workers failed after {tries} tries:\n{last_err}")
+
+
+def test_two_process_dp_matches_single_process():
+    results = _run_workers()
 
     # one runtime: every process sees all 4 devices but addresses only 2
     for r in results:
@@ -110,36 +167,8 @@ def test_two_process_obs_metric_shards_merge(tmp_path):
     two-process runtime: every process writes a ``metrics.shard<i>.json``
     at close, and process 0's merged export sums counters / maxes gauges
     across hosts — the fix for non-zero processes' metrics vanishing."""
-    worker = os.path.join(REPO, "tests", "_mp_worker.py")
     obs_dir = str(tmp_path / "obs")
-    env = os.environ.copy()
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    port = _free_port()
-    procs = [
-        subprocess.Popen(
-            [sys.executable, worker, str(i), "2", str(port), "obs",
-             obs_dir],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            cwd=REPO, env=env,
-        )
-        for i in range(2)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, err = p.communicate(timeout=420)
-            assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
-            outs.append((out, err))
-    finally:
-        for p in procs:
-            p.kill()
-    results = []
-    for out, err in outs:
-        lines = [ln for ln in out.splitlines() if ln.startswith("{")]
-        assert lines, f"no JSON from worker:\n{out}\n{err[-1000:]}"
-        results.append(json.loads(lines[-1]))
-    results.sort(key=lambda r: r["pid"])
+    results = _run_workers(("obs", obs_dir))
     assert [r["is_emitter"] for r in results] == [True, False]
 
     # every process left its shard; only process 0 emitted the stream
@@ -178,34 +207,7 @@ def test_two_process_spmd_pipeline_matches_single_process():
     processes: a 4-stage pp mesh axis spanning 2 hosts x 2 devices, so
     the stage-to-stage ppermute crosses the process boundary.  The loss
     trajectory must equal the plain single-device gradient step."""
-    worker = os.path.join(REPO, "tests", "_mp_worker.py")
-    env = os.environ.copy()
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    port = _free_port()
-    procs = [
-        subprocess.Popen(
-            [sys.executable, worker, str(i), "2", str(port), "pp"],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            cwd=REPO, env=env,
-        )
-        for i in range(2)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, err = p.communicate(timeout=420)
-            assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
-            outs.append((out, err))
-    finally:
-        for p in procs:
-            p.kill()
-    results = []
-    for out, err in outs:
-        lines = [ln for ln in out.splitlines() if ln.startswith("{")]
-        assert lines, f"no JSON from worker:\n{out}\n{err[-1000:]}"
-        results.append(json.loads(lines[-1]))
-    results.sort(key=lambda r: r["pid"])
+    results = _run_workers(("pp",))
     for r in results:
         assert r["process_count"] == 2
         assert r["global_devices"] == 4

@@ -187,6 +187,39 @@ def test_sharded_trainer_evaluate_matches_single_device():
     assert a8 == a8b
 
 
+def test_sharded_trainer_evaluate_pads_with_zeros_not_poison():
+    """Regression (chaos runs): the ragged-batch pad rows must come from
+    ZEROS, not a repeat of the final example — the validity mask cannot
+    scrub a non-finite padded row (``inf * 0 = nan``), so a poisoned
+    final example must count exactly once, like on a single device.
+
+    An identity model (empty layer tuple) makes the poison exact: a
+    ``-inf`` logit at the true class yields a deterministic ``+inf``
+    loss for that one real row.  Zero padding keeps the masked total at
+    ``inf`` (matching the single-device sum); the old repeat-padding
+    replicated the row into the masked pad slots and degraded the total
+    to ``nan`` via ``inf * 0``."""
+    model = SegmentedModel((), (4,))
+    mesh = make_mesh({"data": 2, "model": 4})
+    tx = optax.sgd(0.05)
+    t1 = Trainer.create(model, tx, cross_entropy_loss, seed=0)
+    t8 = ShardedTrainer.create(model, tx, cross_entropy_loss, mesh,
+                               seed=0, min_shard_size=0)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(45, 4)).astype(np.float32)
+    y = (np.arange(45) % 4).astype(np.int32)
+    # poisoned FINAL example of a ragged final batch (15 % 2 != 0: every
+    # batch pads, and the last example is the old pad source)
+    x[-1] = 0.0
+    x[-1, 1] = -np.inf
+    y[-1] = 1
+    batches = [(x[i:i + 15], y[i:i + 15]) for i in range(0, 45, 15)]
+    l1, a1 = t1.evaluate(batches)
+    l8, a8 = t8.evaluate(batches)
+    assert np.isinf(l1) and np.isinf(l8), (l1, l8)
+    assert a1 == a8
+
+
 def test_sharded_trainer_gradient_accumulation_matches():
     """SPMD gradient accumulation (scanned microbatches, each still
     sharded over the data axis) must match the unaccumulated SPMD step."""

@@ -235,8 +235,15 @@ def test_mixed_precision_training_keeps_f32_master_state():
 
 
 def test_remat_training_matches_exact():
-    """jax.checkpoint blocks recompute the forward — results must be
-    IDENTICAL to the non-remat step (same program semantics)."""
+    """jax.checkpoint blocks recompute the forward — same program
+    SEMANTICS as the non-remat step.  The real invariant is pinned in
+    two parts: (1) the trajectories agree to float tolerance — NOT
+    bitwise, because the remat and non-remat programs fuse and schedule
+    their reductions differently, and on the multithreaded XLA CPU
+    backend the summation partitioning can additionally shift with
+    machine load (this test was load-flaky at rtol 1e-6 / atol 1e-6:
+    PR-4/7/8 slow-lane postmortems) — and (2) the checkpoint primitive
+    structurally engages, asserted on the jaxpr below."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -258,10 +265,11 @@ def test_remat_training_matches_exact():
 
     l0, p0 = run(False)
     l1, p1 = run(True)
-    np.testing.assert_allclose(l0, l1, rtol=1e-6)
+    np.testing.assert_allclose(l0, l1, rtol=1e-5)
     for a, b in zip(jax.tree_util.tree_leaves(p0),
                     jax.tree_util.tree_leaves(p1)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
 
     # the checkpoint primitive actually engages (per composite block)
     params, state = __import__(

@@ -133,6 +133,14 @@ def main(argv=None) -> int:
              "'{\"nan_at_step\": 5, \"kill_at_step\": 12}' — for "
              "recovery-path testing; also via TORCHPRUNER_CHAOS env",
     )
+    p.add_argument(
+        "--zero", action="store_true",
+        help="ZeRO-style cross-replica weight-update sharding on the "
+             "configured mesh's data axis (cfg.zero override): optimizer "
+             "state shards 1/N per chip, gradients reduce-scatter, the "
+             "update applies locally, params all-gather — needs a mesh "
+             "with a 'data' axis in the config",
+    )
     args = p.parse_args(argv)
 
     if args.lint_plan and args.lint is None:
@@ -183,6 +191,12 @@ def main(argv=None) -> int:
             "one of --preset / --config / --list / --lint PRESET is "
             "required"
         )
+
+    if args.zero:
+        if "data" not in (cfg.mesh or {}):
+            p.error("--zero needs a config mesh with a 'data' axis "
+                    "(e.g. \"mesh\": {\"data\": 4, \"model\": 2})")
+        cfg.zero = True
 
     if args.lint is not None:
         from torchpruner_tpu.analysis import lint_config

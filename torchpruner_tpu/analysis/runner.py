@@ -88,7 +88,7 @@ def lint_config(
             targets=targets, fraction=fraction, bucket=cfg.bucket,
             tx=make_optimizer(cfg),
             batch_per_chip=max(1, cfg.batch_size // max(1, data)),
-            compute_dtype=cdtype, remat=cfg.remat,
+            compute_dtype=cdtype, remat=cfg.remat, zero=cfg.zero,
         )
 
     # -- pass 3: jaxpr hazards --------------------------------------------

@@ -130,12 +130,16 @@ def lint_sharding(
     compute_dtype=None,
     remat: bool = False,
     hbm_bytes: Optional[int] = None,
+    zero: bool = False,
 ) -> List[Finding]:
     """Findings for pruning ``targets`` of ``model`` (by ``fraction``, or
     explicit per-target ``drops``) under a ``mesh_axes`` mesh.
 
     ``targets=None`` prunes every group the static graph derives (the
-    classifier head excluded), mirroring a full sweep.
+    classifier head excluded), mirroring a full sweep.  ``zero`` counts
+    optimizer slots at their ZeRO weight-update placement
+    (``ShardedTrainer(zero=True)``) in the HBM budget, so the
+    hbm-delta/overflow findings match what the trainer will plan.
     """
     from torchpruner_tpu.core.graph import pruning_graph
 
@@ -210,7 +214,7 @@ def lint_sharding(
         budgets.append(training_memory(
             m, sh, dict(mesh_axes), tx=tx, batch_per_chip=batch_per_chip,
             param_dtype=param_dtype, compute_dtype=compute_dtype,
-            remat=remat, params=p,
+            remat=remat, params=p, zero=zero,
         ))
     pre_b, post_b = budgets
     gib = 2.0 ** 30

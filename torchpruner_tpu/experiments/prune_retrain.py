@@ -293,7 +293,8 @@ def _run_prune_retrain(
             mesh = make_mesh(cfg.mesh)
             trainer = ShardedTrainer.create(
                 model, tx, loss_fn, mesh, seed=cfg.seed,
-                partition=cfg.partition, compute_dtype=cdtype,
+                partition=cfg.partition, zero=cfg.zero,
+                compute_dtype=cdtype,
                 remat=cfg.remat, accum_steps=accum_steps,
                 moe_aux_weight=cfg.moe_aux_weight,
                 grad_norm=cfg.obs_grad_norm, guard=guard,

@@ -29,7 +29,7 @@ from torchpruner_tpu.data.native import (
     shuffled_indices,
 )
 from torchpruner_tpu.train.logger import CSVLogger
-from torchpruner_tpu.train.loop import Trainer
+from torchpruner_tpu.train.loop import Trainer, trainer_from_config
 from torchpruner_tpu.utils.config import ExperimentConfig
 
 
@@ -101,8 +101,6 @@ def run_train(
         resolve_model_and_data,
     )
 
-    import jax.numpy as jnp
-
     if cfg.chaos:
         from torchpruner_tpu.resilience import chaos as _chaos
 
@@ -111,29 +109,32 @@ def run_train(
     steps_per_epoch = max(1, len(train) // cfg.batch_size)
     tx = make_optimizer(cfg, steps_per_epoch=steps_per_epoch)
     loss_fn = LOSS_REGISTRY[cfg.loss]
-    cdtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else None
+
+    mesh = None
+    data_size = 1
+    if cfg.mesh:
+        # SPMD training over the configured mesh (FSDP/TP placement,
+        # optional ZeRO weight-update sharding) — same loop, distributed
+        # placement; ragged tail batches that can't shard are dropped
+        from torchpruner_tpu.parallel import make_mesh
+
+        mesh = make_mesh(cfg.mesh)
+        data_size = int(dict(mesh.shape).get("data", 1))
 
     start_epoch = 0
     if cfg.checkpoint_path and os.path.exists(cfg.checkpoint_path):
         model, params, state, opt_state, meta = restore_checkpoint(
             cfg.checkpoint_path, tx=tx
         )
-        trainer = Trainer.create(model, tx, loss_fn, seed=cfg.seed,
-                                 params=params, state=state,
-                                 compute_dtype=cdtype, remat=cfg.remat,
-                                 accum_steps=cfg.accum_steps,
-                                 moe_aux_weight=cfg.moe_aux_weight)
-        if opt_state is not None:
-            trainer.opt_state = opt_state
+        trainer = trainer_from_config(cfg, model, tx, loss_fn, mesh=mesh,
+                                      params=params, state=state,
+                                      opt_state=opt_state)
         start_epoch = int(meta.get("extra", {}).get("epoch", 0))
         if verbose:
             print(f"[{cfg.name}] resumed from {cfg.checkpoint_path} "
                   f"at epoch {start_epoch}", flush=True)
     else:
-        trainer = Trainer.create(model, tx, loss_fn, seed=cfg.seed,
-                                 compute_dtype=cdtype, remat=cfg.remat,
-                                 accum_steps=cfg.accum_steps,
-                                 moe_aux_weight=cfg.moe_aux_weight)
+        trainer = trainer_from_config(cfg, model, tx, loss_fn, mesh=mesh)
 
     logger = CSVLogger(cfg.log_path, experiment=cfg.name)
     test_batches = test.batches(cfg.eval_batch_size)
@@ -146,6 +147,13 @@ def run_train(
             stream = device_prefetch(stream, size=cfg.device_prefetch)
         with obs.span("train", epoch=epoch):
             for x, y in stream:
+                if data_size > 1 and x.shape[0] % data_size:
+                    # the epoch's ragged tail can't shard over the data
+                    # axis — drop it, counted (never silently)
+                    obs.inc("mesh_ragged_drops_total",
+                            help="tail batches dropped because they "
+                                 "don't divide the mesh's data axis")
+                    continue
                 # keep the loss on device: a float() here would fence every
                 # step and forfeit both async dispatch and the prefetch; the
                 # periodic fence on a loss 8 steps back bounds dispatch

@@ -1,0 +1,271 @@
+"""ZeRO weight-update-sharding A/B bench: zero-vs-replicated on the
+vgg16/llama train legs, plus the widened batch sweep the freed HBM buys.
+
+For each model the SAME ``ShardedTrainer`` config runs twice — once
+replicated (``zero=False``: optimizer state and the weight update
+repeated on every data replica) and once with ``zero=True`` (gradients
+reduce-scattered onto the data axis, the optax update applied to the
+local 1/N shard, params all-gathered) — measuring:
+
+- steady-state ms/step via ``multi_step`` (K steps per dispatched
+  program, the same protocol as bench.py's train legs), after a
+  parity check that the two trainers' losses agree;
+- ``planned_opt_bytes_per_chip`` from ``parallel.memory.training_memory``
+  for both placements — the acceptance invariant
+  ``zero_opt <= replicated_opt / data_axis + const`` is asserted here;
+- on TPU (non-smoke): the batch sweep ONE BUCKET past the vgg16/llama
+  train legs' plateau (vgg16 to 4096, llama to 128), with MFU per
+  point — the freed optimizer HBM is exactly what capped the r05 sweep.
+
+Every number exports as a ``zero_*`` gauge into the active obs session,
+so the rows land in ``report.json`` and ride ``obs diff --gate``
+(dynamic scalar family, like ``kernel_*``); CI drives this module on an
+8-virtual-device CPU and gates against
+``results/obs_report_golden_zero_cpu.json``.
+
+Run: ``python -m torchpruner_tpu.experiments.zero_bench [--smoke]
+[--cpu] [--devices N] [--obs-dir DIR] [--out PATH]``.  ``--devices N``
+forces N virtual host devices (CPU only; must be set before the backend
+initializes, which is why this module never imports jax at module
+scope).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+#: acceptance slack for the opt-bytes invariant: replicated non-param
+#: leaves (step counters) plus per-leaf ceil-division padding
+OPT_BYTES_SLACK = 1 << 16
+
+
+def _make_mesh():
+    import jax
+
+    from torchpruner_tpu.parallel import make_mesh
+
+    n = jax.device_count()
+    if n < 2:
+        raise RuntimeError(
+            f"zero_bench needs >= 2 devices for a data axis (have {n}); "
+            "on CPU pass --devices 8"
+        )
+    model_ax = 2 if n >= 4 and n % 2 == 0 else 1
+    return make_mesh({"data": n // model_ax, "model": model_ax})
+
+
+def _measure_pair(name, model_fn, batch, loss_fn, make_batch, mesh,
+                  smoke: bool, out: dict):
+    """One model's zero-vs-replicated A/B; mutates ``out[name]`` and
+    exports the ``zero_<name>_*`` gauges."""
+    import jax
+    import numpy as np
+    import optax
+
+    from torchpruner_tpu import obs
+    from torchpruner_tpu.parallel import ShardedTrainer, training_memory
+    from torchpruner_tpu.utils.profiling import (
+        steady_s,
+        time_train_multi_step,
+    )
+
+    data_ax = int(dict(mesh.shape).get("data", 1))
+    K = 2 if smoke else 4
+    iters = 2 if smoke else 4
+    x, y = make_batch(batch)
+    xs = jax.numpy.stack([x] * K)
+    ys = jax.numpy.stack([y] * K)
+
+    trainers = {}
+    for zero in (False, True):
+        trainers[zero] = ShardedTrainer.create(
+            model_fn(), optax.adam(1e-3), loss_fn, mesh, seed=0,
+            zero=zero, compute_dtype=jax.numpy.bfloat16,
+        )
+    # parity before timing (doubles as warmup): the two placements must
+    # walk the same trajectory at bf16/reduction-order tolerance
+    for _ in range(2):
+        l_rep = float(trainers[False].step(x, y))
+        l_zero = float(trainers[True].step(x, y))
+        np.testing.assert_allclose(l_rep, l_zero, rtol=1e-4, atol=1e-5)
+
+    row = {"batch": batch, "parity_loss": round(l_rep, 5)}
+    for zero in (False, True):
+        stats = time_train_multi_step(trainers[zero], xs, ys, iters=iters,
+                                      warmup=1, chained=True)
+        key = "ms" if zero else "rep_ms"
+        row[key] = round(steady_s(stats) / K * 1e3, 3)
+        row[("compile_s" if zero else "rep_compile_s")] = round(
+            stats["compile_s"], 2)
+        budget = training_memory(
+            trainers[zero].model, trainers[zero]._placements[0],
+            dict(mesh.shape), tx=trainers[zero].tx,
+            compute_dtype=jax.numpy.bfloat16,
+            params=trainers[zero].params, zero=zero,
+        )
+        row["opt_mb" if zero else "rep_opt_mb"] = round(
+            budget.opt_bytes / 2**20, 3)
+        row["opt_bytes" if zero else "rep_opt_bytes"] = budget.opt_bytes
+    row["step_speedup"] = round(row["rep_ms"] / row["ms"], 3) \
+        if row["ms"] else None
+    row["opt_ratio"] = round(row["opt_bytes"] / row["rep_opt_bytes"], 4) \
+        if row["rep_opt_bytes"] else None
+    # the acceptance invariant: ZeRO's persistent opt state is at most
+    # the replicated bytes / data-axis size, plus replicated scalars
+    assert row["opt_bytes"] <= row["rep_opt_bytes"] / data_ax \
+        + OPT_BYTES_SLACK, (row, data_ax)
+    out[name] = row
+    for key in ("ms", "rep_ms", "step_speedup", "opt_mb", "rep_opt_mb",
+                "opt_ratio"):
+        if row.get(key) is not None:
+            obs.gauge_set(f"zero_{name}_{key}", float(row[key]),
+                          help="zero_bench zero-vs-replicated A/B")
+    return trainers[True]
+
+
+def _batch_sweep(name, trainer, make_batch, batches, K, out: dict):
+    """Widened batch sweep on the ZeRO trainer (TPU full runs): ms/step,
+    throughput and MFU per batch; an OOM records an error cell and ends
+    the sweep (larger batches would only fail harder)."""
+    import jax
+
+    from torchpruner_tpu.utils.flops import model_cost, peak_bf16_flops
+    from torchpruner_tpu.utils.profiling import (
+        steady_s,
+        time_train_multi_step,
+    )
+
+    peak = peak_bf16_flops(jax.devices()[0])
+    sweep = {}
+    for b in batches:
+        try:
+            x, y = make_batch(b)
+            xs = jax.numpy.stack([x] * K)
+            ys = jax.numpy.stack([y] * K)
+            stats = time_train_multi_step(trainer, xs, ys, iters=3,
+                                          warmup=1, chained=True)
+            step_s = steady_s(stats) / K
+            cell = {"ms": round(step_s * 1e3, 3),
+                    "ex_per_s_per_chip": round(b / step_s, 1)}
+            _, fwd = model_cost(trainer.model, trainer.params,
+                               trainer.state, batch_size=b)
+            if fwd and peak:
+                cell["mfu"] = round((3.0 * fwd / step_s) / peak, 4)
+            sweep[str(b)] = cell
+        except Exception as e:  # noqa: BLE001 - OOM ends the sweep
+            sweep[str(b)] = {"error": f"{type(e).__name__}: {e}"[:200]}
+            break
+    out[name]["batch_sweep"] = sweep
+    best = max((v["mfu"] for v in sweep.values() if v.get("mfu")),
+               default=None)
+    if best is not None:
+        out[name]["best_mfu"] = best
+        from torchpruner_tpu import obs
+
+        obs.gauge_set(f"zero_{name}_best_mfu", best,
+                      help="best MFU over the widened zero batch sweep")
+
+
+def run(smoke: bool = False, obs_dir: str | None = None) -> dict:
+    import jax
+    import numpy as np
+
+    from torchpruner_tpu import obs
+    from torchpruner_tpu.models import llama_tiny, mfu_llama, vgg16_bn
+    from torchpruner_tpu.utils.losses import (
+        cross_entropy_loss,
+        lm_cross_entropy_loss,
+    )
+
+    session = obs.configure(obs_dir) if obs_dir else None
+    try:
+        with obs.span("zero_bench"):
+            mesh = _make_mesh()
+            data_ax = int(dict(mesh.shape).get("data", 1))
+            on_tpu = jax.devices()[0].platform == "tpu"
+            out = {
+                "smoke": smoke,
+                "platform": jax.devices()[0].platform,
+                "devices": jax.device_count(),
+                "mesh": dict(mesh.shape),
+            }
+            obs.gauge_set("zero_data_axis", float(data_ax),
+                          help="data-axis size of the zero_bench mesh")
+            rng = np.random.default_rng(0)
+
+            if smoke:
+                vgg_fn = lambda: vgg16_bn(width_multiplier=0.125,  # noqa: E731
+                                          classifier_width=64)
+                vgg_batch = 2 * data_ax
+                llama_fn, llama_batch = llama_tiny, 2 * data_ax
+            else:
+                vgg_fn, vgg_batch = vgg16_bn, 256
+                llama_fn, llama_batch = mfu_llama, 8
+
+            def img_batch(b):
+                return (
+                    jax.numpy.asarray(
+                        rng.normal(size=(b, 32, 32, 3)).astype("float32")),
+                    jax.numpy.asarray(
+                        rng.integers(0, 10, size=(b,)).astype("int32")),
+                )
+
+            S = llama_fn().input_shape[0]
+
+            def tok_batch(b):
+                t = jax.numpy.asarray(
+                    rng.integers(0, 255, size=(b, S)).astype("int32"))
+                return t, t
+
+            t_vgg = _measure_pair("vgg", vgg_fn, vgg_batch,
+                                  cross_entropy_loss, img_batch, mesh,
+                                  smoke, out)
+            t_llama = _measure_pair("llama", llama_fn, llama_batch,
+                                    lm_cross_entropy_loss, tok_batch, mesh,
+                                    smoke, out)
+            if on_tpu and not smoke:
+                # the point of the freed HBM: one bucket past the r05
+                # plateau (vgg16 swept 512-2048, mfu_llama 16-64)
+                _batch_sweep("vgg", t_vgg, img_batch, (1024, 2048, 4096),
+                             K=4, out=out)
+                _batch_sweep("llama", t_llama, tok_batch, (32, 64, 128),
+                             K=4, out=out)
+    finally:
+        if session is not None:
+            session.close()
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--devices", type=int, default=0,
+                    help="force N virtual host devices (CPU)")
+    ap.add_argument("--obs-dir", default="")
+    ap.add_argument("--out", default="", help="also write the result JSON here")
+    args = ap.parse_args(argv)
+    if args.devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{args.devices}").strip()
+    if args.cpu or args.devices:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    out = run(smoke=args.smoke, obs_dir=args.obs_dir or None)
+    blob = json.dumps(out, indent=1)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(blob)
+    print(blob)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -82,8 +82,10 @@ _SCALARS = {
 
 #: dynamic scalar families: any metric matching one of these prefixes
 #: participates in diff/gating even though its exact name depends on
-#: the run (per-kernel scalars are named after the compiled ops)
-_DYNAMIC_SCALAR_PREFIXES = ("kernel_", "serve_slo_breach")
+#: the run (per-kernel scalars are named after the compiled ops;
+#: ``zero_*`` are the ZeRO weight-update-sharding A/B gauges from
+#: experiments.zero_bench / the bench ``zero`` leg)
+_DYNAMIC_SCALAR_PREFIXES = ("kernel_", "serve_slo_breach", "zero_")
 _DYNAMIC_EXTRA = ("profile_coverage", "profile_windows_total",
                   "profile_steps_total")
 

@@ -25,6 +25,8 @@ from torchpruner_tpu.parallel.sharding import (
     shard_params,
     tp_sharding,
     tp_specs,
+    zero_update_sharding,
+    zero_update_spec,
 )
 from torchpruner_tpu.parallel.memory import (
     HBM_BYTES,
@@ -61,6 +63,8 @@ __all__ = [
     "shard_params",
     "tp_sharding",
     "tp_specs",
+    "zero_update_sharding",
+    "zero_update_spec",
     "DistributedScorer",
     "HBM_BYTES",
     "MemoryBudget",

@@ -97,6 +97,8 @@ def training_memory(
     remat: bool = False,
     seed: int = 0,
     params=None,
+    zero: bool = False,
+    data_axis: str = "data",
 ) -> MemoryBudget:
     """Per-chip byte budget for training ``model`` under ``shardings``.
 
@@ -106,6 +108,15 @@ def training_memory(
     Gradients mirror the parameter shardings; optimizer slots are counted
     from ``jax.eval_shape(tx.init, params)`` with param-shaped leaves
     sharded like their param.
+
+    ``zero=True`` counts optimizer slots at their ZeRO weight-update
+    placement instead (``ShardedTrainer(zero=True)``): each param-shaped
+    slot's spec gains the ``data_axis`` per
+    ``parallel.sharding.zero_update_spec`` — the same rule the trainer
+    places real state with, so ``opt_bytes`` drops by ~the data-axis
+    size.  Params/grads are unchanged: ZeRO-1 keeps params at their
+    model-axis placement between steps (the gradient reduce-scatter and
+    param all-gather are transient, inside the step).
 
     ``params`` (concrete or ShapeDtypeStruct tree) overrides the
     re-initialized tree — required for pruned models, whose surgered
@@ -174,6 +185,13 @@ def training_memory(
                     leaf.dtype
                 ).itemsize
             else:
+                if zero:
+                    from torchpruner_tpu.parallel.sharding import (
+                        zero_update_spec,
+                    )
+
+                    spec = zero_update_spec(leaf.shape, spec, mesh_shape,
+                                            data_axis)
                 opt_bytes += _sharded_bytes(
                     leaf.shape, leaf.dtype, spec, mesh_shape
                 )

@@ -77,6 +77,72 @@ def fsdp_sharding(tree, mesh: Mesh, axis="model",
     )
 
 
+def zero_update_spec(shape, spec, mesh_shape: Dict[str, int],
+                     data_axis: str = "data"):
+    """PartitionSpec for one array's ZeRO weight-update shard: ``spec``
+    (the param's model-axis placement) with ``data_axis`` added on a
+    divisible dim — the domain in which gradients are reduce-scattered,
+    the optimizer state lives, and the 1/N update applies ("Automatic
+    Cross-Replica Sharding of Weight Update in Data-Parallel Training").
+
+    Placement rule: prefer the largest dim the param placement left
+    unsharded; otherwise extend an already-sharded dim to a
+    ``(model_axes..., data)`` tuple when the compound size still
+    divides.  Every param-shaped leaf is eligible regardless of size
+    (ZeRO shards the whole update — opt-state HBM is the point, and the
+    per-leaf collectives ride the step's existing reduce); a leaf none
+    of whose dims divide keeps ``spec`` (replicated update, exactly
+    today's behavior).  Scalars keep ``spec`` too."""
+    n = int(mesh_shape.get(data_axis, 1))
+    if n <= 1 or not shape:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    used = set()
+    for e in entries:
+        for a in (e if isinstance(e, tuple) else (e,)):
+            if a is not None:
+                used.add(a)
+    if data_axis in used:  # already data-sharded (full-mesh tuple FSDP)
+        return spec
+    order = sorted(range(len(shape)), key=lambda d: -shape[d])
+    for d in order:
+        if entries[d] is None and shape[d] % n == 0:
+            entries[d] = data_axis
+            return P(*entries)
+    for d in order:
+        e = entries[d]
+        if e is None:
+            continue
+        ax = e if isinstance(e, tuple) else (e,)
+        k = n
+        for a in ax:
+            k *= int(mesh_shape.get(a, 1))
+        if shape[d] % k == 0:
+            entries[d] = tuple(ax) + (data_axis,)
+            return P(*entries)
+    return spec
+
+
+def zero_update_sharding(tree, shardings, mesh: Mesh,
+                         data_axis: str = "data"):
+    """Param-shaped ``NamedSharding`` tree for the ZeRO update domain:
+    each leaf's param placement from ``shardings`` with the data axis
+    added per :func:`zero_update_spec`.  Used three ways by the trainer:
+    as the ``with_sharding_constraint`` target that turns the gradient
+    all-reduce into a reduce-scatter, as the optimizer-state placement
+    (via ``optax.tree_map_params``), and as the sharding the update's
+    output holds before the param all-gather."""
+    mesh_shape = dict(mesh.shape)
+
+    def one(leaf, sh):
+        return NamedSharding(
+            mesh,
+            zero_update_spec(np.shape(leaf), sh.spec, mesh_shape, data_axis),
+        )
+
+    return jax.tree_util.tree_map(one, tree, shardings)
+
+
 def shard_params(tree, mesh: Mesh, axis: str = "model",
                  min_size: int = 2**14):
     """Place a params-like pytree on the mesh under the FSDP rule.

@@ -34,6 +34,7 @@ from torchpruner_tpu.parallel.sharding import (
     replicate,
     shard_batch,
     tp_sharding,
+    zero_update_sharding,
 )
 
 
@@ -52,6 +53,7 @@ def make_sharded_train_step(
     moe_aux_weight: float = 0.0,
     grad_norm: bool = False,
     guard: bool = False,
+    zero_shardings=None,
 ):
     """Compile the SPMD train step with explicit in/out shardings.
     Mixed precision / remat / gradient accumulation come from the shared
@@ -62,7 +64,13 @@ def make_sharded_train_step(
     cross-shard reduction; the ``rep`` out-sharding prefix covers both).
     ``guard`` compiles the non-finite skip guard into the SPMD program
     (the ``ok`` decision is a replicated scalar, so every shard skips or
-    applies the update identically — mesh-consistent by construction)."""
+    applies the update identically — mesh-consistent by construction).
+
+    ``zero_shardings`` (``ShardedTrainer(zero=True)``) is the param-shaped
+    update-domain placement: the step body reduce-scatters gradients onto
+    the data axis, updates the local 1/N shard, and all-gathers fresh
+    params — with ``opt_shardings`` expected to already carry the same
+    data-sharded placement so optimizer state persists at 1/N per chip."""
     from torchpruner_tpu.train.loop import make_loss_closure, make_step_body
 
     loss_c = make_loss_closure(model, loss_fn, compute_dtype, remat,
@@ -71,10 +79,69 @@ def make_sharded_train_step(
     rep = replicate(mesh)
 
     return jax.jit(
-        make_step_body(loss_c, tx, accum_steps, grad_norm, guard),
+        make_step_body(loss_c, tx, accum_steps, grad_norm, guard,
+                       zero_shardings=zero_shardings,
+                       gather_shardings=param_shardings),
         in_shardings=(param_shardings, state_shardings, opt_shardings,
                       bs, bs, rep),
         out_shardings=(param_shardings, state_shardings, opt_shardings, rep),
+        donate_argnums=(0, 2),
+    )
+
+
+def make_sharded_multi_step(
+    model: SegmentedModel,
+    tx,
+    loss_fn,
+    mesh: Mesh,
+    param_shardings,
+    state_shardings,
+    opt_shardings,
+    data_axis: str = "data",
+    compute_dtype=None,
+    remat: bool = False,
+    accum_steps: int = 1,
+    moe_aux_weight: float = 0.0,
+    zero_shardings=None,
+):
+    """``(params, state, opt_state, xs, ys, rng) -> (params, state,
+    opt_state, rng', losses)`` — K full optimizer steps in ONE compiled
+    SPMD program over stacked batches ``xs`` of shape ``(K, B, ...)``
+    (each scanned batch keeps its example dim sharded on ``data_axis``).
+    The SPMD twin of :func:`torchpruner_tpu.train.loop.make_multi_step`,
+    with the same 1/K dispatch amortization; the inner body is the shared
+    step body, so ZeRO update sharding (``zero_shardings``) composes —
+    each scanned step carries its own reduce-scatter → sharded update →
+    all-gather sequence."""
+    from torchpruner_tpu.train.loop import make_loss_closure, make_step_body
+
+    loss_c = make_loss_closure(model, loss_fn, compute_dtype, remat,
+                               moe_aux_weight)
+    step = make_step_body(loss_c, tx, accum_steps,
+                          zero_shardings=zero_shardings,
+                          gather_shardings=param_shardings)
+    rep = replicate(mesh)
+    bs2 = NamedSharding(mesh, P(None, data_axis))  # (K, B, ...) stacks
+
+    def multi(params, state, opt_state, xs, ys, rng):
+        def body(carry, inp):
+            p, st, o, r = carry
+            xb, yb = inp
+            r, sub = jax.random.split(r)
+            p, st, o, l = step(p, st, o, xb, yb, sub)
+            return (p, st, o, r), l
+
+        (params, state, opt_state, rng), losses = jax.lax.scan(
+            body, (params, state, opt_state, rng), (xs, ys)
+        )
+        return params, state, opt_state, rng, losses
+
+    return jax.jit(
+        multi,
+        in_shardings=(param_shardings, state_shardings, opt_shardings,
+                      bs2, bs2, rep),
+        out_shardings=(param_shardings, state_shardings, opt_shardings,
+                       rep, rep),
         donate_argnums=(0, 2),
     )
 
@@ -97,6 +164,14 @@ class ShardedTrainer:
     #: "fsdp" = shard each large param's largest axis; "tp" = pruning-graph
     #: tensor parallelism (column/row-parallel pairs) with FSDP fallback
     partition: str = "fsdp"
+    #: ZeRO-style cross-replica weight-update sharding: optimizer state
+    #: (every param-shaped slot whose shape divides) lives sharded over
+    #: the DATA axis on top of the partition's model-axis spec, gradients
+    #: reduce-scatter instead of all-reduce, the update applies to the
+    #: local 1/N shard, and fresh params all-gather for the next forward.
+    #: Composes with both partitions, accum_steps, guard, and multi_step;
+    #: frees ~(1 - 1/data_axis) of optimizer HBM per chip.
+    zero: bool = False
     #: None = f32; jnp.bfloat16 = mixed precision (f32 masters)
     compute_dtype: Any = None
     #: checkpoint composite blocks (recompute-in-backward)
@@ -111,6 +186,9 @@ class ShardedTrainer:
     #: into the SPMD step; see ``train.loop.Trainer.guard``
     guard: Any = None
     _step_fn: Any = field(default=None, repr=False)
+    _multi_fn: Any = field(default=None, repr=False)
+    #: placement tuple from the last _place(), for multi_step compilation
+    _placements: Any = field(default=None, repr=False)
     #: previous step's end timestamp — see train.loop.Trainer._t_stream
     #: (telemetry records return-to-return intervals within a streak)
     _t_stream: Any = field(default=None, repr=False)
@@ -128,21 +206,33 @@ class ShardedTrainer:
         model_axis: str = "model",
         min_shard_size: int = 2**14,
         partition: str = "fsdp",
+        zero: bool = False,
         compute_dtype=None,
         remat: bool = False,
         accum_steps: int = 1,
         moe_aux_weight: float = 0.0,
         grad_norm: bool = False,
         guard: Any = None,
+        params: Any = None,
+        state: Any = None,
+        opt_state: Any = None,
     ) -> "ShardedTrainer":
+        """``params``/``state``/``opt_state`` adopt restored host trees
+        directly (placed once, at their actual shapes) instead of
+        re-initializing — required for pruned/surgered models, whose
+        trees cannot round-trip through ``model.init``."""
         key = jax.random.PRNGKey(seed)
-        params, state = model.init(key)
-        opt_state = tx.init(params)
+        if params is None:
+            params, state = model.init(key)
+        elif state is None:
+            state = {}
+        if opt_state is None:
+            opt_state = tx.init(params)
         t = cls(
             model=model, params=params, state=state, tx=tx,
             opt_state=opt_state, loss_fn=loss_fn, rng=key, mesh=mesh,
             data_axis=data_axis, model_axis=model_axis,
-            min_shard_size=min_shard_size, partition=partition,
+            min_shard_size=min_shard_size, partition=partition, zero=zero,
             compute_dtype=compute_dtype, remat=remat,
             accum_steps=accum_steps, moe_aux_weight=moe_aux_weight,
             grad_norm=grad_norm, guard=guard,
@@ -153,6 +243,10 @@ class ShardedTrainer:
     # -- placement ---------------------------------------------------------
 
     def _shardings(self):
+        """``(param, state, opt, zero)`` sharding trees.  ``zero`` is the
+        param-shaped update-domain tree (param spec + data axis) or None;
+        when set, param-shaped optimizer slots take IT as their placement
+        — the persistent 1/N-per-chip opt state ZeRO is for."""
         if self.partition not in ("fsdp", "tp"):
             raise ValueError(
                 f"unknown partition {self.partition!r} (use 'fsdp' or 'tp')"
@@ -164,30 +258,38 @@ class ShardedTrainer:
             ps = fsdp_sharding(self.params, self.mesh, self.model_axis,
                                self.min_shard_size)
         ss = jax.tree_util.tree_map(lambda _: replicate(self.mesh), self.state)
+        zs = None
+        if self.zero and self.mesh.shape.get(self.data_axis, 1) > 1:
+            zs = zero_update_sharding(self.params, ps, self.mesh,
+                                      self.data_axis)
         # param-shaped optimizer-state leaves (momentum, Adam m/v) shard with
-        # their param; non-param leaves (step counts) replicate
+        # their param — or with the ZeRO update domain when zero=True; non-
+        # param leaves (step counts) replicate
         os_ = optax.tree_map_params(
             self.tx,
             lambda _leaf, spec: spec,
             self.opt_state,
-            ps,
+            zs if zs is not None else ps,
             transform_non_params=lambda _leaf: replicate(self.mesh),
         )
-        return ps, ss, os_
+        return ps, ss, os_, zs
 
     def _place(self):
-        with obs.span("shard", partition=self.partition):
-            ps, ss, os_ = self._shardings()
+        with obs.span("shard", partition=self.partition, zero=self.zero):
+            ps, ss, os_, zs = self._shardings()
             self.params = jax.device_put(self.params, ps)
             self.state = jax.device_put(self.state, ss)
             self.opt_state = jax.device_put(self.opt_state, os_)
+            self._placements = (ps, ss, os_, zs)
             self._step_fn = make_sharded_train_step(
                 self.model, self.tx, self.loss_fn, self.mesh, ps, ss, os_,
                 self.data_axis, compute_dtype=self.compute_dtype,
                 remat=self.remat, accum_steps=self.accum_steps,
                 moe_aux_weight=self.moe_aux_weight,
                 grad_norm=self.grad_norm, guard=self.guard is not None,
+                zero_shardings=zs,
             )
+            self._multi_fn = None  # compiled lazily at the stacked shape
             self._record_memory_budget(ps)
 
     def _record_memory_budget(self, param_shardings):
@@ -206,12 +308,24 @@ class ShardedTrainer:
                 self.model, param_shardings, dict(self.mesh.shape),
                 tx=self.tx, compute_dtype=self.compute_dtype,
                 remat=self.remat, params=self.params,
+                zero=self.zero, data_axis=self.data_axis,
             )
             g = session.metrics.gauge
             g("planned_params_bytes_per_chip").set(budget.params_bytes)
             g("planned_grads_bytes_per_chip").set(budget.grads_bytes)
             g("planned_opt_bytes_per_chip").set(budget.opt_bytes)
             g("planned_total_bytes_per_chip").set(budget.total_bytes)
+            if self.zero:
+                # the counterfactual replicated-update budget next to the
+                # ZeRO one, so the freed opt HBM is a first-class gauge
+                rep = training_memory(
+                    self.model, param_shardings, dict(self.mesh.shape),
+                    tx=self.tx, compute_dtype=self.compute_dtype,
+                    remat=self.remat, params=self.params,
+                )
+                g("planned_opt_replicated_bytes_per_chip").set(rep.opt_bytes)
+                g("zero_opt_bytes_freed_per_chip").set(
+                    max(0, rep.opt_bytes - budget.opt_bytes))
             record_device_memory(session.metrics)
         except Exception:
             pass
@@ -250,16 +364,55 @@ class ShardedTrainer:
         self._t_stream = now
         return l
 
+    def multi_step(self, xs, ys):
+        """K full optimizer steps in ONE dispatched SPMD program over
+        stacked batches ``xs`` of shape (K, B, ...) — the distributed
+        twin of ``Trainer.multi_step`` (1/K dispatch amortization).
+        Each scanned batch shards its example dim over the data axis;
+        ZeRO update sharding rides along when ``zero=True``.  Returns the
+        (K,) per-step losses; identical results to K :meth:`step` calls
+        on the same data (modulo guard/grad_norm, which multi_step does
+        not thread — use :meth:`step` for guarded runs)."""
+        if self._multi_fn is None:
+            ps, ss, os_, zs = self._placements
+            self._multi_fn = make_sharded_multi_step(
+                self.model, self.tx, self.loss_fn, self.mesh, ps, ss, os_,
+                self.data_axis, compute_dtype=self.compute_dtype,
+                remat=self.remat, accum_steps=self.accum_steps,
+                moe_aux_weight=self.moe_aux_weight, zero_shardings=zs,
+            )
+        xs, ys = jnp.asarray(xs), jnp.asarray(ys)
+        sh = NamedSharding(self.mesh, P(None, self.data_axis))
+        xs, ys = jax.device_put(xs, sh), jax.device_put(ys, sh)
+        (self.params, self.state, self.opt_state, self.rng,
+         losses) = self._multi_fn(
+            self.params, self.state, self.opt_state, xs, ys, self.rng
+        )
+        k = int(xs.shape[0])
+        self.step_count += k
+        now = time.perf_counter()
+        if self._t_stream is not None:  # see step(): first of a streak
+            yshape = getattr(ys, "shape", ())
+            tok = int(yshape[0] * yshape[1] * yshape[2]) \
+                if len(yshape) >= 3 else None
+            obs.record_step(now - self._t_stream, int(xs.shape[1]) * k,
+                            tok, steps=k)
+        self._t_stream = now
+        return losses
+
     def rebuild(self, model, params, state, opt_state) -> "ShardedTrainer":
         """Adopt pruned (smaller) pytrees: re-shard over the same mesh,
-        recompile the step."""
+        recompile the step.  ``zero=True`` carries through: the SMALLER
+        optimizer state re-shards over the data axis (leaves whose pruned
+        dims stopped dividing fall back per ``zero_update_spec``)."""
         t = ShardedTrainer(
             model=model, params=params,
             state=state if state is not None else {},
             tx=self.tx, opt_state=opt_state, loss_fn=self.loss_fn,
             rng=self.rng, mesh=self.mesh, data_axis=self.data_axis,
             model_axis=self.model_axis, min_shard_size=self.min_shard_size,
-            partition=self.partition, compute_dtype=self.compute_dtype,
+            partition=self.partition, zero=self.zero,
+            compute_dtype=self.compute_dtype,
             remat=self.remat, accum_steps=self.accum_steps,
             moe_aux_weight=self.moe_aux_weight, grad_norm=self.grad_norm,
             guard=self.guard, step_count=self.step_count,
@@ -270,10 +423,14 @@ class ShardedTrainer:
     def evaluate(self, data):
         """Evaluation with every batch sharded over the data axis (XLA
         all-reduces the loss/count sums).  A batch that doesn't divide the
-        axis is PADDED to the next multiple (repeating its last example)
-        and evaluated under a validity mask, so the ragged final batch of
-        a dataset keeps all devices busy instead of silently replicating —
-        while still counting exactly the real examples."""
+        axis is PADDED to the next multiple with ZEROS and evaluated under
+        a validity mask, so the ragged final batch of a dataset keeps all
+        devices busy instead of silently replicating — while still
+        counting exactly the real examples.  Zeros, not a repeat of the
+        last example: the mask multiplication cannot scrub a non-finite
+        padded row (``inf * 0 = nan``), so a NaN/Inf-poisoned final
+        example (chaos runs) must never be replicated into the padding —
+        it should count exactly once, like on a single device."""
         from torchpruner_tpu.train.loop import make_masked_eval_step
 
         self._t_stream = None  # eval wall time is not step time
@@ -292,8 +449,10 @@ class ShardedTrainer:
             b = x.shape[0]
             pad = (-b) % n
             if pad:
-                x = jnp.concatenate([x, jnp.repeat(x[-1:], pad, axis=0)])
-                y = jnp.concatenate([y, jnp.repeat(y[-1:], pad, axis=0)])
+                x = jnp.concatenate(
+                    [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)])
+                y = jnp.concatenate(
+                    [y, jnp.zeros((pad,) + y.shape[1:], y.dtype)])
             valid = jnp.arange(b + pad) < b
             x, y, valid = shard_batch((x, y, valid), self.mesh,
                                       self.data_axis)

@@ -222,7 +222,7 @@ def run_resilient_train(cfg, *, model=None, datasets=None,
     )
     from torchpruner_tpu.experiments.train_model import epoch_batches
     from torchpruner_tpu.train.logger import CSVLogger
-    from torchpruner_tpu.train.loop import Trainer
+    from torchpruner_tpu.train.loop import trainer_from_config
 
     if cfg.chaos:
         chaos.configure(cfg.chaos)
@@ -235,28 +235,38 @@ def run_resilient_train(cfg, *, model=None, datasets=None,
     model, (train, _val, test) = resolve_model_and_data(cfg, model, datasets)
     spe = max(1, len(train) // cfg.batch_size)
     loss_fn = LOSS_REGISTRY[cfg.loss]
-    cdtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else None
     accum = manifest.accum_steps or cfg.accum_steps
     guard = StepGuard(cfg.max_bad_steps) if cfg.guard_nonfinite else None
+    mesh = None
+    data_size = 1
+    if cfg.mesh:
+        # SPMD resilient training: the same manifest/commit protocol over
+        # a ShardedTrainer (FSDP/TP placement, optional ZeRO update
+        # sharding) — checkpoints gather to host on save, and restore
+        # re-places every tree (opt state included, at the ZeRO
+        # placement when cfg.zero) through rebuild()
+        from torchpruner_tpu.parallel import make_mesh
 
-    def build_trainer(params=None, state=None) -> Trainer:
-        t = Trainer.create(
-            model, scaled_optimizer(cfg, spe, manifest.lr_scale), loss_fn,
-            seed=cfg.seed, params=params, state=state,
-            compute_dtype=cdtype, remat=cfg.remat, accum_steps=accum,
-            moe_aux_weight=cfg.moe_aux_weight, grad_norm=cfg.obs_grad_norm,
-            guard=guard,
+        mesh = make_mesh(cfg.mesh)
+        data_size = int(dict(mesh.shape).get("data", 1))
+
+    def build_trainer(params=None, state=None, opt_state=None):
+        # restored trees are ADOPTED at their actual (possibly pruned)
+        # shapes — on the mesh path the opt state lands directly at its
+        # sharded placement (the ZeRO domain when cfg.zero)
+        return trainer_from_config(
+            cfg, model, scaled_optimizer(cfg, spe, manifest.lr_scale),
+            loss_fn, mesh=mesh, params=params, state=state,
+            opt_state=opt_state, accum_steps=accum,
+            grad_norm=cfg.obs_grad_norm, guard=guard,
         )
-        return t
 
-    def restore_trainer() -> Trainer:
+    def restore_trainer():
         nonlocal model
         tx = scaled_optimizer(cfg, spe, manifest.lr_scale)
         m2, p2, s2, o2, meta = restore_committed(run_dir, manifest, tx)
         model = m2
-        t = build_trainer(params=p2, state=s2)
-        if o2 is not None:
-            t.opt_state = o2
+        t = build_trainer(params=p2, state=s2, opt_state=o2)
         rng = meta.get("extra", {}).get("rng")
         if rng is not None:
             t.rng = rng_from_list(rng)
@@ -388,6 +398,18 @@ def run_resilient_train(cfg, *, model=None, datasets=None,
                                     help="tail batches dropped because "
                                          "they don't divide the degraded "
                                          "accum_steps")
+                                continue
+                            if data_size > 1 and x.shape[0] % data_size:
+                                # shard_batch requires the example dim to
+                                # divide the data axis; the epoch's ragged
+                                # tail can't — drop it, counted, cursor
+                                # still aligned with the stream
+                                cursor += 1
+                                obs.inc(
+                                    "resilience_mesh_ragged_drops_total",
+                                    help="tail batches dropped because "
+                                         "they don't divide the mesh's "
+                                         "data axis")
                                 continue
                             losses.append(trainer.step(x, y))
                             cursor += 1

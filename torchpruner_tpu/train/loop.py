@@ -113,7 +113,8 @@ def make_train_step(model: SegmentedModel, tx, loss_fn, donate: bool = True,
 
 
 def make_step_body(loss_c, tx, accum_steps: int = 1,
-                   grad_norm: bool = False, guard: bool = False):
+                   grad_norm: bool = False, guard: bool = False,
+                   zero_shardings=None, gather_shardings=None):
     """The un-jitted ``(params, state, opt_state, x, y, rng) -> (params,
     state, opt_state, loss)`` body shared by the local and SPMD trainers —
     callers add their own ``jit`` (with explicit shardings for SPMD).
@@ -126,11 +127,30 @@ def make_step_body(loss_c, tx, accum_steps: int = 1,
     leaves the training bundle bit-identical (true skip-and-count, no
     host round-trip in the decision).  The loss output grows a trailing
     ``bad`` flag (0./1.) the host-side ``resilience.StepGuard`` consumes:
-    ``(loss, bad)`` / ``(loss, gnorm, bad)`` with ``grad_norm``."""
+    ``(loss, bad)`` / ``(loss, gnorm, bad)`` with ``grad_norm``.
+
+    ``zero_shardings`` (a param-shaped ``NamedSharding`` tree, SPMD
+    callers only) compiles ZeRO-style cross-replica weight-update
+    sharding into the body: gradients and the params feeding the update
+    are pinned to the update domain (param spec + data axis), so XLA
+    lowers the gradient reduction as a reduce-scatter and the optax
+    update — f32 masters included under ``compute_dtype=bf16`` — runs on
+    the local 1/N shard against the data-sharded optimizer state; the
+    fresh params are then pinned back to ``gather_shardings`` (the plain
+    param placement), which lowers as the all-gather feeding the next
+    forward.  The guard's ``jnp.where`` gates in the sharded update
+    domain — skip-and-count costs no extra collective."""
 
     def _finish(l, grads, params, state, opt_state, new_state):
-        updates, new_opt = tx.update(grads, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
+        if zero_shardings is not None:
+            # reduce-scatter point: the update's inputs live data-sharded
+            grads = jax.lax.with_sharding_constraint(grads, zero_shardings)
+            params_u = jax.lax.with_sharding_constraint(
+                params, zero_shardings)
+        else:
+            params_u = params
+        updates, new_opt = tx.update(grads, opt_state, params_u)
+        new_params = optax.apply_updates(params_u, updates)
         gnorm = optax.global_norm(grads) if (grad_norm or guard) else None
         if guard:
             ok = jnp.isfinite(l) & jnp.isfinite(gnorm)
@@ -140,9 +160,14 @@ def make_step_body(loss_c, tx, accum_steps: int = 1,
                     lambda a, b: jnp.where(ok, a, b), new, old
                 )
 
-            new_params = pick(new_params, params)
+            new_params = pick(new_params, params_u)
             new_state = pick(new_state, state)
             new_opt = pick(new_opt, opt_state)
+        if zero_shardings is not None and gather_shardings is not None:
+            # all-gather point: fresh params return to the param placement
+            # for the next forward (also the step's out_sharding)
+            new_params = jax.lax.with_sharding_constraint(
+                new_params, gather_shardings)
         out = (l,)
         if grad_norm:
             out += (gnorm,)
@@ -498,3 +523,38 @@ class Trainer:
     def evaluate(self, data):
         self._t_stream = None  # eval wall time is not step time
         return evaluate(self.model, self.params, self.state, data, self.loss_fn)
+
+
+def trainer_from_config(cfg, model, tx, loss_fn, *, mesh=None,
+                        params=None, state=None, opt_state=None,
+                        accum_steps=None, grad_norm=False, guard=None):
+    """The ONE trainer factory the experiment drivers share: a
+    ``ShardedTrainer`` over ``mesh`` (FSDP/TP placement per
+    ``cfg.partition``, ZeRO weight-update sharding per ``cfg.zero``) when
+    a mesh is given, else the single-device ``Trainer``.  Restored
+    ``params``/``state``/``opt_state`` are ADOPTED at their actual shapes
+    — never re-initialized, so pruned/surgered checkpoints (whose trees
+    cannot round-trip through ``model.init``) resume on either path.
+    ``accum_steps`` overrides ``cfg.accum_steps`` (the resilient runner's
+    manifest carries an OOM-doubled value)."""
+    cdtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else None
+    accum = cfg.accum_steps if accum_steps is None else accum_steps
+    if mesh is not None:
+        from torchpruner_tpu.parallel import ShardedTrainer
+
+        return ShardedTrainer.create(
+            model, tx, loss_fn, mesh, seed=cfg.seed,
+            partition=cfg.partition, zero=cfg.zero,
+            compute_dtype=cdtype, remat=cfg.remat, accum_steps=accum,
+            moe_aux_weight=cfg.moe_aux_weight, grad_norm=grad_norm,
+            guard=guard, params=params, state=state, opt_state=opt_state,
+        )
+    t = Trainer.create(
+        model, tx, loss_fn, seed=cfg.seed, params=params, state=state,
+        compute_dtype=cdtype, remat=cfg.remat, accum_steps=accum,
+        moe_aux_weight=cfg.moe_aux_weight, grad_norm=grad_norm,
+        guard=guard,
+    )
+    if opt_state is not None:
+        t.opt_state = opt_state
+    return t

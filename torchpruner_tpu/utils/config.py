@@ -69,6 +69,13 @@ class ExperimentConfig:
     #: parameter partitioning over the mesh: "fsdp" or "tp" (pruning-
     #: graph-derived tensor parallelism); used when mesh is non-empty
     partition: str = "fsdp"
+    #: ZeRO-style cross-replica weight-update sharding (composes with
+    #: either partition): optimizer state lives sharded over the DATA
+    #: axis, gradients reduce-scatter, the update applies to the local
+    #: 1/N shard, params all-gather for the next forward — frees
+    #: ~(1 - 1/data) of optimizer HBM per chip for larger batches.
+    #: Requires a mesh with a "data" axis.  CLI: --zero
+    zero: bool = False
 
     #: float32 | bfloat16 — bf16 runs the fwd/bwd at MXU rate with f32
     #: master params/updates (mixed precision, the TPU-native default for
@@ -183,6 +190,11 @@ class ExperimentConfig:
         if self.partition not in ("fsdp", "tp"):
             raise ValueError(
                 f"unknown partition {self.partition!r} (use 'fsdp' or 'tp')"
+            )
+        if self.zero and "data" not in (self.mesh or {}):
+            raise ValueError(
+                "zero=True shards the weight update over the mesh's "
+                "'data' axis — set mesh={'data': N, ...} (N > 1) too"
             )
         for fld in ("compute_dtype", "score_dtype"):
             if getattr(self, fld) not in ("float32", "bfloat16"):
