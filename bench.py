@@ -579,6 +579,21 @@ def _leg_vgg_train(smoke: bool) -> dict:
         "f32": f32,
         **({"kernels": bf16["kernels"]} if "kernels" in bf16 else {}),
     }
+    try:
+        # static cost model (analysis/cost_model.py): the roofline
+        # prediction for this leg's bf16 step, printed next to the
+        # measurement so prediction drift is visible in every bench row
+        from torchpruner_tpu.analysis import cost_model
+
+        pred = cost_model.predict_train_step(
+            model, optax.sgd(0.05, momentum=0.9), cross_entropy_loss,
+            batch=batch, compute_dtype=jax.numpy.bfloat16)
+        if pred is not None:
+            out["predicted_step_ms"] = round(pred.step_ms, 3)
+            out["predicted_comm_ms"] = round(pred.comm_ms, 3)
+            out["predicted_bound"] = pred.bound
+    except Exception:
+        pass
     if not smoke and jax.devices()[0].platform == "tpu":
         # batch scaling: small 32x32 convs underfill the MXU at b256, so
         # sweep larger batches and surface the best-MFU configuration
@@ -980,6 +995,20 @@ def _leg_llama_decode(smoke: bool, progress=None) -> dict:
                   else "llama_tiny"),
         "shape": f"B{B} prompt{S} new{n_new}",
     }
+    result["measured_ms_per_step"] = round(1e3 * steady / n_new, 3)
+    try:
+        # static cost model: predicted decode-step time (one token for
+        # all B rows) next to the measured per-token step — drift between
+        # the two is the lint-grade honesty check PERF.md documents
+        from torchpruner_tpu.analysis import cost_model
+
+        pred = cost_model.predict_decode(model, n_slots=B,
+                                         max_len=S + n_new)
+        if pred is not None:
+            result["predicted_step_ms_decode"] = round(pred.step_ms, 3)
+            result["predicted_comm_ms_decode"] = round(pred.comm_ms, 3)
+    except Exception:
+        pass
     # one capture window over a dense decode: per-token kernel table
     # (steps = generated tokens, so ms_per_step reads as ms/token)
     with _kernel_window(result, steps=n_new):

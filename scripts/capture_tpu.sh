@@ -157,6 +157,34 @@ timeout 1800 python -m torchpruner_tpu.experiments.step_trace \
     --out "results/steptrace_mfullama_tpu_${stamp}_${commit}.json" \
     2> "logs/steptrace_llama_${stamp}.err" && echo "[capture] mfu_llama trace done"
 
+# 4b. STAGED ASSERTION (tpu-lint v2 cost model): on-chip the static
+#     roofline prediction must land within 30% of the measured step.
+#     Runs the smoke train under --obs-dir on the TPU (the driver
+#     records predicted_step_ms before the first step), then compares
+#     the report's prediction-vs-measured drift row.  Also runs the
+#     full collective-contract lint of the llama preset on the real
+#     devices with the compile budget raised (CPU skips programs this
+#     size; the chip does not).  A miss is loud but does not abort —
+#     PERF.md freezes prediction-derived claims until diagnosed.
+timeout 1800 python -m torchpruner_tpu --preset llama3_ffn_taylor --smoke \
+    --obs-dir "logs/lint_cost_tpu_${stamp}" 2> "logs/lint_cost_${stamp}.err" \
+    && python - "logs/lint_cost_tpu_${stamp}" <<'EOF' \
+    && echo "[capture] cost-model <30% on-chip HOLDS" \
+    || echo "[capture] cost-model >30% drift — recalibrate utils/flops.py peaks before citing predictions"
+import sys
+from torchpruner_tpu.obs.report import load_run, _scalars_of
+sc = _scalars_of(load_run(sys.argv[1]))
+drift = sc.get("predicted_vs_measured_step_pct")
+assert drift is not None, "no prediction recorded (budget? predict=0?)"
+print(f"predicted-vs-measured drift: {drift:+.1f}%")
+assert abs(drift) < 30, f"drift {drift:+.1f}% exceeds the 30% target"
+EOF
+TORCHPRUNER_LINT_COMPILE_BUDGET=1e10 timeout 3600 \
+    python -m torchpruner_tpu --lint llama3_ffn_taylor \
+    > "results/lint_tpu_${stamp}_${commit}.txt" 2>&1 \
+    && echo "[capture] on-chip collective lint clean" \
+    || echo "[capture] on-chip collective lint FOUND ERRORS — see results/lint_tpu_${stamp}_${commit}.txt"
+
 # 5. kernel-level profile leg (obs.profile): continuous capture windows
 #    over a short mfu_llama train run — the on-chip per-kernel table +
 #    roofline positions ROADMAP item 2's retune reads, plus a fresh

@@ -275,6 +275,18 @@ def main(argv=None) -> int:
 
 def _run_experiment(cfg, profile_ctx, run_ctx) -> None:
     with profile_ctx, run_ctx:
+        from torchpruner_tpu import obs as _obs
+
+        if _obs.get() is not None:
+            # static cost model (analysis/cost_model.py): predict this
+            # config's step/decode/capture programs up front so the
+            # run's report.json carries predicted_step_ms /
+            # predicted_comm_ms next to what gets measured (obs diff
+            # renders the drift).  Best-effort and param-budgeted;
+            # TORCHPRUNER_COST_PREDICT=0 opts out.
+            from torchpruner_tpu.analysis import cost_model
+
+            cost_model.record_config_predictions(cfg)
         if cfg.experiment == "robustness":
             from torchpruner_tpu.experiments.robustness import (
                 run_robustness_config,

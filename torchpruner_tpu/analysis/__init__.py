@@ -32,6 +32,25 @@ pre-flight inside ``apply_plan`` (raising
 a library (:func:`lint_config` / :func:`lint_preset`).  Findings are
 structured :class:`Finding` records with an error/warning/info split;
 per-check severities are re-gradeable through :data:`severity_config`.
+
+Passes 4 and 5 go one level deeper than abstract evaluation: they
+COMPILE the step programs the framework actually runs — over abstract
+``ShapeDtypeStruct`` trees, so still zero parameter bytes — and check
+the post-partitioning HLO itself.  The collective-contract pass
+(analysis/collective_lint.py) extracts every collective the SPMD
+partitioner emitted and verifies the communication structure the
+configured mode promises (``zero=True`` ⇒ reduce-scatter → sharded
+update → all-gather, never a replicated gradient all-reduce; FSDP ⇒
+model-axis gathers exist; TP decode ⇒ the KV cache is never
+reassembled), plus jaxpr-level deadlock hazards (cond-divergent
+collective sequences, collectives over undefined mesh axes).  The cost
+pass (analysis/cost_model.py) turns the same compiled programs into
+roofline step-time predictions — max(compute, HBM, ICI) from the
+executable's own FLOP/byte counts and the extracted wire bytes — that
+land as ``predicted_step_ms``/``predicted_comm_ms`` gauges in
+``report.json`` and flag comm-bound configs.  Both passes degrade to
+info findings (never a host-melting compile) via a param budget
+(``collective_lint.compile_budget``).
 """
 
 from torchpruner_tpu.analysis.findings import (
@@ -54,6 +73,19 @@ from torchpruner_tpu.analysis.sharding_lint import (
     lint_sharding,
     simulate_prune,
 )
+from torchpruner_tpu.analysis.collective_lint import (
+    build_programs,
+    hlo_collectives,
+    lint_collective_jaxpr,
+    lint_collectives,
+)
+from torchpruner_tpu.analysis.cost_model import (
+    cost_findings,
+    device_peaks,
+    predict_programs,
+    predict_record,
+    record_config_predictions,
+)
 from torchpruner_tpu.analysis.runner import lint_config, lint_preset
 
 __all__ = [
@@ -62,5 +94,9 @@ __all__ = [
     "lint_plan", "lint_group", "lint_model_plans", "abstract_trees",
     "lint_sharding", "simulate_prune", "abstract_mesh",
     "lint_jaxpr", "lint_step", "trace_step",
+    "hlo_collectives", "lint_collective_jaxpr", "lint_collectives",
+    "build_programs",
+    "predict_record", "predict_programs", "cost_findings",
+    "device_peaks", "record_config_predictions",
     "lint_config", "lint_preset",
 ]

@@ -33,9 +33,10 @@ SEVERITIES = ("error", "warning", "info", "ignore")
 class Finding:
     """One diagnosed hazard.
 
-    ``lint`` names the pass (``"plan"`` | ``"sharding"`` | ``"jaxpr"``),
-    ``check`` is the stable id severity overrides key on, ``path`` the
-    pytree path / layer path / jaxpr site the finding anchors to.
+    ``lint`` names the pass (``"plan"`` | ``"sharding"`` | ``"jaxpr"`` |
+    ``"collective"`` | ``"cost"``), ``check`` is the stable id severity
+    overrides key on, ``path`` the pytree path / layer path / jaxpr site
+    / program name the finding anchors to.
     """
 
     severity: str

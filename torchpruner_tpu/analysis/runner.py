@@ -7,14 +7,25 @@ and runs
    (analysis/plan_lint.py),
 2. **sharding lint** for configs with a mesh — the config's own
    mesh/partition/fraction/bucket, simulated over the config's filtered
-   targets (analysis/sharding_lint.py),
+   targets (analysis/sharding_lint.py); explicit ``plans`` are matched
+   back to their graph groups and linted under the same mesh,
 3. **jaxpr hazard lint** on the config's train step — its real
    loss/optimizer/compute_dtype/remat (analysis/jaxpr_lint.py),
+4. **collective-contract lint** on the COMPILED step programs — the
+   collectives the SPMD partitioner actually emits, checked against
+   what the config's mode (zero/fsdp/tp) promises
+   (analysis/collective_lint.py),
+5. **static cost model** — roofline compute/HBM/ICI step-time
+   prediction per compiled program, comm-bound configs flagged
+   (analysis/cost_model.py),
 
 merges the findings under the active severity config, and returns a
-:class:`~torchpruner_tpu.analysis.findings.LintReport`.  Everything is
-abstract evaluation: an 8B-param mesh preset lints on a laptop CPU in
-seconds, with zero bytes of parameters materialized.
+:class:`~torchpruner_tpu.analysis.findings.LintReport`.  Passes 1–3 are
+pure abstract evaluation (an 8B-param mesh preset lints on a laptop CPU
+in seconds, zero parameter bytes materialized); passes 4–5 compile the
+real programs over abstract avals, bounded by a param budget
+(``collective_lint.compile_budget``) so oversized programs degrade to an
+info finding instead of a minutes-long host compile.
 """
 
 from __future__ import annotations
@@ -22,7 +33,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from torchpruner_tpu.analysis.findings import LintReport, merge_reports
-from torchpruner_tpu.analysis.jaxpr_lint import lint_step
+from torchpruner_tpu.analysis.jaxpr_lint import lint_jaxpr, trace_step
 from torchpruner_tpu.analysis.plan_lint import (
     abstract_trees,
     lint_model_plans,
@@ -32,12 +43,32 @@ from torchpruner_tpu.analysis.sharding_lint import lint_sharding
 from torchpruner_tpu.utils.config import ExperimentConfig
 
 
+def _match_plan_targets(model, plans) -> tuple:
+    """``(matched_targets, unmatched_count)`` — each explicit plan
+    matched back to the graph group that produces it (PrunePlan equality
+    against ``plan_for_group``), so the sharding pass can simulate the
+    prune the plan actually describes."""
+    from torchpruner_tpu.core.graph import pruning_graph
+    from torchpruner_tpu.core.pruner import plan_for_group
+
+    by_plan = {}
+    for g in pruning_graph(model):
+        try:
+            by_plan[plan_for_group(model, g)] = g.target
+        except Exception:  # noqa: BLE001 — unmatchable group, skip
+            continue
+    matched = [by_plan[p] for p in plans if p in by_plan]
+    return matched, len(plans) - len(matched)
+
+
 def lint_config(
     cfg: ExperimentConfig,
     *,
     model=None,
     plans=None,
     jaxpr: bool = True,
+    collectives: bool = True,
+    cost: bool = True,
 ) -> LintReport:
     """Full tpu-lint run for one config.
 
@@ -45,11 +76,15 @@ def lint_config(
     :class:`~torchpruner_tpu.core.plan.PrunePlan` objects) are linted
     INSTEAD of the graph-derived groups when given — the entry point for
     validating hand-written or deserialized plans.  The sharding pass
-    simulates the CONFIG's sweep (its targets/fraction/bucket), so it is
-    skipped when explicit ``plans`` are given (its findings would
-    describe a different prune) and when the plan pass already found
-    errors (a broken plan cannot be meaningfully simulated).
-    ``jaxpr=False`` skips the (most expensive) trace pass.
+    simulates the CONFIG's sweep (its targets/fraction/bucket); with
+    explicit ``plans`` it simulates exactly the plans' own targets
+    (matched back to their graph groups) under the config mesh.  It is
+    skipped only when the plan pass already found errors (a broken plan
+    cannot be meaningfully simulated).  ``jaxpr=False`` skips every
+    abstract trace of the step — pass 3 AND the jaxpr-collective half
+    of pass 4 (with ``jaxpr=True`` they share ONE trace);
+    ``collectives=False`` / ``cost=False`` skip the compile-based
+    passes (4/5 — the only passes that invoke XLA).
     """
     from torchpruner_tpu.experiments.prune_retrain import (
         LOSS_REGISTRY,
@@ -73,25 +108,53 @@ def lint_config(
     else:
         findings += lint_model_plans(model)
 
-    # -- pass 2: sharding lint (mesh configs only; see docstring for the
-    # two skip conditions) ------------------------------------------------
+    # -- pass 2: sharding lint (mesh configs only; skipped when the plan
+    # pass already errored — a broken plan cannot be simulated) -----------
     plan_errors = any(f.severity == "error" for f in findings)
-    if cfg.mesh and plans is None and not plan_errors:
-        targets = filter_targets(
-            [g.target for g in pruning_graph(model)], cfg
-        )
+    if cfg.mesh and not plan_errors:
+        from torchpruner_tpu.analysis.findings import Finding
+
+        if plans is None:
+            targets = filter_targets(
+                [g.target for g in pruning_graph(model)], cfg
+            )
+        else:
+            targets, unmatched = _match_plan_targets(model, plans)
+            if unmatched:
+                findings.append(Finding(
+                    "info", "sharding", "sharding/plan-unmatched",
+                    "<plans>",
+                    f"{unmatched} explicit plan(s) match no current "
+                    f"graph group (stale serialization or a custom "
+                    f"surgery path) — the sharding pass simulates only "
+                    f"the {len(targets)} matched target(s)",
+                ))
         fraction = cfg.fraction if cfg.policy == "fraction" else 0.5
-        data = cfg.mesh.get("data", 1)
-        cdtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else None
-        findings += lint_sharding(
-            model, dict(cfg.mesh), partition=cfg.partition,
-            targets=targets, fraction=fraction, bucket=cfg.bucket,
-            tx=make_optimizer(cfg),
-            batch_per_chip=max(1, cfg.batch_size // max(1, data)),
-            compute_dtype=cdtype, remat=cfg.remat, zero=cfg.zero,
-        )
+        if cfg.policy != "fraction":
+            findings.append(Finding(
+                "info", "sharding", "sharding/fraction-stand-in",
+                "<policy>",
+                f"policy {cfg.policy!r} picks drop counts from scores "
+                f"at runtime, which abstract evaluation cannot know — "
+                f"the sharding pass simulates a fraction={fraction} "
+                f"stand-in prune; divisibility findings below describe "
+                f"THAT width, not necessarily the runtime one",
+            ))
+        if targets:
+            data = cfg.mesh.get("data", 1)
+            cdtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" \
+                else None
+            findings += lint_sharding(
+                model, dict(cfg.mesh), partition=cfg.partition,
+                targets=targets, fraction=fraction, bucket=cfg.bucket,
+                tx=make_optimizer(cfg),
+                batch_per_chip=max(1, cfg.batch_size // max(1, data)),
+                compute_dtype=cdtype, remat=cfg.remat, zero=cfg.zero,
+            )
 
     # -- pass 3: jaxpr hazards --------------------------------------------
+    closed_step = None  # pass 3's trace, shared with pass 4's jaxpr half
+    closed_site = "train step"
     if jaxpr:
         loss_fn = LOSS_REGISTRY[cfg.loss]
         cdtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else None
@@ -99,11 +162,40 @@ def lint_config(
             cfg.finetune_epochs or cfg.epochs
             or cfg.experiment in ("train", "train_robustness")
         )
-        findings += lint_step(
+        closed_site = "train step" if train else "eval step"
+        closed_step = trace_step(
             model, loss_fn, tx=make_optimizer(cfg) if train else None,
             train=train, compute_dtype=cdtype, remat=cfg.remat,
             lm=cfg.loss == "lm_cross_entropy",
         )
+        findings += lint_jaxpr(
+            closed_step, compute_dtype=cdtype, site=closed_site,
+        )
+
+    # -- passes 4/5: collective contracts + cost model over the REAL
+    # compiled programs (the only passes that invoke XLA; param-budgeted
+    # inside build_programs) ----------------------------------------------
+    if collectives or cost:
+        from torchpruner_tpu.analysis import cost_model
+        from torchpruner_tpu.analysis.collective_lint import (
+            build_programs,
+            env_plant,
+            lint_collectives,
+        )
+
+        if collectives:
+            cfindings, records = lint_collectives(
+                cfg, model=model, closed=closed_step,
+                closed_site=closed_site, trace=jaxpr)
+            findings += cfindings
+        else:
+            records, bfindings = build_programs(
+                cfg, model, plant=env_plant())
+            findings += bfindings
+        if cost:
+            preds = cost_model.predict_programs(records)
+            findings += cost_model.cost_findings(preds)
+            cost_model.record_gauges(preds)
 
     return merge_reports(cfg.name, findings)
 

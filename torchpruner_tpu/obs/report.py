@@ -84,8 +84,11 @@ _SCALARS = {
 #: participates in diff/gating even though its exact name depends on
 #: the run (per-kernel scalars are named after the compiled ops;
 #: ``zero_*`` are the ZeRO weight-update-sharding A/B gauges from
-#: experiments.zero_bench / the bench ``zero`` leg)
-_DYNAMIC_SCALAR_PREFIXES = ("kernel_", "serve_slo_breach", "zero_")
+#: experiments.zero_bench / the bench ``zero`` leg; ``predicted_*``
+#: are the static cost model's step/comm predictions plus the
+#: prediction-vs-measured drift rows computed in ``_scalars_of``)
+_DYNAMIC_SCALAR_PREFIXES = ("kernel_", "serve_slo_breach", "zero_",
+                            "predicted_")
 _DYNAMIC_EXTRA = ("profile_coverage", "profile_windows_total",
                   "profile_steps_total")
 
@@ -189,7 +192,7 @@ def _scalars_of(report: Dict[str, Any]) -> Dict[str, Optional[float]]:
     derived = report.get("derived") or {}
     compiles = report.get("compiles") or {}
     metrics = report.get("metrics") or {}
-    return {
+    out = {
         "step_time_mean_s": derived.get("step_time_mean_s"),
         "step_time_p50_s": derived.get("step_time_p50_s"),
         "mfu": _finite(derived.get("mfu")),
@@ -210,6 +213,22 @@ def _scalars_of(report: Dict[str, Any]) -> Dict[str, Optional[float]]:
         # dynamically — their names depend on the compiled program
         **_dynamic_scalars(metrics),
     }
+    # prediction-vs-measured drift: the static cost model's predicted
+    # step/token time against what the run measured, as a signed % —
+    # the row `obs diff` renders (and the capture script's staged lint
+    # leg gates at <30% on-chip).  Computed here so SIGKILLed runs
+    # reconstructed from shards get it too.
+    pred = _finite(metrics.get("predicted_step_ms"))
+    meas = out.get("step_time_p50_s")
+    if pred is not None and meas:
+        out["predicted_vs_measured_step_pct"] = (
+            100.0 * (pred - 1e3 * meas) / (1e3 * meas))
+    pred_d = _finite(metrics.get("predicted_step_ms_decode"))
+    meas_d = out.get("serve_token_p50_s")
+    if pred_d is not None and meas_d:
+        out["predicted_vs_measured_decode_pct"] = (
+            100.0 * (pred_d - 1e3 * meas_d) / (1e3 * meas_d))
+    return out
 
 
 def _finite(v) -> Optional[float]:
@@ -255,6 +274,37 @@ def format_report(report: Dict[str, Any]) -> str:
         bits.append(f"wall {sc['wall_s']:.1f}s")
     if bits:
         lines.append("run: " + ", ".join(bits))
+        lines.append("")
+
+    # static cost model: predicted vs measured, per program (the train
+    # step compares against step-time p50, decode against per-token p50)
+    metrics = report.get("metrics") or {}
+    preds = []
+    for key, label, meas_key, meas_scale in (
+        ("predicted_step_ms", "step", "step_time_p50_s", 1e3),
+        ("predicted_step_ms_decode", "decode", "serve_token_p50_s", 1e3),
+        ("predicted_step_ms_capture", "capture", None, None),
+        ("predicted_step_ms_prefill", "prefill", None, None),
+    ):
+        p = _finite(metrics.get(key))
+        if p is None:
+            continue
+        comm = _finite(metrics.get(key.replace("predicted_step_ms",
+                                               "predicted_comm_ms")))
+        bit = f"{label} {p:.3f} ms predicted"
+        if comm:
+            bit += f" ({comm:.3f} ms comm)"
+        # the drift itself comes from _scalars_of — ONE formula, shared
+        # with the obs-diff scalar the capture script gates on
+        m = sc.get(meas_key) if meas_key else None
+        drift = sc.get({"step": "predicted_vs_measured_step_pct",
+                        "decode": "predicted_vs_measured_decode_pct"}
+                       .get(label))
+        if m and drift is not None:
+            bit += f" vs {meas_scale * m:.3f} ms measured ({drift:+.0f}%)"
+        preds.append(bit)
+    if preds:
+        lines.append("cost model: " + ", ".join(preds))
         lines.append("")
 
     rounds = report.get("rounds") or []

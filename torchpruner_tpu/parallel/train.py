@@ -30,12 +30,64 @@ from torchpruner_tpu.core.segment import SegmentedModel
 from torchpruner_tpu.train.loop import _batch_tokens
 from torchpruner_tpu.parallel.sharding import (
     batch_sharding,
-    fsdp_sharding,
     replicate,
     shard_batch,
-    tp_sharding,
-    zero_update_sharding,
 )
+
+
+def plan_placements(model, params, state, opt_state, tx, mesh,
+                    *, partition: str = "fsdp", zero: bool = False,
+                    data_axis: str = "data", model_axis: str = "model",
+                    min_shard_size: int = 2 ** 14, plant: str = None):
+    """``(param, state, opt, zero)`` NamedSharding trees — the ONE
+    placement planner shared by :class:`ShardedTrainer` and the static
+    analyzer's collective-contract pass (analysis/collective_lint.py).
+    Pure tree/shape work: ``params``/``state``/``opt_state`` may be
+    concrete arrays or abstract ``ShapeDtypeStruct`` trees, so the lint
+    plans the EXACT placement production will use without materializing
+    a parameter.
+
+    ``plant="replicated_allreduce"`` knocks the ZeRO update transform
+    out (the zero tree comes back ``None`` while the caller still
+    believes ``zero=True``) — the planted hazard the collective lint's
+    CI drill drives (env ``TORCHPRUNER_LINT_PLANT``, read ONLY by the
+    lint drivers via ``analysis/collective_lint.env_plant`` — never by
+    the trainer or the telemetry cost predictor, so a stale shell
+    export can neither degrade real training nor skew the run's
+    ``predicted_*`` gauges),
+    standing in for the refactor that regresses the reduce-scatter →
+    sharded update → all-gather sequence to a replicated all-reduce
+    while every numeric test still passes."""
+    from torchpruner_tpu.parallel.sharding import (
+        fsdp_sharding as _fsdp, tp_sharding as _tp,
+        zero_update_sharding as _zero,
+    )
+
+    if partition not in ("fsdp", "tp"):
+        raise ValueError(
+            f"unknown partition {partition!r} (use 'fsdp' or 'tp')"
+        )
+    if partition == "tp":
+        ps = _tp(model, params, mesh, model_axis, min_shard_size)
+    else:
+        ps = _fsdp(params, mesh, model_axis, min_shard_size)
+    ss = jax.tree_util.tree_map(lambda _: replicate(mesh), state)
+    zs = None
+    if zero and mesh.shape.get(data_axis, 1) > 1:
+        zs = _zero(params, ps, mesh, data_axis)
+    if plant == "replicated_allreduce":
+        zs = None  # the planted hazard: ZeRO silently knocked out
+    # param-shaped optimizer-state leaves (momentum, Adam m/v) shard with
+    # their param — or with the ZeRO update domain when zero=True; non-
+    # param leaves (step counts) replicate
+    os_ = optax.tree_map_params(
+        tx,
+        lambda _leaf, spec: spec,
+        opt_state,
+        zs if zs is not None else ps,
+        transform_non_params=lambda _leaf: replicate(mesh),
+    )
+    return ps, ss, os_, zs
 
 
 def make_sharded_train_step(
@@ -243,36 +295,18 @@ class ShardedTrainer:
     # -- placement ---------------------------------------------------------
 
     def _shardings(self):
-        """``(param, state, opt, zero)`` sharding trees.  ``zero`` is the
-        param-shaped update-domain tree (param spec + data axis) or None;
-        when set, param-shaped optimizer slots take IT as their placement
-        — the persistent 1/N-per-chip opt state ZeRO is for."""
-        if self.partition not in ("fsdp", "tp"):
-            raise ValueError(
-                f"unknown partition {self.partition!r} (use 'fsdp' or 'tp')"
-            )
-        if self.partition == "tp":
-            ps = tp_sharding(self.model, self.params, self.mesh,
-                             self.model_axis, self.min_shard_size)
-        else:
-            ps = fsdp_sharding(self.params, self.mesh, self.model_axis,
-                               self.min_shard_size)
-        ss = jax.tree_util.tree_map(lambda _: replicate(self.mesh), self.state)
-        zs = None
-        if self.zero and self.mesh.shape.get(self.data_axis, 1) > 1:
-            zs = zero_update_sharding(self.params, ps, self.mesh,
-                                      self.data_axis)
-        # param-shaped optimizer-state leaves (momentum, Adam m/v) shard with
-        # their param — or with the ZeRO update domain when zero=True; non-
-        # param leaves (step counts) replicate
-        os_ = optax.tree_map_params(
-            self.tx,
-            lambda _leaf, spec: spec,
-            self.opt_state,
-            zs if zs is not None else ps,
-            transform_non_params=lambda _leaf: replicate(self.mesh),
+        """``(param, state, opt, zero)`` sharding trees via the shared
+        :func:`plan_placements` planner (one placement policy for the
+        trainer and the static analyzer).  ``zero`` is the param-shaped
+        update-domain tree (param spec + data axis) or None; when set,
+        param-shaped optimizer slots take IT as their placement — the
+        persistent 1/N-per-chip opt state ZeRO is for."""
+        return plan_placements(
+            self.model, self.params, self.state, self.opt_state, self.tx,
+            self.mesh, partition=self.partition, zero=self.zero,
+            data_axis=self.data_axis, model_axis=self.model_axis,
+            min_shard_size=self.min_shard_size,
         )
-        return ps, ss, os_, zs
 
     def _place(self):
         with obs.span("shard", partition=self.partition, zero=self.zero):

@@ -269,6 +269,16 @@ class ServeEngine:
             "serve_decode_kernel_active", float(self.decode_kernel),
             help="1 when the decode-shaped Pallas attention kernel "
                  "serves this engine's cache geometry")
+        # static cost model: predict the slot-decode step at THIS
+        # engine's geometry (slots × max_len × cache dtype) so the
+        # serve run's report.json carries predicted_step_ms_decode /
+        # predicted_comm_ms_decode next to the measured per-token
+        # latency (obs diff renders the drift).  Best-effort and
+        # param-budgeted; TORCHPRUNER_COST_PREDICT=0 opts out.  The
+        # twin compile is deferred to the first step() — construction
+        # compiles NOTHING (the hot-swap overlap window relies on it).
+        self._cost_predicted = False
+        self._cost_thread: Optional[threading.Thread] = None
         self.scheduler = Scheduler(
             KVCacheAllocator(n_slots, max_len, page_len=page_len,
                              page_budget=page_budget))
@@ -423,6 +433,22 @@ class ServeEngine:
         batched decode step.  Returns whether any work happened."""
         if self._t_first is None:
             self._t_first = time.perf_counter()
+        if not self._cost_predicted:
+            # the cost-model twin compiles on a BACKGROUND thread,
+            # overlapping the first step's real decode/prefill compiles
+            # instead of serializing after them (construction still
+            # compiles nothing); summary() joins it before the gauges
+            # are read out
+            self._cost_predicted = True
+            from torchpruner_tpu.analysis import cost_model
+
+            self._cost_thread = threading.Thread(
+                target=cost_model.record_decode_prediction,
+                args=(self.programs.model,),
+                kwargs=dict(n_slots=self.n_slots, max_len=self.max_len,
+                            cache_dtype=self.programs.cache_dtype),
+                daemon=True)
+            self._cost_thread.start()
         did = False
         if admit:
             for req in self.scheduler.admit():
@@ -609,6 +635,11 @@ class ServeEngine:
         ``sustained_gen_tok_s``) covers the most recent :meth:`run`;
         latency percentiles come from retained results (``None`` with
         ``retain_results=False`` — read the obs histograms instead)."""
+        if self._cost_thread is not None:
+            # bound the wait: a wedged twin compile must not hang the
+            # summary — the gauges just stay absent (best-effort)
+            self._cost_thread.join(timeout=120.0)
+            self._cost_thread = None
         done = [r for r in self._results if r.state == DONE]
         wall = ((self._t_last - self._t_first)
                 if self._t_first is not None and self._t_last is not None

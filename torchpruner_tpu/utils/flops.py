@@ -122,8 +122,36 @@ PEAK_HBM_BYTES_PER_S = {
 }
 
 
+#: Per-axis one-way ICI bandwidth per chip (bytes/s, approximate public
+#: aggregates divided across torus directions) — the communication
+#: ceiling of the static cost model (analysis/cost_model.py).  These are
+#: lint-grade constants: good enough to rank comm-bound vs compute-bound
+#: and to predict step time within the <30% on-chip target the capture
+#: script asserts, not a substitute for a measured profile.
+PEAK_ICI_BYTES_PER_S = {
+    "TPU v3": 70e9,
+    "TPU v4": 100e9,
+    "TPU v5 lite": 66e9,
+    "TPU v5e": 66e9,
+    "TPU v5p": 200e9,
+    "TPU v5": 200e9,
+    "TPU v6 lite": 150e9,
+    "TPU v6e": 150e9,
+    "TPU7": 400e9,
+}
+
+
+#: Order-of-magnitude host constants the cost model falls back to on the
+#: CPU backend, so smoke runs produce DETERMINISTIC (if rough)
+#: predictions the golden predicted-vs-measured tests can pin.  Each is
+#: env-overridable (TORCHPRUNER_COST_CPU_FLOPS / _BW / _ICI); on-chip
+#: predictions never consult these.
+CPU_COST_DEFAULTS = {"flops": 5e10, "hbm": 2e10, "ici": 1e10}
+
+
 def _by_kind_prefix(table: dict, device) -> float | None:
-    kind = getattr(device, "device_kind", "") or ""
+    kind = device if isinstance(device, str) else \
+        (getattr(device, "device_kind", "") or "")
     for prefix in sorted(table, key=len, reverse=True):
         if kind.startswith(prefix):
             return table[prefix]
@@ -131,15 +159,22 @@ def _by_kind_prefix(table: dict, device) -> float | None:
 
 
 def peak_bf16_flops(device) -> float | None:
-    """Spec-sheet bf16 peak for ``device`` (None when unknown)."""
+    """Spec-sheet bf16 peak for ``device`` (a Device or a device-kind
+    string; None when unknown)."""
     return _by_kind_prefix(PEAK_BF16_FLOPS, device)
 
 
 def peak_hbm_bw(device) -> float | None:
-    """Spec-sheet HBM bandwidth (bytes/s) for ``device`` (None when
-    unknown — e.g. the CPU backend, where DRAM bandwidth is not a chip
-    constant worth pretending to know)."""
+    """Spec-sheet HBM bandwidth (bytes/s) for ``device`` (a Device or a
+    device-kind string; None when unknown — e.g. the CPU backend, where
+    DRAM bandwidth is not a chip constant worth pretending to know)."""
     return _by_kind_prefix(PEAK_HBM_BYTES_PER_S, device)
+
+
+def peak_ici_bw(device) -> float | None:
+    """Per-axis one-way ICI bandwidth (bytes/s) for ``device`` (a Device
+    or a device-kind string; None when unknown)."""
+    return _by_kind_prefix(PEAK_ICI_BYTES_PER_S, device)
 
 
 def roofline_position(flops: float | None, bytes_moved: float | None,
