@@ -124,6 +124,7 @@ _LEG_EST_S = {
     # decode 63 s, flash 10 s, sweep 928 s), with 2-6x cold margin
     "mnist_prune": (150, 520),
     "resilience": (150, 240),
+    "plan": (240, 120),
     "zero": (300, 420),
     "vgg16_train": (120, 3600),
     "mfu_llama": (180, 3600),
@@ -1400,6 +1401,66 @@ def _leg_zero(smoke: bool) -> dict:
     return out
 
 
+def _leg_plan(smoke: bool) -> dict:
+    """Leg: the auto-parallelism planner (analysis/planner.py) over the
+    vgg16 recipe — the zero-to-ranked-table wall time, candidate/
+    feasible counts, and the winner's predicted margin over the
+    hand-written preset config.  On TPU the top-2 candidates get short
+    measured probes (the drift column the capture script's staged MFU
+    assertion reads); the CPU leg stays static (probe drift against
+    order-of-magnitude CPU constants gates everything, which is
+    signal-free).  Search cost is the point of this leg: pricing the
+    whole space must stay cheap enough to run before every expensive
+    configuration decision."""
+    import jax
+
+    from torchpruner_tpu.analysis import planner
+    from torchpruner_tpu.experiments.presets import get_preset
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    # the vgg16 recipe is the MFU-plateau target (ROADMAP item 3); the
+    # smoke variant keeps the identical search shape CPU-sized
+    cfg = get_preset("vgg16_digits32_layerwise", smoke=smoke or not on_tpu)
+    t0 = time.perf_counter()
+    plan = planner.plan_auto(
+        cfg, n_devices=len(jax.devices()),
+        probe_top=2 if on_tpu else 0, probe_steps=8,
+    )
+    wall = time.perf_counter() - t0
+    by_label = {c["label"]: c for c in plan["candidates"]}
+    winner = by_label.get(plan["winner"] or "")
+    out = {
+        "value": round(wall, 3),
+        "unit": "s (search wall)",
+        "config": plan["config"],
+        "n_devices": plan["n_devices_target"],
+        "candidates": len(plan["candidates"]),
+        "feasible": len(plan["ranked"]),
+        "winner": plan["winner"],
+        "baseline": plan["baseline"],
+        "margin_over_baseline_pct": plan["margin_over_baseline_pct"],
+        "margin_over_runner_up_pct": plan["margin_over_runner_up_pct"],
+    }
+    if winner:
+        out["winner_predicted_step_ms"] = winner["predicted"]["step_ms"]
+        out["winner_bound"] = winner["predicted"]["bound"]
+        out["winner_hbm_gib_per_chip"] = round(
+            winner["hbm"]["watermark_bytes_per_chip"] / 2 ** 30, 4)
+        if winner.get("probe"):
+            out["winner_probe"] = winner["probe"]
+    excluded = [c for c in plan["candidates"] if c["excluded_by"]]
+    if excluded:
+        out["excluded"] = {c["label"]: c["excluded_by"] for c in excluded}
+    try:
+        from torchpruner_tpu import obs
+
+        obs.gauge_set("plan_search_wall_s", wall,
+                      help="planner: full search wall time (s)")
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
 def _leg_ok(legs: dict, name: str) -> bool:
     return (name in legs and "error" not in legs[name]
             and "skipped" not in legs[name]
@@ -1603,6 +1664,9 @@ def main() -> dict:
     # exercises (kill/resume, NaN skip, digest verify) are exactly what a
     # preemptible TPU attempt of the legs below depends on
     run_leg("resilience", _leg_resilience)
+    # planner search: cheap on every platform (static pricing; probes
+    # only on TPU) and the config it proposes frames the train legs below
+    run_leg("plan", _leg_plan)
     if on_tpu or smoke or "--all-legs" in sys.argv:
         # cheap legs first, the long full-sweep leg last: if the child is
         # killed mid-run, the streamed snapshots hold the most

@@ -185,6 +185,47 @@ TORCHPRUNER_LINT_COMPILE_BUDGET=1e10 timeout 3600 \
     && echo "[capture] on-chip collective lint clean" \
     || echo "[capture] on-chip collective lint FOUND ERRORS — see results/lint_tpu_${stamp}_${commit}.txt"
 
+# 4c. STAGED ASSERTION (ISSUE 11 acceptance, the vgg16 MFU plateau):
+#     `--plan auto` on the vgg16 recipe with measured probes of the
+#     top-3 candidates.  The planner's proposed config must beat the
+#     0.25 hand-tuned MFU plateau in its MEASURED probe — or the plan
+#     artifact must name which roofline term (compute/hbm/ici) says it
+#     cannot (an hbm/ici-bound winner is the cost model asserting the
+#     plateau is physics, not a bad hand choice).  A miss is loud but
+#     does not abort the capture.
+timeout 3600 python -m torchpruner_tpu vgg16_digits32_layerwise \
+    --plan auto --plan-probe 3 \
+    --plan-out "results/plan_vgg16_tpu_${stamp}_${commit}.json" \
+    > "results/plan_vgg16_tpu_${stamp}_${commit}.txt" \
+    2> "logs/plan_vgg16_${stamp}.err" \
+    && python - "results/plan_vgg16_tpu_${stamp}_${commit}.json" <<'EOF' \
+    && echo "[capture] planner beats the 0.25 vgg16 MFU plateau (or names the binding term) HOLDS" \
+    || echo "[capture] planner vgg16 assertion FAILED — diagnose the plan artifact before merging PERF claims"
+import json, sys
+plan = json.load(open(sys.argv[1]))
+by = {c["label"]: c for c in plan["candidates"]}
+assert plan["winner"], f"no feasible candidate: {plan['findings']}"
+winner = by[plan["winner"]]
+probes = [c for c in plan["candidates"]
+          if (c.get("probe") or {}).get("mfu") is not None]
+assert probes, "no probe carried an MFU reading"
+best = max(probes, key=lambda c: c["probe"]["mfu"])
+mfu = best["probe"]["mfu"]
+bound = winner["predicted"]["bound"]
+print(f"best probed MFU {mfu:.3f} ({best['label']}); "
+      f"winner {plan['winner']} is {bound}-bound "
+      f"[compute {winner['predicted']['compute_ms']:.3f} / "
+      f"hbm {winner['predicted']['hbm_ms']:.3f} / "
+      f"ici {winner['predicted']['ici_ms']:.3f} ms]")
+if mfu <= 0.25:
+    # the plateau stands only if the roofline explains it: the winner
+    # must be memory- or wire-bound, not compute-bound (a compute-bound
+    # winner under 0.25 MFU means the model is wrong or the config is)
+    assert bound in ("hbm", "ici"), (
+        f"MFU {mfu:.3f} <= 0.25 but the winner is {bound}-bound — "
+        f"the cost model does NOT explain the plateau")
+EOF
+
 # 5. kernel-level profile leg (obs.profile): continuous capture windows
 #    over a short mfu_llama train run — the on-chip per-kernel table +
 #    roofline positions ROADMAP item 2's retune reads, plus a fresh

@@ -42,6 +42,7 @@ import bench  # noqa: E402  (the leg functions + cache merge live there)
 #: timeouts sized ~4x the round-2 cold-run observations.
 LEGS = [
     ("mnist_prune", 600),
+    ("plan", 1800),
     ("mfu_llama", 2400),
     ("vgg16_train", 2400),
     ("flash_attention", 1800),
@@ -68,6 +69,7 @@ print("LEGJSON " + json.dumps(fn(False, **kw)), flush=True)
 #: leg name -> the bench module's function suffix
 _FN = {
     "mnist_prune": "mnist",
+    "plan": "plan",
     "vgg16_robustness": "vgg_robustness",
     "vgg16_train": "vgg_train",
     "mfu_llama": "mfu_llama",

@@ -1,0 +1,199 @@
+"""`parallel.train.plan_placements` edge cases in isolation.
+
+The placement planner is load-bearing three ways — ShardedTrainer places
+real state with it, the collective lint compiles contract programs over
+it, and the auto-parallelism planner enumerates candidates through it —
+but until now it was only tested through those consumers.  These tests
+pin its rules directly on abstract trees (no parameter materialized):
+non-divisible largest dims, already-model-sharded params under zero,
+the replicated fallback, and re-derivation on pruned (smaller) trees,
+plus the planted-hazard knob and the mesh-factorization enumeration.
+"""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from torchpruner_tpu.parallel.train import (
+    mesh_factorizations,
+    plan_placements,
+)
+
+
+def _mesh(data=2, model=2):
+    n = data * model
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices")
+    return Mesh(
+        np.array(jax.devices()[:n]).reshape(data, model),
+        ("data", "model"),
+    )
+
+
+def _abstract(shapes):
+    return {k: jax.ShapeDtypeStruct(s, jnp.float32)
+            for k, s in shapes.items()}
+
+
+def _plan(params, mesh, *, tx=None, zero=False, partition="fsdp",
+          state=None, plant=None):
+    tx = tx or optax.adam(1e-3)
+    opt = jax.eval_shape(tx.init, params)
+    return plan_placements(
+        None, params, state if state is not None else {}, opt, tx, mesh,
+        partition=partition, zero=zero, plant=plant,
+    )
+
+
+def test_fsdp_shards_largest_divisible_dim():
+    mesh = _mesh()
+    params = {"w": jax.ShapeDtypeStruct((2 ** 14, 6), jnp.float32)}
+    ps, ss, os_, zs = _plan(params, mesh)
+    assert ps["w"].spec == P("model", None)
+    assert zs is None
+
+
+def test_nondivisible_largest_dim_falls_to_next_or_replicates():
+    mesh = _mesh()
+    # largest dim 3*2**13 odd multiple — 24576 % 2 == 0 so it shards;
+    # force TRUE non-divisibility with odd dims on every axis
+    params = _abstract({
+        "odd": (2 ** 14 + 1, 5),        # no dim divides model=2
+        "second": (2 ** 13 * 3, 7),     # largest divides -> sharded
+    })
+    ps, *_ = _plan(params, mesh)
+    assert ps["odd"].spec == P(), "no divisible dim must replicate"
+    assert ps["second"].spec == P("model", None)
+
+
+def test_small_arrays_replicate_under_min_shard_size():
+    mesh = _mesh()
+    params = _abstract({"tiny": (64, 64)})  # 4096 < 2**14 default
+    ps, *_ = _plan(params, mesh)
+    assert ps["tiny"].spec == P()
+
+
+def test_zero_adds_data_axis_on_unsharded_dim():
+    mesh = _mesh()
+    params = _abstract({"w": (2 ** 14, 8)})
+    ps, _, os_, zs = _plan(params, mesh, zero=True)
+    assert ps["w"].spec == P("model", None)
+    # zero spec: data axis lands on the largest dim the param placement
+    # left unsharded — here dim 1 (8 % data=2 == 0)
+    assert zs["w"].spec == P("model", "data")
+
+
+def test_zero_extends_already_model_sharded_dim_to_tuple():
+    mesh = _mesh()
+    # dim 1 (=3) does not divide data; dim 0 is model-sharded but
+    # divides model*data -> the spec extends to the compound tuple
+    params = _abstract({"w": (2 ** 14, 3)})
+    ps, _, os_, zs = _plan(params, mesh, zero=True)
+    assert ps["w"].spec == P("model", None)
+    assert zs["w"].spec == P(("model", "data"), None)
+
+
+def test_zero_replicated_fallback_keeps_param_spec():
+    mesh = _mesh()
+    # nothing divides data=2: the update domain degrades to the param
+    # placement (replicated update — exactly pre-ZeRO behavior)
+    params = _abstract({"w": (3, 5)})
+    ps, _, os_, zs = _plan(params, mesh, zero=True)
+    assert ps["w"].spec == P()
+    assert zs["w"].spec == P()
+
+
+def test_opt_state_takes_zero_placement_and_counts_replicate():
+    mesh = _mesh()
+    params = _abstract({"w": (2 ** 14, 8)})
+    tx = optax.adam(1e-3)
+    ps, _, os_, zs = _plan(params, mesh, tx=tx, zero=True)
+    # adam: ScaleByAdamState(count, mu, nu) — param-shaped slots carry
+    # the ZERO spec, the scalar count replicates
+    flat = jax.tree_util.tree_leaves(
+        os_, is_leaf=lambda x: hasattr(x, "spec"))
+    specs = {tuple(s.spec) for s in flat}
+    assert tuple(zs["w"].spec) in specs
+    assert () in specs  # the replicated count
+
+
+def test_zero_skipped_without_data_axis_gt_one():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 2),
+                ("data", "model"))
+    params = _abstract({"w": (2 ** 14, 8)})
+    *_, zs = _plan(params, mesh, zero=True)
+    assert zs is None
+
+
+def test_plant_knocks_out_zero_tree():
+    mesh = _mesh()
+    params = _abstract({"w": (2 ** 14, 8)})
+    *_, zs = _plan(params, mesh, zero=True, plant="replicated_allreduce")
+    assert zs is None
+
+
+def test_state_replicates():
+    mesh = _mesh()
+    params = _abstract({"w": (2 ** 14, 8)})
+    state = _abstract({"bn_mean": (2 ** 14,)})
+    _, ss, *_ = _plan(params, mesh, state=state)
+    assert ss["bn_mean"].spec == P()
+
+
+def test_pruned_tree_rederivation_falls_back():
+    """The rebuild() path in isolation: the SAME planner call over the
+    pruned (smaller) trees — a dim that stopped dividing loses its
+    shard, and the zero domain re-derives under the new shapes."""
+    mesh = _mesh()
+    full = _abstract({"w": (2 ** 14, 8), "v": (2 ** 14, 4)})
+    ps_full, _, _, zs_full = _plan(full, mesh, zero=True)
+    assert ps_full["w"].spec == P("model", None)
+    assert zs_full["w"].spec == P("model", "data")
+
+    # prune w's rows to an odd width: no dim of w divides model OR data
+    pruned = _abstract({"w": (2 ** 14 - 1, 3), "v": (2 ** 14, 4)})
+    ps_p, _, os_p, zs_p = _plan(pruned, mesh, zero=True)
+    assert ps_p["w"].spec == P()       # replicated fallback
+    assert zs_p["w"].spec == P()       # update domain degrades with it
+    assert ps_p["v"].spec == P("model", None)  # untouched leaf keeps its shard
+    assert zs_p["v"].spec == P("model", "data")
+
+
+def test_unknown_partition_raises():
+    mesh = _mesh()
+    params = _abstract({"w": (2 ** 14, 8)})
+    with pytest.raises(ValueError, match="partition"):
+        _plan(params, mesh, partition="3d")
+
+
+# ---------------------------------------------------------------------------
+# mesh_factorizations — the planner's candidate-mesh enumeration
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_factorizations_covers_all_divisors():
+    got = mesh_factorizations(8)
+    assert got == [
+        {"data": 8},
+        {"data": 4, "model": 2},
+        {"data": 2, "model": 4},
+        {"data": 1, "model": 8},
+    ]
+
+
+def test_mesh_factorizations_single_device_and_bounds():
+    assert mesh_factorizations(1) == [{"data": 1}]
+    assert mesh_factorizations(12, max_model=3) == [
+        {"data": 12},
+        {"data": 6, "model": 2},
+        {"data": 4, "model": 3},
+    ]
+    # every entry is a valid mesh over exactly n devices
+    for axes in mesh_factorizations(16):
+        assert int(np.prod(list(axes.values()))) == 16

@@ -1,0 +1,266 @@
+"""Auto-parallelism planner (analysis/planner.py, CLI ``--plan auto``).
+
+The search tests run on the 8 virtual CPU devices conftest forces, over
+the digits smoke preset — the same geometry the CI planner smoke uses.
+One full ``plan_auto`` run is shared module-wide (it compiles ~25 real
+candidate programs); the planted-infeasible and probe paths run on
+narrowed search spaces to stay fast.
+"""
+
+import dataclasses
+import json
+import os
+
+import pytest
+
+import jax
+
+from torchpruner_tpu.analysis import planner
+from torchpruner_tpu.analysis.planner import (
+    Candidate,
+    enumerate_candidates,
+    format_plan,
+    plan_auto,
+    probe_candidate,
+)
+from torchpruner_tpu.experiments.presets import mnist_mlp_shapley
+from torchpruner_tpu.experiments.prune_retrain import MODEL_REGISTRY
+
+
+def _cfg(**kw):
+    return dataclasses.replace(
+        mnist_mlp_shapley(smoke=True), name="planner_test", **kw)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return MODEL_REGISTRY[_cfg().model][0]()
+
+
+@pytest.fixture(scope="module")
+def plan(model):
+    """One full search over the digits smoke preset on 8 devices."""
+    return plan_auto(_cfg(), model=model, n_devices=8)
+
+
+# ---------------------------------------------------------------------------
+# enumeration
+# ---------------------------------------------------------------------------
+
+
+def test_enumerate_baseline_first_and_unique(model):
+    cfg = _cfg()
+    cands = enumerate_candidates(cfg, 8, model=model)
+    assert cands[0].baseline
+    assert cands[0].batch_size == cfg.batch_size
+    assert cands[0].mesh == {}
+    labels = [c.label for c in cands]
+    assert len(labels) == len(set(labels))
+
+
+def test_enumerate_respects_mode_validity(model):
+    for c in enumerate_candidates(_cfg(), 8, model=model):
+        data = c.mesh.get("data", 1)
+        model_ax = c.mesh.get("model", 1)
+        if c.zero:
+            assert data > 1
+        if c.partition == "tp" and not c.baseline:
+            assert model_ax > 1
+        if c.mesh:
+            assert c.batch_size % (data * c.accum_steps) == 0
+        # every candidate round-trips through config validation
+        c.config(_cfg())
+
+
+def test_repairs_reround_batch_to_new_accum_multiple():
+    """The accum repair must re-round the batch like the enumerator
+    does — otherwise the recommended config violates the
+    batch % (data * accum) invariant its own search maintains."""
+    from torchpruner_tpu.analysis.planner import _repairs
+
+    cand = Candidate(mesh={"data": 4}, partition="fsdp", zero=False,
+                     batch_size=12, accum_steps=1, remat=False)
+    reps = {r.label: r for r in _repairs(cand)}
+    accum_rep = next(r for r in reps.values() if r.accum_steps == 2)
+    assert accum_rep.batch_size % (4 * 2) == 0
+    assert accum_rep.batch_size == 16  # 12 rounded up to data*accum
+    assert all(r.repair_of == cand.label for r in reps.values())
+
+
+def test_candidate_labels_are_stable():
+    c = Candidate(mesh={"data": 4, "model": 2}, partition="tp",
+                  zero=True, batch_size=128, accum_steps=2, remat=True)
+    assert c.label == "d4xm2/tp/zero/b128/a2/remat"
+    c2 = Candidate(mesh={}, partition="fsdp", zero=False,
+                   batch_size=32, accum_steps=1, remat=False)
+    assert c2.label == "single/local/b32"
+
+
+# ---------------------------------------------------------------------------
+# the full search
+# ---------------------------------------------------------------------------
+
+
+def test_plan_ranks_three_plus_feasible_candidates(plan):
+    """The acceptance bar: >= 3 feasible candidates ranked by predicted
+    step time, each lint-clean and within its own HBM budget."""
+    assert len(plan["ranked"]) >= 3
+    by_label = {c["label"]: c for c in plan["candidates"]}
+    for label in plan["ranked"]:
+        c = by_label[label]
+        assert c["feasible"]
+        assert not c["lint"]["errors"], (label, c["lint"])
+        assert c["hbm"]["fits"], label
+        assert c["predicted"]["step_ms"] > 0
+        assert c["predicted"]["bound"] in ("compute", "hbm", "ici")
+    # ranked is genuinely ordered by predicted ms/example
+    scores = [by_label[l]["predicted"]["step_ms_per_example"]
+              for l in plan["ranked"]]
+    assert scores == sorted(scores)
+
+
+def test_winner_beats_hand_written_baseline(plan):
+    by_label = {c["label"]: c for c in plan["candidates"]}
+    winner = by_label[plan["winner"]]
+    baseline = by_label[plan["baseline"]]
+    assert baseline["baseline"]
+    assert winner["predicted"]["step_ms_per_example"] <= \
+        baseline["predicted"]["step_ms_per_example"]
+    assert plan["margin_over_baseline_pct"] is not None
+
+
+def test_plan_artifact_renders_and_roundtrips(plan, tmp_path):
+    text = format_plan(plan)
+    assert plan["winner"] in text
+    assert "| bound |" in text.replace("| bound ", "| bound |")[:10**6]
+    path = tmp_path / "plan.json"
+    planner.write_plan(plan, str(path))
+    again = json.loads(path.read_text())
+    assert again["ranked"] == plan["ranked"]
+    assert format_plan(again) == text
+
+
+def test_planted_infeasible_excluded_loudly_by_name(plan, model):
+    """The CI drill's logic: an HBM budget planted between two
+    candidates' watermarks must exclude the over-budget candidate BY
+    NAME (artifact reasons + planner/over-hbm finding), never
+    silently."""
+    ws = sorted({c["hbm"]["watermark_bytes_per_chip"]
+                 for c in plan["candidates"]})
+    assert ws[0] < ws[-1], "search space must spread watermarks"
+    budget = (ws[0] + ws[-1]) / 2 / 0.85
+    narrowed = plan_auto(_cfg(), model=model, n_devices=8,
+                         batch_ladder=(1, 2), hbm_budget=budget)
+    over = [c for c in narrowed["candidates"] if c["excluded_by"] == "hbm"]
+    kept = [c for c in narrowed["candidates"] if c["feasible"]]
+    assert over, "planted budget excluded nothing"
+    assert kept, "planted budget excluded everything"
+    finding_paths = {f["path"] for f in narrowed["findings"]
+                     if f["check"] == "planner/over-hbm"}
+    rendered = format_plan(narrowed)
+    for c in over:
+        assert c["label"] not in narrowed["ranked"]
+        assert any("HBM watermark" in r for r in c["reasons"]), c
+        assert c["label"] in finding_paths
+        # the exact exclusion line — a ranked repair label like
+        # `<victim>/a2` must not satisfy this by substring
+        assert f"- `{c['label']}` [hbm]" in rendered
+
+
+def test_no_feasible_candidate_is_an_error_finding(model):
+    out = plan_auto(_cfg(), model=model, n_devices=8, hbm_budget=1.0)
+    assert out["ranked"] == []
+    assert out["winner"] is None
+    assert any(f["check"] == "planner/no-feasible"
+               and f["severity"] == "error" for f in out["findings"])
+
+
+def test_compile_cap_truncates_loudly(model):
+    out = plan_auto(_cfg(), model=model, n_devices=8, max_compile=3)
+    capped = [c for c in out["candidates"] if c["excluded_by"] == "cap"]
+    assert capped
+    assert any(f["check"] == "planner/truncated" for f in out["findings"])
+    assert len(out["ranked"]) <= 3
+
+
+# ---------------------------------------------------------------------------
+# probes
+# ---------------------------------------------------------------------------
+
+
+def test_probe_measures_and_gates(model):
+    cfg = _cfg()
+    cand = Candidate(mesh={"data": 2}, partition="fsdp", zero=False,
+                     batch_size=cfg.batch_size, accum_steps=1,
+                     remat=False)
+    cand.predicted = {"step_ms": 1e-6, "flops": 1e6,
+                      "step_ms_per_example": 1e-9}
+    probe = probe_candidate(cand, cfg, model, steps=2, warmup=1)
+    assert probe["measured_ms"] > 0
+    assert probe["steps"] == 2
+    # a 1 ns prediction can never be within 30% of a real measurement
+    assert probe["gated"] and abs(probe["drift_pct"]) > 30
+    assert probe["mfu"] > 0
+
+
+def test_probe_demotes_gated_candidates(model):
+    out = plan_auto(_cfg(), model=model, n_devices=8, probe_top=2,
+                    probe_steps=2, batch_ladder=(1,), max_model=1,
+                    drift_gate_pct=1e-9)  # everything probed gates
+    probed = [c for c in out["candidates"]
+              if (c.get("probe") or {}).get("gated")]
+    assert probed, "top candidates must have been probed and gated"
+    # gated candidates sank below every un-probed feasible one
+    ranked = out["ranked"]
+    gated_idx = [ranked.index(c["label"]) for c in probed
+                 if c["label"] in ranked]
+    clean_idx = [i for i, l in enumerate(ranked)
+                 if l not in {c["label"] for c in probed}]
+    if clean_idx and gated_idx:
+        assert min(gated_idx) > max(clean_idx)
+    assert any(f["check"] == "planner/probe-drift"
+               for f in out["findings"])
+
+
+# ---------------------------------------------------------------------------
+# CLI + obs wiring
+# ---------------------------------------------------------------------------
+
+
+def test_cli_plan_auto_and_report(tmp_path, capsys):
+    from torchpruner_tpu.__main__ import main
+
+    out = str(tmp_path / "plan.json")
+    rc = main(["mnist_mlp_shapley", "--smoke", "--cpu", "--plan", "auto",
+               "--plan-out", out, "--no-compilation-cache"])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "winner" in text and os.path.exists(out)
+    rc = main(["mnist_mlp_shapley", "--smoke", "--cpu", "--plan",
+               "report", "--plan-out", out, "--no-compilation-cache"])
+    assert rc == 0
+    assert "plan: mnist_mlp_shapley" in capsys.readouterr().out
+
+
+def test_plan_gauges_and_ledger_record_land(tmp_path, model):
+    from torchpruner_tpu import obs
+    from torchpruner_tpu.obs.report import load_run
+
+    obs_dir = str(tmp_path / "obs")
+    obs.configure(obs_dir)
+    try:
+        plan_auto(_cfg(), model=model, n_devices=8, batch_ladder=(1,),
+                  max_model=1)
+    finally:
+        obs.shutdown()
+    rep = load_run(obs_dir)
+    metrics = rep.get("metrics") or {}
+    assert metrics.get("plan_candidates_total", 0) >= 2
+    assert metrics.get("plan_feasible_total", 0) >= 1
+    assert metrics.get("plan_winner_step_ms", 0) > 0
+    recs = rep.get("plan") or []
+    assert recs and recs[-1]["winner"]
+    # the report renders a plan section
+    from torchpruner_tpu.obs.report import format_report
+
+    assert "plan: winner" in format_report(rep)

@@ -11,6 +11,8 @@ Examples::
     python -m torchpruner_tpu --list
     python -m torchpruner_tpu --lint llama3_ffn_taylor
     python -m torchpruner_tpu --lint my_experiment.json --lint-plan plan.json
+    python -m torchpruner_tpu vgg16_layerwise --plan auto --plan-probe 2
+    python -m torchpruner_tpu vgg16_layerwise --plan report
     python -m torchpruner_tpu serve llama3_ffn_taylor --smoke --synthetic 16
     python -m torchpruner_tpu obs report logs/obs
     python -m torchpruner_tpu --preset mnist_mlp_shapley --smoke \\
@@ -47,6 +49,12 @@ def main(argv=None) -> int:
                     "(subcommands: obs report/diff — run-ledger tooling; "
                     "serve — continuous-batching inference engine)",
     )
+    p.add_argument(
+        "target", nargs="?", default=None,
+        help="preset name or config JSON path (positional shorthand "
+             "for --preset / --config; e.g. `python -m torchpruner_tpu "
+             "vgg16_layerwise --plan auto`)",
+    )
     p.add_argument("--preset", help="named preset (see --list)")
     p.add_argument("--config", help="path to an ExperimentConfig JSON")
     p.add_argument(
@@ -73,6 +81,33 @@ def main(argv=None) -> int:
         help="with --lint: validate this JSON-serialized PrunePlan "
              "against the config's model instead of the graph-derived "
              "groups (see core.plan.plan_to_dict for the schema)",
+    )
+    p.add_argument(
+        "--plan", choices=("auto", "report"), default=None,
+        help="auto-parallelism planner (analysis/planner.py): 'auto' "
+             "searches mesh shape × zero/fsdp/tp × batch × accum × "
+             "remat for the config's model, prices every candidate "
+             "with the static cost model (predicted step time + HBM "
+             "watermark), discards over-budget or lint-failing "
+             "candidates loudly, and prints the ranked table; 'report' "
+             "re-renders a previously written plan artifact",
+    )
+    p.add_argument(
+        "--plan-probe", metavar="K", type=int, default=0,
+        help="with --plan auto: validate the top-K candidates with "
+             "short measured probes (a real trainer stepped a few "
+             "times), drift-gated against the prediction",
+    )
+    p.add_argument(
+        "--plan-out", metavar="PATH",
+        help="plan artifact path (default logs/plan_<config>.json); "
+             "--plan report reads the same path",
+    )
+    p.add_argument(
+        "--plan-devices", metavar="N", type=int, default=None,
+        help="with --plan auto: target device count to plan for "
+             "(default: the config mesh's size, else this host's "
+             "device count)",
     )
     p.add_argument(
         "--no-compilation-cache", action="store_true",
@@ -143,8 +178,24 @@ def main(argv=None) -> int:
     )
     args = p.parse_args(argv)
 
+    if args.target:
+        # positional shorthand: `python -m torchpruner_tpu <preset>`
+        if args.preset or args.config:
+            p.error("give the experiment either positionally or via "
+                    "--preset/--config, not both")
+        if args.target.endswith(".json"):
+            args.config = args.target
+        else:
+            args.preset = args.target
     if args.lint_plan and args.lint is None:
         p.error("--lint-plan only makes sense together with --lint")
+    if args.plan is not None and args.lint is not None:
+        p.error("--plan and --lint are separate modes — run them "
+                "one at a time")
+    if args.plan is None and (args.plan_probe or args.plan_out
+                              or args.plan_devices):
+        p.error("--plan-probe/--plan-out/--plan-devices only make "
+                "sense together with --plan")
     if args.obs_dir and args.no_obs:
         p.error("--obs-dir and --no-obs are mutually exclusive")
     if args.profile_every is not None and not args.obs_dir:
@@ -197,6 +248,30 @@ def main(argv=None) -> int:
             p.error("--zero needs a config mesh with a 'data' axis "
                     "(e.g. \"mesh\": {\"data\": 4, \"model\": 2})")
         cfg.zero = True
+
+    if args.plan is not None:
+        import contextlib
+
+        from torchpruner_tpu.analysis import planner
+
+        obs = None
+        if args.plan == "auto" and args.obs_dir and not args.no_obs:
+            # a plan run under --obs-dir lands plan_* gauges + the
+            # ledger `plan` record so `obs report`/`obs diff` carry it
+            from torchpruner_tpu import obs
+
+            obs.configure(args.obs_dir)
+            obs.annotate_run(experiment=cfg.name, kind="plan",
+                             model=cfg.model, method=cfg.method)
+        try:
+            ctx = obs.span("plan", experiment=cfg.name) \
+                if obs is not None else contextlib.nullcontext()
+            with ctx:
+                rc = planner.plan_main(cfg, args)
+        finally:
+            if obs is not None:
+                obs.shutdown(print_to=sys.stderr)
+        return rc
 
     if args.lint is not None:
         from torchpruner_tpu.analysis import lint_config

@@ -85,8 +85,19 @@ from torchpruner_tpu.analysis.cost_model import (
     predict_programs,
     predict_record,
     record_config_predictions,
+    record_hbm_prediction,
 )
-from torchpruner_tpu.analysis.runner import lint_config, lint_preset
+from torchpruner_tpu.analysis.planner import (
+    enumerate_candidates,
+    format_plan,
+    plan_auto,
+    probe_candidate,
+)
+from torchpruner_tpu.analysis.runner import (
+    lint_config,
+    lint_preset,
+    plan_preset,
+)
 
 __all__ = [
     "Finding", "LintReport", "SeverityConfig", "severity_config",
@@ -98,5 +109,8 @@ __all__ = [
     "build_programs",
     "predict_record", "predict_programs", "cost_findings",
     "device_peaks", "record_config_predictions",
-    "lint_config", "lint_preset",
+    "record_hbm_prediction",
+    "plan_auto", "enumerate_candidates", "probe_candidate",
+    "format_plan",
+    "lint_config", "lint_preset", "plan_preset",
 ]

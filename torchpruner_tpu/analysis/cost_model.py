@@ -232,9 +232,52 @@ def record_config_predictions(cfg, model=None) -> List[CostPrediction]:
                 programs=("train_step", "capture", "decode", "prefill"))
             preds = predict_programs(records)
             record_gauges(preds)
+            record_hbm_prediction(cfg, model)
         return preds
     except Exception:  # noqa: BLE001 — telemetry must never kill a run
         return []
+
+
+def record_hbm_prediction(cfg, model=None) -> Optional[int]:
+    """The HBM twin of the step-time gauges: predicted per-chip
+    watermark (``utils.flops.predicted_hbm_bytes_per_chip``) at the
+    config's own placement, landed as the
+    ``predicted_hbm_bytes_per_chip`` gauge — so ``obs diff`` carries
+    HBM drift (``predicted_vs_measured_hbm_pct`` against the live
+    device watermark) the same way it carries step-time drift.  Same
+    best-effort contract as every other telemetry hook."""
+    import jax.numpy as jnp
+
+    from torchpruner_tpu import obs
+
+    if obs.get() is None:
+        return None
+    try:
+        from torchpruner_tpu.experiments.prune_retrain import (
+            MODEL_REGISTRY,
+            make_optimizer,
+        )
+        from torchpruner_tpu.utils.flops import predicted_hbm_bytes_per_chip
+
+        if model is None:
+            model = MODEL_REGISTRY[cfg.model][0]()
+        data = max(1, (cfg.mesh or {}).get("data", 1))
+        hbm = predicted_hbm_bytes_per_chip(
+            model, cfg.mesh or {},
+            partition=cfg.partition, zero=cfg.zero,
+            tx=make_optimizer(cfg),
+            batch_per_chip=max(
+                1, cfg.batch_size // data // max(1, cfg.accum_steps)),
+            compute_dtype=jnp.bfloat16
+            if cfg.compute_dtype == "bfloat16" else None,
+            remat=cfg.remat,
+        )
+        obs.gauge_set("predicted_hbm_bytes_per_chip", hbm,
+                      help="static cost-model predicted per-chip HBM "
+                           "watermark (bytes)")
+        return int(hbm)
+    except Exception:  # noqa: BLE001
+        return None
 
 
 def predict_decode(model, *, n_slots: int, max_len: int,

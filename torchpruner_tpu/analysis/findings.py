@@ -34,9 +34,11 @@ class Finding:
     """One diagnosed hazard.
 
     ``lint`` names the pass (``"plan"`` | ``"sharding"`` | ``"jaxpr"`` |
-    ``"collective"`` | ``"cost"``), ``check`` is the stable id severity
-    overrides key on, ``path`` the pytree path / layer path / jaxpr site
-    / program name the finding anchors to.
+    ``"collective"`` | ``"cost"`` | ``"planner"`` — the last being the
+    auto-parallelism planner's candidate-exclusion findings,
+    analysis/planner.py), ``check`` is the stable id severity overrides
+    key on, ``path`` the pytree path / layer path / jaxpr site /
+    program name / candidate label the finding anchors to.
     """
 
     severity: str

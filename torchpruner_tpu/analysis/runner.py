@@ -205,3 +205,15 @@ def lint_preset(name: str, smoke: bool = False, **kw) -> LintReport:
     from torchpruner_tpu.experiments.presets import get_preset
 
     return lint_config(get_preset(name, smoke=smoke), **kw)
+
+
+def plan_preset(name: str, smoke: bool = False, **kw) -> dict:
+    """``analysis.planner.plan_auto`` over a named preset — the search
+    twin of :func:`lint_preset` (lint answers "is this config sound",
+    the planner answers "which config should it be").  Returns the plan
+    artifact dict; ``kw`` passes through (``probe_top``,
+    ``n_devices``, ``hbm_budget``, ...)."""
+    from torchpruner_tpu.analysis.planner import plan_auto
+    from torchpruner_tpu.experiments.presets import get_preset
+
+    return plan_auto(get_preset(name, smoke=smoke), **kw)

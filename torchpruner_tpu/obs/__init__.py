@@ -633,6 +633,17 @@ def record_serve(*, kind: str, **fields) -> None:
         s.ledger.record({"event": "serve", "kind": kind, **fields})
 
 
+def record_plan(**fields) -> None:
+    """Ledger one auto-parallelism planner run (analysis/planner.py):
+    the winner/baseline labels, candidate/feasible counts, predicted
+    margins, and the winner's probe drift — the provenance behind a
+    config the planner chose, rendered by ``obs report``'s plan
+    section.  Informational records — never deduped."""
+    s = _session
+    if s is not None and s.ledger is not None:
+        s.ledger.record({"event": "plan", **fields})
+
+
 def ledger_backfill(records, kind: str = "round") -> int:
     """Rehydrate ledger records from a RunManifest history on resume
     (``kind`` = "round" | "epoch") — keeps the ledger continuous when a

@@ -324,6 +324,7 @@ def build_report(*, run_meta: Optional[Dict[str, Any]] = None,
         "scores": picked("scores"),
         "prunes": picked("prune"),
         "serve": picked("serve"),
+        "plan": picked("plan"),
         "derived": dict(derived or {}),
         "phases": dict(phases or {}),
         "compiles": dict(compiles or {}),

@@ -283,7 +283,12 @@ class StepTelemetry:
 def record_device_memory(registry: MetricsRegistry) -> Dict[str, int]:
     """Best-effort per-device live-bytes gauges (``memory_stats()`` is
     TPU/GPU-only; absent stats leave the gauges untouched).  Returns the
-    bytes read, keyed ``hbm_bytes_in_use{device}``."""
+    bytes read, keyed ``hbm_bytes_in_use{device}``.  Alongside the
+    instantaneous gauge, ``hbm_bytes_peak_device{d}`` records the
+    runtime's ``peak_bytes_in_use`` high-water mark — the quantity the
+    static HBM watermark prediction is compared against (the
+    ``predicted_vs_measured_hbm_pct`` drift scalar; an end-of-run
+    instantaneous reading has already freed the activation peak)."""
     out: Dict[str, int] = {}
     try:
         import jax
@@ -298,6 +303,10 @@ def record_device_memory(registry: MetricsRegistry) -> Dict[str, int]:
             name = f"hbm_bytes_in_use_device{d.id}"
             registry.gauge(name, "live device bytes").set(b)
             out[name] = int(b)
+            peak = stats.get("peak_bytes_in_use")
+            if peak is not None:
+                registry.gauge(f"hbm_bytes_peak_device{d.id}",
+                               "device bytes high-water mark").set(peak)
     except Exception:
         pass
     return out

@@ -87,8 +87,10 @@ _SCALARS = {
 #: experiments.zero_bench / the bench ``zero`` leg; ``predicted_*``
 #: are the static cost model's step/comm predictions plus the
 #: prediction-vs-measured drift rows computed in ``_scalars_of``)
+#: ``plan_*`` are the auto-parallelism planner's candidate/winner
+#: gauges (analysis/planner.py)
 _DYNAMIC_SCALAR_PREFIXES = ("kernel_", "serve_slo_breach", "zero_",
-                            "predicted_")
+                            "predicted_", "plan_")
 _DYNAMIC_EXTRA = ("profile_coverage", "profile_windows_total",
                   "profile_steps_total")
 
@@ -228,6 +230,19 @@ def _scalars_of(report: Dict[str, Any]) -> Dict[str, Optional[float]]:
     if pred_d is not None and meas_d:
         out["predicted_vs_measured_decode_pct"] = (
             100.0 * (pred_d - 1e3 * meas_d) / (1e3 * meas_d))
+    # HBM drift: the static watermark prediction against the device
+    # HIGH-WATER gauge (peak_bytes_in_use — an instantaneous end-of-run
+    # reading has already freed the activation peak and would show a
+    # large spurious drift).  TPU/GPU only: memory_stats() is absent on
+    # CPU, where the predicted gauge still rides the diff alone.
+    pred_h = _finite(metrics.get("predicted_hbm_bytes_per_chip"))
+    meas_h = max(
+        (v for k, v in (metrics or {}).items()
+         if k.startswith("hbm_bytes_peak_device")
+         and _finite(v) is not None), default=None)
+    if pred_h is not None and meas_h:
+        out["predicted_vs_measured_hbm_pct"] = (
+            100.0 * (pred_h - meas_h) / meas_h)
     return out
 
 
@@ -303,8 +318,43 @@ def format_report(report: Dict[str, Any]) -> str:
         if m and drift is not None:
             bit += f" vs {meas_scale * m:.3f} ms measured ({drift:+.0f}%)"
         preds.append(bit)
+    pred_hbm = _finite(metrics.get("predicted_hbm_bytes_per_chip"))
+    if pred_hbm is not None:
+        bit = f"hbm {pred_hbm / 2**30:.3f} GiB/chip predicted"
+        if sc.get("predicted_vs_measured_hbm_pct") is not None:
+            bit += f" ({sc['predicted_vs_measured_hbm_pct']:+.0f}% " \
+                   f"vs peak watermark)"
+        preds.append(bit)
     if preds:
         lines.append("cost model: " + ", ".join(preds))
+        lines.append("")
+
+    # auto-parallelism planner (analysis/planner.py): the chosen config,
+    # its predicted margins, and the winner's probe drift
+    plans = report.get("plan") or []
+    if plans:
+        p = plans[-1]
+        bits = []
+        if p.get("winner"):
+            bits.append(f"winner `{p['winner']}`")
+        if p.get("margin_over_runner_up_pct") is not None:
+            bits.append(
+                f"{p['margin_over_runner_up_pct']:+.1f}% over runner-up")
+        if p.get("margin_over_baseline_pct") is not None:
+            bits.append(f"{p['margin_over_baseline_pct']:+.1f}% over "
+                        f"baseline `{p.get('baseline')}`")
+        if p.get("feasible") is not None:
+            bits.append(f"{p['feasible']}/{p.get('candidates')} "
+                        f"candidates feasible")
+        wp = p.get("winner_predicted") or {}
+        if wp.get("step_ms") is not None:
+            bits.append(f"predicted {wp['step_ms']:.3f} ms/step "
+                        f"({wp.get('bound', '?')}-bound)")
+        probe = p.get("winner_probe") or {}
+        if probe.get("drift_pct") is not None:
+            bits.append(f"probe drift {probe['drift_pct']:+.0f}%"
+                        + (" GATED" if probe.get("gated") else ""))
+        lines.append("plan: " + ", ".join(bits))
         lines.append("")
 
     rounds = report.get("rounds") or []

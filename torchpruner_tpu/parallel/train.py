@@ -90,6 +90,34 @@ def plan_placements(model, params, state, opt_state, tx, mesh,
     return ps, ss, os_, zs
 
 
+def mesh_factorizations(n_devices: int, *, data_axis: str = "data",
+                        model_axis: str = "model",
+                        max_model: int = None) -> list:
+    """Every 2-axis factorization of ``n_devices`` into
+    ``{data: d, model: m}`` with ``d*m == n_devices`` — the mesh half of
+    the planner's candidate space (analysis/planner.py).  Ordered
+    data-major (pure DP first, pure model-parallel last); the pure-DP
+    entry omits the degenerate ``model: 1`` axis so the candidate config
+    round-trips through the same validation the hand-written presets
+    use.  ``max_model`` bounds the model axis (attention-head counts
+    rarely divide very wide TP).  Every returned mesh is a valid input
+    to :func:`plan_placements` — the enumeration and the placement
+    planner share one config vocabulary by construction."""
+    out = []
+    n = max(1, int(n_devices))
+    for m in range(1, n + 1):
+        if n % m:
+            continue
+        if max_model is not None and m > max_model:
+            break
+        d = n // m
+        if m == 1:
+            out.append({data_axis: d})
+        else:
+            out.append({data_axis: d, model_axis: m})
+    return out
+
+
 def make_sharded_train_step(
     model: SegmentedModel,
     tx,

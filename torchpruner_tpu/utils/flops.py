@@ -35,10 +35,12 @@ def model_cost(
 
     flops = None
     try:
+        # the ONE cost_analysis() normalizer (the return type changed
+        # shape across jax releases) — every consumer routes through it
+        from torchpruner_tpu.analysis.cost_model import cost_analysis_dict
+
         compiled = jax.jit(fwd).lower(params, state, x).compile()
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
+        ca = cost_analysis_dict(compiled)
         if ca:
             flops = float(ca.get("flops", 0.0)) or None
     except Exception:  # cost analysis is best-effort on some backends
@@ -147,6 +149,87 @@ PEAK_ICI_BYTES_PER_S = {
 #: env-overridable (TORCHPRUNER_COST_CPU_FLOPS / _BW / _ICI); on-chip
 #: predictions never consult these.
 CPU_COST_DEFAULTS = {"flops": 5e10, "hbm": 2e10, "ici": 1e10}
+
+#: Deterministic stand-in HBM capacity for hosts whose device kind has
+#: no spec-sheet entry (the CPU backend) — big enough that smoke
+#: configs are never spuriously infeasible, small enough that a planted
+#: budget (TORCHPRUNER_PLAN_HBM_BYTES) can undercut it in tests.
+CPU_HBM_CAPACITY_BYTES = 8 * 2 ** 30
+
+#: env override for the per-chip HBM capacity the planner budgets
+#: against — the planted-infeasible CI drill shrinks it to prove the
+#: planner excludes over-budget candidates loudly.
+PLAN_HBM_ENV = "TORCHPRUNER_PLAN_HBM_BYTES"
+
+
+def hbm_capacity(device=None) -> float:
+    """Per-chip HBM capacity in bytes for ``device`` (a Device, a
+    device-kind string, or None for this host's first device) — the
+    denominator of the planner's feasibility gate.  Spec-sheet table
+    (``parallel.memory.HBM_BYTES``) by device-kind prefix; unknown kinds
+    (the CPU backend) fall back to :data:`CPU_HBM_CAPACITY_BYTES`.
+    ``TORCHPRUNER_PLAN_HBM_BYTES`` overrides everything (calibrated
+    hosts, and the CI planted-infeasible drill)."""
+    import os
+
+    env = os.environ.get(PLAN_HBM_ENV)
+    if env:
+        return float(env)
+    from torchpruner_tpu.parallel.memory import HBM_BYTES
+
+    if device is None:
+        device = jax.devices()[0]
+    cap = _by_kind_prefix(HBM_BYTES, device)
+    return float(cap) if cap is not None else float(CPU_HBM_CAPACITY_BYTES)
+
+
+def predicted_hbm_bytes_per_chip(
+    model,
+    mesh_axes: dict,
+    *,
+    partition: str = "fsdp",
+    zero: bool = False,
+    tx=None,
+    batch_per_chip: int = 1,
+    compute_dtype=None,
+    remat: bool = False,
+    params=None,
+    min_shard_size: int = 2 ** 14,
+) -> int:
+    """Predicted per-chip HBM watermark (bytes) for training ``model``
+    at a placement — params + grads + optimizer slots (at their ZeRO
+    placement when ``zero``) + the coarse activation estimate, all from
+    ``parallel.memory.training_memory`` over an ``AbstractMesh`` (pure
+    shape math, no devices, no materialized parameter).
+
+    This is the static HBM twin of the cost model's predicted step
+    time: it lands as the ``predicted_hbm_bytes_per_chip`` gauge next
+    to ``predicted_step_ms`` in every run's report.json, and it is the
+    number the planner's feasibility gate compares against
+    :func:`hbm_capacity`.  ``mesh_axes`` may be empty (single-device
+    placement: everything replicated-on-one-chip)."""
+    from torchpruner_tpu.analysis.sharding_lint import abstract_mesh
+    from torchpruner_tpu.parallel.memory import training_memory
+    from torchpruner_tpu.parallel.sharding import fsdp_sharding, tp_sharding
+
+    axes = dict(mesh_axes or {"data": 1})
+    if "data" not in axes:
+        axes["data"] = 1
+    mesh = abstract_mesh(axes)
+    if params is None:
+        from torchpruner_tpu.analysis.plan_lint import abstract_trees
+
+        params, _ = abstract_trees(model)
+    if partition == "tp" and "model" in axes:
+        sh = tp_sharding(model, params, mesh, min_size=min_shard_size)
+    else:
+        sh = fsdp_sharding(params, mesh, min_size=min_shard_size)
+    budget = training_memory(
+        model, sh, axes, tx=tx, batch_per_chip=max(1, batch_per_chip),
+        compute_dtype=compute_dtype, remat=remat, params=params,
+        zero=zero,
+    )
+    return int(budget.total_bytes)
 
 
 def _by_kind_prefix(table: dict, device) -> float | None:
