@@ -125,6 +125,7 @@ _LEG_EST_S = {
     "mnist_prune": (150, 520),
     "resilience": (150, 240),
     "plan": (240, 120),
+    "search": (180, 180),
     "zero": (300, 420),
     "vgg16_train": (120, 3600),
     "mfu_llama": (180, 3600),
@@ -1461,6 +1462,56 @@ def _leg_plan(smoke: bool) -> dict:
     return out
 
 
+def _leg_search(smoke: bool) -> dict:
+    """Leg: the Pareto sparsity-search campaign driver (search/) on the
+    digits_smoke grid — zero-to-frontier wall time, candidate/excluded/
+    early-stopped counts, and the frontier's best-accuracy-at-FLOPs
+    buckets.  The leg measures the CAMPAIGN machinery (pre-pricing,
+    concurrent workers, dominance early-stop, frontier assembly), not
+    any single trial: its wall is what 'run the experiment campaign'
+    costs end to end on this host."""
+    import shutil
+    import tempfile
+
+    from torchpruner_tpu.search.driver import run_campaign
+    from torchpruner_tpu.search.grid import digits_smoke
+
+    spec = digits_smoke()
+    campaign_dir = tempfile.mkdtemp(prefix="bench_search_")
+    t0 = time.perf_counter()
+    try:
+        fr = run_campaign(spec, campaign_dir, cpu=True, verbose=False)
+    finally:
+        shutil.rmtree(campaign_dir, ignore_errors=True)
+    wall = time.perf_counter() - t0
+    c = fr["counts"]
+    out = {
+        "value": round(wall, 3),
+        "unit": "s (campaign wall, zero to frontier)",
+        "campaign": fr["campaign"],
+        "trials": c["trials"],
+        "completed": c["completed"],
+        "non_dominated": c["non_dominated"],
+        "early_stopped": c["early_stopped"],
+        "excluded_by_pricing": c["excluded"],
+        "failed": c["failed"],
+        "frontier_digest": fr["frontier_digest"][:12],
+        "buckets": dict(fr["buckets"]),
+    }
+    accs = [p["accuracy"] for p in fr["points"]
+            if p.get("accuracy") is not None]
+    if accs:
+        out["best_acc"] = max(accs)
+    try:
+        from torchpruner_tpu import obs
+
+        obs.gauge_set("search_campaign_wall_s", wall,
+                      help="search: digits_smoke campaign wall (s)")
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
 def _leg_ok(legs: dict, name: str) -> bool:
     return (name in legs and "error" not in legs[name]
             and "skipped" not in legs[name]
@@ -1667,6 +1718,11 @@ def main() -> dict:
     # planner search: cheap on every platform (static pricing; probes
     # only on TPU) and the config it proposes frames the train legs below
     run_leg("plan", _leg_plan)
+    # sparsity-search campaign: the digits_smoke grid end to end
+    # (pre-pricing -> concurrent prune-retrain workers -> dominance
+    # early-stop -> frontier artifact); CPU-cheap, and the campaign wall
+    # is the number ROADMAP item 4's fleet scheduling starts from
+    run_leg("search", _leg_search)
     if on_tpu or smoke or "--all-legs" in sys.argv:
         # cheap legs first, the long full-sweep leg last: if the child is
         # killed mid-run, the streamed snapshots hold the most

@@ -226,6 +226,43 @@ if mfu <= 0.25:
         f"the cost model does NOT explain the plateau")
 EOF
 
+# 4d. STAGED ASSERTION (ISSUE 12 acceptance, the search campaign):
+#     the digits_smoke sparsity-search campaign ON CHIP — the driver
+#     runs chip-less (JAX_PLATFORMS=cpu: pricing is static) and each
+#     worker gets one TPU core (--trial-devices 1 slices
+#     TPU_VISIBLE_DEVICES per slot).  Must hold: the cost-model
+#     pre-pricing excludes >=1 candidate BY NAME before anything
+#     compiles, and the final frontier carries >=5 measured points,
+#     each with checkpoint-digest + ledger provenance.  A miss is loud
+#     but does not abort the capture.
+JAX_PLATFORMS=cpu timeout 1800 python -m torchpruner_tpu search \
+    digits_smoke --jobs 2 --trial-devices 1 \
+    --campaign-dir "logs/search_tpu_${stamp}" \
+    > "results/search_tpu_${stamp}_${commit}.txt" \
+    2> "logs/search_${stamp}.err" \
+    && python - "logs/search_tpu_${stamp}" \
+        "results/search_tpu_${stamp}_${commit}.txt" <<'EOF' \
+    && echo "[capture] on-chip search campaign assertions HOLD" \
+    || echo "[capture] on-chip search campaign assertions FAILED — diagnose frontier.json before citing campaign claims"
+import json, sys
+fr = json.load(open(f"{sys.argv[1]}/frontier.json"))
+out = open(sys.argv[2]).read()
+excl = fr["excluded"]
+assert excl, "pre-pricing excluded nothing"
+for e in excl:
+    assert f"- `{e['trial_id']}` [{e['excluded_by']}]:" in out, \
+        f"exclusion of {e['trial_id']} not printed by name"
+pts = [p for p in fr["points"]
+       if p["accuracy"] is not None and p["flops"]]
+assert len(pts) >= 5, f"only {len(pts)} measured frontier points"
+assert all(p["checkpoint_digest"] and p["ledger_run_id"] for p in pts)
+print(f"on-chip campaign: {len(pts)} measured points, "
+      f"{fr['counts']['early_stopped']} early-stopped, "
+      f"excluded by name: {[e['trial_id'] for e in excl]}")
+EOF
+cp "logs/search_tpu_${stamp}/frontier.json" \
+    "results/frontier_tpu_${stamp}_${commit}.json" 2>/dev/null || true
+
 # 5. kernel-level profile leg (obs.profile): continuous capture windows
 #    over a short mfu_llama train run — the on-chip per-kernel table +
 #    roofline positions ROADMAP item 2's retune reads, plus a fresh

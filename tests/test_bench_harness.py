@@ -32,6 +32,10 @@ def bench(monkeypatch, tmp_path):
     # search — in-process it compiles ~25 candidate programs)
     monkeypatch.setattr(mod, "_leg_plan",
                         lambda smoke: {"value": 0.1, "unit": "s"})
+    # and the sparsity-search campaign leg (tests/test_search.py owns
+    # the real driver — it spawns worker subprocesses)
+    monkeypatch.setattr(mod, "_leg_search",
+                        lambda smoke: {"value": 0.1, "unit": "s"})
     return mod
 
 
@@ -58,16 +62,19 @@ def test_partial_record_written_after_every_leg(bench, monkeypatch):
     monkeypatch.setattr(bench, "_leg_llama_decode",
                         stub("llama_decode", 2.0))
     monkeypatch.setattr(bench, "_leg_serve", stub("serve", 3.0))
+    monkeypatch.setattr(bench, "_leg_search", stub("search", 0.9))
     monkeypatch.setattr(sys, "argv", ["bench.py", "--run", "--cpu", "--no-cache"])
     out = bench.main()
-    assert calls == ["mnist_prune", "resilience", "plan",
+    assert calls == ["mnist_prune", "resilience", "plan", "search",
                      "llama_decode", "serve"]
     # each later leg saw the earlier legs' records already persisted
     assert disk_at_call == [None, ["mnist_prune"],
                             ["mnist_prune", "resilience"],
                             ["mnist_prune", "resilience", "plan"],
                             ["mnist_prune", "resilience", "plan",
-                             "llama_decode"]]
+                             "search"],
+                            ["mnist_prune", "resilience", "plan",
+                             "search", "llama_decode"]]
     part = json.load(open(bench.PARTIAL_PATH))
     assert list(part["legs"]) == calls
     assert part["platform"] == "cpu"
@@ -110,8 +117,8 @@ def test_snapshot_streamed_after_every_leg(bench, monkeypatch, capsys):
     out = bench.main()
     lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
     snaps = [json.loads(ln) for ln in lines]
-    # one per leg (mnist, resilience, plan, decode, serve)
-    assert len(snaps) == 5
+    # one per leg (mnist, resilience, plan, search, decode, serve)
+    assert len(snaps) == 6
     for snap in snaps:
         assert snap["stream"] == "in_progress"
         assert {"metric", "value", "unit", "vs_baseline", "legs"} <= set(snap)
@@ -119,7 +126,8 @@ def test_snapshot_streamed_after_every_leg(bench, monkeypatch, capsys):
     assert snaps[0]["metric"] == "mnist_fc_shapley_prune_wall_clock"
     assert snaps[0]["value"] == 1.5
     assert list(snaps[-1]["legs"]) == ["mnist_prune", "resilience",
-                                       "plan", "llama_decode", "serve"]
+                                       "plan", "search", "llama_decode",
+                                       "serve"]
     assert out["value"] == 1.5 and "stream" not in out
 
 
@@ -139,13 +147,14 @@ def test_budget_guard_skips_unfinishable_legs(bench, monkeypatch, capsys):
     assert "budget" in out["legs"]["mnist_prune"]["skipped"]
     assert "budget" in out["legs"]["resilience"]["skipped"]
     assert "budget" in out["legs"]["plan"]["skipped"]
+    assert "budget" in out["legs"]["search"]["skipped"]
     assert "budget" in out["legs"]["llama_decode"]["skipped"]
     assert "budget" in out["legs"]["serve"]["skipped"]
     assert out["value"] is None  # skipped legs never fake a headline
     # ...but the skip decisions themselves were streamed
     snaps = [json.loads(ln)
              for ln in capsys.readouterr().out.splitlines() if ln.strip()]
-    assert len(snaps) == 5
+    assert len(snaps) == 6
 
 
 def test_leg_progress_checkpoints_are_streamed(bench, monkeypatch, capsys):

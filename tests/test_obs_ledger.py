@@ -95,10 +95,11 @@ def test_recorder_dedupes_in_session_and_scopes_view_per_run(tmp_path):
     assert rec2.rounds() == []
     assert rec2.record_round(target="fc1", round=0, n_dropped=9)
     assert [r["n_dropped"] for r in rec2.rounds()] == [9]
-    # ...but can ADOPT a prior record explicitly (the resume bridge)
-    assert rec2.adopt(("round", "fc2", 1))
-    assert not rec2.adopt(("round", "fc2", 1))      # once
-    assert not rec2.adopt(("round", "nothere", 0))  # unknown key
+    # ...but can ADOPT a prior record explicitly (the resume bridge;
+    # keys carry the trial_id slot — None outside campaigns)
+    assert rec2.adopt(("round", None, "fc2", 1))
+    assert not rec2.adopt(("round", None, "fc2", 1))      # once
+    assert not rec2.adopt(("round", None, "nothere", 0))  # unknown key
     assert [r["target"] for r in rec2.rounds()] == ["fc1", "fc2"]
     assert rec2.rounds()[1]["n_dropped"] == 1  # prior payload intact
     rec2.close()
@@ -148,7 +149,7 @@ def test_ledger_tolerates_torn_tail(tmp_path):
     path.write_text('{"event": "round", "target": "a"}\n{"torn')
     rec = ProvenanceRecorder(str(tmp_path))  # opens despite the tear
     # the intact record is adoptable (no round field -> None in key)
-    assert rec.adopt(("round", "a", None))
+    assert rec.adopt(("round", None, "a", None))
     rec.close()
 
 

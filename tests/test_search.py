@@ -1,0 +1,644 @@
+"""search/: campaign grids, pre-pricing gates, Pareto dominance, the
+frontier artifact, ledger trial stamping, and (slow lane) the live
+campaign driver with its kill -9 → resume → identical-frontier drill."""
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from torchpruner_tpu.search.driver import (
+    CampaignManifest,
+    run_campaign,
+)
+from torchpruner_tpu.search.frontier import (
+    build_frontier,
+    bucket_scalars,
+    curve_dominated,
+    dominates,
+    frontier_digest,
+    pareto_flags,
+)
+from torchpruner_tpu.search.grid import CampaignSpec, digits_smoke
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# grid
+# ---------------------------------------------------------------------------
+
+
+def test_enumeration_is_deterministic_and_digest_stable():
+    spec = digits_smoke()
+    a = spec.enumerate_trials()
+    b = digits_smoke().enumerate_trials()
+    assert [t.trial_id for t in a] == [t.trial_id for t in b]
+    assert len({t.trial_id for t in a}) == len(a) >= 8
+    # execution knobs are not search identity: a resume may run wider
+    assert dataclasses.replace(spec, jobs=7).digest() == spec.digest()
+    # the search space IS identity
+    assert dataclasses.replace(spec, axes={}).digest() != spec.digest()
+
+
+def test_unknown_trial_field_is_loud():
+    spec = CampaignSpec(name="x", base="mnist_mlp_shapley", smoke=True,
+                        axes={"not_a_field": [1, 2]})
+    with pytest.raises(ValueError, match="not_a_field"):
+        spec.enumerate_trials()
+
+
+def test_trial_config_materializes_overrides(tmp_path):
+    spec = digits_smoke()
+    trial = next(t for t in spec.enumerate_trials()
+                 if t.trial_id.endswith("layerwise"))
+    cfg = spec.trial_config(trial, str(tmp_path / "t"))
+    assert cfg.experiment == "prune_retrain"
+    assert cfg.layer_fractions == {"fc1": 0.25, "fc2": 0.625}
+    assert cfg.run_dir == str(tmp_path / "t")
+    assert cfg.name.startswith("digits_smoke:")
+
+
+def test_campaign_from_json_file_roundtrip(tmp_path):
+    spec = digits_smoke()
+    path = tmp_path / "campaign.json"
+    path.write_text(json.dumps(spec.to_dict()))
+    loaded = CampaignSpec.from_any(str(path))
+    assert loaded.digest() == spec.digest()
+    assert [t.trial_id for t in loaded.enumerate_trials()] == \
+        [t.trial_id for t in spec.enumerate_trials()]
+
+
+def test_unknown_campaign_name_is_loud():
+    with pytest.raises(KeyError, match="digits_smoke"):
+        CampaignSpec.from_any("no_such_campaign")
+
+
+# ---------------------------------------------------------------------------
+# dominance (satellite: isolation/property tests)
+# ---------------------------------------------------------------------------
+
+
+def test_dominates_margin_semantics():
+    # classic Pareto at margin 0: strictly better in one, no worse in
+    # the other
+    assert dominates((10, 0.9), (10, 0.8))
+    assert dominates((5, 0.8), (10, 0.8))
+    assert not dominates((10, 0.8), (10, 0.8))       # exact tie
+    assert not dominates((11, 0.95), (10, 0.8))      # more flops
+    # near-tie margin: within-margin accuracy gaps don't dominate at
+    # equal flops; beyond-margin gaps do
+    assert not dominates((10, 0.81), (10, 0.80), margin=0.02)
+    assert dominates((10, 0.83), (10, 0.80), margin=0.02)
+    # fewer flops at no worse accuracy still dominates under a margin
+    assert dominates((5, 0.80), (10, 0.80), margin=0.02)
+
+
+def test_pareto_flags_order_independent():
+    rng = np.random.default_rng(0)
+    pts = [(float(f), float(a)) for f, a in
+           rng.uniform(0, 1, size=(40, 2))]
+    base = dict(zip(pts, pareto_flags(pts, margin=0.03)))
+    for seed in range(5):
+        perm = list(pts)
+        np.random.default_rng(seed).shuffle(perm)
+        flags = pareto_flags(perm, margin=0.03)
+        assert all(base[p] == fl for p, fl in zip(perm, flags))
+
+
+def test_pareto_near_ties_survive():
+    pts = [(10.0, 0.90), (10.0, 0.89), (10.0, 0.80)]
+    flags = pareto_flags(pts, margin=0.02)
+    # the 0.89 point is within the near-tie margin of 0.90 — a
+    # legitimate run-to-run coin flip stays on the frontier; 0.80 is
+    # beaten beyond the margin and is flagged dominated
+    assert flags == [True, True, False]
+
+
+def test_curve_dominated_is_rung_matched():
+    # the completed trial's FINAL point (5, 0.9) crushes the partial
+    # round-1 point (20, 0.4) — but at the MATCHED rung (round 1) the
+    # completed trial was also at 0.45: within the margin, so a later
+    # round could catch up, and the trial must NOT stop
+    completed = [[(20.0, 0.45), (5.0, 0.9)]]
+    assert not curve_dominated([(20.0, 0.4)], completed, margin=0.1)
+    # a genuinely collapsed trial (chance-level at the same rung) stops
+    assert curve_dominated([(20.0, 0.1)], completed, margin=0.1)
+
+
+def test_curve_dominated_requires_every_rung_beaten():
+    completed = [[(20.0, 0.8), (5.0, 0.9)]]
+    # rung 0 beaten, rung 1 within margin -> no stop
+    assert not curve_dominated([(20.0, 0.2), (5.0, 0.85)], completed,
+                               margin=0.1)
+    # both rungs beaten past the margin -> stop
+    assert curve_dominated([(20.0, 0.2), (5.0, 0.5)], completed,
+                           margin=0.1)
+
+
+def test_curve_dominated_margin_is_strict():
+    completed = [[(10.0, 0.5)]]
+    # beaten by EXACTLY the margin = within confidence -> never stop
+    assert not curve_dominated([(10.0, 0.4)], completed, margin=0.1)
+    assert curve_dominated([(10.0, 0.39)], completed, margin=0.1)
+
+
+def test_curve_dominated_guards():
+    completed = [[(10.0, 0.9)]]
+    assert not curve_dominated([], completed, margin=0.1)
+    assert not curve_dominated([(10.0, 0.1)], [], margin=0.1)
+    assert not curve_dominated([(10.0, 0.1)], completed, margin=0.1,
+                               min_points=2)
+    # a partial curve LONGER than every completed curve has rungs
+    # nobody can judge -> no stop
+    assert not curve_dominated([(10.0, 0.1), (5.0, 0.1)], completed,
+                               margin=0.1)
+    # fewer flops at the matched rung is new Pareto territory -> no stop
+    assert not curve_dominated([(8.0, 0.1)], completed, margin=0.1)
+
+
+# ---------------------------------------------------------------------------
+# pre-pricing gates
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def smoke_pricing(tmp_path_factory):
+    from torchpruner_tpu.search.pricing import price_campaign
+
+    spec = digits_smoke()
+    trials = spec.enumerate_trials()
+    pricing = price_campaign(
+        spec, trials, str(tmp_path_factory.mktemp("camp")))
+    return spec, trials, pricing
+
+
+def test_pricing_excludes_over_budget_by_name(smoke_pricing):
+    from torchpruner_tpu.search.pricing import format_exclusions
+
+    _, _, pricing = smoke_pricing
+    victim = next(tid for tid in pricing if tid.endswith("over_budget"))
+    p = pricing[victim]
+    assert p["excluded_by"] == "cost" and not p["feasible"]
+    assert any("median" in r for r in p["reasons"])
+    # the loud exclusion list names the victim
+    assert f"- `{victim}` [cost]:" in format_exclusions(pricing)
+
+
+def test_pricing_shares_compiles_and_prices_survivors(smoke_pricing):
+    _, _, pricing = smoke_pricing
+    ok = {tid: p for tid, p in pricing.items() if not p["excluded_by"]}
+    assert len(ok) >= 7
+    steps = {p["predicted_step_ms"] for p in ok.values()}
+    # every survivor shares the one train-step program shape -> one
+    # compile, one prediction
+    assert len(steps) == 1
+    for p in ok.values():
+        assert p["predicted_trial_s"] > 0
+        assert p["predicted_hbm_bytes_per_chip"] > 0
+        assert p["n_rounds"] == 2
+
+
+def test_pricing_hbm_gate_via_env(tmp_path, monkeypatch):
+    from torchpruner_tpu.search.pricing import price_campaign
+
+    monkeypatch.setenv("TORCHPRUNER_PLAN_HBM_BYTES", "1024")
+    spec = digits_smoke()
+    trials = spec.enumerate_trials()[:2]
+    pricing = price_campaign(spec, trials, str(tmp_path))
+    for tid, p in pricing.items():
+        assert p["excluded_by"] == "hbm", (tid, p)
+        assert any("watermark" in r for r in p["reasons"])
+
+
+def test_pricing_config_gate_dead_layer_fraction(tmp_path):
+    from torchpruner_tpu.search.grid import TrialSpec
+    from torchpruner_tpu.search.pricing import price_campaign
+
+    spec = digits_smoke()
+    trials = [
+        TrialSpec("t00_dead", {"policy": "fraction",
+                               "layer_fractions": {"conv9": 0.5}}),
+        TrialSpec("t01_bad_frac", {"policy": "fraction",
+                                   "fraction": 1.5}),
+    ]
+    pricing = price_campaign(spec, trials, str(tmp_path))
+    assert pricing["t00_dead"]["excluded_by"] == "config"
+    assert any("conv9" in r for r in pricing["t00_dead"]["reasons"])
+    assert pricing["t01_bad_frac"]["excluded_by"] == "config"
+
+
+def test_pricing_config_gate_non_numeric_fraction(tmp_path):
+    """A null/non-numeric fraction override must exclude THAT candidate
+    loudly — never crash the whole campaign's pricing pass."""
+    from torchpruner_tpu.search.grid import TrialSpec
+    from torchpruner_tpu.search.pricing import price_campaign
+
+    trials = [
+        TrialSpec("t00_null_frac", {"policy": "fraction",
+                                    "fraction": None}),
+        TrialSpec("t01_ok", {"policy": "fraction", "fraction": 0.5}),
+    ]
+    pricing = price_campaign(digits_smoke(), trials, str(tmp_path))
+    assert pricing["t00_null_frac"]["excluded_by"] == "config"
+    assert any("non-numeric" in r
+               for r in pricing["t00_null_frac"]["reasons"])
+    assert pricing["t01_ok"]["feasible"]
+
+
+# ---------------------------------------------------------------------------
+# per-layer fractions (config + prune loop)
+# ---------------------------------------------------------------------------
+
+
+def test_policy_for_target_first_match_wins():
+    from torchpruner_tpu.experiments.prune_retrain import policy_for_target
+    from torchpruner_tpu.utils.config import ExperimentConfig
+
+    cfg = ExperimentConfig(policy="negative", fraction=0.5,
+                           layer_fractions={"fc": 0.25, "fc2": 0.75})
+    assert policy_for_target(cfg, "fc1") == ("fraction", 0.25)
+    # insertion order: "fc" matches fc2 first
+    assert policy_for_target(cfg, "fc2") == ("fraction", 0.25)
+    assert policy_for_target(cfg, "out") == ("negative", 0.5)
+
+
+def test_layer_fractions_validation():
+    from torchpruner_tpu.utils.config import ExperimentConfig
+
+    with pytest.raises(ValueError, match="layer_fractions"):
+        ExperimentConfig(layer_fractions={"fc1": 1.0})
+
+
+def test_prune_retrain_honors_layer_fractions():
+    from torchpruner_tpu.experiments.presets import get_preset
+    from torchpruner_tpu.experiments.prune_retrain import run_prune_retrain
+
+    cfg = dataclasses.replace(
+        get_preset("mnist_mlp_shapley", smoke=True),
+        name="layerfrac_smoke", method="weight_norm", method_kwargs={},
+        policy="fraction", fraction=0.5,
+        layer_fractions={"fc1": 0.25}, finetune_epochs=0,
+    )
+    history = run_prune_retrain(cfg, verbose=False)
+    widths = history[-1].widths
+    # fc1 pruned at its per-layer 0.25, fc2 at the global 0.5
+    assert widths["fc1"] == 48 and widths["fc2"] == 32, widths
+
+
+# ---------------------------------------------------------------------------
+# frontier artifact
+# ---------------------------------------------------------------------------
+
+
+def _fake_manifest_and_results():
+    spec = digits_smoke()
+    manifest = CampaignManifest(
+        name=spec.name, campaign_id=spec.campaign_id,
+        spec_digest=spec.digest(),
+        trials={
+            "t0": {"overrides": {"fraction": 0.25}, "status": "done",
+                   "pricing": {"predicted_step_ms": 0.03,
+                               "predicted_trial_s": 1.0}},
+            "t1": {"overrides": {"fraction": 0.5}, "status": "done"},
+            "t2": {"overrides": {"fraction": 0.5, "lr": 3.0},
+                   "status": "early_stopped"},
+            "t3": {"overrides": {"finetune_epochs": 512},
+                   "status": "excluded",
+                   "pricing": {"excluded_by": "cost",
+                               "reasons": ["512x the median"]}},
+        })
+    results = {
+        "t0": {"final_acc": 0.9, "final_loss": 0.3, "params": 5962,
+               "flops": 24000.0, "rounds": 2, "checkpoint": "ckpt-000002",
+               "checkpoint_digest": "abc123", "ledger_run_id": "c:t0",
+               "curve": [[30000.0, 0.5], [24000.0, 0.9]],
+               "step_time_mean_s": 0.001, "wall_s": 5.0},
+        "t1": {"final_acc": 0.6, "final_loss": 0.9, "params": 3466,
+               "flops": 14000.0, "rounds": 2, "checkpoint": "ckpt-000002",
+               "checkpoint_digest": "def456", "ledger_run_id": "c:t1",
+               "curve": [[20000.0, 0.4], [14000.0, 0.6]],
+               "step_time_mean_s": 0.001, "wall_s": 5.0},
+    }
+    return spec, manifest, results
+
+
+def test_build_frontier_points_counts_and_provenance():
+    spec, manifest, results = _fake_manifest_and_results()
+    fr = build_frontier(spec=spec, manifest=manifest, results=results,
+                        dense_flops=32000.0, margin=0.02)
+    assert fr["counts"] == {"trials": 4, "completed": 2,
+                            "non_dominated": 2, "dominated": 0,
+                            "early_stopped": 1, "excluded": 1,
+                            "failed": 0}
+    by = {p["trial_id"]: p for p in fr["points"]}
+    assert by["t0"]["checkpoint_digest"] == "abc123"
+    assert by["t0"]["ledger_run_id"] == "c:t0"
+    assert by["t0"]["config"] == {"fraction": 0.25}
+    assert fr["early_stopped"] == ["t2"]
+    assert fr["excluded"][0]["trial_id"] == "t3"
+    assert fr["buckets"]["frontier_best_acc_flops_le_50pct"] == 0.6
+    assert fr["buckets"]["frontier_best_acc_flops_le_100pct"] == 0.9
+
+
+def test_frontier_digest_ignores_volatile_fields():
+    spec, manifest, results = _fake_manifest_and_results()
+    fr1 = build_frontier(spec=spec, manifest=manifest, results=results,
+                         dense_flops=32000.0, margin=0.02)
+    # volatile: wall-clock measurements and the commit-counter-shaped
+    # checkpoint NAME (an interrupted trial commits more often)
+    results["t0"] = dict(results["t0"], wall_s=99.0,
+                         step_time_mean_s=0.5, checkpoint="ckpt-000007")
+    fr2 = build_frontier(spec=spec, manifest=manifest, results=results,
+                         dense_flops=32000.0, margin=0.02)
+    assert fr1["frontier_digest"] == fr2["frontier_digest"]
+    # deterministic content: any accuracy change must change the digest
+    results["t0"] = dict(results["t0"], final_acc=0.91)
+    fr3 = build_frontier(spec=spec, manifest=manifest, results=results,
+                         dense_flops=32000.0, margin=0.02)
+    assert fr3["frontier_digest"] != fr1["frontier_digest"]
+    assert frontier_digest(fr3) == fr3["frontier_digest"]
+
+
+def test_bucket_scalars_names_and_values():
+    pts = [{"accuracy": 0.9, "flops": 80.0},
+           {"accuracy": 0.7, "flops": 40.0},
+           {"accuracy": 0.5, "flops": 20.0}]
+    s = bucket_scalars(pts, 100.0, [0.25, 0.5, 1.0])
+    assert s == {"frontier_best_acc_flops_le_25pct": 0.5,
+                 "frontier_best_acc_flops_le_50pct": 0.7,
+                 "frontier_best_acc_flops_le_100pct": 0.9}
+
+
+def test_frontier_gauges_ledger_and_report_section(tmp_path):
+    from torchpruner_tpu import obs
+    from torchpruner_tpu.obs.report import format_report, load_run
+    from torchpruner_tpu.search.frontier import record_obs
+
+    spec, manifest, results = _fake_manifest_and_results()
+    fr = build_frontier(spec=spec, manifest=manifest, results=results,
+                        dense_flops=32000.0, margin=0.02)
+    obs.configure(str(tmp_path))
+    try:
+        record_obs(fr)
+        assert obs.counter_value("frontier_points_total") == 2
+        assert obs.counter_value("frontier_early_stopped_total") == 1
+        assert obs.counter_value(
+            "frontier_best_acc_flops_le_50pct") == 0.6
+    finally:
+        obs.shutdown()
+    rep = load_run(str(tmp_path))
+    assert rep["frontier"], "frontier ledger record missing"
+    assert rep["metrics"]["frontier_best_acc"] == 0.9
+    md = format_report(rep)
+    assert "frontier: 2 point(s), 2 non-dominated" in md
+    assert "`t0`" in md and "abc123"[:12] in md
+    assert "<=50pct=0.6" in md
+
+
+def test_obs_diff_carries_frontier_scalars_and_gates(tmp_path):
+    from torchpruner_tpu.obs.ledger import build_report
+    from torchpruner_tpu.obs.report import check_gates, diff_runs
+
+    def rep(best):
+        return build_report(metrics={
+            "frontier_best_acc": best,
+            "frontier_best_acc_flops_le_50pct": best - 0.2,
+            "search_trials_early_stopped_total": 1,
+        })
+
+    d = diff_runs(rep(0.9), rep(0.7))
+    assert d["scalars"]["frontier_best_acc"]["delta"] == pytest.approx(
+        -0.2)
+    gates = {"frontier_best_acc": {"max_decrease": 0.1},
+             "search_trials_early_stopped_total": {"max_decrease": 0}}
+    v = check_gates(d, gates)
+    assert [x["gate"] for x in v] == ["frontier_best_acc"]
+    assert not check_gates(diff_runs(rep(0.9), rep(0.9)), gates)
+
+
+# ---------------------------------------------------------------------------
+# ledger trial stamping (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_stamps_and_dedups_per_trial(tmp_path):
+    from torchpruner_tpu.obs.ledger import ProvenanceRecorder
+
+    rec = ProvenanceRecorder(str(tmp_path))
+    rec.set_context(trial_id="tA", campaign_id="c1")
+    assert rec.record_round(target="fc1", round=0, post={"acc": 0.5})
+    # same identity within the trial dedups...
+    assert not rec.record_round(target="fc1", round=0,
+                                post={"acc": 0.6})
+    # ...but ANOTHER trial's same-named round coexists
+    rec.set_context(trial_id="tB", campaign_id="c1")
+    assert rec.record_round(target="fc1", round=0, post={"acc": 0.7})
+    rec.close()
+    from torchpruner_tpu.obs.ledger import load_ledger
+
+    rounds = [r for r in load_ledger(str(tmp_path / "ledger.jsonl"))
+              if r.get("event") == "round"]
+    assert [(r["trial_id"], r["campaign_id"]) for r in rounds] == \
+        [("tA", "c1"), ("tB", "c1")]
+
+
+def test_report_groups_rounds_per_trial(tmp_path):
+    from torchpruner_tpu.obs.ledger import build_report
+    from torchpruner_tpu.obs.report import (
+        _rounds_by_label,
+        diff_runs,
+        format_report,
+    )
+
+    rounds = [
+        {"event": "round", "trial_id": "tB", "target": "fc1", "round": 0,
+         "post": {"acc": 0.7}, "pre": {"acc": 0.2}},
+        {"event": "round", "trial_id": "tA", "target": "fc1", "round": 0,
+         "post": {"acc": 0.5}, "pre": {"acc": 0.2}},
+    ]
+    rep = build_report(records=rounds)
+    labels = set(_rounds_by_label(rep))
+    assert labels == {"tA/fc1", "tB/fc1"}
+    md = format_report(rep)
+    assert "| trial " in md and "`tA`" in md and "`tB`" in md
+    # per-trial matching: a diff of the same report has zero missing
+    d = diff_runs(rep, rep)
+    assert set(d["rounds"]) == labels and not d["missing_rounds"]
+    # un-stamped reports keep the pre-campaign rendering (no column)
+    plain = build_report(records=[dict(rounds[0], trial_id=None)])
+    assert "| trial " not in format_report(plain)
+
+
+def test_set_trial_module_hook(tmp_path):
+    from torchpruner_tpu import obs
+    from torchpruner_tpu.obs.report import load_run
+
+    obs.configure(str(tmp_path))
+    try:
+        obs.set_trial("t42", campaign_id="camp-1")
+        obs.record_round(target="fc1", round=0, post={"acc": 0.5})
+        obs.record_trial(trial_id="t42", status="done", accuracy=0.5)
+    finally:
+        obs.shutdown()
+    rep = load_run(str(tmp_path))
+    assert rep["rounds"][0]["trial_id"] == "t42"
+    assert rep["rounds"][0]["campaign_id"] == "camp-1"
+    assert rep["trials"][0]["status"] == "done"
+
+
+# ---------------------------------------------------------------------------
+# campaign manifest
+# ---------------------------------------------------------------------------
+
+
+def test_campaign_manifest_roundtrip_and_kind_check(tmp_path):
+    m = CampaignManifest(name="x", campaign_id="x-1", spec_digest="d",
+                         trials={"t0": {"status": "pending"}})
+    m.save(str(tmp_path))
+    loaded = CampaignManifest.load(str(tmp_path))
+    assert loaded.trials == m.trials and loaded.campaign_id == "x-1"
+    bad = dataclasses.replace(m, kind="serve")
+    bad.save(str(tmp_path))
+    with pytest.raises(ValueError, match="search"):
+        CampaignManifest.load(str(tmp_path))
+
+
+def test_run_campaign_refuses_grid_mismatch(tmp_path):
+    spec = digits_smoke()
+    CampaignManifest(name=spec.name, campaign_id=spec.campaign_id,
+                     spec_digest="somethingelse").save(str(tmp_path))
+    with pytest.raises(ValueError, match="different grid"):
+        run_campaign(spec, str(tmp_path), jobs=1)
+
+
+# ---------------------------------------------------------------------------
+# the live driver (slow lane: subprocess workers, real prune-retrain)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_spec() -> CampaignSpec:
+    """A reduced digits campaign for the in-test driver runs: 3 healthy
+    trials, one doomed (diverging LR, slow enough to be judged), one
+    over-budget — the full gate/early-stop/frontier shape at ~third of
+    the smoke preset's wall."""
+    return CampaignSpec(
+        name="tiny_ci",
+        base="mnist_mlp_shapley",
+        smoke=True,
+        common={"policy": "fraction", "finetune_epochs": 1, "lr": 0.05,
+                "method_kwargs": {}},
+        axes={"method": ["weight_norm"], "fraction": [0.25, 0.5, 0.75]},
+        trials=[
+            {"id": "doomed_lr", "method": "random", "fraction": 0.5,
+             "finetune_epochs": 4, "lr": 3.0},
+            {"id": "over_budget", "method": "weight_norm",
+             "fraction": 0.5, "finetune_epochs": 512},
+        ],
+        jobs=2,
+        early_stop={"margin": 0.15, "min_rounds": 1},
+        max_trial_cost_ratio=16.0,
+    )
+
+
+@pytest.mark.slow
+def test_campaign_end_to_end(tmp_path):
+    from torchpruner_tpu import obs
+    from torchpruner_tpu.obs.report import load_run
+
+    spec = _tiny_spec()
+    obs.configure(str(tmp_path / "obs"))
+    try:
+        fr = run_campaign(spec, str(tmp_path), cpu=True, poll_s=0.2)
+    finally:
+        obs.shutdown()
+    assert fr["counts"]["completed"] == 3
+    assert fr["early_stopped"] == ["t03_doomed_lr"]
+    assert [e["trial_id"] for e in fr["excluded"]] == ["t04_over_budget"]
+    by = {p["trial_id"]: p for p in fr["points"]}
+    for p in by.values():
+        # every point carries config + checkpoint digest + ledger
+        # provenance (the acceptance criterion)
+        assert p["config"].get("fraction") in (0.25, 0.5, 0.75)
+        assert p["checkpoint_digest"] and p["ledger_run_id"]
+        assert p["accuracy"] is not None and p["flops"] > 0
+        assert len(p["curve"]) if "curve" in p else True
+    # the artifact is on disk, digest-consistent, and re-renderable
+    disk = json.load(open(tmp_path / "frontier.json"))
+    assert disk["frontier_digest"] == fr["frontier_digest"]
+    assert frontier_digest(disk) == disk["frontier_digest"]
+    # campaign-level report: frontier section + counters
+    rep = load_run(str(tmp_path / "obs"))
+    assert rep["metrics"]["search_trials_early_stopped_total"] == 1
+    assert rep["metrics"]["search_trials_completed_total"] == 3
+    assert rep["metrics"]["frontier_points_total"] == 3
+    # each trial's own obs dir carries its stamped rounds
+    done = [tid for tid, st in CampaignManifest.load(
+        str(tmp_path)).trials.items() if st["status"] == "done"]
+    one = load_run(os.path.join(str(tmp_path), "trials", done[0], "obs"))
+    assert all(r["trial_id"] == done[0] for r in one["rounds"])
+    assert one["run"]["campaign_id"] == spec.campaign_id
+    # worker output is preserved per trial (failed-trial diagnosis)
+    assert os.path.exists(
+        os.path.join(str(tmp_path), "trials", done[0], "worker.log"))
+
+
+@pytest.mark.slow
+def test_campaign_kill9_resume_reproduces_identical_frontier(tmp_path):
+    """The chaos drill: SIGKILL the driver (and its workers) mid-
+    campaign and mid-early-stop; resuming must reproduce the IDENTICAL
+    frontier an uninterrupted campaign produces."""
+    spec = _tiny_spec()
+    spec_path = tmp_path / "spec.json"
+    spec_path.write_text(json.dumps(spec.to_dict()))
+
+    def cli(dir_, *extra, check=True):
+        r = subprocess.run(
+            [sys.executable, "-m", "torchpruner_tpu", "search",
+             str(spec_path), "--cpu", "--campaign-dir", str(dir_),
+             "--poll-s", "0.2", *extra],
+            capture_output=True, text=True, timeout=900, cwd=REPO)
+        if check:
+            assert r.returncode == 0, r.stderr[-2000:]
+        return r
+
+    # uninterrupted reference
+    cli(tmp_path / "ref")
+    ref = json.load(open(tmp_path / "ref" / "frontier.json"))
+    assert ref["counts"]["early_stopped"] == 1
+
+    # drill 1: kill -9 mid-campaign (after the 2nd completion, queue
+    # still full), then resume
+    killed = cli(tmp_path / "drill", "--chaos",
+                 '{"kill_after_trials": 2}', check=False)
+    assert killed.returncode == -signal.SIGKILL, (
+        killed.returncode, killed.stderr[-1000:])
+    m = CampaignManifest.load(str(tmp_path / "drill"))
+    assert sum(1 for s in m.trials.values()
+               if s["status"] == "done") >= 2
+    assert any(s["status"] in ("pending", "running")
+               for s in m.trials.values())
+    cli(tmp_path / "drill")
+    got = json.load(open(tmp_path / "drill" / "frontier.json"))
+    assert got["frontier_digest"] == ref["frontier_digest"], (
+        got["counts"], ref["counts"])
+
+    # drill 2: kill -9 mid-early-stop (the decision is recorded, the
+    # worker still lives), then resume — the durable decision holds
+    killed = cli(tmp_path / "drill2", "--chaos",
+                 '{"kill_on_early_stop": true}', check=False)
+    assert killed.returncode == -signal.SIGKILL
+    m = CampaignManifest.load(str(tmp_path / "drill2"))
+    assert any(s["status"] == "early_stop_requested"
+               for s in m.trials.values()), \
+        {t: s["status"] for t, s in m.trials.items()}
+    cli(tmp_path / "drill2")
+    got2 = json.load(open(tmp_path / "drill2" / "frontier.json"))
+    assert got2["frontier_digest"] == ref["frontier_digest"]
+    m = CampaignManifest.load(str(tmp_path / "drill2"))
+    assert m.trials["t03_doomed_lr"]["status"] == "early_stopped"

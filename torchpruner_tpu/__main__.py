@@ -14,6 +14,7 @@ Examples::
     python -m torchpruner_tpu vgg16_layerwise --plan auto --plan-probe 2
     python -m torchpruner_tpu vgg16_layerwise --plan report
     python -m torchpruner_tpu serve llama3_ffn_taylor --smoke --synthetic 16
+    python -m torchpruner_tpu search digits_smoke --jobs 2
     python -m torchpruner_tpu obs report logs/obs
     python -m torchpruner_tpu --preset mnist_mlp_shapley --smoke \\
         --obs-dir logs/obs --profile-every 20
@@ -43,11 +44,21 @@ def main(argv=None) -> int:
         from torchpruner_tpu.serve.frontend import serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "search":
+        # Pareto sparsity-search campaign driver (search.driver):
+        # `python -m torchpruner_tpu search <campaign> [--jobs N]
+        # [--campaign-dir DIR]` — concurrent prune-retrain trials with
+        # cost-model pre-pricing, dominance early-stop, and a resumable
+        # frontier.json artifact
+        from torchpruner_tpu.search.driver import search_main
+
+        return search_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="torchpruner_tpu",
         description="TPU-native structured pruning experiments "
                     "(subcommands: obs report/diff — run-ledger tooling; "
-                    "serve — continuous-batching inference engine)",
+                    "serve — continuous-batching inference engine; "
+                    "search — Pareto sparsity-search campaign driver)",
     )
     p.add_argument(
         "target", nargs="?", default=None,

@@ -143,6 +143,19 @@ def filter_targets(targets, cfg: ExperimentConfig):
     return [t for t in targets if any(s in t for s in cfg.target_filter)]
 
 
+def policy_for_target(cfg: ExperimentConfig, target: str):
+    """``(policy, fraction)`` for one prune target: a
+    ``cfg.layer_fractions`` substring match (first match wins, insertion
+    order) forces the fraction policy at the mapped per-layer ratio;
+    otherwise the config's global policy/fraction apply.  The one place
+    the per-layer sparsity-search axis resolves, shared by the real and
+    simulated prune paths so provenance can never disagree."""
+    for key, frac in (cfg.layer_fractions or {}).items():
+        if key in target:
+            return "fraction", float(frac)
+    return cfg.policy, cfg.fraction
+
+
 def make_lr_schedule(cfg: ExperimentConfig, steps_per_epoch: int = 1,
                      total_epochs: Optional[int] = None):
     """``cfg.lr_schedule`` as an optax schedule (or the constant lr).
@@ -389,8 +402,9 @@ def _run_prune_retrain(
             # ONE policy evaluation feeds the real prune, the simulated
             # prune, AND the ledger's decision/margin record, so the
             # provenance can never disagree with what was removed
+            policy, fraction = policy_for_target(cfg, target)
             drop_idx = score_drop_indices(
-                scores, policy=cfg.policy, fraction=cfg.fraction,
+                scores, policy=policy, fraction=fraction,
                 bucket=cfg.bucket,
             )
             score_dist = obs.score_distribution(scores, drop_idx)

@@ -72,7 +72,7 @@ __all__ = [
     "request_profile_window", "profile_tick", "profile_step",
     "record_scores", "record_prune", "record_round", "record_epoch",
     "record_sweep_layer", "record_serve", "ledger_backfill",
-    "annotate_run",
+    "annotate_run", "set_trial", "record_trial", "record_frontier",
     "MetricsRegistry", "StepTelemetry",
     "SpanTracer", "SpanRecord", "train_flops_per_step",
     "ProvenanceRecorder", "score_distribution",
@@ -631,6 +631,41 @@ def record_serve(*, kind: str, **fields) -> None:
     s = _session
     if s is not None and s.ledger is not None:
         s.ledger.record({"event": "serve", "kind": kind, **fields})
+
+
+def set_trial(trial_id: Optional[str],
+              campaign_id: Optional[str] = None) -> None:
+    """Stamp every subsequent ledger record with a campaign trial
+    identity (``trial_id``/``campaign_id`` — ``None`` clears).  The
+    search driver's satellite: records from concurrent trials pointed
+    at one shared obs dir stay dedup-keyed and groupable PER TRIAL
+    (``obs report`` renders a trial column; ``obs diff`` matches rounds
+    per trial).  No-op without a session/ledger."""
+    s = _session
+    if s is not None and s.ledger is not None:
+        s.ledger.set_context(trial_id=trial_id, campaign_id=campaign_id)
+
+
+def record_trial(*, trial_id: str, status: str, **fields) -> None:
+    """Ledger one campaign-trial status transition (``status`` =
+    "excluded" | "done" | "early_stopped" | "failed") — the per-trial
+    provenance trail the search driver leaves next to its frontier
+    record.  Deduped per (trial, status)."""
+    s = _session
+    if s is not None and s.ledger is not None:
+        s.ledger.record({"event": "trial", "trial_id": trial_id,
+                         "status": status, **fields})
+
+
+def record_frontier(**fields) -> None:
+    """Ledger one campaign frontier summary (search/frontier.py): the
+    non-dominated point set with provenance digests, dominated/early-
+    stopped/excluded counts, and the FLOPs-bucket best accuracies —
+    rendered by ``obs report``'s frontier section.  Informational —
+    never deduped."""
+    s = _session
+    if s is not None and s.ledger is not None:
+        s.ledger.record({"event": "frontier", **fields})
 
 
 def record_plan(**fields) -> None:

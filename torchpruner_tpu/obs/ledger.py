@@ -107,16 +107,24 @@ def _dedup_key(rec: Dict[str, Any]) -> Optional[Tuple]:
     """The identity under which a record is written at most once.
     ``None`` = always write (informational events may legitimately
     repeat, e.g. a re-scored target after a kill before its prune
-    anchor)."""
+    anchor).  Records stamped with a ``trial_id`` (campaign trials
+    sharing an obs dir) key per trial — concurrent runs' same-named
+    rounds must coexist, not dedup each other; un-stamped records keep
+    their pre-campaign identity (``trial_id`` is just ``None``)."""
     ev = rec.get("event")
+    tid = rec.get("trial_id")
     if ev == "round":
         # round index in the key: iterative schedules prune the SAME
         # layer in several rounds, and each must ledger separately
-        return ("round", rec.get("target"), rec.get("round"))
+        return ("round", tid, rec.get("target"), rec.get("round"))
     if ev == "sweep_layer":
-        return ("sweep_layer", rec.get("layer"))
+        return ("sweep_layer", tid, rec.get("layer"))
     if ev == "epoch":
-        return ("epoch", rec.get("epoch"))
+        return ("epoch", tid, rec.get("epoch"))
+    if ev == "trial":
+        # one status transition per trial per run view (a resumed driver
+        # may re-announce) — keyed on the transition, not the payload
+        return ("trial", tid, rec.get("status"))
     return None
 
 
@@ -150,6 +158,10 @@ class ProvenanceRecorder:
         self.obs_dir = obs_dir
         self.path = os.path.join(obs_dir, LEDGER_FILENAME)
         os.makedirs(obs_dir, exist_ok=True)
+        #: stamped onto every subsequent record (``set_context``): the
+        #: campaign driver sets ``trial_id``/``campaign_id`` here so a
+        #: shared obs dir's records stay groupable per trial
+        self.context: Dict[str, Any] = {}
         #: dedup keys of records in THIS run's view
         self._seen: set = set()
         #: this run's records (report.json's source) — starts empty
@@ -165,14 +177,28 @@ class ProvenanceRecorder:
 
     # -- core --------------------------------------------------------------
 
+    def set_context(self, **fields) -> None:
+        """Install fields stamped onto every later record (``None``
+        values clear).  The campaign driver's satellite: with
+        ``trial_id``/``campaign_id`` stamped, ``obs report`` on a
+        shared obs dir groups rounds per trial instead of dedup-mixing
+        concurrent runs."""
+        for k, v in fields.items():
+            if v is None:
+                self.context.pop(k, None)
+            else:
+                self.context[k] = v
+
     def record(self, rec: Dict[str, Any]) -> bool:
         """Write one record (dedup-checked against THIS run's view).
         Returns False when this run already holds a record of the same
         identity."""
+        rec = dict(rec)
+        for k, v in self.context.items():
+            rec.setdefault(k, v)
         key = _dedup_key(rec)
         if key is not None and key in self._seen:
             return False
-        rec = dict(rec)
         rec.setdefault("ts", time.time())
         try:
             self._f.write(json.dumps(sanitize(rec), default=_jsonable)
@@ -253,7 +279,8 @@ class ProvenanceRecorder:
             target = r.get("layer") or r.get("target")
             if target is None:
                 continue
-            if self.adopt(("round", target, i)):
+            if self.adopt(("round", self.context.get("trial_id"),
+                           target, i)):
                 n += 1
                 continue
             wrote = self.record_round(
@@ -273,7 +300,8 @@ class ProvenanceRecorder:
         for r in records:
             if "epoch" not in r:
                 continue
-            if self.adopt(("epoch", int(r["epoch"]))):
+            if self.adopt(("epoch", self.context.get("trial_id"),
+                           int(r["epoch"]))):
                 n += 1
                 continue
             n += int(self.record_epoch(backfilled=True, **r))
@@ -325,6 +353,8 @@ def build_report(*, run_meta: Optional[Dict[str, Any]] = None,
         "prunes": picked("prune"),
         "serve": picked("serve"),
         "plan": picked("plan"),
+        "trials": picked("trial"),
+        "frontier": picked("frontier"),
         "derived": dict(derived or {}),
         "phases": dict(phases or {}),
         "compiles": dict(compiles or {}),

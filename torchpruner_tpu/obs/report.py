@@ -88,9 +88,13 @@ _SCALARS = {
 #: are the static cost model's step/comm predictions plus the
 #: prediction-vs-measured drift rows computed in ``_scalars_of``)
 #: ``plan_*`` are the auto-parallelism planner's candidate/winner
-#: gauges (analysis/planner.py)
+#: gauges (analysis/planner.py); ``frontier_*`` / ``search_*`` are the
+#: sparsity-search campaign's frontier scalars (best accuracy at fixed
+#: FLOPs buckets, point/early-stop counts — search/frontier.py), the
+#: gates CI holds frontier regressions with
 _DYNAMIC_SCALAR_PREFIXES = ("kernel_", "serve_slo_breach", "zero_",
-                            "predicted_", "plan_")
+                            "predicted_", "plan_", "frontier_",
+                            "search_")
 _DYNAMIC_EXTRA = ("profile_coverage", "profile_windows_total",
                   "profile_steps_total")
 
@@ -357,11 +361,58 @@ def format_report(report: Dict[str, Any]) -> str:
         lines.append("plan: " + ", ".join(bits))
         lines.append("")
 
+    # sparsity-search campaign frontier (search/frontier.py): the non-
+    # dominated point table with dominated / early-stopped / excluded
+    # counts — the section `obs report` renders for a campaign obs dir
+    fronts = report.get("frontier") or []
+    if fronts:
+        fr = fronts[-1]
+        c = fr.get("counts") or {}
+        lines.append(
+            f"frontier: {c.get('completed', 0)} point(s), "
+            f"{c.get('non_dominated', 0)} non-dominated, "
+            f"{c.get('dominated', 0)} dominated, "
+            f"{c.get('early_stopped', 0)} early-stopped, "
+            f"{c.get('excluded', 0)} excluded"
+            + (f" (digest {str(fr.get('digest') or '')[:12]})"
+               if fr.get("digest") else ""))
+        nd = [p for p in (fr.get("points") or [])
+              if p.get("non_dominated")]
+        if nd:
+            lines.append("")
+            lines.append("| trial | acc | flops | params | ckpt digest "
+                         "| ledger run |")
+            lines.append("|---|---|---|---|---|---|")
+            for p in sorted(nd, key=lambda p: p.get("flops") or 0):
+                lines.append(
+                    f"| `{p.get('trial_id')}` | {_f(p.get('accuracy'))} "
+                    f"| {_f(p.get('flops'), '.3g')} "
+                    f"| {_i(p.get('params'))} "
+                    f"| {str(p.get('checkpoint_digest') or '')[:12]} "
+                    f"| {p.get('ledger_run_id') or ''} |")
+        buckets = fr.get("buckets") or {}
+        if buckets:
+            lines.append("")
+            lines.append("buckets: " + ", ".join(
+                f"{k.replace('frontier_best_acc_flops_le_', '<=')}"
+                f"={_f(v)}" for k, v in sorted(buckets.items())))
+        lines.append("")
+
     rounds = report.get("rounds") or []
     if rounds:
-        lines.append("| round | target | method | dropped | pre acc "
-                     "| post acc | Δacc | params | margin | near ties |")
-        lines.append("|---|---|---|---|---|---|---|---|---|---|")
+        # rounds stamped with trial ids (a campaign's shared obs dir)
+        # group per trial — the column only appears when it means
+        # something
+        trialed = any(r.get("trial_id") for r in rounds)
+        trial_col = "| trial " if trialed else ""
+        lines.append(f"{trial_col}| round | target | method | dropped "
+                     "| pre acc | post acc | Δacc | params | margin "
+                     "| near ties |")
+        lines.append("|---" * (10 + int(trialed)) + "|")
+        if trialed:
+            rounds = sorted(
+                rounds, key=lambda r: (str(r.get("trial_id") or ""),
+                                       r.get("round") or 0))
         for i, r in enumerate(rounds):
             pre = (r.get("pre") or {})
             post = (r.get("post") or {})
@@ -369,8 +420,9 @@ def format_report(report: Dict[str, Any]) -> str:
             dacc = (post.get("acc") - pre.get("acc")
                     if post.get("acc") is not None
                     and pre.get("acc") is not None else None)
+            tcell = f"| `{r.get('trial_id') or ''}` " if trialed else ""
             lines.append(
-                f"| {r.get('round', i)} | {r.get('target')} "
+                f"{tcell}| {r.get('round', i)} | {r.get('target')} "
                 f"| {r.get('method', '')} | {_i(r.get('n_dropped'))} "
                 f"| {_f(pre.get('acc'))} | {_f(post.get('acc'))} "
                 f"| {_f(dacc, '+.4f')} | {_i(r.get('params'))} "
@@ -467,7 +519,7 @@ def format_report(report: Dict[str, Any]) -> str:
                 f"| {_f(best[1].get('auc_mean')) if best else ''} |")
         lines.append("")
     if not rounds and not epochs and not sweeps and not serve \
-            and not sc_serve and not kernels:
+            and not sc_serve and not kernels and not fronts:
         lines.append("(no ledger records)")
     return "\n".join(lines)
 
@@ -490,11 +542,16 @@ def _i(v) -> str:
 
 def _rounds_by_label(report: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
     """Rounds keyed by a stable label: the target name, with a ``#k``
-    suffix from the second occurrence on (iterative schedules)."""
+    suffix from the second occurrence on (iterative schedules), and a
+    ``<trial_id>/`` prefix when the record carries a campaign trial
+    stamp — concurrent trials' same-named rounds in one shared obs dir
+    must diff trial-for-trial, never cross-match."""
     out: Dict[str, Dict[str, Any]] = {}
     seen: Dict[str, int] = {}
     for r in (report.get("rounds") or []):
         target = str(r.get("target"))
+        if r.get("trial_id"):
+            target = f"{r['trial_id']}/{target}"
         k = seen.get(target, 0)
         seen[target] = k + 1
         out[target if k == 0 else f"{target}#{k}"] = r

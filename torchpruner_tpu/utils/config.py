@@ -39,6 +39,13 @@ class ExperimentConfig:
     # pruning schedule
     policy: str = "negative"         # negative|fraction
     fraction: float = 0.5
+    #: per-layer prune-fraction overrides (substring match against the
+    #: target name, like target_filter; FIRST match wins in insertion
+    #: order).  A matching target prunes by the fraction policy at the
+    #: mapped fraction regardless of ``policy``; non-matching targets
+    #: keep ``policy``/``fraction``.  The sparsity-search campaign's
+    #: per-layer-ratio axis (search/grid.py)
+    layer_fractions: Dict[str, float] = field(default_factory=dict)
     bucket: int = 1                  # round kept widths up to a multiple
                                      # (8/128 = TPU sublane/lane alignment;
                                      # bounds recompile diversity)
@@ -196,6 +203,12 @@ class ExperimentConfig:
                 "zero=True shards the weight update over the mesh's "
                 "'data' axis — set mesh={'data': N, ...} (N > 1) too"
             )
+        for k, v in (self.layer_fractions or {}).items():
+            if not 0.0 <= float(v) < 1.0:
+                raise ValueError(
+                    f"layer_fractions[{k!r}] = {v} is outside [0, 1) — "
+                    "a fraction of 1 would empty the layer"
+                )
         for fld in ("compute_dtype", "score_dtype"):
             if getattr(self, fld) not in ("float32", "bfloat16"):
                 raise ValueError(
