@@ -131,6 +131,7 @@ _LEG_EST_S = {
     "mfu_llama": (180, 3600),
     "llama_decode": (180, 300),
     "serve": (240, 300),
+    "fleet": (180, 180),
     "flash_attention": (60, 600),
     "blocksparse": (90, 300),
     "vgg16_robustness": (1500, 100000),
@@ -1233,6 +1234,51 @@ def _leg_serve(smoke: bool, progress=None) -> dict:
     return result
 
 
+def _leg_fleet(smoke: bool) -> dict:
+    """Leg: the kill -9 failover drill on the multi-replica serving
+    plane (torchpruner_tpu.fleet) — 3 subprocess replicas under
+    open-loop Poisson load, one SIGKILLed mid-stream; the journaled
+    queue must redrive to the survivors with zero accepted-request
+    loss and every completed request bit-identical to solo decode
+    (--verify).  Value = drill wall seconds; the real products are the
+    failover/redrive counters and the zero-loss invariant.  Always a
+    CPU subprocess drill: N replicas sharing one chip would measure
+    contention, not failover."""
+    import json as _json
+    import subprocess
+    import tempfile
+
+    n = 12 if smoke else 24
+    fleet_dir = tempfile.mkdtemp(prefix="bench_fleet_")
+    t0 = time.perf_counter()
+    r = subprocess.run(
+        [sys.executable, "-m", "torchpruner_tpu", "fleet", "llama_tiny",
+         "--cpu", "--replicas", "3", "--slots", "2", "--max-len", "96",
+         "--synthetic", str(n), "--rate", "3.0", "--verify",
+         "--prompt-lens", "4,8", "--max-new", "8,12",
+         "--fleet-dir", fleet_dir,
+         "--chaos", '{"kill_replica_at_step": 5}'],
+        capture_output=True, text=True, timeout=900)
+    wall = time.perf_counter() - t0
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"fleet drill exited {r.returncode}: {r.stderr[-500:]}")
+    s = _json.loads([l for l in r.stdout.splitlines()
+                     if l.startswith("{")][-1])
+    assert s["lost"] == 0 and s["verify_mismatches"] == 0, s
+    return {
+        "value": round(wall, 2),
+        "unit": "s (kill -9 failover drill wall)",
+        "requests": s["requests"],
+        "completed": s["completed"],
+        "failovers": s["failovers"],
+        "redrives": s["redrives"],
+        "shed": s["shed"],
+        "verify_mismatches": s["verify_mismatches"],
+        "killed": s["killed"],
+    }
+
+
 def _leg_resilience(smoke: bool) -> dict:
     """Leg: chaos drill — every resilience recovery path exercised and
     timed on the digits smoke preset (torchpruner_tpu.resilience):
@@ -1734,6 +1780,9 @@ def main() -> dict:
         run_leg("blocksparse", _leg_blocksparse)
         run_leg("llama_decode", _leg_llama_decode)
         run_leg("serve", _leg_serve)
+        # fleet failover drill: CPU subprocesses on every platform (the
+        # drill measures the serving PLANE's robustness, not the chip)
+        run_leg("fleet", _leg_fleet)
         run_leg("vgg16_robustness", _leg_vgg_robustness)
     else:
         # CPU fallback: the VGG legs are TPU-sized, but decode on
@@ -1742,6 +1791,7 @@ def main() -> dict:
         # (continuous batching on the same tiny model) likewise
         run_leg("llama_decode", _leg_llama_decode)
         run_leg("serve", _leg_serve)
+        run_leg("fleet", _leg_fleet)
 
     # assemble BEFORE shutdown (it reads the live session's phase
     # summary), then flush the exporters — with BENCH_OBS_DIR set this

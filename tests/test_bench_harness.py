@@ -36,6 +36,10 @@ def bench(monkeypatch, tmp_path):
     # the real driver — it spawns worker subprocesses)
     monkeypatch.setattr(mod, "_leg_search",
                         lambda smoke: {"value": 0.1, "unit": "s"})
+    # and the fleet failover drill (tests/test_fleet.py owns the real
+    # kill -9 drill — it spawns 3 replica subprocesses)
+    monkeypatch.setattr(mod, "_leg_fleet",
+                        lambda smoke: {"value": 0.1, "unit": "s"})
     return mod
 
 
@@ -63,10 +67,11 @@ def test_partial_record_written_after_every_leg(bench, monkeypatch):
                         stub("llama_decode", 2.0))
     monkeypatch.setattr(bench, "_leg_serve", stub("serve", 3.0))
     monkeypatch.setattr(bench, "_leg_search", stub("search", 0.9))
+    monkeypatch.setattr(bench, "_leg_fleet", stub("fleet", 0.8))
     monkeypatch.setattr(sys, "argv", ["bench.py", "--run", "--cpu", "--no-cache"])
     out = bench.main()
     assert calls == ["mnist_prune", "resilience", "plan", "search",
-                     "llama_decode", "serve"]
+                     "llama_decode", "serve", "fleet"]
     # each later leg saw the earlier legs' records already persisted
     assert disk_at_call == [None, ["mnist_prune"],
                             ["mnist_prune", "resilience"],
@@ -74,7 +79,9 @@ def test_partial_record_written_after_every_leg(bench, monkeypatch):
                             ["mnist_prune", "resilience", "plan",
                              "search"],
                             ["mnist_prune", "resilience", "plan",
-                             "search", "llama_decode"]]
+                             "search", "llama_decode"],
+                            ["mnist_prune", "resilience", "plan",
+                             "search", "llama_decode", "serve"]]
     part = json.load(open(bench.PARTIAL_PATH))
     assert list(part["legs"]) == calls
     assert part["platform"] == "cpu"
@@ -117,8 +124,9 @@ def test_snapshot_streamed_after_every_leg(bench, monkeypatch, capsys):
     out = bench.main()
     lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
     snaps = [json.loads(ln) for ln in lines]
-    # one per leg (mnist, resilience, plan, search, decode, serve)
-    assert len(snaps) == 6
+    # one per leg (mnist, resilience, plan, search, decode, serve,
+    # fleet)
+    assert len(snaps) == 7
     for snap in snaps:
         assert snap["stream"] == "in_progress"
         assert {"metric", "value", "unit", "vs_baseline", "legs"} <= set(snap)
@@ -127,7 +135,7 @@ def test_snapshot_streamed_after_every_leg(bench, monkeypatch, capsys):
     assert snaps[0]["value"] == 1.5
     assert list(snaps[-1]["legs"]) == ["mnist_prune", "resilience",
                                        "plan", "search", "llama_decode",
-                                       "serve"]
+                                       "serve", "fleet"]
     assert out["value"] == 1.5 and "stream" not in out
 
 
@@ -150,11 +158,12 @@ def test_budget_guard_skips_unfinishable_legs(bench, monkeypatch, capsys):
     assert "budget" in out["legs"]["search"]["skipped"]
     assert "budget" in out["legs"]["llama_decode"]["skipped"]
     assert "budget" in out["legs"]["serve"]["skipped"]
+    assert "budget" in out["legs"]["fleet"]["skipped"]
     assert out["value"] is None  # skipped legs never fake a headline
     # ...but the skip decisions themselves were streamed
     snaps = [json.loads(ln)
              for ln in capsys.readouterr().out.splitlines() if ln.strip()]
-    assert len(snaps) == 6
+    assert len(snaps) == 7
 
 
 def test_leg_progress_checkpoints_are_streamed(bench, monkeypatch, capsys):

@@ -1,0 +1,484 @@
+"""Fleet serving-plane tests: durable request-plane journal semantics
+(accept ⇒ completed-or-redrivable by construction), health-checked
+router dispatch with retry/backoff/deadline budgets, failover +
+journaled redrive on replica death, degraded-mode admission shedding,
+rolling hot-swap, the fleet-wide obs shard merge, and (slow lane) the
+real kill -9 subprocess drill."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from torchpruner_tpu.fleet import (
+    ACCEPTED,
+    COMPLETED,
+    DISPATCHED,
+    FAILED,
+    FleetRouter,
+    PlaneRecord,
+    ReplicaBusy,
+    ReplicaDown,
+    RequestPlane,
+    RouterPolicy,
+)
+from torchpruner_tpu.fleet.frontend import FleetChaos
+from torchpruner_tpu.fleet.report import merge_replica_shards
+
+PAYLOAD = {"prompt_ids": [1, 2, 3], "max_new": 4, "eos_id": None,
+           "temperature": 0.0, "top_k": None, "top_p": None, "seed": 7}
+
+
+# -- request plane -----------------------------------------------------------
+
+
+def test_plane_accept_is_durable_before_ack(tmp_path):
+    journal = str(tmp_path / "journal.json")
+    plane = RequestPlane(journal)
+    rec = plane.accept(PAYLOAD, deadline_s=60.0)
+    # the journal already holds the record when accept() returns — the
+    # "accepted ⇒ durable" half of the zero-loss contract
+    raw = json.load(open(journal))
+    assert [r["rid"] for r in raw["records"]] == [rec.rid]
+    assert raw["records"][0]["state"] == ACCEPTED
+    assert raw["records"][0]["payload"]["prompt_ids"] == [1, 2, 3]
+    assert rec.remaining_s() > 50
+
+
+def test_plane_lifecycle_and_idempotent_completion(tmp_path):
+    plane = RequestPlane(str(tmp_path / "j.json"))
+    rec = plane.accept(PAYLOAD, deadline_s=60.0)
+    got = plane.checkout()
+    assert got is rec and rec.state == DISPATCHED
+    assert plane.checkout() is None
+    plane.assign(rec.rid, "replica0")
+    assert rec.replica == "replica0" and rec.attempts == 1
+    assert plane.assigned_to("replica0") == [rec.rid]
+    # release → pending again (front), redrive counted
+    assert plane.release(rec.rid, redrive=True)
+    assert rec.state == ACCEPTED and rec.redrives == 1
+    assert plane.pending_depth == 1
+    plane.checkout()
+    assert plane.complete(rec.rid, [9, 8, 7, 6], "replica1")
+    assert rec.state == COMPLETED and rec.completed_by == "replica1"
+    assert rec._event.is_set()
+    # a hedged duplicate finishing second is dropped, not double-counted
+    assert not plane.complete(rec.rid, [0, 0, 0, 0], "replica0")
+    assert rec.tokens == [9, 8, 7, 6]
+    assert plane.duplicate_results_total == 1
+    # terminal records cannot be released or failed
+    assert not plane.release(rec.rid)
+    assert not plane.fail(rec.rid, "late")
+    assert plane.all_terminal()
+
+
+def test_plane_load_redrives_non_terminal(tmp_path):
+    """Router death: reloading the journal turns accepted AND
+    dispatched records back into pending work (redrive), keeps
+    completed ones terminal, and never reuses an rid."""
+    journal = str(tmp_path / "j.json")
+    plane = RequestPlane(journal)
+    a = plane.accept(PAYLOAD, deadline_s=60.0)
+    b = plane.accept(PAYLOAD, deadline_s=60.0)
+    c = plane.accept(PAYLOAD, deadline_s=60.0)
+    plane.checkout()
+    plane.assign(a.rid, "replica0")
+    plane.checkout()
+    plane.complete(b.rid, [1], "replica1")
+    del plane
+
+    revived = RequestPlane.load(journal)
+    assert revived.get(b.rid).state == COMPLETED
+    assert revived.get(b.rid)._event.is_set()
+    assert revived.get(a.rid).state == ACCEPTED
+    assert revived.get(a.rid).redrives == 1  # was dispatched
+    assert revived.get(c.rid).redrives == 0  # was merely accepted
+    assert revived.pending_depth == 2
+    fresh = revived.accept(PAYLOAD, deadline_s=1.0)
+    assert fresh.rid not in {a.rid, b.rid, c.rid}
+
+
+def test_plane_compaction_bounds_journal(tmp_path):
+    """The long-running endpoint's journal stays bounded: only the
+    newest ``retain_terminal`` terminal records are kept (waiters hold
+    their own record reference; non-terminal records are never
+    touched)."""
+    journal = str(tmp_path / "j.json")
+    plane = RequestPlane(journal, retain_terminal=2)
+    recs = [plane.accept(PAYLOAD, deadline_s=60.0) for _ in range(5)]
+    keep = plane.accept(PAYLOAD, deadline_s=60.0)  # stays accepted
+    for r in recs:
+        plane.checkout()
+        plane.complete(r.rid, [1], "replica0")
+    assert plane.compacted_total == 3
+    raw = json.load(open(journal))
+    states = [r["state"] for r in raw["records"]]
+    assert states.count("completed") == 2
+    assert plane.get(keep.rid) is not None
+    assert recs[0]._event.is_set()  # the waiter's copy is unaffected
+
+
+# -- fake replicas for router unit tests -------------------------------------
+
+
+class FakeReplica:
+    """Scripted stand-in for ReplicaClient: serves greedy 'tokens'
+    derived from the payload, can die after K requests, report a
+    health state, or shed with 503."""
+
+    def __init__(self, name, *, die_after=None, state="ready",
+                 busy=False, latency_s=0.0):
+        self.name = name
+        self.die_after = die_after
+        self.state = state
+        self.busy = busy
+        self.latency_s = latency_s
+        self.served = 0
+        self.dead = False
+        self.swapped = 0
+
+    def healthz(self, timeout=None):
+        if self.dead:
+            return {"live": False, "ready": False, "state": "dead"}
+        return {"live": True, "ready": self.state == "ready",
+                "state": self.state}
+
+    def stats(self, timeout=None):
+        return {"kv_page_occupancy": 0.1 * self.served,
+                "slot_utilization": 0.0, "queue_depth": 0,
+                "swaps": self.swapped, "state": self.state}
+
+    def generate(self, payload, timeout=None):
+        if self.dead:
+            raise ReplicaDown(f"{self.name}: connection refused")
+        if self.busy:
+            raise ReplicaBusy(f"{self.name}: 503", retry_after_s=0.01)
+        if self.latency_s:
+            time.sleep(self.latency_s)
+        if self.die_after is not None and self.served >= self.die_after:
+            self.dead = True
+            raise ReplicaDown(f"{self.name}: connection reset mid-request")
+        self.served += 1
+        return {"state": "done",
+                "tokens": [x + 1 for x in payload["prompt_ids"]]}
+
+    def swap(self, checkpoint, timeout=None):
+        self.swapped += 1
+        return {"staging": True}
+
+
+def _fast_policy(**kw):
+    base = dict(queue_bound=32, max_attempts=6, attempt_timeout_s=5.0,
+                default_deadline_s=30.0, base_backoff_s=0.001,
+                max_backoff_s=0.01, health_every_s=0.01,
+                max_inflight_per_replica=4)
+    base.update(kw)
+    return RouterPolicy(**base)
+
+
+def _run_router(router, timeout_s=30.0):
+    router.run_until_drained(poll_s=0.002, timeout_s=timeout_s)
+    router.close()
+
+
+# -- router ------------------------------------------------------------------
+
+
+def test_router_dispatches_least_loaded_and_completes(tmp_path):
+    plane = RequestPlane(str(tmp_path / "j.json"))
+    reps = [FakeReplica("replica0"), FakeReplica("replica1")]
+    router = FleetRouter(plane, reps, policy=_fast_policy())
+    recs = [router.submit({**PAYLOAD, "prompt_ids": [i, i + 1]})
+            for i in range(8)]
+    assert all(r is not None for r in recs)
+    _run_router(router)
+    for i, rec in enumerate(recs):
+        assert rec.state == COMPLETED
+        assert rec.tokens == [i + 1, i + 2]
+    # least-loaded routing spread the work over both replicas
+    assert reps[0].served > 0 and reps[1].served > 0
+    assert router.failovers_total == 0
+
+
+def test_router_failover_redrives_dead_replicas_requests(tmp_path):
+    """A replica dying mid-request loses nothing: its journaled
+    records re-enter the pending queue (redrive) and complete on the
+    survivor; the death is counted exactly once."""
+    plane = RequestPlane(str(tmp_path / "j.json"))
+    reps = [FakeReplica("replica0", die_after=2),
+            FakeReplica("replica1")]
+    router = FleetRouter(plane, reps, policy=_fast_policy())
+    recs = [router.submit({**PAYLOAD, "prompt_ids": [i]})
+            for i in range(10)]
+    _run_router(router)
+    assert all(r.state == COMPLETED for r in recs)
+    assert all(r.tokens == [i + 1] for i, r in enumerate(recs))
+    assert router.failovers_total == 1
+    assert reps[0].served == 2
+    assert reps[1].served >= 8
+    # the records replica0 killed carry their redrive/attempt history
+    assert sum(r.redrives for r in recs) >= 1 \
+        or sum(r.attempts for r in recs) > len(recs)
+
+
+def test_router_all_dead_fails_records_not_silently(tmp_path):
+    """Nothing usable: records fail LOUDLY (attempts/deadline
+    exhausted, fleet_failed counters) — never hang, never vanish."""
+    plane = RequestPlane(str(tmp_path / "j.json"))
+    router = FleetRouter(
+        plane, [FakeReplica("replica0", state="draining")],
+        policy=_fast_policy(max_attempts=3, default_deadline_s=0.5))
+    rec = router.submit(PAYLOAD)
+    assert rec is not None
+    router.run_until_drained(poll_s=0.002, timeout_s=30.0)
+    router.close()
+    assert rec.state == FAILED
+    assert rec.error
+
+
+def test_router_admission_sheds_on_bound_and_degraded(tmp_path):
+    plane = RequestPlane(str(tmp_path / "j.json"))
+    reps = [FakeReplica("replica0"), FakeReplica("replica1")]
+    router = FleetRouter(plane, reps,
+                         policy=_fast_policy(queue_bound=4,
+                                             degraded_queue_factor=0.5))
+    router.check_health(force=True)
+    # fill the pending queue to the bound without dispatching
+    for i in range(4):
+        assert router.submit(PAYLOAD) is not None
+    verdict = router.admission()
+    assert not verdict["accepting"] and verdict["reason"] == "backpressure"
+    assert verdict["code"] == 429 and verdict["retry_after_s"] >= 1
+    assert router.submit(PAYLOAD) is None
+    assert router.shed_total == 1 and plane.counts()["shed"] == 1
+    # SLO-breach majority tightens the bound (degraded admission):
+    # depth 2 < bound 4 would accept, but 2 >= 4*0.5 sheds
+    _run_router(router)
+    for r in reps:
+        r.state = "slo_breach"
+    router2 = FleetRouter(RequestPlane(), reps,
+                          policy=_fast_policy(queue_bound=4,
+                                              degraded_queue_factor=0.5))
+    router2.check_health(force=True)
+    assert router2.degraded()
+    assert router2.effective_queue_bound() == 2
+    assert router2.submit(PAYLOAD) is not None
+    assert router2.submit(PAYLOAD) is not None
+    assert router2.submit(PAYLOAD) is None  # shed at the tightened bound
+    assert router2.admission()["reason"] == "degraded"
+    router2.close()
+
+
+def test_router_prefers_ready_but_degrades_gracefully(tmp_path):
+    """slo_breach replicas are avoided while a ready one exists, but a
+    fully-degraded fleet still serves (only draining/dead are never
+    picked)."""
+    plane = RequestPlane()
+    breached = FakeReplica("replica0", state="slo_breach")
+    ready = FakeReplica("replica1")
+    router = FleetRouter(plane, [breached, ready],
+                         policy=_fast_policy())
+    recs = [router.submit({**PAYLOAD, "prompt_ids": [i]})
+            for i in range(6)]
+    _run_router(router)
+    assert all(r.state == COMPLETED for r in recs)
+    assert breached.served == 0 and ready.served == 6
+    # now nothing is ready: the breached replica still gets the work
+    breached2 = FakeReplica("replica0", state="slo_breach")
+    router2 = FleetRouter(RequestPlane(), [breached2],
+                          policy=_fast_policy())
+    rec = router2.submit(PAYLOAD)
+    _run_router(router2)
+    assert rec.state == COMPLETED and breached2.served == 1
+
+
+def test_router_rolling_swap_walks_replicas(tmp_path):
+    reps = [FakeReplica("replica0"), FakeReplica("replica1"),
+            FakeReplica("replica2")]
+    # FakeReplica.swap bumps its own counter, which stats() reports —
+    # the router's wait-for-landing loop sees it immediately
+    router = FleetRouter(RequestPlane(), reps, policy=_fast_policy())
+    router.check_health(force=True)
+    assert router.rolling_swap("/fake/ckpt", wait_s=5.0) == 3
+    assert [r.swapped for r in reps] == [1, 1, 1]
+    router.close()
+
+
+def test_fleet_chaos_validates_keys():
+    c = FleetChaos.from_any('{"kill_replica_at_step": 3, '
+                            '"replica_index": 1}')
+    assert c.kill_replica_at_step == 3 and c.replica_index == 1
+    assert FleetChaos.from_any(None).kill_replica_at_step == -1
+    with pytest.raises(ValueError, match="unknown fleet chaos"):
+        FleetChaos.from_any('{"kill_at_step": 3}')
+
+
+# -- fleet-wide obs shard merge ----------------------------------------------
+
+
+def test_merge_replica_shards_rehomes_and_skips_missing(tmp_path):
+    from torchpruner_tpu.obs.aggregate import (
+        load_shards,
+        merge_shards,
+        shard_path,
+    )
+
+    fleet_obs = str(tmp_path / "obs")
+    os.makedirs(fleet_obs)
+    rep_dirs = [str(tmp_path / f"obs/replica{i}") for i in range(3)]
+    for i, d in enumerate(rep_dirs[:2]):  # replica2 was kill -9'd
+        os.makedirs(d)
+        json.dump({"process_index": 0,
+                   "counters": {"serve_completed_total":
+                                {"value": 5 + i, "help": "x"}},
+                   "gauges": {}, "histograms": {}},
+                  open(shard_path(d, 0), "w"))
+    present = merge_replica_shards(fleet_obs, rep_dirs)
+    assert [present[d] for d in rep_dirs] == [True, True, False]
+    shards = load_shards(fleet_obs)
+    assert [s["process_index"] for s in shards] == [1, 2]
+    merged = merge_shards(shards)
+    # counters SUM across replicas — the fleet-wide view
+    assert merged.get("serve_completed_total").value == 11
+
+
+# -- integration: router over real engines (in-process HTTP) -----------------
+
+
+@pytest.fixture
+def live_replicas():
+    """Two REAL ServeEngine replicas behind the real HTTP front end,
+    in-process (threads) — identical weights/geometry, ephemeral
+    ports."""
+    import jax.numpy as jnp  # noqa: F401 - ensures jax configured
+
+    from torchpruner_tpu.core.segment import init_model
+    from torchpruner_tpu.fleet.replica import ReplicaClient
+    from torchpruner_tpu.models import llama_tiny
+    from torchpruner_tpu.serve import ServeEngine
+    from torchpruner_tpu.serve.frontend import _http_server
+
+    model = llama_tiny()
+    params, _ = init_model(model, seed=0)
+    engines, servers, stops, threads, clients = [], [], [], [], []
+    for i in range(2):
+        eng = ServeEngine(model, params, n_slots=2, max_len=64,
+                          queue_bound=8, retain_results=False)
+        server = _http_server(eng, 0, request_timeout_s=120.0)
+        port = server.server_address[1]
+        stop = threading.Event()
+        threads.append(threading.Thread(target=server.serve_forever,
+                                        daemon=True))
+        threads.append(threading.Thread(
+            target=lambda e=eng, s=stop: e.run(stop_event=s),
+            daemon=True))
+        engines.append(eng)
+        servers.append(server)
+        stops.append(stop)
+        clients.append(ReplicaClient(f"replica{i}", port))
+    for t in threads:
+        t.start()
+    try:
+        yield model, params, engines, servers, stops, clients
+    finally:
+        for stop in stops:
+            stop.set()
+        for server in servers:
+            server.shutdown()
+
+
+def test_router_over_real_replicas_bit_identical(live_replicas,
+                                                 tmp_path):
+    """End to end over the REAL serve HTTP front end: the router
+    completes every request and each result is bit-identical to its
+    solo generate() decode — then one replica 'dies' (server torn
+    down) and the remainder still completes on the survivor with a
+    counted failover."""
+    from torchpruner_tpu.generate import generate
+
+    model, params, engines, servers, stops, clients = live_replicas
+    plane = RequestPlane(str(tmp_path / "j.json"))
+    router = FleetRouter(
+        plane, clients,
+        policy=_fast_policy(attempt_timeout_s=120.0,
+                            default_deadline_s=240.0,
+                            base_backoff_s=0.01, max_backoff_s=0.1,
+                            health_every_s=0.05))
+    rng = np.random.default_rng(0)
+    payloads = [{"prompt_ids": rng.integers(0, 64, size=4 + (i % 3)
+                                            ).tolist(),
+                 "max_new": 3 + (i % 2), "seed": i,
+                 "temperature": 0.0}
+                for i in range(6)]
+    recs = [router.submit(p) for p in payloads]
+    router.run_until_drained(poll_s=0.01, timeout_s=240.0)
+    assert all(r.state == COMPLETED for r in recs)
+
+    # replica0 dies; the rest of the traffic survives on replica1
+    stops[0].set()
+    servers[0].shutdown()
+    recs2 = [router.submit(p) for p in payloads[:3]]
+    router.run_until_drained(poll_s=0.01, timeout_s=240.0)
+    router.close()
+    assert all(r.state == COMPLETED for r in recs2)
+    assert all(r.completed_by == "replica1" for r in recs2)
+    assert router.failovers_total >= 1
+
+    import jax
+
+    for p, rec in zip(payloads, recs):
+        want = np.asarray(generate(
+            model, params,
+            np.asarray(p["prompt_ids"], np.int32)[None], p["max_new"],
+            rng=jax.random.PRNGKey(p["seed"]), max_len=64))[0]
+        np.testing.assert_array_equal(
+            np.asarray(rec.tokens, np.int32), want)
+
+
+# -- the real thing: subprocess kill -9 drill (slow lane) --------------------
+
+
+@pytest.mark.slow
+def test_fleet_kill9_drill_zero_loss(tmp_path):
+    """3 subprocess replicas, open-loop Poisson load, kill -9 one
+    mid-stream: zero accepted-request loss, journaled redrive to the
+    survivors, bit-identical --verify, and the survivors' obs shards
+    merged into one fleet report."""
+    import subprocess
+    import sys
+
+    fleet_dir = str(tmp_path / "fleet")
+    r = subprocess.run(
+        [sys.executable, "-m", "torchpruner_tpu", "fleet", "llama_tiny",
+         "--cpu", "--replicas", "3", "--slots", "2", "--max-len", "96",
+         "--synthetic", "18", "--rate", "3.0", "--verify",
+         "--max-new", "8,12", "--prompt-lens", "4,8",
+         "--fleet-dir", fleet_dir,
+         "--chaos", '{"kill_replica_at_step": 5}'],
+        capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    s = json.loads([l for l in r.stdout.splitlines()
+                    if l.startswith("{")][-1])
+    assert s["killed"] == ["replica0"]
+    assert s["accepted"] == 18 and s["completed"] == 18
+    assert s["lost"] == 0 and s["verify_mismatches"] == 0
+    assert s["failovers"] >= 1 and s["redrives"] >= 1
+    assert s["shards_merged"] == 2  # the kill -9'd replica ships none
+    # the journal is the durable account of the whole drill
+    j = json.load(open(os.path.join(fleet_dir, "fleet_journal.json")))
+    assert len(j["records"]) == 18
+    assert all(rec["state"] == "completed" for rec in j["records"])
+    assert any(rec["redrives"] > 0 for rec in j["records"])
+    # the merged fleet report carries the failover counters + the
+    # summed serve histograms from the surviving replicas
+    from torchpruner_tpu.obs.report import load_run
+
+    rep = load_run(os.path.join(fleet_dir, "obs"))
+    m = rep["metrics"]
+    assert m.get("fleet_failover_total", 0) >= 1
+    assert m.get("fleet_redrive_total", 0) >= 1
+    assert m.get("fleet_completed_total") == 18
+    assert m.get("serve_ttft_seconds_count", 0) > 0

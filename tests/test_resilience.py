@@ -8,6 +8,7 @@ import os
 import signal
 import subprocess
 import sys
+import time
 
 import jax
 import numpy as np
@@ -104,6 +105,78 @@ def test_retry_recovers_transient_and_reraises_persistent():
                    sleep=lambda _s: None)
 
 
+def test_with_retries_deadline_and_exhaustion_ordering():
+    """The shared Deadline/with_retries helper (data-stream retries AND
+    fleet router dispatch) pins its error ordering: the LAST allowed
+    attempt's failure re-raises unchanged (exhaustion wins), while a
+    mid-budget deadline cut raises DeadlineExceeded chained from the
+    last real failure."""
+    from torchpruner_tpu.resilience.retry import (
+        Deadline,
+        DeadlineExceeded,
+        with_retries,
+    )
+
+    # exhaustion wins when the deadline expires DURING the last
+    # allowed attempt: the caller sees the real failure, not a wrapper
+    boom = OSError("real failure")
+
+    def slow_fail(_t):
+        time.sleep(0.6)
+        raise boom
+
+    with pytest.raises(OSError) as ei:
+        with_retries(slow_fail,
+                     policy=RetryPolicy(tries=2, base_delay_s=0.0,
+                                        jitter=0.0),
+                     deadline=Deadline.after(1.0),
+                     sleep=lambda _s: None)
+    assert ei.value is boom
+
+    # an expired deadline BEFORE any attempt: DeadlineExceeded, zero
+    # attempts burned
+    calls = {"n": 0}
+
+    def count(_t):
+        calls["n"] += 1
+        raise OSError("x")
+
+    with pytest.raises(DeadlineExceeded):
+        with_retries(count, policy=RetryPolicy(tries=5),
+                     deadline=Deadline(t_end=0.0, budget_s=0.0),
+                     sleep=lambda _s: None)
+    assert calls["n"] == 0
+
+    # a backoff sleep that would cross the deadline is never taken:
+    # DeadlineExceeded chained from the failure that spent the budget
+    with pytest.raises(DeadlineExceeded) as ei:
+        with_retries(count,
+                     policy=RetryPolicy(tries=5, base_delay_s=10.0,
+                                        jitter=0.0),
+                     deadline=Deadline.after(0.5),
+                     sleep=lambda _s: None)
+    assert calls["n"] == 1
+    assert isinstance(ei.value.__cause__, OSError)
+
+    # success path: fn receives the per-attempt timeout clamped to the
+    # remaining budget
+    seen = []
+
+    def ok(timeout_s):
+        seen.append(timeout_s)
+        return "ok"
+
+    assert with_retries(ok, deadline=Deadline.after(100.0),
+                        attempt_timeout_s=5.0) == "ok"
+    assert seen[0] == pytest.approx(5.0)
+    assert with_retries(ok, attempt_timeout_s=3.0) == "ok"
+    assert seen[1] == 3.0
+    # Deadline.clamp: remaining budget caps a larger attempt timeout
+    d = Deadline.after(1.0)
+    assert d.clamp(100.0) <= 1.0
+    assert 0.0 < d.remaining() <= 1.0 and not d.expired
+
+
 # -- chaos -------------------------------------------------------------------
 
 
@@ -116,6 +189,14 @@ def test_chaos_config_parsing_and_validation():
     assert chaos.configure({"nan_at_step": -1}) is None
     assert chaos.configure({"nan_at_step": 4}) is not None
     assert chaos.active()
+    # the fleet "slow replica" fault is an active injection and fires
+    # on EVERY step (latency degradation, not a one-shot)
+    assert chaos.configure({"slow_steps_ms": 1.0}) is not None
+    t0 = time.perf_counter()
+    chaos.maybe_slow_step()
+    chaos.maybe_slow_step()
+    assert time.perf_counter() - t0 >= 0.002
+    chaos.disable()
 
 
 def test_chaos_fires_once_at_exact_step():

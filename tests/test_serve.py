@@ -635,6 +635,234 @@ def test_http_metrics_endpoint_with_session(tmp_path):
         obs.shutdown()
 
 
+def test_healthz_readiness_states(tmp_path):
+    """/healthz splits liveness from readiness: ready answers 200;
+    draining / staging_swap / slo_breach answer 503 with the state
+    named, so a probe (or the fleet router) stops dispatching BEFORE a
+    drain completes."""
+    import urllib.error
+    import urllib.request
+
+    from torchpruner_tpu.serve.frontend import _http_server
+    from torchpruner_tpu.serve.slo import SLOMonitor
+
+    model = llama_tiny()
+    params, _ = init_model(model, seed=0)
+    eng = ServeEngine(model, params, n_slots=2, max_len=64)
+    server = _http_server(eng, 0, request_timeout_s=10.0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    def probe():
+        try:
+            out = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10))
+            return 200, out
+        except urllib.error.HTTPError as e:
+            return e.code, json.load(e)
+
+    try:
+        code, out = probe()
+        assert code == 200 and out == {"ok": True, "live": True,
+                                       "state": "ready"}
+        # staging a swap degrades readiness (router rotates away)
+        eng._pending_swap = "/fake/ckpt"
+        code, out = probe()
+        assert code == 503 and out["state"] == "staging_swap"
+        assert out["live"] and not out["ok"]
+        eng._pending_swap = None
+        # an SLO breach episode degrades readiness
+        eng.slo = SLOMonitor(ttft_p99_s=0.001, window=8,
+                             check_every_steps=1, min_samples=1)
+        eng.slo.on_ttft(1.0)
+        eng.slo.check(0)
+        assert eng.slo.in_breach_any()
+        code, out = probe()
+        assert code == 503 and out["state"] == "slo_breach"
+        eng.slo = None
+        # a drain (scheduler closed) wins over everything
+        eng.scheduler.closed = True
+        code, out = probe()
+        assert code == 503 and out["state"] == "draining"
+        # /stats carries the same state + the swap counter the rolling
+        # fleet upgrade polls
+        stats = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats", timeout=10))
+        assert stats["state"] == "draining" and stats["swaps"] == 0
+    finally:
+        server.shutdown()
+
+
+def test_http_backpressure_sheds_with_retry_after():
+    """Over-capacity POSTs get 503 + Retry-After (bounded queue), never
+    an unboundedly growing queue: with queue_bound=1 and no engine loop
+    draining it, the second submission is shed immediately while the
+    first stays queued."""
+    import urllib.error
+    import urllib.request
+
+    from torchpruner_tpu import obs as obs_mod
+    from torchpruner_tpu.serve.frontend import _http_server
+    from torchpruner_tpu.serve.request import SHED
+
+    model = llama_tiny()
+    params, _ = init_model(model, seed=0)
+    eng = ServeEngine(model, params, n_slots=2, max_len=64,
+                      queue_bound=1)
+    server = _http_server(eng, 0, request_timeout_s=60.0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    body = json.dumps({"prompt_ids": [5, 9, 2], "max_new": 4}).encode()
+
+    def post():
+        return urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/generate", data=body,
+            headers={"Content-Type": "application/json"}), timeout=60)
+
+    first_result = {}
+    t = threading.Thread(
+        target=lambda: first_result.update(json.load(post())),
+        daemon=True)
+    t.start()
+    deadline = time.time() + 30
+    while eng.scheduler.queue_depth < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    assert eng.scheduler.queue_depth == 1
+    try:
+        post()
+        raise AssertionError("expected 503 over capacity")
+    except urllib.error.HTTPError as e:
+        assert e.code == 503
+        assert int(e.headers["Retry-After"]) >= 1
+        assert json.load(e)["state"] == SHED
+    assert eng.scheduler.shed_total == 1
+    try:
+        # the engine drains the queued request; the shed one is gone
+        eng.run()
+        t.join(timeout=60)
+        assert first_result.get("state") == "done"
+        assert len(first_result["tokens"]) == 4
+    finally:
+        server.shutdown()
+
+
+def test_http_swap_endpoint_stages_hot_swap(tmp_path):
+    """POST /swap stages a checkpoint hot-swap on the live endpoint
+    (202; 409 while one is already staging) — the per-replica step of
+    the fleet's rolling upgrade."""
+    import urllib.error
+    import urllib.request
+
+    from torchpruner_tpu.checkpoint import save_checkpoint
+    from torchpruner_tpu.serve.frontend import _http_server
+
+    model = llama_tiny()
+    params, _ = init_model(model, seed=0)
+    r = prune(model, params, "block1_ffn/gate", [1, 2])
+    ck = os.path.join(tmp_path, "ckpt-pruned")
+    save_checkpoint(ck, r.model, r.params)
+
+    eng = ServeEngine(model, params, n_slots=2, max_len=64)
+    server = _http_server(eng, 0, request_timeout_s=60.0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    stop = threading.Event()
+    loop = threading.Thread(target=lambda: eng.run(stop_event=stop),
+                            daemon=True)
+    loop.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/swap",
+            data=json.dumps({"checkpoint": ck}).encode(),
+            headers={"Content-Type": "application/json"})
+        resp = urllib.request.urlopen(req, timeout=30)
+        assert resp.status == 202 and json.load(resp)["staging"]
+        # a second staging request while one is in flight: 409 (unless
+        # the first already landed, which is also a pass)
+        try:
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/swap",
+                data=json.dumps({"checkpoint": ck}).encode(),
+                headers={"Content-Type": "application/json"}),
+                timeout=30)
+            assert eng.swaps_total >= 1
+        except urllib.error.HTTPError as e:
+            assert e.code == 409
+        deadline = time.time() + 120
+        while eng.swaps_total < 1 and time.time() < deadline:
+            time.sleep(0.05)
+        assert eng.swaps_total == 1
+        stats = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats", timeout=10))
+        assert stats["swaps"] == 1
+        assert eng.model.widths() == r.model.widths()
+    finally:
+        stop.set()
+        server.shutdown()
+        loop.join(timeout=30)
+
+
+def test_queue_snapshot_resubmission_roundtrip(tmp_path):
+    """The PR 6 drain snapshot actually ROUND-TRIPS: requests drained
+    by a SIGTERM-style preemption are resubmitted from
+    serve_queue_snapshot.json into a fresh engine and decode
+    BIT-IDENTICALLY to what an uninterrupted engine (and solo
+    generate()) produces — the redrive path the fleet router rides."""
+    from torchpruner_tpu.resilience.guards import PreemptionHandler
+    from torchpruner_tpu.serve.engine import SNAPSHOT_FILENAME
+
+    model = llama_tiny()
+    params, _ = init_model(model, seed=0)
+    eng = ServeEngine(model, params, n_slots=2, max_len=96,
+                      run_dir=str(tmp_path))
+    reqs = synthetic_requests(6, vocab=64, prompt_lens=[4, 7],
+                              max_new=[16, 12], seed=21,
+                              temperature=0.8)
+    traffic = OpenLoopTraffic(reqs, staggered_arrivals(6, every_steps=1),
+                              by_step=True)
+    pre = PreemptionHandler()
+
+    class FireAt:
+        def __init__(self, inner):
+            self.inner = inner
+
+        @property
+        def exhausted(self):
+            return self.inner.exhausted
+
+        def drain(self):
+            return self.inner.drain()
+
+        def pump(self, engine):
+            n = self.inner.pump(engine)
+            if engine.steps == 4:
+                pre.request()
+            return n
+
+    eng.run(FireAt(traffic), preemption=pre)
+    drained = [r for r in reqs if r.state == DRAINED]
+    assert drained, "drill needs at least one drained request"
+    snap = json.load(open(tmp_path / SNAPSHOT_FILENAME))
+    assert len(snap["requests"]) == len(drained)
+
+    # resubmit the snapshot into a FRESH engine (the restart path)
+    eng2 = ServeEngine(model, params, n_slots=2, max_len=96)
+    revived = [eng2.submit(Request.from_snapshot(d))
+               for d in snap["requests"]]
+    eng2.run()
+    from torchpruner_tpu.generate import generate as _generate
+
+    for r in revived:
+        assert r.state == DONE and len(r.tokens) == r.max_new
+        s = r.sampling
+        want = np.asarray(_generate(
+            model, params, r.prompt_ids[None], r.max_new,
+            temperature=s.temperature, top_k=s.top_k, top_p=s.top_p,
+            rng=jax.random.PRNGKey(s.seed), max_len=eng2.max_len))[0]
+        np.testing.assert_array_equal(np.asarray(r.tokens, np.int32),
+                                      want)
+
+
 def test_poisson_arrivals_seeded_and_monotone():
     a = poisson_arrivals(50, rate_per_s=10.0, seed=3)
     b = poisson_arrivals(50, rate_per_s=10.0, seed=3)

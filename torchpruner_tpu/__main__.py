@@ -44,6 +44,15 @@ def main(argv=None) -> int:
         from torchpruner_tpu.serve.frontend import serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "fleet":
+        # fault-tolerant multi-replica serving plane (fleet.frontend):
+        # `python -m torchpruner_tpu fleet <preset> --replicas 3
+        # [--synthetic N | --http PORT] ...` — health-checked router
+        # over N serve replicas, durable request journal, kill -9
+        # failover drills
+        from torchpruner_tpu.fleet.frontend import fleet_main
+
+        return fleet_main(argv[1:])
     if argv and argv[0] == "search":
         # Pareto sparsity-search campaign driver (search.driver):
         # `python -m torchpruner_tpu search <campaign> [--jobs N]
@@ -58,6 +67,7 @@ def main(argv=None) -> int:
         description="TPU-native structured pruning experiments "
                     "(subcommands: obs report/diff — run-ledger tooling; "
                     "serve — continuous-batching inference engine; "
+                    "fleet — fault-tolerant multi-replica serving plane; "
                     "search — Pareto sparsity-search campaign driver)",
     )
     p.add_argument(

@@ -1,0 +1,63 @@
+"""``torchpruner_tpu.fleet`` — the fault-tolerant multi-replica
+serving plane (ROADMAP item 2's composition refactor).
+
+One engine serves one chip group; a fleet serves traffic.  This package
+splits the TRANSPORT-AGNOSTIC request plane out of the engine-side
+scheduler and composes the existing subsystems into a plane where a
+``kill -9``'d replica is a non-event:
+
+- :class:`~torchpruner_tpu.fleet.plane.RequestPlane` — durable request
+  records in an atomic journal: every ACCEPTED request is either
+  completed or redrivable, by construction.
+- :class:`~torchpruner_tpu.fleet.replica.ReplicaClient` /
+  :class:`~torchpruner_tpu.fleet.replica.ReplicaProcess` — the HTTP
+  view of one serve replica (generate / healthz readiness states /
+  stats gauges / swap) + subprocess lifecycle (spawn, kill -9,
+  SIGSTOP "hang", SIGTERM drain).
+- :class:`~torchpruner_tpu.fleet.router.FleetRouter` — health-checked
+  least-loaded dispatch over the live ``kv_page_occupancy`` /
+  ``slot_utilization`` gauges, per-request deadline budgets with
+  bounded deterministic-jitter retries
+  (``resilience.retry.with_retries``), hedged redrive of a dead
+  replica's journaled queue, degraded-mode admission (bounded queue,
+  SLO-tightened, 429/503 + Retry-After), rolling checkpoint hot-swap.
+- :mod:`~torchpruner_tpu.fleet.report` — every replica's obs shard
+  merged into ONE fleet-wide report (PR 5 aggregation).
+- ``python -m torchpruner_tpu fleet <preset>``
+  (:mod:`~torchpruner_tpu.fleet.frontend`) — the endpoint and the
+  kill-9 failover drill CI runs.
+"""
+
+from torchpruner_tpu.fleet.plane import (
+    ACCEPTED,
+    COMPLETED,
+    DISPATCHED,
+    FAILED,
+    PlaneRecord,
+    RequestPlane,
+)
+from torchpruner_tpu.fleet.replica import (
+    ReplicaBusy,
+    ReplicaClient,
+    ReplicaDown,
+    ReplicaError,
+    ReplicaProcess,
+    ReplicaRejected,
+    ReplicaTimeout,
+    free_port,
+)
+from torchpruner_tpu.fleet.report import merge_replica_shards
+from torchpruner_tpu.fleet.router import (
+    FleetRouter,
+    ReplicaView,
+    RouterPolicy,
+)
+
+__all__ = [
+    "ACCEPTED", "DISPATCHED", "COMPLETED", "FAILED",
+    "PlaneRecord", "RequestPlane",
+    "ReplicaClient", "ReplicaProcess", "ReplicaError", "ReplicaDown",
+    "ReplicaTimeout", "ReplicaBusy", "ReplicaRejected", "free_port",
+    "FleetRouter", "RouterPolicy", "ReplicaView",
+    "merge_replica_shards",
+]

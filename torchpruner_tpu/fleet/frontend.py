@@ -1,0 +1,565 @@
+"""``python -m torchpruner_tpu fleet`` — the multi-replica serving plane.
+
+Spawns N single-replica serve processes (``serve --http``, each with its
+own obs dir, bounded queue, and drain snapshot dir), fronts them with
+the health-checked :class:`~torchpruner_tpu.fleet.router.FleetRouter`
+over a durable :class:`~torchpruner_tpu.fleet.plane.RequestPlane`
+journal, and runs one of two modes:
+
+- ``--synthetic N`` — the FAILOVER DRILL: N seeded synthetic requests
+  on an open-loop Poisson schedule (``--rate`` req/s), optional fleet
+  chaos (``--chaos '{"kill_replica_at_step": 8}'`` SIGKILLs a replica
+  once the router has dispatched 8 requests; ``hang_replica_at_step``
+  SIGSTOPs it; ``slow_replica_ms`` degrades one replica's per-step
+  latency via the core chaos env), optional ``--swap-checkpoint`` (a
+  rolling fleet upgrade mid-drill), then: SIGTERM-drains the
+  survivors, merges every replica's obs shard into ONE fleet-wide
+  report, ``--verify`` re-decodes every completed request from the
+  JOURNAL through solo ``generate()`` (bit-identity: the redrive
+  correctness contract), prints a JSON summary, and exits non-zero on
+  ANY accepted-request loss or verify mismatch.
+- ``--http PORT`` — the serving-plane endpoint: ``POST /v1/generate``
+  accepts into the journal (durable before the 200 path starts) and
+  blocks for the routed result; over-capacity answers 429/503 +
+  Retry-After by the router's (degradation-tightened) admission
+  policy; ``GET /healthz`` / ``GET /stats`` expose the fleet view.
+
+Every replica is started with the SAME seed/checkpoint and geometry, so
+a redriven request re-decodes bit-identically on any survivor — greedy
+requests always, sampled requests because their rng is seed-pinned (see
+the README caveat: that guarantee is a property of identical replicas,
+not of redrive itself).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+from torchpruner_tpu.fleet.plane import COMPLETED, RequestPlane
+from torchpruner_tpu.fleet.replica import ReplicaProcess, free_port
+from torchpruner_tpu.serve.request import request_from_dict
+from torchpruner_tpu.fleet.report import merge_replica_shards
+from torchpruner_tpu.fleet.router import FleetRouter, RouterPolicy
+
+JOURNAL_FILENAME = "fleet_journal.json"
+
+
+@dataclass
+class FleetChaos:
+    """Driver-side fleet fault injection (the chaos harness's fleet
+    extension): ``*_at_step`` counts ROUTER DISPATCHES (deterministic
+    under a fixed arrival schedule), ``replica_index`` picks the
+    victim.  ``slow_replica_ms`` is forwarded to the victim's env as
+    core chaos ``slow_steps_ms`` (a per-decode-step stall)."""
+
+    kill_replica_at_step: int = -1
+    hang_replica_at_step: int = -1
+    slow_replica_ms: float = 0.0
+    replica_index: int = 0
+
+    @classmethod
+    def from_any(cls, spec) -> "FleetChaos":
+        if spec is None:
+            return cls()
+        if isinstance(spec, cls):
+            return spec
+        if isinstance(spec, str):
+            spec = json.loads(spec)
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(spec) - known
+        if unknown:
+            raise ValueError(f"unknown fleet chaos keys: "
+                             f"{sorted(unknown)} (known: {sorted(known)})")
+        return cls(**spec)
+
+
+def replica_argv(preset: str, port: int, args,
+                 obs_dir: str, run_dir: str) -> List[str]:
+    """The serve subcommand line one replica runs."""
+    argv = [sys.executable, "-m", "torchpruner_tpu", "serve", preset,
+            "--http", str(port), "--slots", str(args.slots),
+            "--max-len", str(args.max_len), "--seed", str(args.seed),
+            "--queue-bound", str(args.replica_queue_bound),
+            "--obs-dir", obs_dir, "--run-dir", run_dir,
+            "--timeout", str(args.deadline_s)]
+    if args.smoke:
+        argv.append("--smoke")
+    if args.cpu:
+        argv.append("--cpu")
+    if args.checkpoint:
+        argv += ["--checkpoint", args.checkpoint]
+    if args.slo_ttft_p99_ms is not None:
+        argv += ["--slo-ttft-p99-ms", str(args.slo_ttft_p99_ms)]
+    if args.slo_token_p99_ms is not None:
+        argv += ["--slo-token-p99-ms", str(args.slo_token_p99_ms)]
+    return argv
+
+
+def spawn_fleet(preset: str, args, fleet_dir: str,
+                chaos: FleetChaos) -> List[ReplicaProcess]:
+    """Spawn + wait-listening on every replica.  All replicas share the
+    seed/checkpoint and geometry — the redrive bit-identity contract."""
+    procs: List[ReplicaProcess] = []
+    for i in range(args.replicas):
+        port = free_port()
+        obs_dir = os.path.join(fleet_dir, "obs", f"replica{i}")
+        run_dir = os.path.join(fleet_dir, f"replica{i}_run")
+        env = dict(os.environ)
+        env.pop("TORCHPRUNER_CHAOS", None)  # fleet chaos is driver-side
+        if chaos.slow_replica_ms > 0 and i == chaos.replica_index:
+            env["TORCHPRUNER_CHAOS"] = json.dumps(
+                {"slow_steps_ms": chaos.slow_replica_ms})
+        rep = ReplicaProcess(
+            name=f"replica{i}", port=port,
+            argv=replica_argv(preset, port, args, obs_dir, run_dir),
+            env=env,
+            log_path=os.path.join(fleet_dir, f"replica{i}.log"))
+        rep.obs_dir = obs_dir
+        rep.spawn()
+        procs.append(rep)
+    for rep in procs:
+        if not rep.wait_listening(timeout_s=args.startup_timeout_s):
+            for r in procs:
+                r.kill9()
+            raise SystemExit(
+                f"fleet: {rep.name} never started listening "
+                f"(see {rep.log_path})")
+    return procs
+
+
+def _payload_of(req) -> dict:
+    """serve.Request → the wire dict (request_from_dict schema)."""
+    s = req.sampling
+    return {"prompt_ids": req.prompt_ids.tolist(),
+            "max_new": int(req.max_new), "eos_id": req.eos_id,
+            "temperature": s.temperature, "top_k": s.top_k,
+            "top_p": s.top_p, "seed": s.seed}
+
+
+class _ChaosTrigger:
+    """Fires the driver-side injections at their dispatch-count step."""
+
+    def __init__(self, chaos: FleetChaos, procs: List[ReplicaProcess]):
+        self.chaos, self.procs = chaos, procs
+        self.killed: List[str] = []
+        self.hung: List[str] = []
+
+    def __call__(self, router: FleetRouter) -> None:
+        c = self.chaos
+        idx = c.replica_index
+        if 0 <= c.kill_replica_at_step <= router.dispatched_total \
+                and not self.killed and idx < len(self.procs):
+            victim = self.procs[idx]
+            print(f"[fleet] chaos: kill -9 {victim.name} at dispatch "
+                  f"{router.dispatched_total}", file=sys.stderr,
+                  flush=True)
+            victim.kill9()
+            self.killed.append(victim.name)
+        if 0 <= c.hang_replica_at_step <= router.dispatched_total \
+                and not self.hung and idx < len(self.procs):
+            victim = self.procs[idx]
+            print(f"[fleet] chaos: SIGSTOP {victim.name} at dispatch "
+                  f"{router.dispatched_total}", file=sys.stderr,
+                  flush=True)
+            victim.hang()
+            self.hung.append(victim.name)
+
+
+def run_drill(preset: str, args, fleet_dir: str,
+              chaos: FleetChaos) -> int:
+    """The synthetic failover drill (see module docstring)."""
+    from torchpruner_tpu import obs
+    from torchpruner_tpu.serve.engine import vocab_of
+    from torchpruner_tpu.serve.frontend import _resolve_model
+    from torchpruner_tpu.serve.traffic import (
+        poisson_arrivals,
+        synthetic_requests,
+    )
+
+    # the driver's own copy of the weights — vocab for the synthetic
+    # prompts now, solo-decode replays for --verify later
+    model, params, _meta = _resolve_model(
+        preset, smoke=args.smoke, seed=args.seed,
+        checkpoint=args.checkpoint)
+    n = args.synthetic
+    prompt_lens = [int(x) for x in args.prompt_lens.split(",") if x]
+    max_new = [int(x) for x in args.max_new.split(",") if x]
+    reqs = synthetic_requests(
+        n, vocab=vocab_of(model), prompt_lens=prompt_lens,
+        max_new=max_new, seed=args.seed, temperature=args.temperature)
+    payloads = [_payload_of(r) for r in reqs]
+    arrivals = poisson_arrivals(n, args.rate, seed=args.seed)
+
+    procs = spawn_fleet(preset, args, fleet_dir, chaos)
+    plane = RequestPlane(os.path.join(fleet_dir, JOURNAL_FILENAME))
+    router = FleetRouter(plane, procs, policy=_policy_of(args))
+    trigger = _ChaosTrigger(chaos, procs)
+    swap_thread = None
+    t0 = time.monotonic()
+    try:
+        router.check_health(force=True)
+        i = 0
+        shed = 0
+        while True:
+            now = time.monotonic() - t0
+            while i < n and arrivals[i] <= now:
+                if router.submit(payloads[i],
+                                 deadline_s=args.deadline_s) is None:
+                    shed += 1
+                i += 1
+            router.tick()
+            trigger(router)
+            if swap_thread is None and args.swap_checkpoint \
+                    and router.dispatched_total >= args.swap_after:
+                swap_thread = threading.Thread(
+                    target=router.rolling_swap,
+                    args=(args.swap_checkpoint,), daemon=True)
+                swap_thread.start()
+            if i >= n and plane.all_terminal() \
+                    and plane.pending_depth == 0:
+                break
+            if now > args.drill_timeout_s:
+                print(f"[fleet] drill timed out: {plane.counts()}",
+                      file=sys.stderr, flush=True)
+                break
+            time.sleep(0.01)
+        if swap_thread is not None:
+            swap_thread.join(timeout=args.drill_timeout_s)
+    finally:
+        router.close()
+        exit_codes = {p.name: p.drain(timeout_s=args.startup_timeout_s)
+                      for p in procs}
+    wall = time.monotonic() - t0
+
+    # fleet-wide report: every survivor's obs shard merged into the
+    # fleet session's registry (BEFORE obs.shutdown exports it)
+    shards = merge_replica_shards(
+        os.path.join(fleet_dir, "obs"), [p.obs_dir for p in procs])
+
+    records = plane.records()
+    completed = [r for r in records if r.state == COMPLETED]
+    lost = [r for r in records if r.state != COMPLETED]
+    redrives = sum(r.redrives for r in records)
+    mismatches = 0
+    if args.verify:
+        mismatches = _verify_from_journal(model, params, completed,
+                                          max_len=args.max_len)
+    summary = {
+        "mode": "drill",
+        "replicas": args.replicas,
+        "requests": n,
+        "accepted": len(records),
+        "completed": len(completed),
+        "lost": len(lost),
+        "shed": shed,
+        "redrives": redrives,
+        "failovers": router.failovers_total,
+        "duplicates": plane.duplicate_results_total,
+        "killed": trigger.killed,
+        "hung": trigger.hung,
+        "replica_exit_codes": exit_codes,
+        "shards_merged": sum(bool(v) for v in shards.values()),
+        "wall_s": round(wall, 3),
+    }
+    if args.swap_checkpoint:
+        summary["rolling_swap"] = args.swap_checkpoint
+    if args.verify:
+        summary["verify_mismatches"] = mismatches
+    obs.record_serve(kind="fleet_drill", **{
+        k: v for k, v in summary.items()
+        if isinstance(v, (int, float, str))})
+    print(json.dumps(summary))
+    if lost:
+        print(f"DRILL FAILED: {len(lost)} accepted request(s) lost: "
+              + ", ".join(f"{r.rid}[{r.state}:{r.error}]"
+                          for r in lost[:8]),
+              file=sys.stderr, flush=True)
+        return 1
+    if mismatches:
+        print(f"VERIFY FAILED: {mismatches} redriven/completed "
+              "request(s) diverged from solo decode",
+              file=sys.stderr, flush=True)
+        return 1
+    return 0
+
+
+def _verify_from_journal(model, params, completed,
+                         max_len: int) -> int:
+    """Re-decode every completed record's journal payload through solo
+    ``generate()`` at the replicas' cache geometry and count token
+    mismatches — works on greedy AND seed-pinned sampled requests
+    because every replica serves identical weights/geometry (the
+    redrive caveat: with non-identical replicas only greedy requests
+    are re-verifiable)."""
+    import jax
+    import numpy as np
+
+    from torchpruner_tpu.generate import generate
+
+    mismatches = 0
+    for rec in completed:
+        p = rec.payload
+        prompt = np.asarray(p["prompt_ids"], np.int32)
+        want = generate(
+            model, params, prompt[None], int(p["max_new"]),
+            temperature=float(p.get("temperature") or 0.0),
+            top_k=p.get("top_k"), top_p=p.get("top_p"),
+            rng=jax.random.PRNGKey(int(p.get("seed") or 0)),
+            max_len=max_len)
+        got = np.asarray(rec.tokens or [], np.int32)
+        if not np.array_equal(got, np.asarray(want)[0][:got.size]) \
+                or got.size != int(p["max_new"]):
+            # eos early-stop: accept a shorter stream only when the
+            # solo replay stops at the same token
+            solo = np.asarray(want)[0]
+            if not (got.size and p.get("eos_id") is not None
+                    and got[-1] == p["eos_id"]
+                    and np.array_equal(got, solo[:got.size])):
+                mismatches += 1
+    return mismatches
+
+
+def run_http(preset: str, args, fleet_dir: str,
+             chaos: FleetChaos) -> int:
+    """The fleet HTTP endpoint: accept → journal → route → answer."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from torchpruner_tpu.resilience.guards import PreemptionHandler
+
+    procs = spawn_fleet(preset, args, fleet_dir, chaos)
+    journal = os.path.join(fleet_dir, JOURNAL_FILENAME)
+    if os.path.exists(journal):
+        # a restarted endpoint REDRIVES its previous incarnation's
+        # journal instead of clobbering it — the router-death half of
+        # the completed-or-redrivable contract
+        plane = RequestPlane.load(journal)
+        redriven = plane.pending_depth
+        if redriven:
+            print(f"[fleet] journal reloaded: {redriven} non-terminal "
+                  f"record(s) redriven", file=sys.stderr, flush=True)
+    else:
+        plane = RequestPlane(journal)
+    # bound the journal: the long-running endpoint keeps only the
+    # newest terminal records (flush cost must not grow with lifetime
+    # traffic)
+    plane.retain_terminal = 512
+    router = FleetRouter(plane, procs, policy=_policy_of(args))
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            router.tick()
+            time.sleep(0.02)
+
+    from torchpruner_tpu.serve.frontend import http_json
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _json(self, code: int, payload: dict,
+                  headers: Optional[dict] = None):
+            http_json(self, code, payload, headers)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                verdict = router.admission()
+                self._json(
+                    200 if verdict["accepting"] else verdict["code"],
+                    {"ok": verdict["accepting"],
+                     "reason": verdict["reason"],
+                     "degraded": router.degraded()})
+            elif self.path == "/stats":
+                self._json(200, router.snapshot())
+            else:
+                self._json(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path != "/v1/generate":
+                self._json(404, {"error": "not found"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n))
+                # validate the wire schema BEFORE the journal accepts
+                # it: a malformed request must be an immediate 400, not
+                # a journaled record that burns the whole retry budget
+                # on replica 400s and lands in the LOSS counter
+                probe = request_from_dict(payload)
+                probe.sampling.validate(0)
+            except (KeyError, TypeError, ValueError,
+                    json.JSONDecodeError) as e:
+                self._json(400, {"error": f"bad request: {e}"})
+                return
+            rec = router.submit(payload, deadline_s=args.deadline_s)
+            if rec is None:
+                verdict = router.admission()
+                self._json(verdict["code"] or 503,
+                           {"error": verdict["reason"] or "shed"},
+                           headers={"Retry-After":
+                                    verdict["retry_after_s"] or 1})
+                return
+            rec.wait(timeout=args.deadline_s + 5)
+            if rec.state == COMPLETED:
+                self._json(200, {"id": rec.rid, "state": "done",
+                                 "tokens": rec.tokens,
+                                 "served_by": rec.completed_by,
+                                 "attempts": rec.attempts,
+                                 "redrives": rec.redrives})
+            else:
+                self._json(504, {"id": rec.rid, "state": rec.state,
+                                 "error": rec.error})
+
+    server = ThreadingHTTPServer(("127.0.0.1", args.http), Handler)
+    pump_t = threading.Thread(target=pump, daemon=True)
+    pump_t.start()
+    srv_t = threading.Thread(target=server.serve_forever, daemon=True)
+    srv_t.start()
+    print(f"fleet: routing {args.replicas} replicas on "
+          f"http://127.0.0.1:{args.http} (POST /v1/generate, "
+          f"GET /healthz /stats)", file=sys.stderr, flush=True)
+    rc = 0
+    try:
+        with PreemptionHandler() as pre:
+            while not pre.requested:
+                time.sleep(0.2)
+            print("[fleet] SIGTERM: draining", file=sys.stderr,
+                  flush=True)
+            deadline = time.monotonic() + args.deadline_s
+            while not plane.all_terminal() \
+                    and time.monotonic() < deadline:
+                time.sleep(0.1)
+    finally:
+        stop.set()
+        server.shutdown()
+        router.close()
+        for p in procs:
+            p.drain(timeout_s=args.startup_timeout_s)
+        merge_replica_shards(os.path.join(fleet_dir, "obs"),
+                             [p.obs_dir for p in procs])
+        print(json.dumps({"mode": "http", **router.snapshot()}),
+              file=sys.stderr, flush=True)
+    return rc
+
+
+def _policy_of(args) -> RouterPolicy:
+    return RouterPolicy(
+        queue_bound=args.queue_bound,
+        max_attempts=args.max_attempts,
+        attempt_timeout_s=args.attempt_timeout_s,
+        default_deadline_s=args.deadline_s,
+        seed=args.seed,
+        health_every_s=args.health_every_s,
+        max_inflight_per_replica=args.inflight_per_replica)
+
+
+def fleet_main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="torchpruner_tpu fleet",
+        description="fault-tolerant multi-replica serving plane: "
+                    "health-checked router over N serve replicas, "
+                    "durable request journal, redrive on replica "
+                    "death, degraded-mode admission, failover drills")
+    p.add_argument("preset", help="preset/model name every replica "
+                                  "serves (same seed ⇒ identical "
+                                  "weights ⇒ redrive bit-identity)")
+    p.add_argument("--checkpoint", metavar="DIR",
+                   help="serve this checkpoint on every replica")
+    p.add_argument("--replicas", type=int, default=3)
+    p.add_argument("--slots", type=int, default=2)
+    p.add_argument("--max-len", type=int, default=96)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--smoke", action="store_true")
+    p.add_argument("--cpu", action="store_true")
+    p.add_argument("--fleet-dir", default="logs/fleet",
+                   help="journal + per-replica obs/run/log dirs + the "
+                        "merged fleet obs dir live here")
+    mode = p.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--synthetic", type=int, metavar="N",
+                      help="failover drill: N open-loop Poisson "
+                           "requests, JSON summary, exit 1 on any "
+                           "accepted-request loss")
+    mode.add_argument("--http", type=int, metavar="PORT",
+                      help="serve the fleet HTTP endpoint")
+    p.add_argument("--rate", type=float, default=4.0,
+                   help="drill: Poisson arrival rate (requests/s)")
+    p.add_argument("--prompt-lens", default="4,8,6")
+    p.add_argument("--max-new", default="8,5,12")
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--verify", action="store_true",
+                   help="drill: re-decode every completed request from "
+                        "the journal through solo generate() and "
+                        "assert token bit-identity (the redrive "
+                        "correctness contract)")
+    p.add_argument("--chaos", metavar="JSON",
+                   help="fleet fault injection, e.g. "
+                        "'{\"kill_replica_at_step\": 8}' (SIGKILL), "
+                        "hang_replica_at_step (SIGSTOP), "
+                        "slow_replica_ms (per-step stall), "
+                        "replica_index")
+    p.add_argument("--swap-checkpoint", metavar="DIR",
+                   help="drill: rolling hot-swap every replica to this "
+                        "checkpoint once --swap-after dispatches "
+                        "happened (the fleet upgrade loop)")
+    p.add_argument("--swap-after", type=int, default=4)
+    p.add_argument("--queue-bound", type=int, default=64,
+                   help="router pending-queue bound (shed past it; "
+                        "tightened while degraded)")
+    p.add_argument("--replica-queue-bound", type=int, default=8,
+                   help="per-replica scheduler queue bound (the serve "
+                        "--queue-bound each replica runs with)")
+    p.add_argument("--deadline-s", type=float, default=300.0,
+                   help="per-request deadline budget")
+    p.add_argument("--max-attempts", type=int, default=10)
+    p.add_argument("--attempt-timeout-s", type=float, default=90.0)
+    p.add_argument("--health-every-s", type=float, default=0.25)
+    p.add_argument("--inflight-per-replica", type=int, default=4)
+    p.add_argument("--drill-timeout-s", type=float, default=900.0)
+    p.add_argument("--startup-timeout-s", type=float, default=300.0)
+    p.add_argument("--slo-ttft-p99-ms", type=float, default=None,
+                   help="forwarded to every replica (their /healthz "
+                        "flips to slo_breach on episodes — the "
+                        "router's degraded-admission signal)")
+    p.add_argument("--slo-token-p99-ms", type=float, default=None)
+    p.add_argument("--no-obs", action="store_true")
+    args = p.parse_args(argv)
+
+    chaos = FleetChaos.from_any(args.chaos)
+    fleet_dir = os.path.abspath(args.fleet_dir)
+    os.makedirs(fleet_dir, exist_ok=True)
+
+    if args.cpu:
+        # the driver itself touches jax (model init for synthetic
+        # vocab + --verify replays) — pin it like the replicas
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from torchpruner_tpu import obs
+
+    session = None
+    if not args.no_obs:
+        session = obs.configure(os.path.join(fleet_dir, "obs"))
+        obs.annotate_run(experiment=f"fleet:{args.preset}", kind="fleet",
+                         model=args.preset, replicas=args.replicas)
+    try:
+        if args.http is not None:
+            return run_http(args.preset, args, fleet_dir, chaos)
+        return run_drill(args.preset, args, fleet_dir, chaos)
+    finally:
+        if session is not None:
+            obs.shutdown(print_to=sys.stderr)
+            print(f"fleet telemetry written to "
+                  f"{os.path.join(fleet_dir, 'obs')}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    sys.exit(fleet_main())

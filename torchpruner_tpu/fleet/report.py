@@ -1,0 +1,70 @@
+"""Fleet-wide observability: merge the replicas' metric shards.
+
+Each replica is its own process with its own obs dir, so each writes a
+``metrics.shard0.json`` at close (PR 5's cross-host aggregation path —
+there, process index distinguishes shards; here every replica is a
+process 0 of its own little world).  The fleet driver re-homes those
+shards into ITS obs dir under distinct indices before its own session
+closes, so the ordinary ``obs.aggregate`` merge produces ONE fleet-wide
+``metrics.prom`` / ``report.json``: serve histograms bucket-merged
+across replicas, counters summed, gauges max-with-min-companion — plus
+the router's own ``fleet_*`` counters riding the same registry.
+
+A ``kill -9``'d replica never reaches its session close and therefore
+ships no shard; the merge reports it missing instead of failing — the
+fleet report is the SURVIVORS' merged view plus the router's account of
+the death (``fleet_failover_total`` / ``fleet_redrive_total``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from torchpruner_tpu.obs.aggregate import shard_path
+from torchpruner_tpu.resilience.manifest import atomic_write_json
+
+
+def merge_replica_shards(fleet_obs_dir: str,
+                         replica_obs_dirs: List[str]) -> Dict[str, bool]:
+    """Re-home each replica's ``metrics.shard0.json`` into
+    ``fleet_obs_dir`` as ``metrics.shard<i+1>.json`` (index 0 is the
+    fleet session's own registry).  Returns ``{replica_dir: present}``
+    — call BEFORE ``obs.shutdown()`` so the fleet session's close
+    merges what landed."""
+    out: Dict[str, bool] = {}
+    for i, rep_dir in enumerate(replica_obs_dirs):
+        src = shard_path(rep_dir, 0)
+        present = os.path.exists(src)
+        out[rep_dir] = present
+        if not present:  # a kill -9'd replica writes no shard
+            continue
+        try:
+            with open(src) as f:
+                shard = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            out[rep_dir] = False
+            continue
+        shard["process_index"] = i + 1
+        atomic_write_json(shard_path(fleet_obs_dir, i + 1), shard,
+                          indent=None)
+    return out
+
+
+def replica_summary_line(log_path: str) -> Optional[dict]:
+    """The last JSON line a serve front end printed (its run summary),
+    scraped from the replica's captured output — best-effort."""
+    try:
+        with open(log_path, "rb") as f:
+            lines = f.read().decode(errors="replace").splitlines()
+    except OSError:
+        return None
+    for line in reversed(lines):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None

@@ -1,0 +1,487 @@
+"""The fleet router: health-checked least-loaded dispatch + failover.
+
+One router process fronts N serve replicas and makes replica death a
+non-event:
+
+- **Health view** — every ``health_every_s`` the router probes each
+  replica's ``/healthz`` (liveness split from readiness: ``draining`` /
+  ``staging_swap`` / ``slo_breach`` answer 503) and scrapes the live
+  ``kv_page_occupancy`` / ``slot_utilization`` / ``queue_depth`` gauges
+  from ``/stats``.  A live→dead transition is a FAILOVER: the dead
+  replica's journaled in-flight records are hedge-re-dispatched to
+  survivors immediately (``fleet_failover_total`` /
+  ``fleet_redrive_total``) — the worker still blocked on the corpse's
+  socket discovers the death itself and its late result, if any, is
+  dropped idempotently.
+- **Dispatch** — pending plane records go to the least-loaded READY
+  replica (scraped occupancy + queue depth + the router's own in-flight
+  count); each record's attempt loop is
+  ``resilience.retry.with_retries``: bounded attempts, per-attempt
+  timeout clamped by the record's deadline budget, deterministic-jitter
+  exponential backoff, pinned exhaustion-vs-deadline ordering.  When no
+  replica is ready the attempt fails retryably — survivor recovery and
+  backoff, not a crash.
+- **Degraded-mode admission** — :meth:`FleetRouter.submit` sheds by
+  policy instead of collapsing: a bounded pending queue
+  (``queue_bound``), tightened to ``degraded_queue_factor`` of itself
+  while the fleet is degraded (a majority of live replicas in
+  ``slo_breach``, or fewer ready replicas than ``min_ready``), and a
+  loud 503-shaped shed (``fleet_shed_*_total`` + Retry-After hint)
+  when the bound is hit or nothing is live.
+- **Fleet upgrade as a loop** — :meth:`rolling_swap` stages PR 6's
+  background checkpoint hot-swap on one replica at a time, waiting for
+  each swap to land (readiness flips through ``staging_swap`` and the
+  router routes around it) before touching the next.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from torchpruner_tpu import obs
+from torchpruner_tpu.fleet.plane import PlaneRecord, RequestPlane
+from torchpruner_tpu.fleet.replica import (
+    ReplicaBusy,
+    ReplicaClient,
+    ReplicaError,
+)
+from torchpruner_tpu.resilience.retry import (
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    with_retries,
+)
+
+
+@dataclass(frozen=True)
+class RouterPolicy:
+    """Every budget/bound in one place (CLI-overridable)."""
+
+    #: pending-queue bound; submissions past it shed (0 = unbounded)
+    queue_bound: int = 64
+    #: bound multiplier while the fleet is degraded (SLO-breach
+    #: majority / not enough ready replicas) — admission tightening
+    degraded_queue_factor: float = 0.25
+    #: live replicas in slo_breach at/above this fraction = degraded
+    degraded_breach_fraction: float = 0.5
+    #: fewer READY replicas than this = degraded
+    min_ready: int = 1
+    #: dispatch attempts per record (first try included) — generous:
+    #: a capacity crunch ("no usable replica") consumes attempts too,
+    #: and an accepted record failed on attempts is accepted-request
+    #: loss, the thing the drill exists to forbid
+    max_attempts: int = 10
+    #: per-attempt transport timeout (clamped by the record deadline)
+    attempt_timeout_s: float = 90.0
+    #: deadline budget stamped on records submitted without one
+    default_deadline_s: float = 300.0
+    base_backoff_s: float = 0.05
+    max_backoff_s: float = 2.0
+    #: deterministic-jitter seed (resilience.retry)
+    seed: int = 0
+    health_every_s: float = 0.5
+    health_timeout_s: float = 2.0
+    #: concurrent in-flight dispatches per replica (≈ slots + a margin
+    #: that keeps the replica's bounded queue warm without flooding it)
+    max_inflight_per_replica: int = 4
+
+    def retry_policy(self) -> RetryPolicy:
+        return RetryPolicy(
+            tries=self.max_attempts, base_delay_s=self.base_backoff_s,
+            max_delay_s=self.max_backoff_s, seed=self.seed)
+
+
+@dataclass
+class ReplicaView:
+    """The router's last-probed view of one replica."""
+
+    client: ReplicaClient
+    live: bool = False
+    ready: bool = False
+    state: str = "unknown"
+    occupancy: float = 0.0
+    slot_utilization: float = 0.0
+    queue_depth: int = 0
+    swaps: int = 0
+    probed_at: float = 0.0
+    #: set once the death was failed over (so one death = one failover)
+    failover_done: bool = False
+    dispatched_total: int = 0
+    inflight: int = 0
+    extra: dict = field(default_factory=dict)
+
+
+class FleetRouter:
+    """See module docstring.  Thread model: front ends call
+    :meth:`submit` from any thread; :meth:`tick` runs on the owner's
+    loop (drill driver or the HTTP server's pump thread); dispatch
+    attempts run on an internal executor, one worker per in-flight
+    record."""
+
+    def __init__(self, plane: RequestPlane,
+                 replicas: List[ReplicaClient],
+                 policy: RouterPolicy = RouterPolicy()):
+        self.plane = plane
+        self.policy = policy
+        self.views: Dict[str, ReplicaView] = {
+            r.name: ReplicaView(client=r) for r in replicas}
+        self._lock = threading.RLock()
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(2, policy.max_inflight_per_replica
+                            * len(replicas)),
+            thread_name_prefix="fleet-dispatch")
+        self._last_health = 0.0
+        self.failovers_total = 0
+        self.shed_total = 0
+        self.dispatched_total = 0
+        self._closed = False
+
+    # -- admission -----------------------------------------------------------
+
+    def degraded(self) -> bool:
+        """Admission tightening trigger: not enough ready replicas, or
+        a majority of the live ones sitting in an SLO-breach episode
+        (the rolling SLOMonitor p99s, scraped via /healthz state)."""
+        with self._lock:
+            live = [v for v in self.views.values() if v.live]
+            ready = [v for v in live if v.ready]
+            if len(ready) < self.policy.min_ready:
+                return True
+            breached = [v for v in live if v.state == "slo_breach"]
+            return bool(live) and (
+                len(breached) / len(live)
+                >= self.policy.degraded_breach_fraction)
+
+    def effective_queue_bound(self) -> int:
+        bound = self.policy.queue_bound
+        if bound and self.degraded():
+            bound = max(1, int(bound * self.policy.degraded_queue_factor))
+        return bound
+
+    def admission(self) -> dict:
+        """One consolidated verdict for front ends: ``accepting`` plus
+        the shed reason / Retry-After hint when not."""
+        live = [v for v in self.views.values() if v.live]
+        if self._closed:
+            return {"accepting": False, "reason": "closing",
+                    "retry_after_s": 5, "code": 503}
+        if not live:
+            return {"accepting": False, "reason": "no_live_replica",
+                    "retry_after_s": 5, "code": 503}
+        bound = self.effective_queue_bound()
+        depth = self.plane.pending_depth
+        if bound and depth >= bound:
+            reason = ("degraded" if bound < self.policy.queue_bound
+                      else "backpressure")
+            return {"accepting": False, "reason": reason,
+                    "retry_after_s": max(1, depth // max(1, len(live))),
+                    "code": 429}
+        return {"accepting": True, "reason": "", "retry_after_s": 0,
+                "code": 200}
+
+    def submit(self, payload: dict,
+               deadline_s: Optional[float] = None
+               ) -> Optional[PlaneRecord]:
+        """Admit one request into the plane, or shed it (``None``) by
+        the current policy — bounded queue, tighter while degraded,
+        immediate when nothing is live."""
+        if self._last_health == 0.0:
+            # first contact: an unprobed fleet must not read as dead
+            self.check_health(force=True)
+        verdict = self.admission()
+        if not verdict["accepting"]:
+            self.shed_total += 1
+            self.plane.note_shed()
+            obs.inc("fleet_shed_total",
+                    help="requests shed at fleet admission (per-reason "
+                         "twins: fleet_shed_<reason>_total)")
+            obs.inc(f"fleet_shed_{verdict['reason']}_total",
+                    help=f"fleet admission sheds ({verdict['reason']})")
+            return None
+        rec = self.plane.accept(
+            payload, deadline_s if deadline_s is not None
+            else self.policy.default_deadline_s)
+        return rec
+
+    # -- health --------------------------------------------------------------
+
+    def check_health(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_health \
+                < self.policy.health_every_s:
+            return
+        self._last_health = now
+        for view in list(self.views.values()):
+            h = view.client.healthz(timeout=self.policy.health_timeout_s)
+            was_live = view.live
+            view.live, view.ready = h["live"], h["ready"]
+            view.state = h["state"]
+            view.probed_at = now
+            if view.live:
+                view.failover_done = False
+                try:
+                    s = view.client.stats(
+                        timeout=self.policy.health_timeout_s)
+                    view.occupancy = float(
+                        s.get("kv_page_occupancy", 0.0))
+                    view.slot_utilization = float(
+                        s.get("slot_utilization", 0.0))
+                    view.queue_depth = int(s.get("queue_depth", 0))
+                    view.swaps = int(s.get("swaps",
+                                           s.get("hot_swaps", 0)) or 0)
+                    view.extra = {k: s.get(k) for k in (
+                        "slo", "decode_steps", "gen_tokens")}
+                except ReplicaError:
+                    pass
+            elif was_live or not view.failover_done:
+                self._failover(view)
+        with self._lock:
+            live = sum(v.live for v in self.views.values())
+            ready = sum(v.ready for v in self.views.values())
+        obs.gauge_set("fleet_replicas_live", live,
+                      help="replicas answering their health probe")
+        obs.gauge_set("fleet_replicas_ready", ready,
+                      help="replicas in the ready routing set")
+        obs.gauge_set("fleet_pending_depth", self.plane.pending_depth,
+                      help="plane records awaiting dispatch")
+
+    def _failover(self, view: ReplicaView) -> None:
+        """A replica left the live set: count the failover once and
+        hedge-re-dispatch its journaled in-flight records to survivors
+        (their original workers are still blocked on the corpse's
+        socket — first completion wins, duplicates drop)."""
+        with self._lock:
+            # dispatch workers probe health concurrently with the tick
+            # loop: exactly ONE of them owns this death
+            if view.failover_done:
+                return
+            view.failover_done = True
+            self.failovers_total += 1
+        obs.inc("fleet_failover_total",
+                help="replica deaths observed by the health monitor")
+        rids = self.plane.assigned_to(view.client.name)
+        print(f"[fleet] replica {view.client.name} is gone "
+              f"({len(rids)} in-flight record(s) redriven)",
+              file=sys.stderr, flush=True)
+        for rid in rids:
+            if self.plane.release(rid, redrive=True):
+                self._spawn_dispatch()
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _pick(self, exclude: Optional[str] = None) -> Optional[ReplicaView]:
+        """Least-loaded routing over the scraped gauges: READY replicas
+        first (excluding the just-failed one when another exists), by
+        (router in-flight fraction + scraped occupancy + queue depth,
+        with a tiny dispatched-count bias that round-robins exact
+        ties); degraded-but-live replicas (slo_breach / staging_swap)
+        are the fallback so a fully-degraded fleet still serves — only
+        draining and dead replicas are never picked.  The winner's
+        in-flight slot is RESERVED under the lock (the caller must
+        release it), so concurrent picks see each other's load."""
+        with self._lock:
+            cap = self.policy.max_inflight_per_replica
+
+            def load(v: ReplicaView) -> float:
+                return (v.inflight / max(1, cap) + v.occupancy
+                        + v.slot_utilization + 0.25 * v.queue_depth
+                        + 1e-3 * v.dispatched_total)
+
+            def usable(v: ReplicaView, ready_only: bool) -> bool:
+                if not v.live or v.state == "draining":
+                    return False
+                if v.inflight >= cap:
+                    return False
+                return v.ready if ready_only else True
+
+            for ready_only in (True, False):
+                pool = [v for v in self.views.values()
+                        if usable(v, ready_only)
+                        and v.client.name != exclude]
+                if not pool and exclude is not None:
+                    pool = [v for v in self.views.values()
+                            if usable(v, ready_only)]
+                if pool:
+                    view = min(pool, key=load)
+                    view.inflight += 1
+                    view.dispatched_total += 1
+                    return view
+            return None
+
+    def pump(self) -> int:
+        """Move pending plane records onto dispatch workers; returns
+        how many were started.  Workers wait for capacity themselves
+        (deadline-bounded), so pending work always ends up terminal —
+        completed on a usable replica, or failed LOUDLY when the
+        deadline expires with nothing usable."""
+        n = 0
+        while self._spawn_dispatch():
+            n += 1
+        return n
+
+    def _spawn_dispatch(self) -> bool:
+        rec = self.plane.checkout()
+        if rec is None:
+            return False
+        self.dispatched_total += 1
+        obs.inc("fleet_dispatch_total",
+                help="plane records handed to a dispatch worker")
+        self._pool.submit(self._dispatch, rec)
+        return True
+
+    def _dispatch(self, rec: PlaneRecord) -> None:
+        deadline = Deadline.after(rec.remaining_s())
+        last_failed: Optional[str] = None
+
+        def attempt(timeout_s: Optional[float]):
+            nonlocal last_failed
+            # capacity/availability waits ride the DEADLINE, not the
+            # attempt budget: attempts are for transport failures, so a
+            # saturated-but-healthy fleet queues work instead of
+            # burning retries into a spurious loss
+            view = self._pick(exclude=last_failed)
+            while view is None:
+                if deadline.expired:
+                    raise DeadlineExceeded(
+                        f"{rec.rid}: no usable replica before the "
+                        f"deadline ({deadline.budget_s:.1f}s)")
+                time.sleep(min(0.05, max(0.001,
+                                         self.policy.health_every_s)))
+                self.check_health()
+                view = self._pick(exclude=last_failed)
+            name = view.client.name
+            self.plane.assign(rec.rid, name)
+            try:
+                out = view.client.generate(rec.payload,
+                                           timeout=timeout_s)
+            except ReplicaError:
+                last_failed = name
+                # probe NOW so a death is seen (and its other records
+                # hedge) before the backoff sleep finishes
+                self.check_health(force=True)
+                raise
+            finally:
+                with self._lock:
+                    view.inflight -= 1  # release the _pick reservation
+            return name, out
+
+        try:
+            name, out = with_retries(
+                attempt, policy=self.policy.retry_policy(),
+                deadline=deadline,
+                attempt_timeout_s=self.policy.attempt_timeout_s,
+                retry_on=(ReplicaError,), label="fleet_dispatch")
+        except DeadlineExceeded as e:
+            obs.inc("fleet_deadline_exceeded_total",
+                    help="records failed by deadline expiry")
+            self.plane.fail(rec.rid, f"deadline: {e}")
+            return
+        except ReplicaError as e:
+            self.plane.fail(rec.rid, f"attempts exhausted: {e}")
+            return
+        except Exception as e:  # noqa: BLE001 - worker must not die silent
+            self.plane.fail(rec.rid, f"{type(e).__name__}: {e}")
+            return
+        self.plane.complete(rec.rid, out.get("tokens", []), name)
+
+    # -- the loop ------------------------------------------------------------
+
+    def tick(self) -> None:
+        """One router heartbeat: health (rate-limited) + dispatch."""
+        self.check_health()
+        self.pump()
+
+    def run_until_drained(self, *, poll_s: float = 0.02,
+                          timeout_s: Optional[float] = None,
+                          stop_event: Optional[threading.Event] = None,
+                          on_tick=None) -> None:
+        """Drive ticks until every accepted record is terminal (the
+        drill loop); ``on_tick`` is the drill's chaos hook."""
+        deadline = (time.monotonic() + timeout_s
+                    if timeout_s is not None else None)
+        while True:
+            self.tick()
+            if on_tick is not None:
+                on_tick(self)
+            if self.plane.all_terminal() \
+                    and self.plane.pending_depth == 0:
+                return
+            if stop_event is not None and stop_event.is_set():
+                return
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"fleet router: records still pending after "
+                    f"{timeout_s:.0f}s: {self.plane.counts()}")
+            time.sleep(poll_s)
+
+    def close(self) -> None:
+        self._closed = True
+        self._pool.shutdown(wait=True)
+
+    # -- fleet upgrade -------------------------------------------------------
+
+    def rolling_swap(self, checkpoint: str, *,
+                     wait_s: float = 600.0) -> int:
+        """Staggered checkpoint hot-swap: one replica at a time, POST
+        /swap then wait for its swap counter to tick (readiness passes
+        through ``staging_swap`` and the router routes around it), then
+        the next — the zero-downtime fleet upgrade loop.  Returns how
+        many replicas swapped."""
+        swapped = 0
+        for view in self.views.values():
+            if not view.live:
+                continue
+            c = view.client
+            before = int(c.stats(timeout=5.0).get("swaps", 0) or 0)
+            c.swap(checkpoint)
+            obs.inc("fleet_swaps_staged_total",
+                    help="rolling-upgrade swap stagings issued")
+            deadline = time.monotonic() + wait_s
+            while time.monotonic() < deadline:
+                try:
+                    if int(c.stats(timeout=5.0).get("swaps", 0) or 0) \
+                            > before:
+                        swapped += 1
+                        break
+                except ReplicaError:
+                    pass
+                time.sleep(0.25)
+            else:
+                raise TimeoutError(
+                    f"rolling swap: {c.name} did not land its swap "
+                    f"inside {wait_s:.0f}s")
+        return swapped
+
+    # -- reporting -----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            reps = {
+                name: {
+                    "live": v.live, "ready": v.ready, "state": v.state,
+                    "occupancy": v.occupancy,
+                    "slot_utilization": v.slot_utilization,
+                    "queue_depth": v.queue_depth,
+                    "inflight": v.inflight,
+                    "dispatched_total": v.dispatched_total,
+                } for name, v in self.views.items()}
+        return {
+            "replicas": reps,
+            "plane": self.plane.counts(),
+            "degraded": self.degraded(),
+            "queue_bound": self.policy.queue_bound,
+            "effective_queue_bound": self.effective_queue_bound(),
+            "failovers_total": self.failovers_total,
+            "shed_total": self.shed_total,
+            "dispatched_total": self.dispatched_total,
+        }
+
+
+def summary_json(router: FleetRouter) -> str:
+    return json.dumps(router.snapshot())

@@ -91,10 +91,12 @@ _SCALARS = {
 #: gauges (analysis/planner.py); ``frontier_*`` / ``search_*`` are the
 #: sparsity-search campaign's frontier scalars (best accuracy at fixed
 #: FLOPs buckets, point/early-stop counts — search/frontier.py), the
-#: gates CI holds frontier regressions with
+#: gates CI holds frontier regressions with; ``fleet_*`` are the
+#: multi-replica serving plane's failover/redrive/shed counters and
+#: replica gauges (fleet/router.py), gated by the CI failover drill
 _DYNAMIC_SCALAR_PREFIXES = ("kernel_", "serve_slo_breach", "zero_",
                             "predicted_", "plan_", "frontier_",
-                            "search_")
+                            "search_", "fleet_")
 _DYNAMIC_EXTRA = ("profile_coverage", "profile_windows_total",
                   "profile_steps_total")
 

@@ -48,9 +48,12 @@ from torchpruner_tpu.resilience.manifest import (
     atomic_write_json,
 )
 from torchpruner_tpu.resilience.retry import (
+    Deadline,
+    DeadlineExceeded,
     RetryPolicy,
     retriable,
     retry_call,
+    with_retries,
 )
 
 __all__ = [
@@ -63,9 +66,12 @@ __all__ = [
     "is_oom_error",
     "RunManifest",
     "atomic_write_json",
+    "Deadline",
+    "DeadlineExceeded",
     "RetryPolicy",
     "retriable",
     "retry_call",
+    "with_retries",
 ]
 
 ChaosConfig = chaos.ChaosConfig

@@ -14,6 +14,7 @@ the ``bench.py`` resilience leg exercise every recovery path on demand:
     {"fail_data_at_step": 2}    # transient OSError from the batch stream
     {"corrupt_checkpoint": true} # flip bytes in the next saved checkpoint
     {"delay_callback_s": 0.05}  # stall host callbacks / data fetch once
+    {"slow_steps_ms": 5}        # stall EVERY serve decode step (slow replica)
 
 Hooks are wired into ``Trainer.step`` / ``ShardedTrainer.step`` and the
 resilient runner; every hook is a single module-global ``None`` check
@@ -79,6 +80,11 @@ class ChaosConfig:
     corrupt_checkpoint: bool = False
     #: one-shot sleep injected into host callbacks / data fetch
     delay_callback_s: float = 0.0
+    #: per-decode-step sleep injected into a SERVING engine's loop (ms)
+    #: — the "slow replica" fleet fault: the process stays live and
+    #: correct but its tail latency degrades until the router's
+    #: health/SLO view routes around it.  Fires every step (not once).
+    slow_steps_ms: float = 0.0
     #: each injection fires at most once per process (default) — set
     #: False only in unit tests that want repeat fires
     once: bool = True
@@ -110,6 +116,7 @@ class ChaosConfig:
             self.nan_at_step >= 0 or self.kill_at_step >= 0
             or self.oom_at_step >= 0 or self.fail_data_at_step >= 0
             or self.corrupt_checkpoint or self.delay_callback_s > 0
+            or self.slow_steps_ms > 0
         )
 
 
@@ -242,6 +249,20 @@ def maybe_fail_data(step: int) -> None:
     raise InjectedDataError(
         f"chaos: transient data-loading failure at step {step}"
     )
+
+
+def maybe_slow_step() -> None:
+    """Per-step stall for a SERVING engine (``slow_steps_ms``) — unlike
+    :func:`maybe_delay` this fires on EVERY decode step, degrading the
+    replica's per-token latency without killing it.  The fleet drill
+    injects it into one replica's env to exercise SLO-driven routing."""
+    if _cfg is None or _cfg.slow_steps_ms <= 0:
+        return
+    if "slow" not in _fired:
+        # count the injection once; the sleeps themselves are the fault
+        _fired.add("slow")
+        obs.inc("chaos_injections_total", help="chaos faults injected")
+    time.sleep(_cfg.slow_steps_ms / 1e3)
 
 
 def maybe_delay() -> None:

@@ -10,6 +10,11 @@ Jitter is deterministic (splitmix-style hash of ``seed`` + attempt), the
 same policy the repo uses for data shuffling: two runs of the same
 config produce the same sleep schedule, so retry behavior never makes a
 resumed run diverge from an uninterrupted one.
+
+:class:`Deadline` / :func:`with_retries` add the serving-plane half:
+one attempt machine shared by data-stream retries AND the fleet
+router's dispatch — max attempts, per-attempt timeout, and an overall
+deadline budget, with pinned exhaustion-vs-deadline error ordering.
 """
 
 from __future__ import annotations
@@ -19,6 +24,44 @@ from dataclasses import dataclass
 from typing import Callable, Optional, Tuple, Type
 
 from torchpruner_tpu import obs
+
+
+class DeadlineExceeded(TimeoutError):
+    """The :class:`Deadline` ran out before the call succeeded.  The
+    last transient failure (when one happened) is chained as
+    ``__cause__`` so the operator sees WHY the budget was spent, not
+    just that it was."""
+
+
+@dataclass(frozen=True)
+class Deadline:
+    """An absolute time budget shared across retry attempts.
+
+    A per-attempt timeout bounds one try; the deadline bounds the WHOLE
+    operation (attempts + backoff sleeps) — the budget a caller with an
+    SLA actually has.  Monotonic-clock based; create with
+    :meth:`after`."""
+
+    t_end: float
+    budget_s: float
+
+    @classmethod
+    def after(cls, budget_s: float) -> "Deadline":
+        return cls(t_end=time.monotonic() + float(budget_s),
+                   budget_s=float(budget_s))
+
+    def remaining(self) -> float:
+        return max(0.0, self.t_end - time.monotonic())
+
+    @property
+    def expired(self) -> bool:
+        return time.monotonic() >= self.t_end
+
+    def clamp(self, timeout_s: Optional[float]) -> float:
+        """The per-attempt timeout: ``timeout_s`` bounded by what is
+        left of the budget (never negative)."""
+        rem = self.remaining()
+        return rem if timeout_s is None else min(float(timeout_s), rem)
 
 #: exception types considered transient by default: data-loading /
 #: host-callback I/O.  Deliberately narrow — an OOM or a NaN streak must
@@ -58,24 +101,51 @@ class RetryPolicy:
         return max(0.0, d)
 
 
-def retry_call(
-    fn: Callable,
-    *args,
+def with_retries(
+    fn: Callable[[Optional[float]], object],
+    *,
     policy: RetryPolicy = RetryPolicy(),
+    deadline: Optional[Deadline] = None,
+    attempt_timeout_s: Optional[float] = None,
     retry_on: Tuple[Type[BaseException], ...] = DEFAULT_TRANSIENT,
     label: str = "call",
     sleep: Callable[[float], None] = time.sleep,
     on_retry: Optional[Callable[[int, BaseException], None]] = None,
-    **kwargs,
 ):
-    """Call ``fn(*args, **kwargs)``, retrying transient failures with
-    exponential backoff.  Each retry bumps ``resilience_retries_total``;
-    exhausting the budget re-raises the LAST exception unchanged (the
-    caller sees the real failure, not a wrapper)."""
+    """The shared attempt machine under both the data-stream retries
+    (:func:`retry_call`) and the fleet router's dispatch: bounded
+    attempts, per-attempt timeout, deterministic-jitter exponential
+    backoff, and an overall :class:`Deadline`.
+
+    ``fn(timeout_s)`` is called with the per-attempt timeout — the
+    caller's ``attempt_timeout_s`` clamped to the deadline's remaining
+    budget (``None`` when neither bound is set; transports pass it to
+    their socket timeout, plain calls may ignore it).
+
+    Error ordering (test-pinned):
+
+    - the deadline already expired before an attempt → raise
+      :class:`DeadlineExceeded` (chained from the last failure, if any)
+      WITHOUT burning another attempt;
+    - the LAST allowed attempt fails → re-raise its exception unchanged
+      (exhaustion wins over a simultaneous deadline expiry: the caller
+      sees the real failure, not a wrapper);
+    - a mid-budget failure whose backoff sleep would cross the deadline
+      → :class:`DeadlineExceeded` chained from that failure (never
+      sleep past the budget just to fail on arrival).
+    """
     last: Optional[BaseException] = None
     for attempt in range(1, policy.tries + 1):
+        if deadline is not None and deadline.expired:
+            obs.inc("resilience_deadline_exceeded_total",
+                    help="retry budgets cut short by their deadline")
+            raise DeadlineExceeded(
+                f"{label}: deadline ({deadline.budget_s:.3f}s) expired "
+                f"after {attempt - 1} attempt(s)") from last
+        timeout = (deadline.clamp(attempt_timeout_s)
+                   if deadline is not None else attempt_timeout_s)
         try:
-            return fn(*args, **kwargs)
+            return fn(timeout)
         except retry_on as e:  # noqa: PERF203 - retry loop by design
             last = e
             if attempt == policy.tries:
@@ -89,8 +159,36 @@ def retry_call(
                         help=f"transient-failure retries ({label})")
             if on_retry is not None:
                 on_retry(attempt, e)
-            sleep(policy.delay(attempt))
+            delay = policy.delay(attempt)
+            if deadline is not None and delay >= deadline.remaining():
+                obs.inc("resilience_deadline_exceeded_total",
+                        help="retry budgets cut short by their deadline")
+                raise DeadlineExceeded(
+                    f"{label}: deadline ({deadline.budget_s:.3f}s) "
+                    f"leaves no room for the {delay:.3f}s backoff after "
+                    f"attempt {attempt}") from e
+            sleep(delay)
     raise last  # unreachable; keeps type checkers honest
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    policy: RetryPolicy = RetryPolicy(),
+    retry_on: Tuple[Type[BaseException], ...] = DEFAULT_TRANSIENT,
+    label: str = "call",
+    sleep: Callable[[float], None] = time.sleep,
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    **kwargs,
+):
+    """Call ``fn(*args, **kwargs)``, retrying transient failures with
+    exponential backoff.  Each retry bumps ``resilience_retries_total``;
+    exhausting the budget re-raises the LAST exception unchanged (the
+    caller sees the real failure, not a wrapper).  Timeout-less facade
+    over :func:`with_retries`."""
+    return with_retries(
+        lambda _timeout_s: fn(*args, **kwargs), policy=policy,
+        retry_on=retry_on, label=label, sleep=sleep, on_retry=on_retry)
 
 
 def retriable(policy: RetryPolicy = RetryPolicy(),

@@ -35,7 +35,11 @@ from torchpruner_tpu.serve.engine import (
     sample_tokens,
     vocab_of,
 )
-from torchpruner_tpu.serve.request import Request, Sampling
+from torchpruner_tpu.serve.request import (
+    Request,
+    Sampling,
+    request_from_dict,
+)
 from torchpruner_tpu.serve.scheduler import Scheduler
 from torchpruner_tpu.serve.slo import SLOMonitor
 from torchpruner_tpu.serve.traffic import (
@@ -49,5 +53,5 @@ __all__ = [
     "Request", "Sampling", "KVCacheAllocator", "Scheduler", "ServeEngine",
     "OpenLoopTraffic", "poisson_arrivals", "staggered_arrivals",
     "synthetic_requests", "aligned_len", "bucket_for", "prefill_buckets",
-    "sample_tokens", "vocab_of", "SLOMonitor",
+    "sample_tokens", "vocab_of", "SLOMonitor", "request_from_dict",
 ]

@@ -53,6 +53,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from torchpruner_tpu import obs
+from torchpruner_tpu.resilience import chaos as _chaos
 from torchpruner_tpu.serve.allocator import (
     KVCacheAllocator,
     bucket_for,
@@ -236,7 +237,7 @@ class ServeEngine:
                  max_len: int = 256, cache_dtype=None, page_len: int = 0,
                  page_budget: int = 0, run_dir: Optional[str] = None,
                  checkpoint_meta: Optional[dict] = None,
-                 retain_results: bool = True):
+                 retain_results: bool = True, queue_bound: int = 0):
         """``retain_results=False`` (the long-running HTTP server) stops
         the engine from accumulating completed Request objects — each
         request (and, across a hot-swap, the old checkpoint's program
@@ -281,7 +282,8 @@ class ServeEngine:
         self._cost_thread: Optional[threading.Thread] = None
         self.scheduler = Scheduler(
             KVCacheAllocator(n_slots, max_len, page_len=page_len,
-                             page_budget=page_budget))
+                             page_budget=page_budget),
+            queue_bound=queue_bound)
         self.run_dir = run_dir
         self.n_slots, self.max_len = n_slots, max_len
         # host slot tables (the continuous-batching state the compiled
@@ -318,6 +320,10 @@ class ServeEngine:
         #: optional live SLO monitor (serve.slo.SLOMonitor) — fed TTFT /
         #: per-token observations and checked at step boundaries
         self.slo = None
+        #: the preemption handler of the CURRENT run() — lets
+        #: health_state() report "draining" the instant a SIGTERM lands,
+        #: before the loop reaches its next boundary
+        self._preemption = None
 
     # -- submission ---------------------------------------------------------
 
@@ -394,6 +400,8 @@ class ServeEngine:
     def _decode_once(self) -> None:
         import jax.numpy as jnp
 
+        if _chaos.active():
+            _chaos.maybe_slow_step()  # "slow replica" fleet fault
         P = self.programs
         t0 = time.perf_counter()
         # inactive slots decode junk under a clamped position; their
@@ -533,6 +541,27 @@ class ServeEngine:
             return True
         return False
 
+    # -- health -------------------------------------------------------------
+
+    def health_state(self) -> str:
+        """Readiness, distinct from liveness (the process answering at
+        all): ``ready`` | ``draining`` (SIGTERM landed / drain begun —
+        submissions bounce, stop dispatching here) | ``staging_swap``
+        (a checkpoint swap is staging; admissions pause once it's
+        warm) | ``slo_breach`` (a rolling p99 is over its threshold —
+        prefer other replicas).  The ``/healthz`` endpoint maps
+        non-``ready`` states to 503, the k8s-style readiness-probe
+        contract the fleet router keys off."""
+        if self.scheduler.closed or (
+                self._preemption is not None
+                and self._preemption.requested):
+            return "draining"
+        if self._pending_swap is not None:
+            return "staging_swap"
+        if self.slo is not None and self.slo.in_breach_any():
+            return "slo_breach"
+        return "ready"
+
     # -- drain / loop -------------------------------------------------------
 
     def _snapshot_queue(self, extra: Optional[List[Request]] = None) -> None:
@@ -571,6 +600,7 @@ class ServeEngine:
         self._t_first = None
         self._t_last = None
         self._window_tokens0 = self.gen_tokens
+        self._preemption = preemption
         draining = False
         while True:
             self.ticks += 1

@@ -12,12 +12,19 @@ gauges, ledger provenance records):
 - ``--http PORT`` — a local HTTP endpoint: ``POST /v1/generate`` with
   ``{"prompt_ids": [...], "max_new": N, "temperature": ..,
   "top_k": .., "top_p": .., "seed": ..}`` blocks until the engine
-  finishes the request and returns its tokens; ``GET /healthz`` and
-  ``GET /stats`` report liveness and serving gauges (KV-page
-  occupancy, slot utilization, rolling SLO state); ``GET /metrics``
+  finishes the request and returns its tokens (or answers 503 +
+  ``Retry-After`` when the ``--queue-bound``ed scheduler queue is
+  full / a drain began — bounded backpressure, never an unbounded
+  queue); ``GET /healthz`` splits liveness from READINESS (200 only
+  when ``ready``; 503 carrying ``draining`` / ``staging_swap`` /
+  ``slo_breach`` so probes and the fleet router stop dispatching
+  early); ``GET /stats`` reports serving gauges (KV-page occupancy,
+  slot utilization, rolling SLO state); ``GET /metrics``
   exposes the session's Prometheus text (scrapeable live, the same
   exposition ``metrics.prom`` holds at close); ``POST /profile`` arms
-  one on-demand kernel-profiling capture window (``obs.profile``).
+  one on-demand kernel-profiling capture window (``obs.profile``);
+  ``POST /swap {"checkpoint": DIR}`` stages a zero-downtime hot-swap
+  (the fleet upgrade loop's per-replica step).
 - ``--stdin`` — one JSON request per line (same schema), results
   echoed as JSON lines; EOF drains and exits.
 
@@ -38,7 +45,11 @@ import sys
 import threading
 from typing import Optional
 
-from torchpruner_tpu.serve.request import Request, Sampling
+from torchpruner_tpu.serve.request import (
+    DRAINED,
+    SHED,
+    request_from_dict,
+)
 
 
 def _resolve_model(name: str, *, smoke: bool, seed: int,
@@ -70,14 +81,32 @@ def _resolve_model(name: str, *, smoke: bool, seed: int,
     return model, params, {"model": model_name}
 
 
-def _request_from_json(d: dict) -> Request:
-    return Request(
-        prompt_ids=d["prompt_ids"], max_new=int(d.get("max_new", 16)),
-        eos_id=d.get("eos_id"),
-        sampling=Sampling(
-            temperature=float(d.get("temperature", 0.0)),
-            top_k=d.get("top_k"), top_p=d.get("top_p"),
-            seed=int(d.get("seed", 0))))
+#: the wire-schema parse lives with the Request type now
+#: (serve.request.request_from_dict) — one schema for HTTP, stdin,
+#: journal redrive, and the fleet router
+_request_from_json = request_from_dict
+
+
+def http_json(handler, code: int, payload: dict,
+              headers: Optional[dict] = None) -> None:
+    """The one JSON-response writer shared by the single-replica and
+    fleet HTTP front ends (body + Content-Length + extra headers)."""
+    body = json.dumps(payload).encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    for k, v in (headers or {}).items():
+        handler.send_header(k, str(v))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def retry_after_s(queue_depth: int, n_slots: int) -> int:
+    """The 503 Retry-After hint: roughly how many scheduling waves the
+    backlog represents (queue depth over the slot-array width), floored
+    at one second — honest enough to spread thundering-herd retries
+    without modeling decode time."""
+    return max(1, int(round(queue_depth / max(1, n_slots))))
 
 
 def _http_server(engine, port: int, request_timeout_s: float):
@@ -89,13 +118,9 @@ def _http_server(engine, port: int, request_timeout_s: float):
         def log_message(self, *a):  # quiet access log
             pass
 
-        def _json(self, code: int, payload: dict):
-            body = json.dumps(payload).encode()
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+        def _json(self, code: int, payload: dict,
+                  headers: Optional[dict] = None):
+            http_json(self, code, payload, headers)
 
         def _text(self, code: int, body: str,
                   content_type: str = "text/plain; version=0.0.4"):
@@ -108,11 +133,21 @@ def _http_server(engine, port: int, request_timeout_s: float):
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._json(200, {"ok": True})
+                # liveness (we answered at all) split from READINESS:
+                # non-ready states answer 503 so a k8s-style probe — and
+                # the fleet router — stops dispatching here before a
+                # drain completes / while a swap stages / during an SLO
+                # breach episode
+                state = engine.health_state()
+                self._json(200 if state == "ready" else 503,
+                           {"ok": state == "ready", "live": True,
+                            "state": state})
             elif self.path == "/stats":
                 sched = engine.scheduler
                 alloc = sched.allocator
                 stats = {
+                    "state": engine.health_state(),
+                    "swaps": engine.swaps_total,
                     "queue_depth": sched.queue_depth,
                     "active_slots": alloc.active_slots,
                     "kv_pages_in_use": alloc.pages_in_use,
@@ -159,6 +194,24 @@ def _http_server(engine, port: int, request_timeout_s: float):
                        {"error": "no obs session/profiler, or a window "
                                  "is already open/armed"})})
                 return
+            if self.path == "/swap":
+                # stage a checkpoint hot-swap (engine.request_swap) —
+                # what makes a fleet upgrade a LOOP over replicas: the
+                # router sees `staging_swap` readiness and rotates
+                # traffic away until the swap lands
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    ckpt = json.loads(self.rfile.read(n))["checkpoint"]
+                    engine.request_swap(str(ckpt))
+                except (ValueError, KeyError,
+                        json.JSONDecodeError) as e:
+                    self._json(400, {"error": str(e)})
+                    return
+                except RuntimeError as e:  # a swap is already staging
+                    self._json(409, {"error": str(e)})
+                    return
+                self._json(202, {"staging": True, "swaps": engine.swaps_total})
+                return
             if self.path != "/v1/generate":
                 self._json(404, {"error": "not found"})
                 return
@@ -169,8 +222,29 @@ def _http_server(engine, port: int, request_timeout_s: float):
             except (ValueError, KeyError, json.JSONDecodeError) as e:
                 self._json(400, {"error": str(e)})
                 return
+            if req.state == SHED:
+                # over-capacity: bounded-queue backpressure, never an
+                # unbounded queue or a blocked accept loop
+                sched = engine.scheduler
+                self._json(503, {"error": "over capacity", "state": SHED,
+                                 "queue_depth": sched.queue_depth},
+                           headers={"Retry-After": retry_after_s(
+                               sched.queue_depth, engine.n_slots)})
+                return
+            if req.state == DRAINED:
+                # racing a drain: resubmit elsewhere (the fleet router
+                # treats this exactly like the backpressure 503)
+                self._json(503, {"error": "draining", "state": DRAINED},
+                           headers={"Retry-After": 1})
+                return
             if not req.wait(timeout=request_timeout_s):
                 self._json(504, {"error": "timed out", "id": req.id})
+                return
+            if req.state == DRAINED:
+                # drained AFTER queueing (SIGTERM mid-wait)
+                self._json(503, {"error": "draining", "state": DRAINED,
+                                 "id": req.id},
+                           headers={"Retry-After": 1})
                 return
             self._json(200, req.result())
 
@@ -257,11 +331,25 @@ def serve_main(argv=None) -> int:
                         "batching correctness contract)")
     p.add_argument("--timeout", type=float, default=120.0,
                    help="http: per-request wait timeout (seconds)")
+    p.add_argument("--queue-bound", type=int, default=0,
+                   help="bound the scheduler's waiting queue: a "
+                        "submission landing on a full queue is shed "
+                        "with 503 + Retry-After "
+                        "(serve_rejected_backpressure_total) instead "
+                        "of queueing unboundedly; 0 = unbounded "
+                        "(batch modes).  The fleet router passes its "
+                        "own bound here.")
     args = p.parse_args(argv)
 
     if args.profile_every is not None and not args.obs_dir:
         p.error("--profile-every needs --obs-dir (the capture windows "
                 "live under it)")
+
+    # TORCHPRUNER_CHAOS env → serving faults (slow_steps_ms: the fleet
+    # drill's "slow replica"); installs nothing when unset
+    from torchpruner_tpu.resilience import chaos as chaos_mod
+
+    chaos_mod.configure(None)
 
     if args.cpu:
         import jax
@@ -290,7 +378,7 @@ def serve_main(argv=None) -> int:
         cache_dtype=(jnp.bfloat16 if args.kv_dtype == "bfloat16"
                      else jnp.float32),
         page_len=args.page_len, run_dir=args.run_dir,
-        checkpoint_meta=meta,
+        checkpoint_meta=meta, queue_bound=args.queue_bound,
         # a long-running HTTP server must not accumulate completed
         # requests (each pins its prompt/tokens and, across a swap, the
         # old program set); batch modes need them for verify/reporting

@@ -51,6 +51,7 @@ QUEUED = "queued"      # submitted, waiting for a slot
 ACTIVE = "active"      # holds a slot (prefilled, decoding)
 DONE = "done"          # emitted max_new tokens (or eos)
 DRAINED = "drained"    # never started; snapshotted at drain
+SHED = "shed"          # rejected at admission (queue over its bound)
 
 
 @dataclass
@@ -142,3 +143,19 @@ class Request:
         return cls(prompt_ids=np.asarray(d["prompt_ids"], np.int32),
                    max_new=int(d["max_new"]), eos_id=d.get("eos_id"),
                    sampling=Sampling(**(d.get("sampling") or {})))
+
+
+def request_from_dict(d: dict) -> Request:
+    """The ONE wire schema → :class:`Request` parse, shared by every
+    transport (single-replica HTTP/stdin front ends, the fleet router's
+    dispatch, journal redrive): ``{"prompt_ids": [...], "max_new": N,
+    "eos_id": ..., "temperature": .., "top_k": .., "top_p": ..,
+    "seed": ..}`` — flat sampling fields, matching ``POST
+    /v1/generate``."""
+    return Request(
+        prompt_ids=d["prompt_ids"], max_new=int(d.get("max_new", 16)),
+        eos_id=d.get("eos_id"),
+        sampling=Sampling(
+            temperature=float(d.get("temperature", 0.0)),
+            top_k=d.get("top_k"), top_p=d.get("top_p"),
+            seed=int(d.get("seed", 0))))

@@ -29,21 +29,34 @@ from torchpruner_tpu.serve.request import (
     DONE,
     DRAINED,
     QUEUED,
+    SHED,
     Request,
 )
 
+_REJECTED_HELP = ("submissions rejected (per-reason twins: "
+                  "serve_rejected_<reason>_total)")
+
 
 class Scheduler:
-    """FIFO queue + slot-table bookkeeping (see module docstring)."""
+    """FIFO queue + slot-table bookkeeping (see module docstring).
 
-    def __init__(self, allocator: KVCacheAllocator):
+    ``queue_bound > 0`` bounds the waiting queue: a submission landing
+    on a full queue is SHED immediately (state ``shed``, event set)
+    instead of queueing unboundedly — the HTTP front end turns that
+    into 503 + Retry-After, and the fleet router reuses the same bound
+    as its per-replica backpressure signal."""
+
+    def __init__(self, allocator: KVCacheAllocator,
+                 queue_bound: int = 0):
         self.allocator = allocator
+        self.queue_bound = int(queue_bound)
         self._queue: Deque[Request] = deque()
         self._lock = threading.Lock()
         #: slot -> active request
         self.running: Dict[int, Request] = {}
         self.admitted_total = 0
         self.completed_total = 0
+        self.shed_total = 0
         #: set when a drain begins: later submissions are REJECTED
         #: (marked drained, event set) instead of queueing forever —
         #: an HTTP client racing a SIGTERM gets an immediate "resubmit
@@ -60,14 +73,29 @@ class Scheduler:
         would for a real caller."""
         request.arrival_s = (time.perf_counter() if arrival_s is None
                              else arrival_s)
-        if self.closed:
-            request.state = DRAINED
-            request._event.set()
-            obs.inc("serve_rejected_total",
-                    help="submissions rejected after a drain began")
-            return request
-        request.state = QUEUED
         with self._lock:
+            # the closed check shares the queue lock with drain_queue:
+            # checked outside it, a submission racing the drain could
+            # append AFTER the drain swept the queue — a permanently
+            # QUEUED request that keeps has_work() true and spins the
+            # SIGTERM'd loop forever
+            if self.closed:
+                request.state = DRAINED
+                request._event.set()
+                obs.inc("serve_rejected_total", help=_REJECTED_HELP)
+                obs.inc("serve_rejected_drain_total",
+                        help="submissions rejected after a drain began")
+                return request
+            if self.queue_bound and len(self._queue) >= self.queue_bound:
+                request.state = SHED
+                request._event.set()
+                self.shed_total += 1
+                obs.inc("serve_rejected_total", help=_REJECTED_HELP)
+                obs.inc("serve_rejected_backpressure_total",
+                        help="submissions shed by the queue bound "
+                             "(503 + Retry-After backpressure)")
+                return request
+            request.state = QUEUED
             self._queue.append(request)
         obs.inc("serve_requests_total", help="requests submitted")
         return request

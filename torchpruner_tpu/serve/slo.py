@@ -114,6 +114,13 @@ class SLOMonitor:
                 self._in_breach[metric] = False
         return dict(self.rolling)
 
+    def in_breach_any(self) -> bool:
+        """True while ANY gated metric's rolling p99 sits over its
+        threshold — the readiness-degradation signal ``/healthz``
+        (and through it the fleet router) keys off."""
+        with self._lock:
+            return any(self._in_breach.values())
+
     def snapshot(self) -> Dict[str, object]:
         """The ``/stats`` block: rolling values, thresholds, breach
         count, in-breach flags."""
