@@ -1229,6 +1229,21 @@ def _leg_serve(smoke: bool, progress=None) -> dict:
         "evictions": eng.scheduler.allocator.total_evictions - evict0,
         "decode_steps": eng.steps - steps0,
     })
+    # latency budget at the 70%-load operating point: where TTFT time
+    # actually went (queue wait vs admit-batch wait vs the prefill
+    # program), from the per-request stage stamps — the top-2
+    # contributors ride next to the p50/p99 columns
+    ttft_sum = float(ttfts.sum()) if ttfts.size else 0.0
+    if ttft_sum > 0:
+        queue_s = sum(max(0.0, r.admitted_s - r.arrival_s) for r in done
+                      if r.admitted_s is not None
+                      and r.arrival_s is not None)
+        prefill_s = sum(r.prefill_s or 0.0 for r in done)
+        parts = {"replica_queue": queue_s, "prefill": prefill_s,
+                 "admission": max(0.0, ttft_sum - queue_s - prefill_s)}
+        top2 = sorted(parts.items(), key=lambda kv: -kv[1])[:2]
+        result["ttft_budget_top2"] = [
+            [k, round(100.0 * v / ttft_sum, 1)] for k, v in top2]
     if progress is not None:
         progress(dict(result))
     return result
@@ -1276,6 +1291,11 @@ def _leg_fleet(smoke: bool) -> dict:
         "shed": s["shed"],
         "verify_mismatches": s["verify_mismatches"],
         "killed": s["killed"],
+        # distributed-tracing verdicts: cross-process waterfall count
+        # and the top-2 TTFT stage contributors under drill load
+        "traces_cross_process": s.get("traces_cross_process"),
+        "ttft_budget_top2": s.get("ttft_budget_top2"),
+        "ttft_recon_pct": s.get("ttft_recon_pct"),
     }
 
 

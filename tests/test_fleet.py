@@ -482,3 +482,21 @@ def test_fleet_kill9_drill_zero_loss(tmp_path):
     assert m.get("fleet_redrive_total", 0) >= 1
     assert m.get("fleet_completed_total") == 18
     assert m.get("serve_ttft_seconds_count", 0) > 0
+    # distributed tracing: EVERY completed request's waterfall is
+    # contiguous across router + replica pids, the redriven one shows
+    # both attempts, and the TTFT stage budget reconciles within 10%
+    assert s["traces_assembled"] == 18 and s["traces_cross_process"] == 18
+    assert s["traces_redriven_cross_process"] >= 1
+    assert abs(s["ttft_recon_pct"]) <= 10
+    assert m.get("serve_queue_wait_seconds_count", 0) >= 18
+    assert m.get("fleet_dispatch_wait_seconds_count", 0) >= 18
+    trace = json.load(open(os.path.join(fleet_dir, "obs", "trace.json")))
+    req = [e for e in trace["traceEvents"]
+           if e.get("cat") == "reqtrace" and e.get("ph") in ("X", "i")]
+    pids = {e["pid"] for e in req}
+    assert 0 in pids and len(pids) >= 2  # router + >=1 replica
+    # the budget + exemplar waterfalls render in `obs report`
+    from torchpruner_tpu.obs.report import format_report
+
+    md = format_report(rep)
+    assert "latency budget:" in md and "exemplar waterfalls" in md

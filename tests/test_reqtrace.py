@@ -1,0 +1,453 @@
+"""Distributed request tracing (obs/reqtrace + trace_export assembly):
+stage recording and exemplar policy, cross-process B/E pairing with
+duplicate span names, synthetic closing of a SIGKILLed replica's torn
+spans, monotonic per-tid timestamps after clock-offset alignment,
+per-request waterfall assembly, the TTFT/E2E latency budget, and the
+fleet wiring (journaled trace ids, dispatch-wait/queue-wait
+histograms)."""
+
+import json
+import os
+
+import pytest
+
+from torchpruner_tpu import obs
+from torchpruner_tpu.obs import reqtrace
+from torchpruner_tpu.obs import trace_export as te
+
+
+@pytest.fixture
+def session(tmp_path):
+    s = obs.configure(str(tmp_path / "obs"), process_index=0,
+                      annotate=False, watch_compiles=False)
+    yield s
+    obs.shutdown()
+    reqtrace.reset()
+
+
+def _events(session):
+    path = os.path.join(session.obs_dir, "events.jsonl")
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# -- recorder ----------------------------------------------------------------
+
+
+def test_eager_mode_emits_every_stage(session):
+    reqtrace.reset(sample_every=1)
+    tid = reqtrace.mint_trace_id("r00000")
+    reqtrace.stage(tid, "accept", rid="r00000")
+    reqtrace.stage(tid, "prefill", dur_s=0.01)
+    reqtrace.finish(tid, outcome="complete", e2e_s=0.02)
+    evs = _events(session)
+    stages = [e for e in evs if e.get("event") == "req_stage"]
+    assert [e["stage"] for e in stages] == ["accept", "prefill"]
+    assert all(e["trace"] == tid for e in stages)
+    summaries = [e for e in evs if e.get("event") == "req_trace"]
+    assert summaries[0]["outcome"] == "complete"
+    # aggregates recorded regardless of exemplar policy
+    m = session.metrics.get("reqtrace_stage_prefill_seconds")
+    assert m.count == 1 and m.sum == pytest.approx(0.01)
+    assert obs.counter_value("reqtrace_exemplars_total") == 1
+
+
+def test_sampled_mode_keeps_slowest_k_plus_hash_sample(session):
+    reqtrace.reset(sample_every=10**9, slowest_k=2, window=6)
+    e2es = [0.01, 0.5, 0.02, 0.9, 0.03, 0.04]
+    tids = []
+    for i, e2e in enumerate(e2es):
+        tid = f"t{i:02d}"
+        tids.append(tid)
+        reqtrace.stage(tid, "prefill", dur_s=0.001)
+        reqtrace.finish(tid, outcome="complete", e2e_s=e2e)
+    # window of 6 closed: exactly the 2 slowest flushed full detail
+    flushed = {e["trace"] for e in _events(session)
+               if e.get("event") == "req_trace"}
+    assert flushed == {"t01", "t03"}
+    assert obs.counter_value("reqtrace_agg_only_total") == 4
+    # every request still contributed to the aggregate histogram
+    assert session.metrics.get(
+        "reqtrace_stage_prefill_seconds").count == 6
+
+
+def test_hash_sampling_is_deterministic_across_processes():
+    # the 1-in-N decision depends only on the trace id, so a replica
+    # and the router flush the SAME subset without coordination
+    ids = [f"tr-r{i:05d}-abc" for i in range(200)]
+    a = [reqtrace.is_sampled(t, 8) for t in ids]
+    b = [reqtrace.is_sampled(t, 8) for t in ids]
+    assert a == b
+    assert 0 < sum(a) < len(ids)
+    assert all(reqtrace.is_sampled(t, 1) for t in ids[:5])
+
+
+def test_session_close_flushes_partial_window(tmp_path):
+    s = obs.configure(str(tmp_path / "obs"), process_index=0,
+                      annotate=False, watch_compiles=False)
+    reqtrace.reset(sample_every=10**9, slowest_k=8, window=1000)
+    reqtrace.stage("tx", "prefill", dur_s=0.01)
+    reqtrace.finish("tx", outcome="complete", e2e_s=0.3)
+    obs.shutdown()  # close flushes the partial slowest-K window
+    with open(tmp_path / "obs" / "events.jsonl") as f:
+        evs = [json.loads(line) for line in f if line.strip()]
+    assert any(e.get("event") == "req_trace" and e["trace"] == "tx"
+               and e.get("exemplar") == "slow" for e in evs)
+    reqtrace.reset()
+
+
+# -- latency budget ----------------------------------------------------------
+
+
+def _metrics_with_stages(session):
+    reqtrace.reset(sample_every=1)
+    for _ in range(4):
+        reqtrace.stage(None, "replica_queue", dur_s=0.003)
+        reqtrace.stage(None, "admission", dur_s=0.001)
+        reqtrace.stage(None, "prefill", dur_s=0.006)
+        reqtrace.stage(None, "decode", dur_s=0.05)
+        reqtrace.stage(None, "journal_flush", dur_s=0.002)
+        reqtrace.stage(None, "dispatch_wait", dur_s=0.004)
+        obs.observe("serve_ttft_seconds", 0.010)
+        obs.observe("reqtrace_e2e_seconds", 0.080)
+    return session.metrics.snapshot()
+
+
+def test_latency_budget_reconciles_and_attributes(session):
+    b = reqtrace.latency_budget(_metrics_with_stages(session))
+    ttft = b["ttft"]
+    # budget = 3+1+6 = 10 ms vs measured 10 ms -> recon ~0
+    assert ttft["measured_mean_ms"] == pytest.approx(10.0)
+    assert ttft["recon_pct"] == pytest.approx(0.0, abs=1e-6)
+    pct = {r["stage"]: r["pct"] for r in ttft["stages"]}
+    assert pct["prefill"] == pytest.approx(60.0)
+    assert pct["replica_queue"] == pytest.approx(30.0)
+    e2e = b["e2e"]
+    # stage sum 66 ms vs e2e 80 ms -> 17.5% unattributed (transport)
+    assert e2e["unattributed_pct"] == pytest.approx(17.5)
+    reqtrace.install_budget_gauges(b)
+    snap = session.metrics.snapshot()
+    assert snap["ttft_stage_prefill_pct"] == pytest.approx(60.0)
+    assert abs(snap["reqtrace_ttft_recon_pct"]) < 1e-6
+
+
+def test_latency_budget_none_without_stage_data():
+    assert reqtrace.latency_budget({"steps_total": 5}) is None
+
+
+# -- trace_export: cross-process span assembly -------------------------------
+
+
+def _span_stream(pid_os, spans):
+    """Events for one process: obs_init + the given (name, tid, t0, t1)
+    spans (t1 None = torn: SIGKILL before span_end)."""
+    evs = [{"event": "obs_init", "ts": 0.0, "pid": pid_os,
+            "process_index": 0}]
+    for i, (name, sid_tid, t0, t1) in enumerate(spans):
+        sid = f"s{pid_os}{i:05d}"
+        evs.append({"event": "span_begin", "span": sid, "name": name,
+                    "parent": None, "depth": 0, "ts": t0,
+                    "tid": sid_tid})
+        if t1 is not None:
+            evs.append({"event": "span_end", "span": sid, "name": name,
+                        "parent": None, "depth": 0, "ts": t1,
+                        "tid": sid_tid, "dur_s": t1 - t0})
+    return evs
+
+
+def test_merged_streams_pair_duplicate_names_within_pid():
+    # BOTH processes run a span named "serve_prefill" — pairing must
+    # stay within each pid (span ids never cross processes)
+    streams = [
+        {"name": "router", "pid": 0, "shift_s": 0.0,
+         "events": _span_stream(100, [("serve_prefill", 7, 10.0, 11.0)])},
+        {"name": "replica0", "pid": 1, "shift_s": 0.0,
+         "events": _span_stream(200, [("serve_prefill", 9, 10.5, 12.0)])},
+    ]
+    out = te.merged_trace_events(streams)
+    be = [(e["ph"], e["pid"]) for e in out
+          if e.get("name") == "serve_prefill"]
+    assert be.count(("B", 0)) == 1 and be.count(("E", 0)) == 1
+    assert be.count(("B", 1)) == 1 and be.count(("E", 1)) == 1
+    # process rows are named
+    meta = [e for e in out if e.get("ph") == "M"
+            and e["name"] == "process_name"]
+    assert {m["args"]["name"].split(" (")[0] for m in meta} \
+        >= {"router", "replica0"}
+
+
+def test_torn_replica_spans_closed_synthetically():
+    # the kill -9'd replica's stream ends mid-span: the B still gets a
+    # synthetic E so the trace opens in Perfetto
+    streams = [{"name": "replica0", "pid": 3, "shift_s": 0.0,
+                "events": _span_stream(
+                    300, [("decode", 5, 10.0, None)])}]
+    out = te.merged_trace_events(streams)
+    es = [e for e in out if e["ph"] == "E" and e["name"] == "decode"]
+    assert len(es) == 1 and es[0]["args"].get("torn") is True
+    bs = [e for e in out if e["ph"] == "B"]
+    assert es[0]["ts"] >= bs[0]["ts"]
+
+
+def test_clock_shift_applied_and_timestamps_monotonic_per_tid():
+    # replica clock runs 2 s AHEAD; shift -2 aligns it.  Feed spans
+    # whose RAW order would go backwards after alignment and assert the
+    # per-(pid, tid) clamp keeps each track monotonic.
+    streams = [
+        {"name": "replica0", "pid": 1, "shift_s": -2.0,
+         "events": _span_stream(200, [
+             ("a", 4, 12.0, 12.5),     # aligned: 10.0..10.5
+             ("b", 4, 11.9, 12.1),     # aligned: 9.9..10.1 (regresses)
+         ])},
+    ]
+    out = te.merged_trace_events(streams)
+    slices = [e for e in out if e["ph"] in ("B", "E")]
+    assert slices[0]["ts"] == pytest.approx(10.0 * 1e6)
+    per_tid = {}
+    for e in slices:
+        key = (e["pid"], e["tid"])
+        assert e["ts"] >= per_tid.get(key, 0.0)
+        per_tid[key] = e["ts"]
+
+
+# -- trace_export: per-request waterfall assembly ----------------------------
+
+
+def _req_streams():
+    router = [
+        {"event": "obs_init", "ts": 0.0, "pid": 1, "process_index": 0},
+        {"event": "req_stage", "trace": "trA", "stage": "accept",
+         "ts": 10.0, "dur_s": 0.0, "rid": "r00000"},
+        {"event": "req_stage", "trace": "trA", "stage": "journal_flush",
+         "ts": 10.0, "dur_s": 0.002},
+        {"event": "req_stage", "trace": "trA", "stage": "dispatch_wait",
+         "ts": 10.01, "dur_s": 0.004, "attempt": 1},
+        {"event": "req_stage", "trace": "trA", "stage": "redrive",
+         "ts": 10.5, "dur_s": 0.0},
+        {"event": "req_stage", "trace": "trA", "stage": "dispatch_wait",
+         "ts": 10.51, "dur_s": 0.001, "attempt": 2},
+        {"event": "req_trace", "trace": "trA", "outcome": "complete",
+         "ts": 11.2, "e2e_s": 1.2},
+        # a second request that died with its replica: no terminal
+        # summary anywhere
+        {"event": "req_stage", "trace": "trB", "stage": "accept",
+         "ts": 10.2, "dur_s": 0.0},
+    ]
+    # the replica clock is 0.25 s ahead (shift -0.25 aligns)
+    replica = [
+        {"event": "obs_init", "ts": 0.0, "pid": 2, "process_index": 0},
+        {"event": "req_stage", "trace": "trA", "stage": "replica_queue",
+         "ts": 10.30, "dur_s": 0.01},
+        {"event": "req_stage", "trace": "trA", "stage": "prefill",
+         "ts": 10.31, "dur_s": 0.02},
+        {"event": "req_trace", "trace": "trA", "outcome": "complete",
+         "ts": 10.9, "ttft_s": 0.05},
+    ]
+    return [
+        {"name": "router", "pid": 0, "events": router, "shift_s": 0.0},
+        {"name": "replica0", "pid": 1, "events": replica,
+         "shift_s": -0.25},
+    ]
+
+
+def test_assemble_request_traces_cross_process():
+    traces = te.assemble_request_traces(_req_streams())
+    a = traces["trA"]
+    assert a["outcome"] == "complete"
+    assert a["e2e_s"] == pytest.approx(1.2)    # router summary wins
+    assert a["ttft_s"] == pytest.approx(0.05)  # replica detail kept
+    assert a["pids"] == [0, 1]
+    assert a["attempts"] == 2 and a["redrive"] and not a["torn"]
+    # stages sorted on the ALIGNED clock: the replica's prefill
+    # (raw 10.31 -> aligned 10.06) lands between the dispatch attempts
+    names = [s["stage"] for s in a["stages"]]
+    assert names == ["accept", "journal_flush", "dispatch_wait",
+                     "replica_queue", "prefill", "redrive",
+                     "dispatch_wait"]
+    assert traces["trB"]["torn"] and traces["trB"]["outcome"] is None
+
+
+def test_waterfall_events_span_both_pids_on_one_tid():
+    traces = te.assemble_request_traces(_req_streams())
+    out = te.reqtrace_trace_events(traces)
+    slices = [e for e in out if e["ph"] in ("X", "i")]
+    tids = {e["args"]["trace"]: e["tid"] for e in slices}
+    assert tids["trA"] >= te.REQTRACE_TID_BASE
+    a_rows = [e for e in slices if e["args"]["trace"] == "trA"]
+    assert {e["pid"] for e in a_rows} == {0, 1}  # the waterfall hops
+    assert len({e["tid"] for e in a_rows}) == 1  # ...on ONE row
+    # instant stages are markers, timed ones are slices
+    phs = {e["name"]: e["ph"] for e in a_rows}
+    assert phs["accept"] == "i" and phs["prefill"] == "X"
+
+
+def test_fleet_report_collect_and_write(tmp_path):
+    """fleet.report end to end on a synthetic layout: clock_offset
+    events drive the replica shift; write_fleet_trace produces ONE
+    trace.json holding spans + waterfalls from both processes."""
+    from torchpruner_tpu.fleet import report as fr
+
+    obs_dir = tmp_path / "obs"
+    rep_dir = obs_dir / "replica0"
+    rep_dir.mkdir(parents=True)
+    streams = _req_streams()
+    router_events = list(streams[0]["events"])
+    router_events.insert(1, {"event": "clock_offset", "ts": 9.0,
+                             "replica": "replica0", "offset_s": 0.25,
+                             "rtt_s": 0.001})
+    with open(obs_dir / "events.jsonl", "w") as f:
+        for ev in router_events:
+            f.write(json.dumps(ev) + "\n")
+    with open(rep_dir / "events.jsonl", "w") as f:
+        for ev in streams[1]["events"]:
+            f.write(json.dumps(ev) + "\n")
+
+    got = fr.collect_streams(str(obs_dir))
+    assert [s["name"] for s in got] == ["router", "replica0"]
+    assert got[1]["shift_s"] == pytest.approx(-0.25)
+
+    traces = fr.assemble_fleet_traces(str(obs_dir))
+    tsum = fr.trace_summary(traces)
+    assert tsum["assembled"] == 2 and tsum["completed"] == 1
+    assert tsum["cross_process"] == 1
+    assert tsum["redriven_cross_process"] == 1 and tsum["torn"] == 1
+    ex = fr.slowest_exemplars(traces, k=3)
+    assert ex[0]["trace"] == "trA" and ex[0]["redrive"]
+    assert ex[0]["stages"][0]["at_ms"] == 0.0
+
+    path = fr.write_fleet_trace(str(obs_dir))
+    trace = json.load(open(path))
+    req = [e for e in trace["traceEvents"]
+           if e.get("cat") == "reqtrace" and e["ph"] in ("X", "i")]
+    assert {e["pid"] for e in req} == {0, 1}
+
+
+# -- fleet wiring ------------------------------------------------------------
+
+
+def test_plane_mints_and_journals_trace_ids(tmp_path, session):
+    from torchpruner_tpu.fleet import RequestPlane
+
+    reqtrace.reset(sample_every=1)
+    journal = str(tmp_path / "j.json")
+    plane = RequestPlane(journal)
+    rec = plane.accept({"prompt_ids": [1], "max_new": 2},
+                       deadline_s=30.0)
+    assert rec.trace_id and rec.trace_id.startswith("tr-r00000")
+    raw = json.load(open(journal))
+    assert raw["records"][0]["trace_id"] == rec.trace_id
+    # accept + journal_flush stages landed in the event stream
+    stages = [e["stage"] for e in _events(session)
+              if e.get("event") == "req_stage"
+              and e.get("trace") == rec.trace_id]
+    assert stages == ["accept", "journal_flush"]
+    assert session.metrics.get(
+        "reqtrace_stage_journal_flush_seconds").count == 1
+    # a reloaded journal keeps the SAME trace id (one waterfall across
+    # a router restart)
+    revived = RequestPlane.load(journal)
+    assert revived.get(rec.rid).trace_id == rec.trace_id
+    # completion observes router-side e2e + emits the summary
+    plane.checkout()
+    plane.complete(rec.rid, [5, 6], "replica1")
+    assert session.metrics.get("reqtrace_e2e_seconds").count == 1
+    summaries = [e for e in _events(session)
+                 if e.get("event") == "req_trace"]
+    assert summaries and summaries[-1]["outcome"] == "complete"
+    assert summaries[-1]["replica"] == "replica1"
+
+
+def test_router_records_dispatch_wait_and_propagates_trace(session):
+    from torchpruner_tpu.fleet import FleetRouter, RequestPlane
+    from torchpruner_tpu.fleet.router import RouterPolicy
+
+    reqtrace.reset(sample_every=1)
+    seen_payloads = []
+
+    class Rep:
+        name = "replica0"
+
+        def healthz(self, timeout=None):
+            return {"live": True, "ready": True, "state": "ready",
+                    "clock_offset_s": 0.002, "rtt_s": 0.0005}
+
+        def stats(self, timeout=None):
+            return {}
+
+        def generate(self, payload, timeout=None):
+            seen_payloads.append(payload)
+            return {"state": "done", "tokens": [1, 2]}
+
+    plane = RequestPlane()
+    router = FleetRouter(plane, [Rep()], policy=RouterPolicy(
+        max_attempts=3, attempt_timeout_s=5.0, default_deadline_s=10.0,
+        health_every_s=0.01))
+    rec = router.submit({"prompt_ids": [3], "max_new": 2})
+    router.run_until_drained(poll_s=0.002, timeout_s=20.0)
+    router.close()
+    assert rec.state == "completed"
+    # the dispatch payload carried the trace id; the JOURNALED payload
+    # did not (redrive/verify replay the original)
+    assert seen_payloads[0]["trace_id"] == rec.trace_id
+    assert "trace_id" not in rec.payload
+    assert session.metrics.get(
+        "fleet_dispatch_wait_seconds").count >= 1
+    # the health probe's offset sample landed as a clock_offset event
+    offs = [e for e in _events(session)
+            if e.get("event") == "clock_offset"]
+    assert offs and offs[0]["replica"] == "replica0"
+    assert offs[0]["offset_s"] == pytest.approx(0.002)
+
+
+def test_router_shed_records_shed_stage(session):
+    from torchpruner_tpu.fleet import FleetRouter, RequestPlane
+    from torchpruner_tpu.fleet.router import RouterPolicy
+
+    reqtrace.reset(sample_every=1)
+    plane = RequestPlane()
+    router = FleetRouter(plane, [], policy=RouterPolicy())
+    assert router.submit({"prompt_ids": [1], "max_new": 1}) is None
+    router.close()
+    evs = _events(session)
+    sheds = [e for e in evs if e.get("event") == "req_stage"
+             and e.get("stage") == "shed"]
+    assert sheds and sheds[0]["reason"] == "no_live_replica"
+
+
+# -- serve wiring ------------------------------------------------------------
+
+
+def test_scheduler_records_queue_age_at_admission(session):
+    import time
+
+    from torchpruner_tpu.serve.allocator import KVCacheAllocator
+    from torchpruner_tpu.serve.request import Request
+    from torchpruner_tpu.serve.scheduler import Scheduler
+
+    reqtrace.reset(sample_every=1)
+    sched = Scheduler(KVCacheAllocator(2, 64))
+    req = Request(prompt_ids=[1, 2], max_new=4, trace_id="tr-x")
+    # backdate the arrival 50 ms: the queue age must be visible AT
+    # ADMISSION, before any token was produced
+    sched.submit(req, arrival_s=time.perf_counter() - 0.05)
+    admitted = sched.admit()
+    assert admitted == [req] and req.admitted_s is not None
+    h = session.metrics.get("serve_queue_wait_seconds")
+    assert h.count == 1 and h.sum >= 0.05
+    live = sched.queue_wait_ms()
+    assert live["p50"] >= 50.0 and live["p99"] >= live["p50"]
+    # the traced request got its replica_queue stage
+    stages = [e for e in _events(session)
+              if e.get("event") == "req_stage"]
+    assert stages and stages[0]["stage"] == "replica_queue"
+    assert stages[0]["trace"] == "tr-x"
+
+
+def test_request_from_dict_parses_trace_id():
+    from torchpruner_tpu.serve.request import request_from_dict
+
+    req = request_from_dict({"prompt_ids": [1, 2], "max_new": 3,
+                             "trace_id": "tr-abc"})
+    assert req.trace_id == "tr-abc"
+    assert request_from_dict(
+        {"prompt_ids": [1], "max_new": 1}).trace_id is None

@@ -14,7 +14,9 @@ Examples::
     python -m torchpruner_tpu vgg16_layerwise --plan auto --plan-probe 2
     python -m torchpruner_tpu vgg16_layerwise --plan report
     python -m torchpruner_tpu serve llama3_ffn_taylor --smoke --synthetic 16
+    python -m torchpruner_tpu fleet llama_tiny --cpu --replicas 3 --synthetic 18
     python -m torchpruner_tpu search digits_smoke --jobs 2
+    python -m torchpruner_tpu obs report logs/fleet/obs   # latency budget
     python -m torchpruner_tpu obs report logs/obs
     python -m torchpruner_tpu --preset mnist_mlp_shapley --smoke \\
         --obs-dir logs/obs --profile-every 20

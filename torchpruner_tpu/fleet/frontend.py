@@ -89,6 +89,7 @@ def replica_argv(preset: str, port: int, args,
             "--max-len", str(args.max_len), "--seed", str(args.seed),
             "--queue-bound", str(args.replica_queue_bound),
             "--obs-dir", obs_dir, "--run-dir", run_dir,
+            "--trace-sample-every", str(args.trace_sample_every),
             "--timeout", str(args.deadline_s)]
     if args.smoke:
         argv.append("--smoke")
@@ -173,6 +174,68 @@ class _ChaosTrigger:
             self.hung.append(victim.name)
 
 
+def _finalize_tracing(fleet_obs_dir: str) -> dict:
+    """The drill/endpoint's trace epilogue, run while the fleet session
+    is still open: flush pending exemplars, assemble every process's
+    stage events into cross-process request traces, compute the
+    TTFT/E2E latency budget over the MERGED stage histograms, and land
+    budget + assembly verdict as gauges (``ttft_stage_*_pct`` /
+    ``reqtrace_*``, gated by ``obs diff``) and a ledger ``reqtrace``
+    record (rendered by ``obs report``).  Returns the summary fields
+    the drill prints."""
+    from torchpruner_tpu import obs
+    from torchpruner_tpu.fleet import report as fleet_report
+    from torchpruner_tpu.obs import aggregate, reqtrace
+
+    session = obs.get()
+    if session is None:
+        return {}
+    reqtrace.session_flush()
+    # NOTE: the merged trace.json is assembled AGAIN after
+    # obs.shutdown (fleet_main) — intentionally, not redundantly: the
+    # router's stream gains this function's own flushes and the
+    # session-close records, so the file must re-read it; this pass
+    # only needs the traces + summary while the session can still
+    # take gauges/ledger records
+    traces = fleet_report.assemble_fleet_traces(fleet_obs_dir)
+    tsum = fleet_report.trace_summary(traces)
+    try:
+        merged = aggregate.merged_registry(fleet_obs_dir,
+                                           local=session.metrics)
+        budget = reqtrace.latency_budget(merged.snapshot())
+    except Exception:
+        budget = None
+    reqtrace.install_budget_gauges(budget)
+    obs.gauge_set("reqtrace_traces_assembled", tsum["assembled"],
+                  help="cross-process request traces assembled from "
+                       "the fleet's event streams")
+    obs.gauge_set("reqtrace_traces_cross_process", tsum["cross_process"],
+                  help="completed traces whose waterfall spans router "
+                       "AND replica pids (contiguity verdict)")
+    obs.gauge_set("reqtrace_traces_torn", tsum["torn"],
+                  help="traces with stage events but no terminal "
+                       "summary from any process")
+    exemplars = fleet_report.slowest_exemplars(traces)
+    obs.record_reqtrace(budget=budget, assembly=tsum,
+                        exemplars=exemplars)
+    out = {
+        "traces_assembled": tsum["assembled"],
+        "traces_cross_process": tsum["cross_process"],
+        "traces_redriven_cross_process": tsum["redriven_cross_process"],
+        "traces_torn": tsum["torn"],
+    }
+    ttft = (budget or {}).get("ttft") or {}
+    if ttft.get("recon_pct") is not None:
+        out["ttft_recon_pct"] = round(ttft["recon_pct"], 2)
+    stages = sorted((r for r in ttft.get("stages") or []
+                     if r.get("pct") is not None),
+                    key=lambda r: -r["pct"])
+    if stages:
+        out["ttft_budget_top2"] = [[r["stage"], round(r["pct"], 1)]
+                                   for r in stages[:2]]
+    return out
+
+
 def run_drill(preset: str, args, fleet_dir: str,
               chaos: FleetChaos) -> int:
     """The synthetic failover drill (see module docstring)."""
@@ -243,6 +306,7 @@ def run_drill(preset: str, args, fleet_dir: str,
     # fleet session's registry (BEFORE obs.shutdown exports it)
     shards = merge_replica_shards(
         os.path.join(fleet_dir, "obs"), [p.obs_dir for p in procs])
+    trace_fields = _finalize_tracing(os.path.join(fleet_dir, "obs"))
 
     records = plane.records()
     completed = [r for r in records if r.state == COMPLETED]
@@ -268,6 +332,7 @@ def run_drill(preset: str, args, fleet_dir: str,
         "replica_exit_codes": exit_codes,
         "shards_merged": sum(bool(v) for v in shards.values()),
         "wall_s": round(wall, 3),
+        **trace_fields,
     }
     if args.swap_checkpoint:
         summary["rolling_swap"] = args.swap_checkpoint
@@ -445,7 +510,9 @@ def run_http(preset: str, args, fleet_dir: str,
             p.drain(timeout_s=args.startup_timeout_s)
         merge_replica_shards(os.path.join(fleet_dir, "obs"),
                              [p.obs_dir for p in procs])
-        print(json.dumps({"mode": "http", **router.snapshot()}),
+        trace_fields = _finalize_tracing(os.path.join(fleet_dir, "obs"))
+        print(json.dumps({"mode": "http", **router.snapshot(),
+                          **trace_fields}),
               file=sys.stderr, flush=True)
     return rc
 
@@ -529,8 +596,19 @@ def fleet_main(argv=None) -> int:
                         "flips to slo_breach on episodes — the "
                         "router's degraded-admission signal)")
     p.add_argument("--slo-token-p99-ms", type=float, default=None)
+    p.add_argument("--trace-sample-every", type=int, default=None,
+                   metavar="N",
+                   help="request-trace exemplar policy on the router "
+                        "AND every replica (obs.reqtrace): full stage "
+                        "detail for 1-in-N requests plus the slowest-K "
+                        "per window; default 1 (eager full tracing) "
+                        "for --synthetic drills, 16 for --http")
     p.add_argument("--no-obs", action="store_true")
     args = p.parse_args(argv)
+    if args.trace_sample_every is None:
+        # the drill's acceptance contract needs EVERY request's
+        # cross-process waterfall; the long-running endpoint samples
+        args.trace_sample_every = 1 if args.synthetic is not None else 16
 
     chaos = FleetChaos.from_any(args.chaos)
     fleet_dir = os.path.abspath(args.fleet_dir)
@@ -544,7 +622,9 @@ def fleet_main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
 
     from torchpruner_tpu import obs
+    from torchpruner_tpu.obs import reqtrace
 
+    reqtrace.configure(sample_every=args.trace_sample_every)
     session = None
     if not args.no_obs:
         session = obs.configure(os.path.join(fleet_dir, "obs"))
@@ -557,8 +637,23 @@ def fleet_main(argv=None) -> int:
     finally:
         if session is not None:
             obs.shutdown(print_to=sys.stderr)
+            # the session's own export wrote the ROUTER-only trace;
+            # overwrite it with the ONE merged fleet trace: every
+            # process's span flame on its own pid + the per-request
+            # cross-process waterfalls (clock-offset aligned)
+            try:
+                from torchpruner_tpu.fleet.report import (
+                    write_fleet_trace,
+                )
+
+                write_fleet_trace(os.path.join(fleet_dir, "obs"))
+            except Exception as e:  # the trace must never fail the run
+                print(f"[fleet] merged trace export failed: {e}",
+                      file=sys.stderr)
             print(f"fleet telemetry written to "
-                  f"{os.path.join(fleet_dir, 'obs')}", file=sys.stderr)
+                  f"{os.path.join(fleet_dir, 'obs')} (merged "
+                  f"trace.json: open in ui.perfetto.dev)",
+                  file=sys.stderr)
 
 
 if __name__ == "__main__":

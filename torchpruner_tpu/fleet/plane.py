@@ -30,6 +30,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from torchpruner_tpu import obs
+from torchpruner_tpu.obs import reqtrace
 from torchpruner_tpu.resilience.manifest import (
     atomic_write_json,
     read_json,
@@ -57,6 +58,9 @@ class PlaneRecord:
     deadline_epoch_s: float
     accepted_epoch_s: float
     state: str = ACCEPTED
+    #: distributed trace id minted at acceptance (obs.reqtrace) —
+    #: journaled so a redriven/reloaded record keeps ONE waterfall
+    trace_id: Optional[str] = None
     #: replica name of the CURRENT/latest dispatch attempt
     replica: Optional[str] = None
     attempts: int = 0
@@ -87,6 +91,7 @@ class PlaneRecord:
             "deadline_epoch_s": self.deadline_epoch_s,
             "accepted_epoch_s": self.accepted_epoch_s,
             "state": self.state,
+            "trace_id": self.trace_id,
             "replica": self.replica,
             "attempts": self.attempts,
             "redrives": self.redrives,
@@ -99,8 +104,8 @@ class PlaneRecord:
     def from_json(cls, d: dict) -> "PlaneRecord":
         return cls(**{k: d.get(k) for k in (
             "rid", "payload", "deadline_epoch_s", "accepted_epoch_s",
-            "state", "replica", "attempts", "redrives", "tokens",
-            "completed_by", "error")})
+            "state", "trace_id", "replica", "attempts", "redrives",
+            "tokens", "completed_by", "error")})
 
 
 class RequestPlane:
@@ -199,12 +204,21 @@ class RequestPlane:
                 rid=f"r{next(self._ids):05d}", payload=dict(payload),
                 deadline_epoch_s=time.time() + float(deadline_s),
                 accepted_epoch_s=time.time())
+            rec.trace_id = reqtrace.mint_trace_id(rec.rid)
             self._records[rec.rid] = rec
             self._pending.append(rec.rid)
+            t0 = time.perf_counter()
             self._flush_locked()
+            flush_s = time.perf_counter() - t0
         obs.inc("fleet_accepted_total",
                 help="requests accepted into the fleet request plane "
                      "(journaled: completed or redrivable from here on)")
+        # the first two trace stages: the acceptance anchor and the
+        # durability cost paid before the ack
+        reqtrace.stage(rec.trace_id, "accept", rid=rec.rid,
+                       t_start=rec.accepted_epoch_s)
+        reqtrace.stage(rec.trace_id, "journal_flush", dur_s=flush_s,
+                       rid=rec.rid)
         return rec
 
     def note_shed(self) -> None:
@@ -255,6 +269,8 @@ class RequestPlane:
             obs.inc("fleet_redrive_total",
                     help="journaled requests re-queued off a dead/"
                          "failed replica to a survivor")
+            reqtrace.stage(rec.trace_id, "redrive", rid=rid,
+                           redrives=rec.redrives)
         return True
 
     def complete(self, rid: str, tokens: List[int],
@@ -280,6 +296,13 @@ class RequestPlane:
             rec._event.set()
         obs.inc("fleet_completed_total",
                 help="fleet requests completed by some replica")
+        e2e = max(0.0, time.time() - rec.accepted_epoch_s)
+        obs.observe("reqtrace_e2e_seconds", e2e,
+                    help="fleet request acceptance -> completion "
+                         "(router-observed end-to-end latency)")
+        reqtrace.finish(rec.trace_id, outcome="complete",
+                        e2e_s=round(e2e, 6), rid=rid, replica=replica,
+                        attempts=rec.attempts, redrives=rec.redrives)
         return True
 
     def fail(self, rid: str, error: str) -> bool:
@@ -298,6 +321,8 @@ class RequestPlane:
         obs.inc("fleet_failed_total",
                 help="accepted requests that exhausted their retry/"
                      "deadline budget (accepted-request LOSS)")
+        reqtrace.finish(rec.trace_id, outcome="failed", rid=rid,
+                        error=rec.error)
         return True
 
     # -- views --------------------------------------------------------------

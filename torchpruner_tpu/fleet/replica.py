@@ -134,16 +134,33 @@ class ReplicaClient:
     def healthz(self, timeout: float = 2.0) -> dict:
         """``{"live": bool, "ready": bool, "state": str}`` — an HTTP
         answer of ANY kind is liveness; readiness is the front end's
-        verdict (503 carries the non-ready state in its JSON body)."""
+        verdict (503 carries the non-ready state in its JSON body).
+
+        When the body carries the replica's wall clock (``ts``), the
+        answer additionally estimates ``clock_offset_s`` (replica −
+        caller, midpoint method over this probe's request/response
+        timestamps) and ``rtt_s`` — the distributed-trace alignment
+        riding the probe the router already makes."""
+
+        def offset_of(body: dict, t0: float, t1: float) -> dict:
+            ts = body.get("ts")
+            if ts is None:
+                return {}
+            return {"clock_offset_s": float(ts) - 0.5 * (t0 + t1),
+                    "rtt_s": t1 - t0}
+
+        t0 = time.time()
         try:
             out = self._request("/healthz", timeout=timeout)
             return {"live": True, "ready": bool(out.get("ok")),
-                    "state": out.get("state", "ready")}
+                    "state": out.get("state", "ready"),
+                    **offset_of(out, t0, time.time())}
         except ReplicaBusy as e:
             # 503 from /healthz = alive but NOT ready; the JSON body
             # carries the state (draining/staging_swap/slo_breach)
             return {"live": True, "ready": False,
-                    "state": e.body.get("state", "not_ready")}
+                    "state": e.body.get("state", "not_ready"),
+                    **offset_of(e.body, t0, time.time())}
         except ReplicaRejected:
             return {"live": True, "ready": False, "state": "error"}
         except (ReplicaDown, ReplicaTimeout):

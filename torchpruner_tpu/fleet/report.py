@@ -1,4 +1,4 @@
-"""Fleet-wide observability: merge the replicas' metric shards.
+"""Fleet-wide observability: shard merging + full trace assembly.
 
 Each replica is its own process with its own obs dir, so each writes a
 ``metrics.shard0.json`` at close (PR 5's cross-host aggregation path —
@@ -14,14 +14,29 @@ A ``kill -9``'d replica never reaches its session close and therefore
 ships no shard; the merge reports it missing instead of failing — the
 fleet report is the SURVIVORS' merged view plus the router's account of
 the death (``fleet_failover_total`` / ``fleet_redrive_total``).
+
+On top of the metric shards, this module assembles the fleet's
+**distributed request traces**: every process's ``events.jsonl`` —
+including the kill -9'd replica's, which flushed per line and so keeps
+every stage event up to the SIGKILL — is aligned onto the router's
+clock (offsets estimated from the health monitor's request/response
+timestamps, emitted as ``clock_offset`` events) and merged into ONE
+Perfetto ``trace.json``: the span flame of each process on its own pid
+plus per-request waterfall tracks whose rows hop router → replica
+(→ survivor on a redrive).  :func:`trace_summary` is the drill's
+contiguity verdict (every completed request cross-process, redriven
+requests showing both attempts) and :func:`slowest_exemplars` feeds
+``obs report``'s exemplar waterfalls.
 """
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 from typing import Dict, List, Optional
 
+from torchpruner_tpu.obs import trace_export
 from torchpruner_tpu.obs.aggregate import shard_path
 from torchpruner_tpu.resilience.manifest import atomic_write_json
 
@@ -50,6 +65,151 @@ def merge_replica_shards(fleet_obs_dir: str,
         atomic_write_json(shard_path(fleet_obs_dir, i + 1), shard,
                           indent=None)
     return out
+
+
+# -- distributed trace assembly ----------------------------------------------
+
+
+def replica_obs_dirs_of(fleet_obs_dir: str) -> List[str]:
+    """The per-replica obs dirs the fleet driver spawned under its own
+    obs dir (``replica<i>/``), in SPAWN order — numeric on the index
+    suffix, so ``replica10`` sorts after ``replica9`` and the stream
+    pids keep matching the re-homed metric-shard indices."""
+
+    def index_of(d: str) -> int:
+        tail = os.path.basename(os.path.normpath(d))[len("replica"):]
+        try:
+            return int(tail)
+        except ValueError:
+            return 1 << 30
+
+    return sorted(
+        (d for d in glob.glob(os.path.join(fleet_obs_dir, "replica*"))
+         if os.path.isdir(d)),
+        key=lambda d: (index_of(d), d))
+
+
+def collect_streams(fleet_obs_dir: str,
+                    replica_obs_dirs: Optional[List[str]] = None
+                    ) -> List[dict]:
+    """Every fleet process's parsed event stream with its trace
+    placement: the router (the fleet session itself) on pid 0, each
+    replica on pid i+1 (matching its re-homed metric-shard index), each
+    replica's clock shifted onto the router's by the LAST offset the
+    health monitor estimated for it (``clock_offset`` events in the
+    router stream; 0 when none landed — e.g. a replica that died before
+    its first probe answered)."""
+    from torchpruner_tpu.utils.profiling import load_span_events
+
+    if replica_obs_dirs is None:
+        replica_obs_dirs = replica_obs_dirs_of(fleet_obs_dir)
+    router_path = os.path.join(fleet_obs_dir, "events.jsonl")
+    router_events = (load_span_events(router_path)
+                     if os.path.exists(router_path) else [])
+    offsets: Dict[str, float] = {}
+    for ev in router_events:
+        if ev.get("event") == "clock_offset" and ev.get("replica"):
+            offsets[str(ev["replica"])] = float(ev.get("offset_s") or 0.0)
+    streams = [{"name": "router", "pid": 0, "events": router_events,
+                "shift_s": 0.0}]
+    for i, rep_dir in enumerate(replica_obs_dirs):
+        name = os.path.basename(os.path.normpath(rep_dir))
+        path = os.path.join(rep_dir, "events.jsonl")
+        events = load_span_events(path) if os.path.exists(path) else []
+        streams.append({
+            "name": name, "pid": i + 1, "events": events,
+            # offset = replica_clock - router_clock, so subtracting it
+            # maps the replica's timestamps onto the router timeline
+            "shift_s": -offsets.get(name, 0.0),
+        })
+    return streams
+
+
+def assemble_fleet_traces(fleet_obs_dir: str,
+                          replica_obs_dirs: Optional[List[str]] = None
+                          ) -> Dict[str, dict]:
+    """Cross-process per-request traces on the router clock (see
+    ``obs.trace_export.assemble_request_traces``)."""
+    return trace_export.assemble_request_traces(
+        collect_streams(fleet_obs_dir, replica_obs_dirs))
+
+
+def trace_summary(traces: Dict[str, dict]) -> Dict[str, int]:
+    """The drill's contiguity verdict over assembled traces:
+
+    - ``assembled`` — traces with any stage/summary event;
+    - ``completed`` — traces whose terminal outcome is ``complete``;
+    - ``cross_process`` — completed traces whose waterfall spans BOTH a
+      router pid and a replica pid and shows the replica-side serving
+      stages (prefill/first_token) — the router accept → replica decode
+      → completion contiguity the drill asserts for EVERY completed
+      request;
+    - ``redriven_cross_process`` — cross-process traces that carry a
+      redrive stage or a second dispatch attempt (both attempts
+      visible);
+    - ``torn`` — traces with stage events but no terminal summary (a
+      request that died with its replica AND never completed anywhere).
+    """
+    out = {"assembled": len(traces), "completed": 0, "cross_process": 0,
+           "redriven_cross_process": 0, "torn": 0}
+    for t in traces.values():
+        names = {s.get("stage") for s in t["stages"]}
+        if t.get("torn"):
+            out["torn"] += 1
+        if t.get("outcome") != "complete":
+            continue
+        out["completed"] += 1
+        cross = (len(t["pids"]) >= 2 and 0 in t["pids"]
+                 and ("prefill" in names or "first_token" in names))
+        if cross:
+            out["cross_process"] += 1
+            if t.get("redrive") or t.get("attempts", 0) >= 2:
+                out["redriven_cross_process"] += 1
+    return out
+
+
+def slowest_exemplars(traces: Dict[str, dict], k: int = 8) -> List[dict]:
+    """The K slowest completed traces as compact waterfall records for
+    the ledger / ``obs report`` (stage name + start offset + duration,
+    ms, relative to the trace's first stage)."""
+    done = [(tid, t) for tid, t in traces.items()
+            if t.get("outcome") == "complete" and t["stages"]]
+    done.sort(key=lambda kv: -(kv[1].get("e2e_s") or 0.0))
+    out = []
+    for tid, t in done[:k]:
+        t0 = t["stages"][0]["ts"]
+        out.append({
+            "trace": tid,
+            "e2e_ms": (round(1e3 * t["e2e_s"], 3)
+                       if t.get("e2e_s") is not None else None),
+            "ttft_ms": (round(1e3 * t["ttft_s"], 3)
+                        if t.get("ttft_s") is not None else None),
+            "attempts": t.get("attempts", 0),
+            "redrive": bool(t.get("redrive")),
+            "stages": [{
+                "stage": s.get("stage"),
+                "at_ms": round(1e3 * (s["ts"] - t0), 3),
+                "dur_ms": round(1e3 * float(s.get("dur_s") or 0.0), 3),
+                "pid": s.get("pid"),
+            } for s in t["stages"]],
+        })
+    return out
+
+
+def write_fleet_trace(fleet_obs_dir: str,
+                      replica_obs_dirs: Optional[List[str]] = None,
+                      out_path: Optional[str] = None) -> str:
+    """The ONE merged ``trace.json``: router + replica span flames on
+    distinct pids plus the per-request waterfall tracks.  Overwrites the
+    fleet session's own (router-only) export — call after
+    ``obs.shutdown()``.  Returns the written path."""
+    streams = collect_streams(fleet_obs_dir, replica_obs_dirs)
+    traces = trace_export.assemble_request_traces(streams)
+    if out_path is None:
+        out_path = os.path.join(fleet_obs_dir,
+                                trace_export.TRACE_FILENAME)
+    return trace_export.write_merged_trace(streams, out_path,
+                                           traces=traces)
 
 
 def replica_summary_line(log_path: str) -> Optional[dict]:

@@ -45,6 +45,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from torchpruner_tpu import obs
+from torchpruner_tpu.obs import reqtrace
 from torchpruner_tpu.fleet.plane import PlaneRecord, RequestPlane
 from torchpruner_tpu.fleet.replica import (
     ReplicaBusy,
@@ -110,6 +111,15 @@ class ReplicaView:
     queue_depth: int = 0
     swaps: int = 0
     probed_at: float = 0.0
+    #: estimated clock offset (replica wall clock − router wall clock,
+    #: seconds) from the health probe's request/response timestamps —
+    #: the alignment the cross-process trace assembly uses.  The kept
+    #: sample is the lowest-RTT one seen recently (NTP-style: a slow
+    #: probe bounds the offset loosely)
+    clock_offset: Optional[float] = None
+    offset_rtt: Optional[float] = None
+    offset_at: float = 0.0
+    offset_emitted: Optional[float] = None
     #: set once the death was failed over (so one death = one failover)
     failover_done: bool = False
     dispatched_total: int = 0
@@ -203,6 +213,15 @@ class FleetRouter:
                          "twins: fleet_shed_<reason>_total)")
             obs.inc(f"fleet_shed_{verdict['reason']}_total",
                     help=f"fleet admission sheds ({verdict['reason']})")
+            # a shed request never enters the plane; the refusal always
+            # counts into the aggregate stage counters, and its trace
+            # events reach the stream eagerly (drills) or 1-in-N by the
+            # sampling hash (a sustained-overload endpoint must not
+            # write a line per shed)
+            tid = reqtrace.mint_trace_id("shed")
+            reqtrace.stage(tid, "shed", reason=verdict["reason"])
+            reqtrace.finish(tid, outcome="shed",
+                            reason=verdict["reason"])
             return None
         rec = self.plane.accept(
             payload, deadline_s if deadline_s is not None
@@ -223,6 +242,7 @@ class FleetRouter:
             view.live, view.ready = h["live"], h["ready"]
             view.state = h["state"]
             view.probed_at = now
+            self._note_clock_offset(view, h)
             if view.live:
                 view.failover_done = False
                 try:
@@ -250,6 +270,37 @@ class FleetRouter:
                       help="replicas in the ready routing set")
         obs.gauge_set("fleet_pending_depth", self.plane.pending_depth,
                       help="plane records awaiting dispatch")
+
+    def _note_clock_offset(self, view: ReplicaView, h: dict) -> None:
+        """Keep the best (lowest-RTT) clock-offset sample the health
+        probe produced and emit it into the event stream (rate-limited
+        to real changes) — the per-replica alignment
+        ``fleet.report.collect_streams`` shifts that replica's
+        ``events.jsonl`` by when assembling the cross-process trace."""
+        off, rtt = h.get("clock_offset_s"), h.get("rtt_s")
+        if off is None:
+            return
+        rtt = float(rtt or 0.0)
+        now = time.monotonic()
+        # NTP-style: keep the lowest-RTT sample (a slower probe bounds
+        # the offset more loosely) — offset and rtt travel as one pair.
+        # A stale best sample (>60 s) is replaced regardless, so a slow
+        # clock drift is still tracked.
+        if view.offset_rtt is not None and rtt > view.offset_rtt \
+                and now - view.offset_at < 60.0:
+            return
+        view.clock_offset = float(off)
+        view.offset_rtt = rtt
+        view.offset_at = now
+        if view.offset_emitted is None \
+                or abs(view.clock_offset - view.offset_emitted) > 5e-4:
+            view.offset_emitted = view.clock_offset
+            obs.emit_event({
+                "event": "clock_offset", "ts": time.time(),
+                "replica": view.client.name,
+                "offset_s": round(view.clock_offset, 6),
+                "rtt_s": round(rtt, 6),
+            })
 
     def _failover(self, view: ReplicaView) -> None:
         """A replica left the live set: count the failover once and
@@ -345,8 +396,15 @@ class FleetRouter:
             # attempt budget: attempts are for transport failures, so a
             # saturated-but-healthy fleet queues work instead of
             # burning retries into a spurious loss
+            t_wait = time.perf_counter()
+            swap_stall = False
             view = self._pick(exclude=last_failed)
             while view is None:
+                if any(v.live and v.state == "staging_swap"
+                       for v in self.views.values()):
+                    # the capacity crunch is (at least partly) a hot-
+                    # swap taking replicas out of the routing set
+                    swap_stall = True
                 if deadline.expired:
                     raise DeadlineExceeded(
                         f"{rec.rid}: no usable replica before the "
@@ -356,10 +414,31 @@ class FleetRouter:
                 self.check_health()
                 view = self._pick(exclude=last_failed)
             name = view.client.name
+            attempt_no = rec.attempts + 1
+            wait_s = time.perf_counter() - t_wait
+            # the latency cost of WAITING for a usable replica — the
+            # invisible half of a retried dispatch (the retry counter
+            # alone says nothing about time spent)
+            obs.observe("fleet_dispatch_wait_seconds", wait_s,
+                        help="per-attempt wait for a usable replica "
+                             "plus retry backoff sleeps (dispatch "
+                             "latency cost, not counted in transport)")
+            reqtrace.stage(rec.trace_id,
+                           "swap_stall" if swap_stall
+                           else "dispatch_wait",
+                           dur_s=wait_s, rid=rec.rid,
+                           attempt=attempt_no, replica=name,
+                           kind="capacity")
             self.plane.assign(rec.rid, name)
             try:
-                out = view.client.generate(rec.payload,
-                                           timeout=timeout_s)
+                # trace propagation: the replica parses trace_id out of
+                # the wire payload and joins its serving stages onto
+                # this request's waterfall (the journal keeps the
+                # ORIGINAL payload — redrive/verify replay unchanged)
+                payload = rec.payload
+                if rec.trace_id:
+                    payload = {**payload, "trace_id": rec.trace_id}
+                out = view.client.generate(payload, timeout=timeout_s)
             except ReplicaError:
                 last_failed = name
                 # probe NOW so a death is seen (and its other records
@@ -371,12 +450,33 @@ class FleetRouter:
                     view.inflight -= 1  # release the _pick reservation
             return name, out
 
+        policy = self.policy.retry_policy()
+
+        def on_retry(attempt_no: int, exc: BaseException) -> None:
+            # the backoff sleep with_retries is ABOUT to take (same
+            # deterministic-jitter formula) — the other invisible
+            # latency cost of a retried dispatch.  with_retries raises
+            # WITHOUT sleeping when the backoff would cross the
+            # deadline; don't record a phantom wait on that path.
+            delay = policy.delay(attempt_no)
+            if delay >= deadline.remaining():
+                return
+            obs.observe("fleet_dispatch_wait_seconds", delay,
+                        help="per-attempt wait for a usable replica "
+                             "plus retry backoff sleeps (dispatch "
+                             "latency cost, not counted in transport)")
+            reqtrace.stage(rec.trace_id, "dispatch_wait", dur_s=delay,
+                           t_start=time.time(), rid=rec.rid,
+                           attempt=attempt_no, kind="backoff",
+                           error=type(exc).__name__)
+
         try:
             name, out = with_retries(
-                attempt, policy=self.policy.retry_policy(),
+                attempt, policy=policy,
                 deadline=deadline,
                 attempt_timeout_s=self.policy.attempt_timeout_s,
-                retry_on=(ReplicaError,), label="fleet_dispatch")
+                retry_on=(ReplicaError,), label="fleet_dispatch",
+                on_retry=on_retry)
         except DeadlineExceeded as e:
             obs.inc("fleet_deadline_exceeded_total",
                     help="records failed by deadline expiry")

@@ -68,10 +68,11 @@ __all__ = [
     "ObsSession", "configure", "get", "shutdown", "span",
     "current_span_id", "record_step", "record_grad_norm",
     "configure_step_flops", "record_capture", "capture_counts",
-    "inc", "observe", "gauge_set", "counter_value",
+    "inc", "observe", "gauge_set", "counter_value", "emit_event",
     "request_profile_window", "profile_tick", "profile_step",
     "record_scores", "record_prune", "record_round", "record_epoch",
-    "record_sweep_layer", "record_serve", "ledger_backfill",
+    "record_sweep_layer", "record_serve", "record_reqtrace",
+    "ledger_backfill",
     "annotate_run", "set_trial", "record_trial", "record_frontier",
     "MetricsRegistry", "StepTelemetry",
     "SpanTracer", "SpanRecord", "train_flops_per_step",
@@ -234,6 +235,15 @@ class ObsSession:
         self.compiles.stop()
         already_closed, self._closed = self._closed, True
         if not already_closed:
+            if self is _session:
+                # pending slowest-K request-trace exemplars flush into
+                # the event stream before it closes (obs.reqtrace)
+                try:
+                    from torchpruner_tpu.obs import reqtrace
+
+                    reqtrace.session_flush()
+                except Exception:
+                    pass
             self._finalize_profile()      # kernel gauges BEFORE export
         derived = self.derived()          # writes derived gauges
         record_device_memory(self.metrics)
@@ -552,6 +562,20 @@ def gauge_set(name: str, value: float, help: str = "") -> None:
         s.metrics.gauge(name, help).set(value)
 
 
+def emit_event(event: dict) -> None:
+    """Append one raw event to the session's ``events.jsonl`` stream
+    (no-op without a session or a file-backed emitter) — the hook the
+    request tracer (``obs.reqtrace``) and the fleet router's
+    clock-offset probe use to ride the span stream's file without being
+    spans."""
+    s = _session
+    if s is not None and s.events is not None:
+        try:
+            s.events(event)
+        except Exception:
+            pass
+
+
 def counter_value(name: str) -> float:
     """Current value of a named counter/gauge (0 without a session or
     before the first bump) — lets tests and smoke scripts assert on
@@ -631,6 +655,16 @@ def record_serve(*, kind: str, **fields) -> None:
     s = _session
     if s is not None and s.ledger is not None:
         s.ledger.record({"event": "serve", "kind": kind, **fields})
+
+
+def record_reqtrace(**fields) -> None:
+    """Ledger one request-trace analysis record (the fleet drill's
+    latency budget + slowest-K exemplar waterfalls + assembly counts)
+    — rendered by ``obs report``'s latency-budget section.
+    Informational — never deduped."""
+    s = _session
+    if s is not None and s.ledger is not None:
+        s.ledger.record({"event": "reqtrace", **fields})
 
 
 def set_trial(trial_id: Optional[str],

@@ -355,6 +355,7 @@ def build_report(*, run_meta: Optional[Dict[str, Any]] = None,
         "plan": picked("plan"),
         "trials": picked("trial"),
         "frontier": picked("frontier"),
+        "reqtrace": picked("reqtrace"),
         "derived": dict(derived or {}),
         "phases": dict(phases or {}),
         "compiles": dict(compiles or {}),

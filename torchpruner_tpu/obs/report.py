@@ -94,9 +94,14 @@ _SCALARS = {
 #: gates CI holds frontier regressions with; ``fleet_*`` are the
 #: multi-replica serving plane's failover/redrive/shed counters and
 #: replica gauges (fleet/router.py), gated by the CI failover drill
+#: (``reqtrace_*`` / ``ttft_stage_*`` are the distributed request
+#: tracer's latency-budget and assembly scalars — per-stage TTFT
+#: share, budget-vs-measured reconciliation, cross-process waterfall
+#: counts; obs/reqtrace.py + fleet/report.py, gated by the CI drill)
 _DYNAMIC_SCALAR_PREFIXES = ("kernel_", "serve_slo_breach", "zero_",
                             "predicted_", "plan_", "frontier_",
-                            "search_", "fleet_")
+                            "search_", "fleet_", "reqtrace_",
+                            "ttft_stage_", "serve_queue_wait")
 _DYNAMIC_EXTRA = ("profile_coverage", "profile_windows_total",
                   "profile_steps_total")
 
@@ -474,6 +479,68 @@ def format_report(report: Dict[str, Any]) -> str:
                 f"{_i(s.get('requests_drained'))}, swaps "
                 f"{_i(s.get('swaps'))}, checkpoint digest "
                 f"{str(s.get('checkpoint_digest') or '')[:12]}")
+        lines.append("")
+
+    # request-trace latency budget (obs/reqtrace.py): per-stage TTFT /
+    # E2E attribution that reconciles against the measured histograms.
+    # Preferred source is the ledger `reqtrace` record (the fleet drill
+    # writes budget + exemplars); without one the budget is recomputed
+    # from the metric snapshot, so a plain serve run renders it too.
+    rt_records = report.get("reqtrace") or []
+    budget = (rt_records[-1].get("budget") if rt_records else None)
+    if budget is None:
+        from torchpruner_tpu.obs.reqtrace import latency_budget
+
+        budget = latency_budget(metrics)
+    if budget:
+        ttft = budget.get("ttft") or {}
+        e2e = budget.get("e2e") or {}
+        bits = []
+        if ttft.get("measured_mean_ms") is not None:
+            bits.append(f"TTFT measured {ttft['measured_mean_ms']:.2f} "
+                        f"ms mean")
+        if ttft.get("recon_pct") is not None:
+            bits.append(f"stage budget reconciles {ttft['recon_pct']:+.1f}%")
+        if e2e.get("unattributed_pct") is not None:
+            bits.append(f"E2E unattributed "
+                        f"{e2e['unattributed_pct']:.1f}%")
+        lines.append("latency budget: " + (", ".join(bits) or "(stages)"))
+        lines.append("")
+        lines.append("| stage | p50 ms | p99 ms | mean ms | % TTFT "
+                     "| % E2E |")
+        lines.append("|---|---|---|---|---|---|")
+        e2e_pct = {r["stage"]: r.get("pct")
+                   for r in e2e.get("stages") or []}
+        ttft_pct = {r["stage"]: r.get("pct")
+                    for r in ttft.get("stages") or []}
+        seen = []
+        for r in (ttft.get("stages") or []) + (e2e.get("stages") or []):
+            if r["stage"] in seen:
+                continue
+            seen.append(r["stage"])
+            lines.append(
+                f"| {r['stage']} | {_f(r.get('p50_ms'), '.3f')} "
+                f"| {_f(r.get('p99_ms'), '.3f')} "
+                f"| {_f(r.get('mean_ms'), '.3f')} "
+                f"| {_f(ttft_pct.get(r['stage']), '.1f')} "
+                f"| {_f(e2e_pct.get(r['stage']), '.1f')} |")
+        lines.append("")
+    exemplars = (rt_records[-1].get("exemplars") if rt_records else None)
+    if exemplars:
+        lines.append(f"slowest-{len(exemplars)} exemplar waterfalls "
+                     "(cross-process; pid 0 = router):")
+        for ex in exemplars:
+            flow = " → ".join(
+                f"{s['stage']}"
+                + (f" {s['dur_ms']:.1f}ms" if s.get("dur_ms") else "")
+                + (f"@p{s['pid']}" if s.get("pid") is not None else "")
+                for s in ex.get("stages") or [])
+            lines.append(
+                f"- `{ex.get('trace')}` e2e {_f(ex.get('e2e_ms'), '.1f')}"
+                f" ms, ttft {_f(ex.get('ttft_ms'), '.1f')} ms, "
+                f"{ex.get('attempts', 0)} attempt(s)"
+                + (" [redriven]" if ex.get("redrive") else "")
+                + f": {flow}")
         lines.append("")
 
     profile = report.get("profile") or {}

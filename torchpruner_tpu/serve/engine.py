@@ -53,6 +53,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from torchpruner_tpu import obs
+from torchpruner_tpu.obs import reqtrace
 from torchpruner_tpu.resilience import chaos as _chaos
 from torchpruner_tpu.serve.allocator import (
     KVCacheAllocator,
@@ -358,6 +359,14 @@ class ServeEngine:
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :n] = req.prompt_ids
         s = req.sampling
+        t_adm = time.perf_counter()
+        if req.admitted_s is not None:
+            # admission stage: slot granted -> this request's prefill
+            # actually starting (a batch admission serializes prefills,
+            # so later batch members wait here)
+            reqtrace.stage(req.trace_id, "admission",
+                           dur_s=max(0.0, t_adm - req.admitted_s),
+                           request=req.id)
         with obs.span("serve_prefill", request=req.id, bucket=bucket):
             tok, carry, small = P.prefill_for(bucket)(
                 P.params, jnp.asarray(padded), jnp.asarray(n),
@@ -371,12 +380,17 @@ class ServeEngine:
             tok = int(tok)
         now = time.perf_counter()
         req.first_token_s = now
+        req.prefill_s = now - t_adm
         req.served_by = P  # which checkpoint's programs decoded it
         req.tokens.append(tok)
         self.gen_tokens += 1
+        reqtrace.stage(req.trace_id, "prefill", dur_s=req.prefill_s,
+                       request=req.id, bucket=bucket)
         if req.ttft_s is not None:
             obs.observe("serve_ttft_seconds", req.ttft_s,
                         help="request arrival -> first token")
+            reqtrace.stage(req.trace_id, "first_token", request=req.id,
+                           ttft_s=round(req.ttft_s, 6))
             if self.slo is not None:
                 self.slo.on_ttft(req.ttft_s)
         # slot tables: next write position is the prompt length
@@ -396,6 +410,27 @@ class ServeEngine:
         if self.retain_results:
             self._results.append(req)
         self.scheduler.evict(req, state=DONE)
+        if req.first_token_s is not None and req.done_s is not None:
+            # unconditional like the other stages: an untraced serve
+            # run's latency budget still needs the decode aggregate
+            reqtrace.stage(req.trace_id, "decode",
+                           dur_s=max(0.0,
+                                     req.done_s - req.first_token_s),
+                           request=req.id, tokens=len(req.tokens))
+        if req.trace_id:
+            reqtrace.stage(req.trace_id, "complete", request=req.id)
+            e2e = (req.done_s - req.arrival_s
+                   if req.done_s is not None and req.arrival_s is not None
+                   else None)
+            reqtrace.finish(
+                req.trace_id, outcome="complete",
+                ttft_s=(round(req.ttft_s, 6)
+                        if req.ttft_s is not None else None),
+                # the replica's local e2e (submit -> done): the sampled
+                # recorder's slowest-K rank key — without it a sampled
+                # replica would never flush its slow exemplars
+                e2e_s=(round(e2e, 6) if e2e is not None else None),
+                tokens=len(req.tokens))
 
     def _decode_once(self) -> None:
         import jax.numpy as jnp
@@ -570,6 +605,8 @@ class ServeEngine:
         for req in queued:
             req.state = DRAINED
             req._event.set()
+            if req.trace_id:
+                reqtrace.finish(req.trace_id, outcome="drained")
         self.drained.extend(queued)
         if queued:
             obs.inc("serve_drained_total", n=len(queued),

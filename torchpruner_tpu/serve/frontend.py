@@ -132,21 +132,30 @@ def _http_server(engine, port: int, request_timeout_s: float):
             self.wfile.write(data)
 
         def do_GET(self):
+            import time as _time
+
             if self.path == "/healthz":
                 # liveness (we answered at all) split from READINESS:
                 # non-ready states answer 503 so a k8s-style probe — and
                 # the fleet router — stops dispatching here before a
                 # drain completes / while a swap stages / during an SLO
-                # breach episode
+                # breach episode.  "ts" is this replica's wall clock:
+                # the router's clock-offset estimate (distributed trace
+                # alignment) rides the health probe it already makes.
                 state = engine.health_state()
                 self._json(200 if state == "ready" else 503,
                            {"ok": state == "ready", "live": True,
-                            "state": state})
+                            "state": state, "ts": _time.time()})
             elif self.path == "/stats":
                 sched = engine.scheduler
                 alloc = sched.allocator
                 stats = {
                     "state": engine.health_state(),
+                    "ts": _time.time(),
+                    # live queue age (submit -> admit) over the recent-
+                    # admissions window — visible while requests are
+                    # still waiting/decoding, not only at completion
+                    "queue_wait_ms": sched.queue_wait_ms(),
                     "swaps": engine.swaps_total,
                     "queue_depth": sched.queue_depth,
                     "active_slots": alloc.active_slots,
@@ -331,6 +340,14 @@ def serve_main(argv=None) -> int:
                         "batching correctness contract)")
     p.add_argument("--timeout", type=float, default=120.0,
                    help="http: per-request wait timeout (seconds)")
+    p.add_argument("--trace-sample-every", type=int, default=None,
+                   metavar="N",
+                   help="request-trace exemplar policy (obs.reqtrace): "
+                        "flush full stage detail for 1-in-N requests "
+                        "(deterministic on the trace id) plus the "
+                        "slowest-K per window; 1 = eager full tracing "
+                        "(drills); default 16 / env "
+                        "TORCHPRUNER_REQTRACE_SAMPLE_EVERY")
     p.add_argument("--queue-bound", type=int, default=0,
                    help="bound the scheduler's waiting queue: a "
                         "submission landing on a full queue is shed "
@@ -369,6 +386,10 @@ def serve_main(argv=None) -> int:
         obs.annotate_run(experiment=f"serve:{args.preset}", kind="serve",
                          model=args.preset,
                          checkpoint=args.checkpoint or "")
+    if args.trace_sample_every is not None:
+        from torchpruner_tpu.obs import reqtrace
+
+        reqtrace.configure(sample_every=args.trace_sample_every)
 
     model, params, meta = _resolve_model(
         args.preset, smoke=args.smoke, seed=args.seed,

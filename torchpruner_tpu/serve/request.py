@@ -68,11 +68,20 @@ class Request:
     eos_id: Optional[int] = None
     id: int = field(default_factory=lambda: next(_ids))
 
+    #: fleet-minted distributed trace id (obs.reqtrace) — propagated in
+    #: the dispatch payload so replica-side stage events join the
+    #: router's on one cross-process waterfall; None = untraced
+    trace_id: Optional[str] = None
+
     # -- engine-owned runtime state ------------------------------------
     state: str = QUEUED
     slot: Optional[int] = None
     tokens: List[int] = field(default_factory=list)
     arrival_s: Optional[float] = None
+    #: when the scheduler granted the slot (queue-age = admitted - arrival)
+    admitted_s: Optional[float] = None
+    #: wall seconds the prefill program (+ cache insert) took
+    prefill_s: Optional[float] = None
     first_token_s: Optional[float] = None
     done_s: Optional[float] = None
     #: wall-clock gaps between successive tokens (len == tokens - 1)
@@ -155,6 +164,9 @@ def request_from_dict(d: dict) -> Request:
     return Request(
         prompt_ids=d["prompt_ids"], max_new=int(d.get("max_new", 16)),
         eos_id=d.get("eos_id"),
+        # the router injects the fleet trace id at dispatch; absent on
+        # direct/journal submissions (untraced)
+        trace_id=d.get("trace_id"),
         sampling=Sampling(
             temperature=float(d.get("temperature", 0.0)),
             top_k=d.get("top_k"), top_p=d.get("top_p"),

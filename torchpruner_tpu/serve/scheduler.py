@@ -23,6 +23,7 @@ from collections import deque
 from typing import Deque, Dict, List, Optional
 
 from torchpruner_tpu import obs
+from torchpruner_tpu.obs import reqtrace
 from torchpruner_tpu.serve.allocator import KVCacheAllocator
 from torchpruner_tpu.serve.request import (
     ACTIVE,
@@ -52,6 +53,10 @@ class Scheduler:
         self.queue_bound = int(queue_bound)
         self._queue: Deque[Request] = deque()
         self._lock = threading.Lock()
+        #: recent queue-age-at-admission samples (seconds) — the LIVE
+        #: p50/p99 the /stats endpoint serves; the full distribution
+        #: rides the serve_queue_wait_seconds histogram
+        self._queue_waits: Deque[float] = deque(maxlen=512)
         #: slot -> active request
         self.running: Dict[int, Request] = {}
         self.admitted_total = 0
@@ -127,6 +132,21 @@ class Scheduler:
                 self._queue.popleft()
             head.slot = lease.slot
             head.state = ACTIVE
+            # queue age is recorded AT ADMISSION, not at completion —
+            # the wait is visible in /stats and the reqtrace budget
+            # while the request is still decoding
+            head.admitted_s = time.perf_counter()
+            if head.arrival_s is not None:
+                wait = max(0.0, head.admitted_s - head.arrival_s)
+                with self._lock:
+                    # /stats handler threads sort this deque live — an
+                    # unlocked append could fault their iteration
+                    self._queue_waits.append(wait)
+                obs.observe("serve_queue_wait_seconds", wait,
+                            help="request submit -> slot admission "
+                                 "(queue age at admit time)")
+                reqtrace.stage(head.trace_id, "replica_queue",
+                               dur_s=wait, request=head.id)
             self.running[lease.slot] = head
             self.admitted_total += 1
             out.append(head)
@@ -152,6 +172,21 @@ class Scheduler:
             obs.inc("serve_completed_total", help="requests completed")
         request._event.set()
         self._gauges()
+
+    def queue_wait_ms(self) -> Dict[str, float]:
+        """Live queue-age percentiles over the recent-admissions window
+        (ms) — empty dict before the first admission.  Thread-safe
+        (called from /stats handler threads while the engine admits)."""
+        with self._lock:
+            xs = sorted(self._queue_waits)
+        if not xs:
+            return {}
+
+        def pct(q: float) -> float:
+            i = min(len(xs) - 1, max(0, round(q * (len(xs) - 1))))
+            return round(1e3 * xs[int(i)], 3)
+
+        return {"p50": pct(0.50), "p99": pct(0.99)}
 
     def drain_queue(self) -> List[Request]:
         """Remove and return every not-yet-started request — the
