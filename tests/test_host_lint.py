@@ -1,0 +1,478 @@
+"""tpu-lint pass 6 (host-side concurrency & durability) tests: a
+synthetic violation corpus — one minimal module per check id, asserted
+by name AND path — a clean fixture that must produce zero findings,
+waiver match / stale-waiver / bad-waiver semantics, the planted-
+violation drill, the standalone CLI exit codes, and the whole-package
+scan smoke (zero error-severity findings on the committed tree, under
+the PERF.md <10 s wall bound)."""
+
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+
+from torchpruner_tpu.analysis import host_lint_default_paths, scan_source
+from torchpruner_tpu.analysis.host_lint import (
+    Waiver,
+    apply_waivers,
+    default_waivers_path,
+    host_lint_main,
+    lint_host,
+    load_waivers,
+)
+
+
+def checks(findings, severity=None):
+    return [f.check for f in findings
+            if severity is None or f.severity == severity]
+
+
+# -- synthetic violation corpus: one minimal module per check id -------------
+
+
+UNLOCKED_WRITE = """
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        with self._lock:
+            self.n += 1
+
+    def racy(self):
+        self.n = 5
+"""
+
+
+def test_unlocked_shared_write_fires():
+    fs = scan_source(UNLOCKED_WRITE, "synthetic/unlocked.py")
+    hits = [f for f in fs if f.check == "host/unlocked-shared-write"]
+    assert len(hits) == 1
+    assert hits[0].severity == "error"
+    assert hits[0].path.startswith("synthetic/unlocked.py:")
+    assert "Counter.racy" in hits[0].path
+    assert "n" in hits[0].message
+
+
+READ_GUARDED_WRITE = """
+import threading
+
+class Gate:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.closed = False
+
+    def submit(self):
+        with self._lock:
+            if self.closed:
+                return False
+        return True
+
+    def shutdown(self):
+        self.closed = True
+"""
+
+
+def test_read_under_lock_guards_the_attribute():
+    # an attribute only READ under the lock is still lock-guarded: the
+    # lock exists because someone consults it (the scheduler.closed
+    # race this check was built from)
+    fs = scan_source(READ_GUARDED_WRITE, "synthetic/readguard.py")
+    hits = [f for f in fs if f.check == "host/unlocked-shared-write"]
+    assert len(hits) == 1
+    assert "Gate.shutdown" in hits[0].path
+
+
+CROSS_OBJECT_WRITE = """
+import threading
+
+class Scheduler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.closed = False
+
+    def close(self):
+        with self._lock:
+            self.closed = True
+
+class Engine:
+    def __init__(self, scheduler):
+        self.scheduler = scheduler
+
+    def drain(self):
+        self.scheduler.closed = True
+"""
+
+
+def test_cross_object_unlocked_write_fires():
+    fs = scan_source(CROSS_OBJECT_WRITE, "synthetic/cross.py")
+    hits = [f for f in fs if f.check == "host/unlocked-shared-write"]
+    assert len(hits) == 1
+    assert "Engine.drain" in hits[0].path
+    assert "Scheduler" in hits[0].message
+
+
+BLOCKING_UNDER_LOCK = """
+import threading
+import time
+
+class Slow:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def pause(self):
+        with self._lock:
+            time.sleep(0.5)
+"""
+
+
+def test_blocking_under_lock_fires():
+    fs = scan_source(BLOCKING_UNDER_LOCK, "synthetic/blocking.py")
+    hits = [f for f in fs if f.check == "host/blocking-under-lock"]
+    assert len(hits) == 1
+    assert hits[0].severity == "warning"
+    assert "Slow.pause" in hits[0].path
+
+
+LOCK_ORDER = """
+import threading
+
+class Deadlocky:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self._io_lock = threading.Lock()
+
+    def forward(self):
+        with self._state_lock:
+            with self._io_lock:
+                pass
+
+    def backward(self):
+        with self._io_lock:
+            with self._state_lock:
+                pass
+"""
+
+
+def test_lock_order_cycle_fires():
+    fs = scan_source(LOCK_ORDER, "synthetic/order.py")
+    hits = [f for f in fs if f.check == "host/lock-order"]
+    assert len(hits) == 1
+    assert hits[0].severity == "error"
+    assert "synthetic/order.py" in hits[0].path
+
+
+TORN_WRITE = """
+import json
+
+def flush(path, records):
+    with open(path + "/journal.json", "w") as f:
+        json.dump(records, f)
+"""
+
+
+def test_torn_write_fires():
+    fs = scan_source(TORN_WRITE, "synthetic/torn.py")
+    hits = [f for f in fs if f.check == "host/torn-write"]
+    assert hits, checks(fs)
+    assert hits[0].severity == "error"
+    assert "atomic_write_json" in hits[0].message
+
+
+DAEMON_LEAK = """
+import threading
+
+def start_pump():
+    t = threading.Thread(target=print)
+    t.start()
+    return t
+"""
+
+
+def test_daemon_leak_fires():
+    fs = scan_source(DAEMON_LEAK, "synthetic/daemon.py")
+    hits = [f for f in fs if f.check == "host/daemon-leak"]
+    assert len(hits) == 1
+    assert hits[0].severity == "warning"
+    assert "start_pump" in hits[0].path
+
+
+def test_daemon_true_and_joined_threads_pass():
+    ok = """
+import threading
+
+def start_daemon():
+    t = threading.Thread(target=print, daemon=True)
+    t.start()
+
+def start_joined():
+    t = threading.Thread(target=print)
+    t.start()
+    t.join()
+"""
+    fs = scan_source(ok, "synthetic/daemon_ok.py")
+    assert "host/daemon-leak" not in checks(fs)
+
+
+WALLCLOCK_DIGEST = """
+import time
+
+def make_trial_id(seq):
+    return f"trial-{seq}-{time.time()}"
+"""
+
+
+def test_wallclock_in_digest_fires():
+    fs = scan_source(WALLCLOCK_DIGEST, "synthetic/wallclock.py")
+    hits = [f for f in fs if f.check == "host/wallclock-in-digest"]
+    assert len(hits) == 1
+    assert hits[0].severity == "error"
+    assert "make_trial_id" in hits[0].path
+
+
+# -- clean fixture ------------------------------------------------------------
+
+
+CLEAN = """
+import json
+import threading
+import time
+
+from torchpruner_tpu.resilience.manifest import atomic_write_json
+
+class Tidy:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        with self._lock:
+            self.n += 1
+
+    def snapshot(self):
+        with self._lock:
+            n = self.n
+        return n
+
+def persist(path, data):
+    atomic_write_json(path + "/manifest.json", data)
+
+def wait_a_bit():
+    time.sleep(0.01)
+"""
+
+
+def test_clean_fixture_zero_findings():
+    assert scan_source(CLEAN, "synthetic/clean.py") == []
+
+
+def test_locked_suffix_convention():
+    # methods named *_locked run with the caller's lock held — their
+    # writes are guarded, not racy (the SLOMonitor._check_locked idiom)
+    src = """
+import threading
+
+class Mon:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rolling = 0
+
+    def check(self):
+        with self._lock:
+            return self._check_locked()
+
+    def _check_locked(self):
+        self.rolling = 1
+        return self.rolling
+"""
+    fs = scan_source(src, "synthetic/locked_suffix.py")
+    assert "host/unlocked-shared-write" not in checks(fs)
+
+
+def test_init_writes_are_exempt():
+    src = """
+import threading
+
+class Boring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = "new"
+
+    def advance(self):
+        with self._lock:
+            self.state = "running"
+"""
+    assert scan_source(src, "synthetic/init_ok.py") == []
+
+
+# -- waiver semantics ---------------------------------------------------------
+
+
+def test_waiver_downgrades_to_info_with_reason(tmp_path):
+    mod = tmp_path / "racy.py"
+    mod.write_text(BLOCKING_UNDER_LOCK)
+    wfile = tmp_path / "waivers.json"
+    wfile.write_text(json.dumps({"waivers": [{
+        "check": "host/blocking-under-lock",
+        "file": "racy.py",
+        "reason": "test fixture: sleep is intentional",
+    }]}))
+    fs = lint_host([str(mod)], waivers_path=str(wfile))
+    assert checks(fs, "error") == []
+    assert checks(fs, "warning") == []
+    waived = [f for f in fs if f.check == "host/blocking-under-lock"]
+    assert len(waived) == 1
+    assert waived[0].severity == "info"
+    assert "waived (test fixture: sleep is intentional)" \
+        in waived[0].message
+
+
+def test_stale_waiver_is_an_error(tmp_path):
+    mod = tmp_path / "fine.py"
+    mod.write_text(CLEAN)
+    wfile = tmp_path / "waivers.json"
+    wfile.write_text(json.dumps({"waivers": [{
+        "check": "host/blocking-under-lock",
+        "file": "fine.py",
+        "reason": "excuses code that no longer exists",
+    }]}))
+    fs = lint_host([str(mod)], waivers_path=str(wfile))
+    assert checks(fs, "error") == ["host/stale-waiver"]
+
+
+def test_waiver_for_unscanned_file_is_not_stale(tmp_path):
+    # the default scan covers the serving plane only; a waiver for a
+    # file OUTSIDE the scanned paths must not be reported stale
+    mod = tmp_path / "fine.py"
+    mod.write_text(CLEAN)
+    wfile = tmp_path / "waivers.json"
+    wfile.write_text(json.dumps({"waivers": [{
+        "check": "host/blocking-under-lock",
+        "file": "somewhere/else.py",
+        "reason": "scanned in the full-package CI lane only",
+    }]}))
+    fs = lint_host([str(mod)], waivers_path=str(wfile))
+    assert checks(fs, "error") == []
+
+
+def test_reasonless_waiver_is_an_error(tmp_path):
+    mod = tmp_path / "fine.py"
+    mod.write_text(CLEAN)
+    wfile = tmp_path / "waivers.json"
+    wfile.write_text(json.dumps({"waivers": [{
+        "check": "host/blocking-under-lock",
+        "file": "fine.py",
+    }]}))
+    fs = lint_host([str(mod)], waivers_path=str(wfile))
+    assert checks(fs, "error") == ["host/bad-waiver"]
+
+
+def test_apply_waivers_counts_hits():
+    fs = scan_source(BLOCKING_UNDER_LOCK, "synthetic/blocking.py")
+    w = Waiver("host/blocking-under-lock", "synthetic/blocking.py",
+               "unit test")
+    out = apply_waivers(fs, [w], ["synthetic/blocking.py"])
+    assert w.hits == 1
+    assert all(f.severity == "info" for f in out)
+
+
+def test_committed_waiver_file_is_well_formed():
+    waivers, findings = load_waivers(default_waivers_path())
+    assert findings == []
+    assert waivers, "committed waiver file should carry entries"
+    assert all(w.reason for w in waivers)
+
+
+# -- planted-violation drill --------------------------------------------------
+
+
+def test_planted_unlocked_write_drill(tmp_path):
+    mod = tmp_path / "fine.py"
+    mod.write_text(CLEAN)
+    fs = lint_host([str(mod)], waivers_path=str(tmp_path / "none.json"),
+                   plant="unlocked_write")
+    errs = [f for f in fs if f.severity == "error"]
+    assert [f.check for f in errs] == ["host/unlocked-shared-write"]
+    assert "<planted:unlocked_write>" in errs[0].path
+
+
+def test_foreign_plant_is_ignored(tmp_path):
+    # TORCHPRUNER_LINT_PLANT is shared with the collective drill —
+    # pass 4's replicated_allreduce must not trip pass 6 (and vice
+    # versa: the placement planner ignores unlocked_write)
+    mod = tmp_path / "fine.py"
+    mod.write_text(CLEAN)
+    fs = lint_host([str(mod)], waivers_path=str(tmp_path / "none.json"),
+                   plant="replicated_allreduce")
+    assert checks(fs, "error") == []
+
+
+# -- entry points -------------------------------------------------------------
+
+
+def test_cli_clean_tree_exits_zero(tmp_path, capsys):
+    artifact = tmp_path / "host_lint.json"
+    rc = host_lint_main(["torchpruner_tpu", "--json", str(artifact)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "host" in out
+    data = json.loads(artifact.read_text())
+    assert data["errors"] == 0
+
+
+def test_cli_planted_drill_exits_one(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("TORCHPRUNER_LINT_PLANT", "unlocked_write")
+    rc = host_lint_main(["torchpruner_tpu"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "host/unlocked-shared-write" in out
+
+
+def test_module_cli_dispatch(tmp_path):
+    mod = tmp_path / "racy.py"
+    mod.write_text(UNLOCKED_WRITE)
+    proc = subprocess.run(
+        [sys.executable, "-m", "torchpruner_tpu", "lint-host", str(mod),
+         "--waivers", str(tmp_path / "none.json")],
+        capture_output=True, text=True)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "host/unlocked-shared-write" in proc.stdout
+
+
+def test_default_paths_are_the_serving_plane():
+    paths = host_lint_default_paths()
+    tails = [p.replace("\\", "/").rsplit("/", 1)[-1] for p in paths]
+    assert tails == ["fleet", "serve", "search", "obs", "resilience"]
+
+
+def test_record_gauges_lands_in_obs(tmp_path):
+    from torchpruner_tpu import obs
+    from torchpruner_tpu.analysis.host_lint import record_gauges
+
+    obs.configure(str(tmp_path / "obs"), annotate=False)
+    try:
+        record_gauges(scan_source(UNLOCKED_WRITE, "synthetic/u.py"))
+        assert obs.counter_value("host_lint_findings_total") == 1
+        assert obs.counter_value("host_lint_errors_total") == 1
+    finally:
+        obs.shutdown()
+
+
+# -- whole-package smoke ------------------------------------------------------
+
+
+def test_whole_package_scan_is_clean_and_fast():
+    t0 = time.perf_counter()
+    fs = lint_host(["torchpruner_tpu"])
+    wall = time.perf_counter() - t0
+    errs = [f for f in fs if f.severity == "error"]
+    assert errs == [], [f.format() for f in errs]
+    # warnings must be fixed or waived too — zero silent exceptions
+    warns = [f for f in fs if f.severity == "warning"]
+    assert warns == [], [f.format() for f in warns]
+    assert wall < 10.0, f"host lint took {wall:.1f}s (PERF.md bound: 10s)"

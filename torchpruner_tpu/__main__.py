@@ -16,6 +16,7 @@ Examples::
     python -m torchpruner_tpu serve llama3_ffn_taylor --smoke --synthetic 16
     python -m torchpruner_tpu fleet llama_tiny --cpu --replicas 3 --synthetic 18
     python -m torchpruner_tpu search digits_smoke --jobs 2
+    python -m torchpruner_tpu lint-host torchpruner_tpu/
     python -m torchpruner_tpu obs report logs/fleet/obs   # latency budget
     python -m torchpruner_tpu obs report logs/obs
     python -m torchpruner_tpu --preset mnist_mlp_shapley --smoke \\
@@ -55,6 +56,14 @@ def main(argv=None) -> int:
         from torchpruner_tpu.fleet.frontend import fleet_main
 
         return fleet_main(argv[1:])
+    if argv and argv[0] == "lint-host":
+        # tpu-lint pass 6 standalone: `python -m torchpruner_tpu
+        # lint-host [paths ...] [--waivers FILE] [--json OUT]` — the
+        # host-side concurrency/durability scan needs no preset, no
+        # model, no XLA, so CI can run it against the whole package
+        from torchpruner_tpu.analysis.host_lint import host_lint_main
+
+        return host_lint_main(argv[1:])
     if argv and argv[0] == "search":
         # Pareto sparsity-search campaign driver (search.driver):
         # `python -m torchpruner_tpu search <campaign> [--jobs N]
@@ -70,7 +79,9 @@ def main(argv=None) -> int:
                     "(subcommands: obs report/diff — run-ledger tooling; "
                     "serve — continuous-batching inference engine; "
                     "fleet — fault-tolerant multi-replica serving plane; "
-                    "search — Pareto sparsity-search campaign driver)",
+                    "search — Pareto sparsity-search campaign driver; "
+                    "lint-host — host-side concurrency/durability "
+                    "lint, no preset needed)",
     )
     p.add_argument(
         "target", nargs="?", default=None,

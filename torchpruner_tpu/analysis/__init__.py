@@ -51,6 +51,21 @@ land as ``predicted_step_ms``/``predicted_comm_ms`` gauges in
 ``report.json`` and flag comm-bound configs.  Both passes degrade to
 info findings (never a host-melting compile) via a param budget
 (``collective_lint.compile_budget``).
+
+Pass 6 (analysis/host_lint.py) leaves the compiled program entirely and
+lints the HOST side — the threaded serving plane the compiled step is
+embedded in.  It is a pure-stdlib AST scan (no jax import, whole
+package in ~1 s) for the bug classes the post-review hardening lists
+kept re-finding by hand: shared attributes written without the lock
+that guards them elsewhere, blocking IO under a held lock, lock-order
+cycles, durable artifacts written without ``atomic_write_json``,
+non-daemon threads with no shutdown join, and wall-clock/randomness
+feeding determinism digests.  Intentional exceptions live in a
+committed reason-carrying waiver file
+(``results/host_lint_waivers.json``); a waiver matching nothing is
+itself an error, so waivers cannot rot.  Standalone entry:
+``python -m torchpruner_tpu lint-host [paths]``; default scan surface
+is :func:`host_lint_default_paths`.
 """
 
 from torchpruner_tpu.analysis.findings import (
@@ -93,6 +108,11 @@ from torchpruner_tpu.analysis.planner import (
     plan_auto,
     probe_candidate,
 )
+from torchpruner_tpu.analysis.host_lint import (
+    host_lint_default_paths,
+    lint_host,
+    scan_source,
+)
 from torchpruner_tpu.analysis.runner import (
     lint_config,
     lint_preset,
@@ -112,5 +132,6 @@ __all__ = [
     "record_hbm_prediction",
     "plan_auto", "enumerate_candidates", "probe_candidate",
     "format_plan",
+    "lint_host", "host_lint_default_paths", "scan_source",
     "lint_config", "lint_preset", "plan_preset",
 ]

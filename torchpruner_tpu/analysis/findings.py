@@ -34,11 +34,14 @@ class Finding:
     """One diagnosed hazard.
 
     ``lint`` names the pass (``"plan"`` | ``"sharding"`` | ``"jaxpr"`` |
-    ``"collective"`` | ``"cost"`` | ``"planner"`` — the last being the
+    ``"collective"`` | ``"cost"`` | ``"host"`` | ``"planner"`` —
+    ``"host"`` is the pass-6 concurrency/durability scan over the
+    serving plane (analysis/host_lint.py), ``"planner"`` the
     auto-parallelism planner's candidate-exclusion findings,
     analysis/planner.py), ``check`` is the stable id severity overrides
     key on, ``path`` the pytree path / layer path / jaxpr site /
-    program name / candidate label the finding anchors to.
+    program name / ``file:line`` source site / candidate label the
+    finding anchors to.
     """
 
     severity: str

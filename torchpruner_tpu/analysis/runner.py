@@ -69,6 +69,7 @@ def lint_config(
     jaxpr: bool = True,
     collectives: bool = True,
     cost: bool = True,
+    host: bool = True,
 ) -> LintReport:
     """Full tpu-lint run for one config.
 
@@ -84,7 +85,10 @@ def lint_config(
     abstract trace of the step — pass 3 AND the jaxpr-collective half
     of pass 4 (with ``jaxpr=True`` they share ONE trace);
     ``collectives=False`` / ``cost=False`` skip the compile-based
-    passes (4/5 — the only passes that invoke XLA).
+    passes (4/5 — the only passes that invoke XLA).  ``host=False``
+    skips pass 6, the host-side concurrency/durability lint — a pure
+    AST scan of the serving-plane packages that needs neither the
+    model nor XLA (``analysis.host_lint``).
     """
     from torchpruner_tpu.experiments.prune_retrain import (
         LOSS_REGISTRY,
@@ -196,6 +200,17 @@ def lint_config(
             preds = cost_model.predict_programs(records)
             findings += cost_model.cost_findings(preds)
             cost_model.record_gauges(preds)
+
+    # -- pass 6: host-side concurrency & durability lint (pure AST over
+    # the serving-plane packages; mtime-cached, so preset sweeps pay the
+    # parse once) ---------------------------------------------------------
+    if host:
+        from torchpruner_tpu.analysis.collective_lint import env_plant
+        from torchpruner_tpu.analysis import host_lint
+
+        hfindings = host_lint.lint_host(plant=env_plant())
+        host_lint.record_gauges(hfindings)
+        findings += hfindings
 
     return merge_reports(cfg.name, findings)
 

@@ -405,17 +405,16 @@ def run_http(preset: str, args, fleet_dir: str,
         # a restarted endpoint REDRIVES its previous incarnation's
         # journal instead of clobbering it — the router-death half of
         # the completed-or-redrivable contract
-        plane = RequestPlane.load(journal)
+        # retain_terminal bounds the journal: the long-running endpoint
+        # keeps only the newest terminal records (flush cost must not
+        # grow with lifetime traffic)
+        plane = RequestPlane.load(journal, retain_terminal=512)
         redriven = plane.pending_depth
         if redriven:
             print(f"[fleet] journal reloaded: {redriven} non-terminal "
                   f"record(s) redriven", file=sys.stderr, flush=True)
     else:
-        plane = RequestPlane(journal)
-    # bound the journal: the long-running endpoint keeps only the
-    # newest terminal records (flush cost must not grow with lifetime
-    # traffic)
-    plane.retain_terminal = 512
+        plane = RequestPlane(journal, retain_terminal=512)
     router = FleetRouter(plane, procs, policy=_policy_of(args))
     stop = threading.Event()
 

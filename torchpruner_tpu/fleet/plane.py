@@ -139,35 +139,40 @@ class RequestPlane:
     # -- construction / recovery -------------------------------------------
 
     @classmethod
-    def load(cls, journal_path: str) -> "RequestPlane":
+    def load(cls, journal_path: str,
+             retain_terminal: int = 0) -> "RequestPlane":
         """Rebuild a plane from a (possibly dead) router's journal.
         Non-terminal records — accepted AND dispatched, since a
         dispatched record whose router died has no worker anymore — go
         back to pending in acceptance order: the redrive-after-router-
         death path."""
-        plane = cls(journal_path)
+        plane = cls(journal_path, retain_terminal=retain_terminal)
         raw = read_json(journal_path)
         max_id = -1
-        for d in raw.get("records", []):
-            rec = PlaneRecord.from_json(d)
-            plane._records[rec.rid] = rec
-            if rec.rid.startswith("r"):
-                try:
-                    max_id = max(max_id, int(rec.rid[1:]))
-                except ValueError:
-                    pass
-            if rec.terminal():
-                rec._event.set()
-            else:
-                if rec.state == DISPATCHED:
-                    rec.redrives += 1
-                rec.state = ACCEPTED
-                rec.replica = None
-                plane._pending.append(rec.rid)
-        plane._pending.sort(
-            key=lambda rid: plane._records[rid].accepted_epoch_s)
-        plane._ids = itertools.count(max_id + 1)
-        plane.shed_total = int(raw.get("shed_total", 0))
+        # the lock makes the rebuild safe even if the caller hands the
+        # plane to accepting threads before load() returns (and keeps
+        # these writes honest under the shared-state lint)
+        with plane._lock:
+            for d in raw.get("records", []):
+                rec = PlaneRecord.from_json(d)
+                plane._records[rec.rid] = rec
+                if rec.rid.startswith("r"):
+                    try:
+                        max_id = max(max_id, int(rec.rid[1:]))
+                    except ValueError:
+                        pass
+                if rec.terminal():
+                    rec._event.set()
+                else:
+                    if rec.state == DISPATCHED:
+                        rec.redrives += 1
+                    rec.state = ACCEPTED
+                    rec.replica = None
+                    plane._pending.append(rec.rid)
+            plane._pending.sort(
+                key=lambda rid: plane._records[rid].accepted_epoch_s)
+            plane._ids = itertools.count(max_id + 1)
+            plane.shed_total = int(raw.get("shed_total", 0))
         return plane
 
     def _compact_locked(self) -> None:

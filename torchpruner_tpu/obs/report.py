@@ -101,7 +101,8 @@ _SCALARS = {
 _DYNAMIC_SCALAR_PREFIXES = ("kernel_", "serve_slo_breach", "zero_",
                             "predicted_", "plan_", "frontier_",
                             "search_", "fleet_", "reqtrace_",
-                            "ttft_stage_", "serve_queue_wait")
+                            "ttft_stage_", "serve_queue_wait",
+                            "host_lint_")
 _DYNAMIC_EXTRA = ("profile_coverage", "profile_windows_total",
                   "profile_steps_total")
 

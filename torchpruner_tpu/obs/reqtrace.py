@@ -128,12 +128,16 @@ class ReqTraceRecorder:
     def configure(self, *, sample_every: Optional[int] = None,
                   slowest_k: Optional[int] = None,
                   window: Optional[int] = None) -> None:
-        if sample_every is not None:
-            self.sample_every = int(sample_every)
-        if slowest_k is not None:
-            self.slowest_k = int(slowest_k)
-        if window is not None:
-            self.window = int(window)
+        # under the lock: recording threads consult these knobs while
+        # mutating the slowest-K heap, so a reconfigure must not
+        # interleave with an in-flight finish()
+        with self._lock:
+            if sample_every is not None:
+                self.sample_every = int(sample_every)
+            if slowest_k is not None:
+                self.slowest_k = int(slowest_k)
+            if window is not None:
+                self.window = int(window)
 
     # -- recording -----------------------------------------------------------
 

@@ -600,7 +600,7 @@ class ServeEngine:
     # -- drain / loop -------------------------------------------------------
 
     def _snapshot_queue(self, extra: Optional[List[Request]] = None) -> None:
-        self.scheduler.closed = True  # later submissions bounce
+        self.scheduler.close()  # later submissions bounce
         queued = self.scheduler.drain_queue() + list(extra or [])
         for req in queued:
             req.state = DRAINED

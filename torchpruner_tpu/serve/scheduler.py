@@ -105,6 +105,15 @@ class Scheduler:
         obs.inc("serve_requests_total", help="requests submitted")
         return request
 
+    def close(self) -> None:
+        """Begin a drain: flip ``closed`` under the queue lock.  Set
+        bare (``scheduler.closed = True``) a submission racing the
+        drain could observe ``closed == False``, pass the gate, and
+        append after the drain swept the queue — the same permanently
+        QUEUED hang the ``submit`` gate exists to prevent."""
+        with self._lock:
+            self.closed = True
+
     # -- engine side (step boundaries only) ---------------------------------
 
     @property
