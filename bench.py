@@ -1208,8 +1208,40 @@ def _leg_serve(smoke: bool, progress=None) -> dict:
     # rebased to report this phase alone
     evict0 = eng.scheduler.allocator.total_evictions
     steps0 = eng.steps
+    # latency numbers come from the steady-state windows of a private
+    # time-series recorder scoped to the measured phase (warmup windows
+    # dropped), not whole-run means — the open loop's ramp-up otherwise
+    # drags the percentiles.  The session recorder (if any) is swapped
+    # out so the bench-wide series isn't polluted with leg-local windows.
+    import tempfile as _tempfile
+
+    from torchpruner_tpu import obs as _obs
+    from torchpruner_tpu.obs.timeseries import (
+        TimeseriesRecorder,
+        steady_state_percentiles,
+    )
+
+    _sess = _obs.get()
+    ts_dir = ts_rec = old_rec = None
+    if _sess is not None:
+        try:
+            ts_dir = _tempfile.mkdtemp(prefix="bench_serve_ts_")
+            ts_rec = TimeseriesRecorder(_sess.metrics, ts_dir,
+                                        interval_s=0.2)
+            old_rec = _sess.timeseries
+            _sess.timeseries = ts_rec
+        except Exception:  # noqa: BLE001 — telemetry never breaks bench
+            ts_dir = ts_rec = None
     t0 = time.perf_counter()
-    eng.run(OpenLoopTraffic(reqs, poisson_arrivals(n, rate, seed=2)))
+    try:
+        eng.run(OpenLoopTraffic(reqs, poisson_arrivals(n, rate, seed=2)))
+    finally:
+        if ts_rec is not None:
+            _sess.timeseries = old_rec
+            try:
+                ts_rec.close()
+            except Exception:  # noqa: BLE001
+                ts_dir = None
     wall = time.perf_counter() - t0
     done = [r for r in reqs if r.state == "done"]
     ttfts = np.asarray([r.ttft_s for r in done if r.ttft_s is not None])
@@ -1229,6 +1261,23 @@ def _leg_serve(smoke: bool, progress=None) -> dict:
         "evictions": eng.scheduler.allocator.total_evictions - evict0,
         "decode_steps": eng.steps - steps0,
     })
+    # prefer the steady-state-window percentiles when the measured
+    # phase produced enough windows (whole-run numbers above stay as
+    # the fallback for very short smoke runs)
+    if ts_dir is not None:
+        steady = {}
+        for metric, label in (("serve_ttft_seconds", "ttft"),
+                              ("serve_token_seconds", "token")):
+            seg = steady_state_percentiles(ts_dir, metric)
+            if seg and seg.get("p50") is not None:
+                steady[label] = seg
+        for label, seg in steady.items():
+            result[f"{label}_p50_ms"] = round(seg["p50"] * 1e3, 3)
+            result[f"{label}_p99_ms"] = round(seg["p99"] * 1e3, 3)
+        if steady:
+            result["latency_source"] = "steady_state_windows"
+            result["steady_obs_n"] = max(
+                int(s.get("n") or 0) for s in steady.values())
     # latency budget at the 70%-load operating point: where TTFT time
     # actually went (queue wait vs admit-batch wait vs the prefill
     # program), from the per-request stage stamps — the top-2
@@ -1296,6 +1345,12 @@ def _leg_fleet(smoke: bool) -> dict:
         "traces_cross_process": s.get("traces_cross_process"),
         "ttft_budget_top2": s.get("ttft_budget_top2"),
         "ttft_recon_pct": s.get("ttft_recon_pct"),
+        # telemetry-plane verdicts: per-process time-series merged onto
+        # the router clock, and the burn-rate alert count (must be 0 —
+        # this drill plants a kill, not an SLO breach)
+        "ts_streams": s.get("ts_streams"),
+        "ts_windows": s.get("ts_windows"),
+        "slo_burn_alerts": s.get("slo_burn_alerts"),
     }
 
 

@@ -307,6 +307,56 @@ def test_router_rolling_swap_walks_replicas(tmp_path):
     router.close()
 
 
+def test_router_policy_loop_knobs(tmp_path):
+    """The hardcoded swap/drain sleeps are RouterPolicy fields now —
+    the defaults match the old constants, and run_until_drained uses
+    the policy cadence when no explicit poll_s is passed."""
+    assert RouterPolicy().swap_poll_s == 0.25
+    assert RouterPolicy().drain_poll_s == 0.02
+    reps = [FakeReplica("replica0")]
+    router = FleetRouter(RequestPlane(), reps,
+                         policy=_fast_policy(swap_poll_s=0.001,
+                                             drain_poll_s=0.001))
+    rec = router.submit(PAYLOAD)
+    router.run_until_drained(timeout_s=30.0)  # policy drain_poll_s
+    router.close()
+    assert rec.state == COMPLETED
+    assert router.policy.swap_poll_s == 0.001
+
+
+def test_health_scrape_records_rtt_and_replica_gauges(tmp_path):
+    """Each health scrape lands its RTT in ``fleet_scrape_seconds``
+    and the scraped view in per-replica gauges — the history the
+    router's own time-series recorder snapshots every window."""
+    from torchpruner_tpu import obs
+
+    obs.shutdown()
+    obs.configure(process_index=0, annotate=False, watch_compiles=False)
+    try:
+        reps = [FakeReplica("replica0"), FakeReplica("replica1")]
+        reps[0].served = 3  # occupancy = 0.3 via FakeReplica.stats
+        router = FleetRouter(RequestPlane(), reps,
+                             policy=_fast_policy())
+        router.check_health(force=True)
+        snap = obs.get().metrics.snapshot()
+        assert snap["fleet_scrape_seconds_count"] == 2
+        assert snap["fleet_replica_replica0_occupancy"] \
+            == pytest.approx(0.3)
+        assert snap["fleet_replica_replica0_queue_depth"] == 0
+        assert snap["fleet_replica_replica0_state_code"] == 0  # ready
+        assert snap["fleet_replica_replica0_scrape_rtt_s"] >= 0.0
+        # a dead replica keeps reporting: state code -1, RTT still
+        # sampled (the probe round trip is what timed out/failed)
+        reps[1].dead = True
+        router.check_health(force=True)
+        snap = obs.get().metrics.snapshot()
+        assert snap["fleet_replica_replica1_state_code"] == -1
+        assert snap["fleet_scrape_seconds_count"] == 4
+        router.close()
+    finally:
+        obs.shutdown()
+
+
 def test_fleet_chaos_validates_keys():
     c = FleetChaos.from_any('{"kill_replica_at_step": 3, '
                             '"replica_index": 1}')

@@ -19,6 +19,7 @@ Examples::
     python -m torchpruner_tpu lint-host torchpruner_tpu/
     python -m torchpruner_tpu obs report logs/fleet/obs   # latency budget
     python -m torchpruner_tpu obs report logs/obs
+    python -m torchpruner_tpu obs watch logs/obs       # live time-series
     python -m torchpruner_tpu --preset mnist_mlp_shapley --smoke \\
         --obs-dir logs/obs --profile-every 20
     python -m torchpruner_tpu obs profile logs/obs
@@ -36,7 +37,8 @@ def main(argv=None) -> int:
         argv = sys.argv[1:]
     if argv and argv[0] == "obs":
         # ledger tooling: `python -m torchpruner_tpu obs report DIR` /
-        # `obs diff A B [--gate tolerances.json]` (obs.report)
+        # `obs diff A B [--gate tolerances.json]` / `obs watch DIR`
+        # (obs.report; watch renders the live time-series)
         from torchpruner_tpu.obs.report import obs_main
 
         return obs_main(argv[1:])
@@ -76,7 +78,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="torchpruner_tpu",
         description="TPU-native structured pruning experiments "
-                    "(subcommands: obs report/diff — run-ledger tooling; "
+                    "(subcommands: obs report/diff/watch — run-ledger tooling; "
                     "serve — continuous-batching inference engine; "
                     "fleet — fault-tolerant multi-replica serving plane; "
                     "search — Pareto sparsity-search campaign driver; "

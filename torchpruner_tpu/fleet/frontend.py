@@ -46,7 +46,10 @@ from typing import List, Optional
 from torchpruner_tpu.fleet.plane import COMPLETED, RequestPlane
 from torchpruner_tpu.fleet.replica import ReplicaProcess, free_port
 from torchpruner_tpu.serve.request import request_from_dict
-from torchpruner_tpu.fleet.report import merge_replica_shards
+from torchpruner_tpu.fleet.report import (
+    merge_replica_shards,
+    merge_timeseries,
+)
 from torchpruner_tpu.fleet.router import FleetRouter, RouterPolicy
 
 JOURNAL_FILENAME = "fleet_journal.json"
@@ -101,6 +104,8 @@ def replica_argv(preset: str, port: int, args,
         argv += ["--slo-ttft-p99-ms", str(args.slo_ttft_p99_ms)]
     if args.slo_token_p99_ms is not None:
         argv += ["--slo-token-p99-ms", str(args.slo_token_p99_ms)]
+    if args.slo_queue_p99_ms is not None:
+        argv += ["--slo-queue-p99-ms", str(args.slo_queue_p99_ms)]
     return argv
 
 
@@ -306,6 +311,18 @@ def run_drill(preset: str, args, fleet_dir: str,
     # fleet session's registry (BEFORE obs.shutdown exports it)
     shards = merge_replica_shards(
         os.path.join(fleet_dir, "obs"), [p.obs_dir for p in procs])
+    # fleet-wide time-series: every process's windows re-homed onto the
+    # router clock (metrics_ts_fleet.jsonl; re-merged after obs.shutdown
+    # in fleet_main so the router's final window lands too)
+    try:
+        ts_merge = merge_timeseries(
+            os.path.join(fleet_dir, "obs"), [p.obs_dir for p in procs])
+    except Exception:
+        ts_merge = {"streams": 0, "windows": 0}
+    # replica-ledgered burn-rate alerts re-homed into the FLEET ledger
+    # (the merged report's provenance of the incident), and the drill's
+    # pass/fail signal: the planted slow_replica_ms drill must fire one
+    burn_alerts = _collect_burn_alerts(procs)
     trace_fields = _finalize_tracing(os.path.join(fleet_dir, "obs"))
 
     records = plane.records()
@@ -331,6 +348,9 @@ def run_drill(preset: str, args, fleet_dir: str,
         "hung": trigger.hung,
         "replica_exit_codes": exit_codes,
         "shards_merged": sum(bool(v) for v in shards.values()),
+        "ts_streams": ts_merge["streams"],
+        "ts_windows": ts_merge["windows"],
+        "slo_burn_alerts": len(burn_alerts),
         "wall_s": round(wall, 3),
         **trace_fields,
     }
@@ -353,7 +373,42 @@ def run_drill(preset: str, args, fleet_dir: str,
               "request(s) diverged from solo decode",
               file=sys.stderr, flush=True)
         return 1
+    if burn_alerts:
+        print("SLO BURN: " + ", ".join(
+            f"{a.get('replica')}:{a.get('metric')} "
+            f"(fast {a.get('burn_fast')}x, slow {a.get('burn_slow')}x)"
+            for a in burn_alerts[:8]),
+            file=sys.stderr, flush=True)
+        return 1
     return 0
+
+
+def _collect_burn_alerts(procs) -> List[dict]:
+    """Every replica's ledgered ``slo_burn`` records (serve/slo.py's
+    multi-window burn-rate alerts), re-recorded into the FLEET session's
+    ledger stamped with the replica name — so the merged fleet report
+    carries the incident — and returned for the drill's verdict."""
+    from torchpruner_tpu import obs
+    from torchpruner_tpu.obs.ledger import LEDGER_FILENAME, load_ledger
+
+    alerts: List[dict] = []
+    for p in procs:
+        path = os.path.join(p.obs_dir, LEDGER_FILENAME)
+        if not os.path.exists(path):
+            continue
+        try:
+            records = load_ledger(path)
+        except Exception:
+            continue
+        for rec in records:
+            if rec.get("event") == "serve" \
+                    and rec.get("kind") == "slo_burn":
+                fields = {k: v for k, v in rec.items()
+                          if k not in ("event", "kind")}
+                obs.record_serve(kind="slo_burn", replica=p.name,
+                                 **fields)
+                alerts.append({"replica": p.name, **fields})
+    return alerts
 
 
 def _verify_from_journal(model, params, completed,
@@ -509,6 +564,11 @@ def run_http(preset: str, args, fleet_dir: str,
             p.drain(timeout_s=args.startup_timeout_s)
         merge_replica_shards(os.path.join(fleet_dir, "obs"),
                              [p.obs_dir for p in procs])
+        try:
+            merge_timeseries(os.path.join(fleet_dir, "obs"),
+                             [p.obs_dir for p in procs])
+        except Exception:
+            pass
         trace_fields = _finalize_tracing(os.path.join(fleet_dir, "obs"))
         print(json.dumps({"mode": "http", **router.snapshot(),
                           **trace_fields}),
@@ -595,6 +655,10 @@ def fleet_main(argv=None) -> int:
                         "flips to slo_breach on episodes — the "
                         "router's degraded-admission signal)")
     p.add_argument("--slo-token-p99-ms", type=float, default=None)
+    p.add_argument("--slo-queue-p99-ms", type=float, default=None,
+                   help="replica queue-age-at-admission p99 SLO (ms); "
+                        "joins the burn-rate evaluation like the "
+                        "ttft/token thresholds")
     p.add_argument("--trace-sample-every", type=int, default=None,
                    metavar="N",
                    help="request-trace exemplar policy on the router "
@@ -649,6 +713,13 @@ def fleet_main(argv=None) -> int:
             except Exception as e:  # the trace must never fail the run
                 print(f"[fleet] merged trace export failed: {e}",
                       file=sys.stderr)
+            # re-merge the fleet time-series: the router's own final
+            # window only lands at session close, so the in-drill merge
+            # missed it
+            try:
+                merge_timeseries(os.path.join(fleet_dir, "obs"))
+            except Exception:
+                pass
             print(f"fleet telemetry written to "
                   f"{os.path.join(fleet_dir, 'obs')} (merged "
                   f"trace.json: open in ui.perfetto.dev)",

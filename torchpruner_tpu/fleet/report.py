@@ -67,6 +67,18 @@ def merge_replica_shards(fleet_obs_dir: str,
     return out
 
 
+def clock_offsets_of(router_events: List[dict]) -> Dict[str, float]:
+    """Per-replica clock offsets (replica clock − router clock) from
+    the ``clock_offset`` events the router's health monitor emitted —
+    LAST wins (the monitor re-emits on real changes, so the last is the
+    freshest estimate)."""
+    offsets: Dict[str, float] = {}
+    for ev in router_events:
+        if ev.get("event") == "clock_offset" and ev.get("replica"):
+            offsets[str(ev["replica"])] = float(ev.get("offset_s") or 0.0)
+    return offsets
+
+
 # -- distributed trace assembly ----------------------------------------------
 
 
@@ -106,10 +118,7 @@ def collect_streams(fleet_obs_dir: str,
     router_path = os.path.join(fleet_obs_dir, "events.jsonl")
     router_events = (load_span_events(router_path)
                      if os.path.exists(router_path) else [])
-    offsets: Dict[str, float] = {}
-    for ev in router_events:
-        if ev.get("event") == "clock_offset" and ev.get("replica"):
-            offsets[str(ev["replica"])] = float(ev.get("offset_s") or 0.0)
+    offsets = clock_offsets_of(router_events)
     streams = [{"name": "router", "pid": 0, "events": router_events,
                 "shift_s": 0.0}]
     for i, rep_dir in enumerate(replica_obs_dirs):
@@ -210,6 +219,64 @@ def write_fleet_trace(fleet_obs_dir: str,
                                 trace_export.TRACE_FILENAME)
     return trace_export.write_merged_trace(streams, out_path,
                                            traces=traces)
+
+
+def merge_timeseries(fleet_obs_dir: str,
+                     replica_obs_dirs: Optional[List[str]] = None
+                     ) -> Dict[str, int]:
+    """Merge every fleet process's windowed metric time-series
+    (``metrics_ts.jsonl`` — obs.timeseries) into ONE
+    ``metrics_ts_fleet.jsonl`` on the **router clock**: each window
+    record is stamped with its process (``proc``/``pid``, matching the
+    trace-assembly placement — router pid 0, replica<i> pid i+1) and
+    its ``ts`` shifted by that replica's estimated clock offset (same
+    ``clock_offset`` machinery :func:`collect_streams` uses).  Records
+    are emitted in shifted-time order, so the merged stream reads as
+    one fleet-wide timeline — per-replica occupancy/queue-depth history
+    next to the router's own scraped gauges.
+
+    Returns ``{"streams": ..., "windows": ...}``.  A kill -9'd replica
+    contributes its readable prefix (the recorder flushes per line)."""
+    from torchpruner_tpu.obs.timeseries import (
+        TS_FLEET_FILENAME,
+        load_series,
+    )
+    from torchpruner_tpu.utils.profiling import load_span_events
+
+    if replica_obs_dirs is None:
+        replica_obs_dirs = replica_obs_dirs_of(fleet_obs_dir)
+    router_path = os.path.join(fleet_obs_dir, "events.jsonl")
+    offsets = clock_offsets_of(
+        load_span_events(router_path)
+        if os.path.exists(router_path) else [])
+    sources = [("router", 0, fleet_obs_dir, 0.0)]
+    for i, rep_dir in enumerate(replica_obs_dirs):
+        name = os.path.basename(os.path.normpath(rep_dir))
+        # offset = replica_clock - router_clock → subtract to re-home
+        sources.append((name, i + 1, rep_dir, -offsets.get(name, 0.0)))
+    merged: List[dict] = []
+    streams = 0
+    for name, pid, run_dir, shift_s in sources:
+        _, windows = load_series(run_dir)
+        if not windows:
+            continue
+        streams += 1
+        for w in windows:
+            rec = dict(w)
+            rec["proc"] = name
+            rec["pid"] = pid
+            rec["ts"] = round(float(w.get("ts") or 0.0) + shift_s, 6)
+            if shift_s:
+                rec["shift_s"] = round(shift_s, 6)
+            merged.append(rec)
+    merged.sort(key=lambda r: r["ts"])
+    out_path = os.path.join(fleet_obs_dir, TS_FLEET_FILENAME)
+    # a derived, regenerable artifact (not a durable log): plain
+    # write-and-close, re-run to rebuild
+    with open(out_path, "w") as f:
+        for rec in merged:
+            f.write(json.dumps(rec) + "\n")
+    return {"streams": streams, "windows": len(merged)}
 
 
 def replica_summary_line(log_path: str) -> Optional[dict]:

@@ -91,6 +91,10 @@ class RouterPolicy:
     #: concurrent in-flight dispatches per replica (≈ slots + a margin
     #: that keeps the replica's bounded queue warm without flooding it)
     max_inflight_per_replica: int = 4
+    #: rolling_swap's swap-counter poll cadence (was a hardcoded sleep)
+    swap_poll_s: float = 0.25
+    #: run_until_drained's default tick sleep (drills override per call)
+    drain_poll_s: float = 0.02
 
     def retry_policy(self) -> RetryPolicy:
         return RetryPolicy(
@@ -237,6 +241,7 @@ class FleetRouter:
             return
         self._last_health = now
         for view in list(self.views.values()):
+            t0 = time.perf_counter()
             h = view.client.healthz(timeout=self.policy.health_timeout_s)
             was_live = view.live
             view.live, view.ready = h["live"], h["ready"]
@@ -261,6 +266,12 @@ class FleetRouter:
                     pass
             elif was_live or not view.failover_done:
                 self._failover(view)
+            # scrape history: RTT into a histogram, the scraped view
+            # into per-replica gauges — which the router's own
+            # time-series recorder snapshots every window, giving the
+            # fleet a per-replica occupancy/queue-depth HISTORY (the
+            # autoscaler's sensor input; obs.timeseries)
+            self._record_scrape(view, time.perf_counter() - t0)
         with self._lock:
             live = sum(v.live for v in self.views.values())
             ready = sum(v.ready for v in self.views.values())
@@ -270,6 +281,33 @@ class FleetRouter:
                       help="replicas in the ready routing set")
         obs.gauge_set("fleet_pending_depth", self.plane.pending_depth,
                       help="plane records awaiting dispatch")
+
+    #: replica state → the numeric code the per-replica state gauge
+    #: carries (a time-series sample must be a scalar)
+    STATE_CODES = {"ready": 0, "draining": 1, "staging_swap": 2,
+                   "slo_breach": 3}
+
+    def _record_scrape(self, view: ReplicaView, rtt_s: float) -> None:
+        """One health-scrape's telemetry: RTT observation + the
+        scraped per-replica gauges (`fleet_replica_<name>_*`)."""
+        obs.observe("fleet_scrape_seconds", rtt_s,
+                    help="router health-scrape round trip "
+                         "(healthz + stats) per replica")
+        name = "".join(c if c.isalnum() else "_"
+                       for c in view.client.name)
+        prefix = f"fleet_replica_{name}"
+        code = (self.STATE_CODES.get(view.state, -1)
+                if view.live else -1)
+        obs.gauge_set(f"{prefix}_state_code", code,
+                      help="scraped replica state (0 ready, 1 draining,"
+                           " 2 staging_swap, 3 slo_breach, -1 dead)")
+        obs.gauge_set(f"{prefix}_scrape_rtt_s", round(rtt_s, 6),
+                      help="last health-scrape RTT for this replica")
+        if view.live:
+            obs.gauge_set(f"{prefix}_occupancy", view.occupancy,
+                          help="scraped KV-page occupancy")
+            obs.gauge_set(f"{prefix}_queue_depth", view.queue_depth,
+                          help="scraped scheduler queue depth")
 
     def _note_clock_offset(self, view: ReplicaView, h: dict) -> None:
         """Keep the best (lowest-RTT) clock-offset sample the health
@@ -496,13 +534,19 @@ class FleetRouter:
         """One router heartbeat: health (rate-limited) + dispatch."""
         self.check_health()
         self.pump()
+        # the router loop is the fleet process's clock for the windowed
+        # time-series (no record_step flows here)
+        obs.timeseries_tick()
 
-    def run_until_drained(self, *, poll_s: float = 0.02,
+    def run_until_drained(self, *, poll_s: Optional[float] = None,
                           timeout_s: Optional[float] = None,
                           stop_event: Optional[threading.Event] = None,
                           on_tick=None) -> None:
         """Drive ticks until every accepted record is terminal (the
-        drill loop); ``on_tick`` is the drill's chaos hook."""
+        drill loop); ``on_tick`` is the drill's chaos hook.  ``poll_s``
+        defaults to the policy's ``drain_poll_s``."""
+        if poll_s is None:
+            poll_s = self.policy.drain_poll_s
         deadline = (time.monotonic() + timeout_s
                     if timeout_s is not None else None)
         while True:
@@ -551,7 +595,7 @@ class FleetRouter:
                         break
                 except ReplicaError:
                     pass
-                time.sleep(0.25)
+                time.sleep(self.policy.swap_poll_s)
             else:
                 raise TimeoutError(
                     f"rolling swap: {c.name} did not land its swap "

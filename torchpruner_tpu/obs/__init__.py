@@ -70,6 +70,7 @@ __all__ = [
     "configure_step_flops", "record_capture", "capture_counts",
     "inc", "observe", "gauge_set", "counter_value", "emit_event",
     "request_profile_window", "profile_tick", "profile_step",
+    "timeseries_tick",
     "record_scores", "record_prune", "record_round", "record_epoch",
     "record_sweep_layer", "record_serve", "record_reqtrace",
     "ledger_backfill",
@@ -97,12 +98,24 @@ ROTATE_ENV = "TORCHPRUNER_OBS_ROTATE_BYTES"
 PROFILE_EVERY_ENV = "TORCHPRUNER_PROFILE_EVERY"
 PROFILE_STEPS_ENV = "TORCHPRUNER_PROFILE_STEPS"
 
+#: env defaults for the windowed time-series recorder (obs.timeseries):
+#: window cadence in seconds (0 disables) and rotation cap in bytes —
+#: also exposed as ``configure(ts_interval_s=...)``.
+TS_INTERVAL_ENV = "TORCHPRUNER_TS_INTERVAL_S"
+
 _session: Optional["ObsSession"] = None
 
 
 def _env_int(name: str, default: int = 0) -> int:
     try:
         return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float = 0.0) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
     except ValueError:
         return default
 
@@ -116,7 +129,8 @@ class ObsSession:
                  annotate: bool = True, watch_compiles: bool = True,
                  rotate_bytes: Optional[int] = None,
                  profile_every: Optional[int] = None,
-                 profile_steps: Optional[int] = None):
+                 profile_steps: Optional[int] = None,
+                 ts_interval_s: Optional[float] = None):
         self.obs_dir = obs_dir
         self._process_index = process_index
         self._closed = False
@@ -125,6 +139,7 @@ class ObsSession:
         self.run_meta: Dict[str, Any] = {}
         self.events: Optional[JsonlWriter] = None
         self.ledger: Optional[ProvenanceRecorder] = None
+        self.timeseries = None
         self.profiler = None
         self.hbm = None
         self.profile: Optional[Dict[str, Any]] = None
@@ -148,6 +163,24 @@ class ObsSession:
             self.events = JsonlWriter(os.path.join(obs_dir, EVENTS_FILENAME),
                                       rotate_bytes=rotate_bytes)
             self.ledger = ProvenanceRecorder(obs_dir)
+            # windowed time-series: delta snapshots of this registry on
+            # an interval cadence (obs.timeseries; 0 disables)
+            if ts_interval_s is None:
+                ts_interval_s = _env_float(TS_INTERVAL_ENV, 1.0)
+            if ts_interval_s and ts_interval_s > 0:
+                from torchpruner_tpu.obs.timeseries import (
+                    DEFAULT_ROTATE_BYTES,
+                    TS_ROTATE_ENV,
+                    TimeseriesRecorder,
+                )
+
+                try:
+                    self.timeseries = TimeseriesRecorder(
+                        self.metrics, obs_dir, interval_s=ts_interval_s,
+                        rotate_bytes=_env_int(TS_ROTATE_ENV,
+                                              DEFAULT_ROTATE_BYTES))
+                except Exception:
+                    self.timeseries = None
         self.tracer = SpanTracer(sink=self.events, annotate=annotate)
         if obs_dir and self.is_emitter:
             # continuous profiling: the profiler exists whenever the
@@ -245,6 +278,14 @@ class ObsSession:
                 except Exception:
                     pass
             self._finalize_profile()      # kernel gauges BEFORE export
+            if self.timeseries is not None:
+                # final forced window + ts_* gauges, BEFORE the shard
+                # ships (the gauges must ride the merge into report.json
+                # and `obs diff`)
+                try:
+                    self.timeseries.close()
+                except Exception:
+                    pass
         derived = self.derived()          # writes derived gauges
         record_device_memory(self.metrics)
         text = summary_table(
@@ -387,7 +428,8 @@ def configure(obs_dir: Optional[str] = None, *,
               watch_compiles: bool = True,
               rotate_bytes: Optional[int] = None,
               profile_every: Optional[int] = None,
-              profile_steps: Optional[int] = None) -> ObsSession:
+              profile_steps: Optional[int] = None,
+              ts_interval_s: Optional[float] = None) -> ObsSession:
     """Install the process-wide session (replacing any previous one).
     The new session is constructed BEFORE the old one is torn down, so a
     failing constructor (e.g. unwritable ``obs_dir``) leaves the previous
@@ -397,13 +439,16 @@ def configure(obs_dir: Optional[str] = None, *,
     ``profile_steps``-step ``jax.profiler`` capture window every N
     recorded steps (0/None = on-demand only; envs
     ``TORCHPRUNER_PROFILE_EVERY`` / ``TORCHPRUNER_PROFILE_STEPS``) —
-    see ``obs.profile``."""
+    see ``obs.profile``.  ``ts_interval_s`` sets the windowed
+    time-series cadence (obs.timeseries; default 1 s, env
+    ``TORCHPRUNER_TS_INTERVAL_S``, 0 disables)."""
     global _session
     new = ObsSession(obs_dir, process_index=process_index,
                      annotate=annotate, watch_compiles=watch_compiles,
                      rotate_bytes=rotate_bytes,
                      profile_every=profile_every,
-                     profile_steps=profile_steps)
+                     profile_steps=profile_steps,
+                     ts_interval_s=ts_interval_s)
     if _session is not None:
         _session.close()
     # only after the old session exported its own windows/profile.json
@@ -453,6 +498,9 @@ def record_step(dt_s: float, examples: int, tokens: Optional[int] = None,
             # capture-window state machine: one increment + compare when
             # no window is open or armed (obs.profile.capture)
             s.profiler.on_step(dt_s)
+        if s.timeseries is not None:
+            # one clock read + compare off-cadence (obs.timeseries)
+            s.timeseries.maybe_tick()
 
 
 def request_profile_window() -> bool:
@@ -482,6 +530,16 @@ def profile_step(dt_s: float = 0.0) -> None:
     s = _session
     if s is not None and s.profiler is not None:
         s.profiler.on_step(dt_s)
+
+
+def timeseries_tick() -> None:
+    """A loop-boundary hook for the windowed time-series recorder —
+    the serving engine's run loop and the fleet router's tick call it
+    so windows keep flowing when no ``record_step`` is (obs.timeseries;
+    one clock read + compare off-cadence).  No-op without a session."""
+    s = _session
+    if s is not None and s.timeseries is not None:
+        s.timeseries.maybe_tick()
 
 
 def record_grad_norm(gnorm) -> None:

@@ -98,11 +98,15 @@ _SCALARS = {
 #: tracer's latency-budget and assembly scalars — per-stage TTFT
 #: share, budget-vs-measured reconciliation, cross-process waterfall
 #: counts; obs/reqtrace.py + fleet/report.py, gated by the CI drill)
+#: (``ts_*`` are the windowed time-series recorder's window-count /
+#: cadence gauges and ``slo_burn_*`` the multi-window burn-rate
+#: gauges — obs/timeseries.py + serve/slo.py, gated by the CI fleet
+#: drill)
 _DYNAMIC_SCALAR_PREFIXES = ("kernel_", "serve_slo_breach", "zero_",
                             "predicted_", "plan_", "frontier_",
                             "search_", "fleet_", "reqtrace_",
                             "ttft_stage_", "serve_queue_wait",
-                            "host_lint_")
+                            "host_lint_", "ts_", "slo_burn_")
 _DYNAMIC_EXTRA = ("profile_coverage", "profile_windows_total",
                   "profile_steps_total")
 
@@ -544,6 +548,55 @@ def format_report(report: Dict[str, Any]) -> str:
                 + f": {flow}")
         lines.append("")
 
+    # timeline: the windowed time-series' warmup-vs-steady-state split
+    # (obs/timeseries.py) — read from the run dir next to the ledger;
+    # committed golden report FILES have no series and skip the section
+    run_dir = report.get("_dir")
+    if run_dir and os.path.isdir(run_dir):
+        from torchpruner_tpu.obs import timeseries as ts_mod
+
+        try:
+            _, windows = ts_mod.load_series(run_dir)
+        except Exception:
+            windows = []
+        if len(windows) >= 2:
+            tsum = ts_mod.series_summary(windows)
+            lines.append(
+                f"timeline: {tsum['windows']} window(s) "
+                f"({tsum['warmup_windows']} warmup / "
+                f"{tsum['steady_windows']} steady-state; steady span "
+                f"{tsum['steady_span_s']:.1f}s)")
+            rows = [r for r in tsum["hist"]
+                    if r.get("warmup") or r.get("steady")]
+            if rows:
+                lines.append("")
+                lines.append("| histogram | warmup p50/p99 ms "
+                             "| steady p50/p99 ms | steady mean ms "
+                             "| steady n |")
+                lines.append("|---|---|---|---|---|")
+
+                def _pp(seg):
+                    if not seg:
+                        return ""
+                    return (f"{_f(1e3 * seg['p50'], '.3f')}/"
+                            f"{_f(1e3 * seg['p99'], '.3f')}"
+                            if seg.get("p50") is not None else "")
+
+                for r in rows:
+                    st = r.get("steady") or {}
+                    lines.append(
+                        f"| {r['name']} | {_pp(r.get('warmup'))} "
+                        f"| {_pp(st)} "
+                        f"| {_f(1e3 * st['mean'], '.3f') if st.get('mean') is not None else ''} "
+                        f"| {_i(st.get('n'))} |")
+            rates = tsum.get("steady_rates_per_s") or {}
+            if rates:
+                top = sorted(rates.items(), key=lambda kv: -kv[1])[:6]
+                lines.append("")
+                lines.append("steady-state rates: " + ", ".join(
+                    f"{k} {v:.2f}/s" for k, v in top))
+            lines.append("")
+
     profile = report.get("profile") or {}
     kernels = profile.get("kernels") or []
     if kernels:
@@ -839,7 +892,24 @@ def obs_main(argv=None) -> int:
     pp.add_argument("--top", type=int, default=25)
     pp.add_argument("--json", action="store_true",
                     help="emit the raw profile JSON instead of markdown")
+    pw = sub.add_parser(
+        "watch",
+        help="live terminal view of a run's windowed metric "
+             "time-series (metrics_ts.jsonl — obs.timeseries): newest "
+             "window's histogram percentiles, counter rates, gauges")
+    pw.add_argument("dir", help="obs dir being written by a live run "
+                                "(or a finished one)")
+    pw.add_argument("--interval", type=float, default=2.0,
+                    help="redraw cadence, seconds")
+    pw.add_argument("--once", action="store_true",
+                    help="render one frame and exit (CI smoke)")
     args = p.parse_args(argv)
+
+    if args.cmd == "watch":
+        from torchpruner_tpu.obs.timeseries import watch as ts_watch
+
+        return ts_watch(args.dir, interval_s=args.interval,
+                        once=args.once)
 
     if args.cmd == "profile":
         from torchpruner_tpu.obs.profile import format_profile, load_profile

@@ -319,12 +319,28 @@ class ServeEngine:
         self._window_tokens0 = 0
         self._eos = np.full(n_slots, -1, np.int64)
         #: optional live SLO monitor (serve.slo.SLOMonitor) — fed TTFT /
-        #: per-token observations and checked at step boundaries
+        #: per-token observations and checked at step boundaries; the
+        #: property setter also wires the scheduler's queue-age hook so
+        #: queue waits join the burn-rate evaluation
         self.slo = None
         #: the preemption handler of the CURRENT run() — lets
         #: health_state() report "draining" the instant a SIGTERM lands,
         #: before the loop reaches its next boundary
         self._preemption = None
+
+    @property
+    def slo(self):
+        return self._slo
+
+    @slo.setter
+    def slo(self, monitor):
+        # drivers assign `engine.slo = SLOMonitor(...)` directly; the
+        # setter keeps the scheduler's queue-age hook in sync so the
+        # monitor sees admission waits without the scheduler knowing
+        # the monitor's type
+        self._slo = monitor
+        self.scheduler.on_queue_wait = (
+            monitor.on_queue if monitor is not None else None)
 
     # -- submission ---------------------------------------------------------
 
@@ -666,6 +682,9 @@ class ServeEngine:
             # on-demand profiler windows (POST /profile) must open/close
             # even when the slot array sits idle between requests
             obs.profile_tick()
+            # windowed time-series: the run loop is the engine's clock
+            # (decode steps stall while idle, windows must not)
+            obs.timeseries_tick()
             if max_steps is not None and self.steps >= max_steps:
                 break
             if not self.scheduler.has_work():

@@ -308,6 +308,9 @@ def serve_main(argv=None) -> int:
                         ", ledgered)")
     p.add_argument("--slo-token-p99-ms", type=float, default=None,
                    help="live SLO threshold: rolling per-token p99 (ms)")
+    p.add_argument("--slo-queue-p99-ms", type=float, default=None,
+                   help="live SLO threshold: rolling queue-age-at-"
+                        "admission p99 (ms)")
     p.add_argument("--slo-window", type=int, default=256,
                    help="observations in the rolling SLO window")
     p.add_argument("--swap-checkpoint", metavar="DIR",
@@ -405,7 +408,8 @@ def serve_main(argv=None) -> int:
         # old program set); batch modes need them for verify/reporting
         retain_results=args.http is None)
     if args.slo_ttft_p99_ms is not None \
-            or args.slo_token_p99_ms is not None:
+            or args.slo_token_p99_ms is not None \
+            or args.slo_queue_p99_ms is not None:
         from torchpruner_tpu.serve.slo import SLOMonitor
 
         engine.slo = SLOMonitor(
@@ -413,6 +417,8 @@ def serve_main(argv=None) -> int:
                         if args.slo_ttft_p99_ms is not None else None),
             token_p99_s=(args.slo_token_p99_ms / 1e3
                          if args.slo_token_p99_ms is not None else None),
+            queue_p99_s=(args.slo_queue_p99_ms / 1e3
+                         if args.slo_queue_p99_ms is not None else None),
             window=args.slo_window)
 
     rc = 0

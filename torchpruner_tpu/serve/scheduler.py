@@ -57,6 +57,10 @@ class Scheduler:
         #: p50/p99 the /stats endpoint serves; the full distribution
         #: rides the serve_queue_wait_seconds histogram
         self._queue_waits: Deque[float] = deque(maxlen=512)
+        #: optional per-admission queue-age callback — the engine wires
+        #: this to ``SLOMonitor.on_queue`` so queue age joins the
+        #: burn-rate evaluation (serve/slo.py)
+        self.on_queue_wait = None
         #: slot -> active request
         self.running: Dict[int, Request] = {}
         self.admitted_total = 0
@@ -154,6 +158,8 @@ class Scheduler:
                 obs.observe("serve_queue_wait_seconds", wait,
                             help="request submit -> slot admission "
                                  "(queue age at admit time)")
+                if self.on_queue_wait is not None:
+                    self.on_queue_wait(wait)
                 reqtrace.stage(head.trace_id, "replica_queue",
                                dur_s=wait, request=head.id)
             self.running[lease.slot] = head

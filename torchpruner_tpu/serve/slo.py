@@ -3,9 +3,9 @@
 The obs histograms answer "what were the latency percentiles of this
 run" — after the run.  A serving endpoint needs the live version:
 "is the p99 over threshold *right now*".  :class:`SLOMonitor` keeps
-bounded rolling windows of the engine's TTFT and per-token latency
-observations, re-computes the rolling p99s every ``check_every_steps``
-step boundaries, and on a threshold crossing:
+bounded rolling windows of the engine's TTFT, per-token latency, and
+queue-age observations, re-computes the rolling p99s every
+``check_every_steps`` step boundaries, and on a threshold crossing:
 
 - bumps ``serve_slo_breach_total`` (plus the per-metric
   ``serve_slo_breach_<metric>_total``) — the Prometheus counter an
@@ -20,11 +20,27 @@ step boundaries, and on a threshold crossing:
 Breaches count *episodes*, not checks: a sustained breach increments
 once on entry and re-arms only after the metric recovers below
 threshold — a 10-minute incident is one breach, not 600.
+
+**Burn rate** (the SRE multi-window alert, Google SRE workbook ch. 5):
+observations carry timestamps, so on each check the monitor also
+computes, per gated metric, the fraction of observations over
+threshold within a FAST window (default 15 s — catches an incident
+quickly) and a SLOW window (default 120 s — rejects blips), each
+divided by the error budget (default 1%: an SLO permits 1% of
+requests over threshold).  When BOTH burns sit at/over
+``burn_threshold`` (default 10× budget) a ``slo_burn`` alert fires —
+once per episode, re-arming when the fast burn recovers — bumping
+``slo_burn_alerts_total``, exporting ``slo_burn_<metric>_fast`` /
+``_slow`` gauges (which ride ``obs diff --gate``), and ledgering a
+``serve``/``slo_burn`` record.  The fleet drill harness exits non-zero
+on any ledgered burn alert, which is what the CI planted
+``slow_replica_ms`` drill asserts.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Dict, Optional
 
@@ -32,24 +48,48 @@ import numpy as np
 
 from torchpruner_tpu import obs
 
+#: burn-rate defaults — windows sized so a CI-scale drill (tens of
+#: seconds) spans both; production drivers pass their own
+BURN_FAST_WINDOW_S = 15.0
+BURN_SLOW_WINDOW_S = 120.0
+BURN_BUDGET = 0.01
+BURN_THRESHOLD = 10.0
+
 
 class SLOMonitor:
     """See module docstring.  Thresholds are seconds; ``None`` disables
     that metric's gate (the rolling gauges still export)."""
 
+    METRICS = ("ttft", "token", "queue")
+
     def __init__(self, ttft_p99_s: Optional[float] = None,
                  token_p99_s: Optional[float] = None,
+                 queue_p99_s: Optional[float] = None,
                  window: int = 256, check_every_steps: int = 8,
-                 min_samples: int = 8):
+                 min_samples: int = 8,
+                 burn_fast_window_s: float = BURN_FAST_WINDOW_S,
+                 burn_slow_window_s: float = BURN_SLOW_WINDOW_S,
+                 burn_budget: float = BURN_BUDGET,
+                 burn_threshold: float = BURN_THRESHOLD):
         self.thresholds: Dict[str, Optional[float]] = {
-            "ttft": ttft_p99_s, "token": token_p99_s}
+            "ttft": ttft_p99_s, "token": token_p99_s,
+            "queue": queue_p99_s}
         self.window = int(window)
         self.check_every_steps = max(1, int(check_every_steps))
         self.min_samples = max(1, int(min_samples))
+        self.burn_fast_window_s = float(burn_fast_window_s)
+        self.burn_slow_window_s = float(burn_slow_window_s)
+        self.burn_budget = max(1e-6, float(burn_budget))
+        self.burn_threshold = float(burn_threshold)
+        #: observations are (wall-clock ts, seconds) pairs — the burn
+        #: windows are TIME windows, not count windows, so the math
+        #: stays true when traffic is bursty
         self._obs: Dict[str, deque] = {
-            "ttft": deque(maxlen=self.window),
-            "token": deque(maxlen=self.window)}
-        self._in_breach: Dict[str, bool] = {"ttft": False, "token": False}
+            m: deque(maxlen=self.window) for m in self.METRICS}
+        self._in_breach: Dict[str, bool] = {
+            m: False for m in self.METRICS}
+        self._in_burn: Dict[str, bool] = {
+            m: False for m in self.METRICS}
         self._last_check_step = -1
         #: check() runs on the engine thread (maybe_check) AND on
         #: /metrics scrape threads, while on_ttft/on_token append from
@@ -58,18 +98,27 @@ class SLOMonitor:
         #: and the deque iteration (append mid-iteration raises)
         self._lock = threading.Lock()
         self.breaches_total = 0
-        self.rolling: Dict[str, Optional[float]] = {"ttft": None,
-                                                    "token": None}
+        self.burn_alerts_total = 0
+        self.rolling: Dict[str, Optional[float]] = {
+            m: None for m in self.METRICS}
 
     # -- engine hooks -------------------------------------------------------
 
-    def on_ttft(self, seconds: float) -> None:
-        with self._lock:
-            self._obs["ttft"].append(float(seconds))
+    def on_ttft(self, seconds: float, ts: Optional[float] = None) -> None:
+        self._observe("ttft", seconds, ts)
 
-    def on_token(self, seconds: float) -> None:
+    def on_token(self, seconds: float, ts: Optional[float] = None) -> None:
+        self._observe("token", seconds, ts)
+
+    def on_queue(self, seconds: float, ts: Optional[float] = None) -> None:
+        """Queue age at admission (scheduler hook)."""
+        self._observe("queue", seconds, ts)
+
+    def _observe(self, metric: str, seconds: float,
+                 ts: Optional[float]) -> None:
+        t = time.time() if ts is None else float(ts)
         with self._lock:
-            self._obs["token"].append(float(seconds))
+            self._obs[metric].append((t, float(seconds)))
 
     def maybe_check(self, step: int) -> None:
         """Called at engine step boundaries; cheap no-op between check
@@ -81,17 +130,24 @@ class SLOMonitor:
 
     # -- the check ----------------------------------------------------------
 
-    def check(self, step: int = 0) -> Dict[str, Optional[float]]:
+    def check(self, step: int = 0, now: Optional[float] = None
+              ) -> Dict[str, Optional[float]]:
         """Recompute rolling p99s, export gauges, count breach episodes
-        (thread-safe).  Returns the rolling values."""
+        (thread-safe).  Returns the rolling values.  ``now`` anchors
+        the burn windows (defaults to wall clock; tests pass it with
+        synthetic observation timestamps)."""
         with self._lock:
-            return self._check_locked(step)
+            return self._check_locked(step, now)
 
-    def _check_locked(self, step: int) -> Dict[str, Optional[float]]:
+    def _check_locked(self, step: int, now: Optional[float] = None
+                      ) -> Dict[str, Optional[float]]:
+        if now is None:
+            now = time.time()
         for metric, samples in self._obs.items():
             if not samples:
                 continue
-            p99 = float(np.percentile(np.asarray(samples), 99))
+            values = np.asarray([v for _, v in samples])
+            p99 = float(np.percentile(values, 99))
             self.rolling[metric] = p99
             obs.gauge_set(
                 f"serve_{metric}_p99_rolling_s", p99,
@@ -112,7 +168,50 @@ class SLOMonitor:
                     threshold_s=limit, window=len(samples), step=step)
             elif p99 <= limit:
                 self._in_breach[metric] = False
+            self._burn_locked(metric, samples, limit, now, step)
         return dict(self.rolling)
+
+    def _burn_locked(self, metric: str, samples, limit: float,
+                     now: float, step: int) -> None:
+        """Multi-window burn-rate evaluation for one gated metric —
+        caller holds the lock and has already verified a threshold."""
+        burns: Dict[str, Optional[float]] = {}
+        counts: Dict[str, int] = {}
+        for which, win_s in (("fast", self.burn_fast_window_s),
+                             ("slow", self.burn_slow_window_s)):
+            sub = [v for ts, v in samples if ts >= now - win_s]
+            counts[which] = len(sub)
+            if not sub:
+                burns[which] = 0.0
+                continue
+            bad = sum(1 for v in sub if v > limit)
+            burns[which] = (bad / len(sub)) / self.burn_budget
+            obs.gauge_set(
+                f"slo_burn_{metric}_{which}", burns[which],
+                help=f"{metric} error-budget burn rate over the "
+                     f"{which} window ({win_s:.0f}s; alert at "
+                     f"{self.burn_threshold:g}×)")
+        firing = (counts["fast"] >= self.min_samples
+                  and burns["fast"] >= self.burn_threshold
+                  and burns["slow"] >= self.burn_threshold)
+        if firing and not self._in_burn[metric]:
+            self._in_burn[metric] = True
+            self.burn_alerts_total += 1
+            obs.inc("slo_burn_alerts_total",
+                    help="multi-window burn-rate alert episodes (fast "
+                         "AND slow burn over threshold; re-arms when "
+                         "the fast burn recovers)")
+            obs.record_serve(
+                kind="slo_burn", metric=metric,
+                burn_fast=round(burns["fast"], 3),
+                burn_slow=round(burns["slow"], 3),
+                budget=self.burn_budget,
+                burn_threshold=self.burn_threshold,
+                fast_window_s=self.burn_fast_window_s,
+                slow_window_s=self.burn_slow_window_s,
+                threshold_s=limit, step=step)
+        elif (burns["fast"] or 0.0) < self.burn_threshold:
+            self._in_burn[metric] = False
 
     def in_breach_any(self) -> bool:
         """True while ANY gated metric's rolling p99 sits over its
@@ -123,7 +222,8 @@ class SLOMonitor:
 
     def snapshot(self) -> Dict[str, object]:
         """The ``/stats`` block: rolling values, thresholds, breach
-        count, in-breach flags."""
+        count, in-breach flags (shape kept stable for clients; the
+        burn fields are additive)."""
         return {
             "ttft_p99_rolling_ms": (round(self.rolling["ttft"] * 1e3, 3)
                                     if self.rolling["ttft"] is not None
@@ -136,4 +236,6 @@ class SLOMonitor:
                 for k, v in self.thresholds.items()},
             "breaches_total": self.breaches_total,
             "in_breach": dict(self._in_breach),
+            "burn_alerts_total": self.burn_alerts_total,
+            "in_burn": dict(self._in_burn),
         }
