@@ -131,6 +131,7 @@ _LEG_EST_S = {
     "mfu_llama": (180, 3600),
     "llama_decode": (180, 300),
     "serve": (240, 300),
+    "serve_prefix": (180, 240),
     "fleet": (180, 180),
     "flash_attention": (60, 600),
     "blocksparse": (90, 300),
@@ -1298,6 +1299,152 @@ def _leg_serve(smoke: bool, progress=None) -> dict:
     return result
 
 
+def _leg_serve_prefix(smoke: bool, progress=None) -> dict:
+    """Leg (``--prefix-heavy`` opt-in): Serve v2's prefix-sharing KV
+    cache + chunked prefill under a prefix-heavy workload — the SAME
+    shared-system-prompt traffic run twice on identical geometry,
+    sharing ON then sharing OFF, on deterministic staggered arrivals
+    (the comparison is prefill COMPUTE, so wall-clock arrival jitter
+    is noise).  Value = prefill-token reduction factor (off/on); the
+    row also carries the hit-rate, tokens served from shared pages,
+    and both runs' TTFT p50/p99 — steady-state TTFT is where the
+    saved prefill actually shows up (admission-to-first-token skips
+    the shared pages' forward entirely)."""
+    import jax
+    import numpy as np
+
+    from torchpruner_tpu.core.segment import init_model
+    from torchpruner_tpu.models import llama_tiny, mfu_llama
+    from torchpruner_tpu.serve import (
+        OpenLoopTraffic,
+        ServeEngine,
+        shared_prefix_requests,
+        staggered_arrivals,
+        vocab_of,
+    )
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if smoke:
+        model, slots, max_len = llama_tiny(), 2, 96
+        n, n_prefixes, prefix_len = 12, 2, 16
+        suffix_lens, max_new = [4, 8], [8, 12]
+        page, chunk, cap, pool = 8, 8, 16, 16
+    elif on_tpu:
+        model, slots, max_len = mfu_llama(), 8, 512
+        n, n_prefixes, prefix_len = 48, 4, 128
+        suffix_lens, max_new = [32, 64], [64, 128]
+        page, chunk, cap, pool = 128, 128, 256, 32
+    else:
+        model, slots, max_len = llama_tiny(), 4, 256
+        n, n_prefixes, prefix_len = 24, 3, 64
+        suffix_lens, max_new = [8, 16], [16, 24]
+        page, chunk, cap, pool = 8, 8, 32, 32
+    params, _ = init_model(model, seed=0)
+    vocab = vocab_of(model)
+    result = {"requests": n, "n_prefixes": n_prefixes,
+              "prefix_len": prefix_len, "slots": slots,
+              "page_len": page, "prefill_chunk": chunk,
+              "prefill_token_cap": cap, "prefix_pages": pool,
+              "model": "mfu_llama (~200M)" if (on_tpu and not smoke)
+                       else "llama_tiny"}
+
+    def run(prefix_pages: int) -> dict:
+        import tempfile as _tempfile
+
+        from torchpruner_tpu import obs as _obs
+        from torchpruner_tpu.obs.timeseries import (
+            TimeseriesRecorder,
+            steady_state_percentiles,
+        )
+
+        eng = ServeEngine(
+            model, params, n_slots=slots, max_len=max_len,
+            page_len=page, prefix_pages=prefix_pages,
+            prefill_chunk=chunk, prefill_token_cap=cap,
+            cache_dtype=jax.numpy.bfloat16 if on_tpu else None)
+        reqs = shared_prefix_requests(
+            n, vocab=vocab, n_prefixes=n_prefixes,
+            prefix_len=prefix_len, suffix_lens=suffix_lens,
+            max_new=max_new, seed=1)
+        # per-run private PR 17 recorder (0.2 s windows) — the leg's
+        # TTFT p50/p99 prefer the steady-state windows over whole-run
+        # stamps, same contract as the base serve leg
+        _sess = _obs.get()
+        ts_dir = ts_rec = old_rec = None
+        if _sess is not None:
+            try:
+                ts_dir = _tempfile.mkdtemp(prefix="bench_prefix_ts_")
+                ts_rec = TimeseriesRecorder(_sess.metrics, ts_dir,
+                                            interval_s=0.2)
+                old_rec = _sess.timeseries
+                _sess.timeseries = ts_rec
+            except Exception:  # noqa: BLE001 — telemetry never breaks bench
+                ts_dir = ts_rec = None
+        t0 = time.perf_counter()
+        try:
+            eng.run(OpenLoopTraffic(reqs, staggered_arrivals(n, 2),
+                                    by_step=True))
+        finally:
+            if ts_rec is not None:
+                _sess.timeseries = old_rec
+                try:
+                    ts_rec.close()
+                except Exception:  # noqa: BLE001
+                    ts_dir = None
+        wall = time.perf_counter() - t0
+        done = [r for r in reqs if r.state == "done"]
+        ttfts = np.asarray([r.ttft_s for r in done
+                            if r.ttft_s is not None])
+        steady = None
+        if ts_dir is not None:
+            seg = steady_state_percentiles(ts_dir, "serve_ttft_seconds")
+            if seg and seg.get("p50") is not None:
+                steady = seg
+        s = eng.summary()
+        out = {
+            "wall_s": round(wall, 2),
+            "completed": len(done),
+            "prefilled_tokens": int(s.get("prefilled_tokens", 0)),
+            "max_prefill_tokens_step":
+                int(s.get("max_prefill_tokens_step", 0)),
+            "prefix_hits": int(s.get("prefix_hits", 0)),
+            "prefix_hit_tokens": int(s.get("prefix_hit_tokens", 0)),
+            "prefix_hit_rate": float(s.get("prefix_hit_rate", 0.0)),
+            "ttft_p50_ms": round(
+                float(np.percentile(ttfts, 50)) * 1e3, 2)
+                if ttfts.size else None,
+            "ttft_p99_ms": round(
+                float(np.percentile(ttfts, 99)) * 1e3, 2)
+                if ttfts.size else None,
+            "latency_source": "request_stamps",
+        }
+        if steady is not None:
+            out["ttft_p50_ms"] = round(steady["p50"] * 1e3, 3)
+            out["ttft_p99_ms"] = round(steady["p99"] * 1e3, 3)
+            out["latency_source"] = "steady_state_windows"
+        return out
+
+    on = run(pool)
+    result["sharing_on"] = on
+    if progress is not None:
+        progress(dict(result))
+    off = run(0)
+    result["sharing_off"] = off
+    saved = off["prefilled_tokens"] - on["prefilled_tokens"]
+    result.update({
+        "prefix_hit_rate": on["prefix_hit_rate"],
+        "prefilled_tokens_saved": saved,
+        "ttft_p50_ms": on["ttft_p50_ms"],
+        "ttft_p99_ms": on["ttft_p99_ms"],
+        "value": round(off["prefilled_tokens"]
+                       / max(1, on["prefilled_tokens"]), 2),
+        "unit": "prefill_reduction_x",
+    })
+    if progress is not None:
+        progress(dict(result))
+    return result
+
+
 def _leg_fleet(smoke: bool) -> dict:
     """Leg: the kill -9 failover drill on the multi-replica serving
     plane (torchpruner_tpu.fleet) — 3 subprocess replicas under
@@ -1855,6 +2002,10 @@ def main() -> dict:
         run_leg("blocksparse", _leg_blocksparse)
         run_leg("llama_decode", _leg_llama_decode)
         run_leg("serve", _leg_serve)
+        if "--prefix-heavy" in sys.argv:
+            # Serve v2 opt-in: the prefix-sharing on/off A-B costs a
+            # second engine's compiles, so it doesn't ride every run
+            run_leg("serve_prefix", _leg_serve_prefix)
         # fleet failover drill: CPU subprocesses on every platform (the
         # drill measures the serving PLANE's robustness, not the chip)
         run_leg("fleet", _leg_fleet)
@@ -1866,6 +2017,8 @@ def main() -> dict:
         # (continuous batching on the same tiny model) likewise
         run_leg("llama_decode", _leg_llama_decode)
         run_leg("serve", _leg_serve)
+        if "--prefix-heavy" in sys.argv:
+            run_leg("serve_prefix", _leg_serve_prefix)
         run_leg("fleet", _leg_fleet)
 
     # assemble BEFORE shutdown (it reads the live session's phase

@@ -1,0 +1,589 @@
+"""Serve v2 tests: the prefix-sharing KV cache (radix trie over page
+chunks, refcounts, copy-on-write materialization, LRU eviction of
+unpinned prefixes), chunked prefill (lane-aligned chunks interleaved
+with decode, per-step token cap), and the fleet router's session/prefix
+affinity.
+
+The load-bearing invariants:
+
+- **Bit-parity**: decode with sharing ON equals decode with sharing
+  OFF equals solo ``generate()`` — a poisoned shared page would break
+  greedy argmax, so token equality IS the cache-correctness proof.
+- **Refcounts never go negative** and an evictor can never reclaim a
+  page a resident request still reads.
+- **Zero hits on disjoint prompts** — the trie must never invent a
+  match.
+- **The prefill cap is a hard per-step budget** (floored at one
+  chunk), observable via ``max_prefill_tokens_step``.
+"""
+
+import numpy as np
+import pytest
+
+from torchpruner_tpu.serve.allocator import KVCacheAllocator, PrefixTrie
+
+# -- trie units --------------------------------------------------------------
+
+
+def _ids(*xs):
+    return np.asarray(xs, np.int32)
+
+
+def seq(n, base=0):
+    return np.arange(base, base + n, dtype=np.int32)
+
+
+def test_trie_insert_match_roundtrip():
+    t = PrefixTrie(page_len=4)
+    pages = iter(range(100))
+    plan = t.insert(seq(12), 12, lambda protect: next(pages))
+    assert [p for _, p in plan] == [0, 1, 2]
+    n_tok, got_pages, path = t.match(seq(12), max_tokens=12)
+    assert n_tok == 12 and got_pages == [0, 1, 2]
+    # a shorter probe matches only whole pages
+    n_tok, got_pages, _ = t.match(seq(7), max_tokens=7)
+    assert n_tok == 4 and got_pages == [0]
+    # max_tokens caps the match at a page boundary
+    n_tok, got_pages, _ = t.match(seq(12), max_tokens=11)
+    assert n_tok == 8 and got_pages == [0, 1]
+
+
+def test_trie_split_on_divergence_preserves_shared_prefix():
+    t = PrefixTrie(page_len=4)
+    pages = iter(range(100))
+    t.insert(seq(12), 12, lambda protect: next(pages))
+    # same first 2 pages, divergent third page
+    other = np.concatenate([seq(8), seq(4, base=100)])
+    plan = t.insert(other, 12, lambda protect: next(pages))
+    assert [i for i, _ in plan] == [2]  # only the novel page acquired
+    n_a, pages_a, _ = t.match(seq(12), max_tokens=12)
+    n_b, pages_b, _ = t.match(other, max_tokens=12)
+    assert n_a == n_b == 12
+    assert pages_a[:2] == pages_b[:2]      # shared prefix shares pages
+    assert pages_a[2] != pages_b[2]        # divergent tails don't
+
+
+def test_trie_refcount_pin_unpin_and_underflow():
+    t = PrefixTrie(page_len=4)
+    pages = iter(range(100))
+    t.insert(seq(8), 8, lambda protect: next(pages))
+    _, _, path = t.match(seq(8), max_tokens=8)
+    t.pin(path)
+    t.pin(path)
+    assert all(n.refcount == 2 for n in path)
+    t.unpin(path)
+    t.unpin(path)
+    assert all(n.refcount == 0 for n in path)
+    with pytest.raises(RuntimeError):
+        t.unpin(path)  # refcounts must never go negative
+
+
+def test_trie_evict_refuses_pinned_and_takes_lru_unpinned_leaf():
+    t = PrefixTrie(page_len=4)
+    pages = iter(range(100))
+    t.insert(seq(4), 4, lambda protect: next(pages))
+    t.insert(seq(4, base=50), 4, lambda protect: next(pages))
+    _, pages_a, path_a = t.match(seq(4), max_tokens=4)
+    t.pin(path_a)
+    # the pinned leaf is untouchable: eviction takes the unpinned one
+    freed = t.evict_lru(protect=[])
+    assert freed and freed != pages_a
+    # only the pinned leaf remains → eviction REFUSES (empty), it
+    # never reclaims a page a resident request still reads
+    assert t.evict_lru(protect=[]) == []
+    t.unpin(path_a)
+    assert t.evict_lru(protect=[]) == pages_a
+
+
+def test_trie_split_inherits_refcount():
+    """Splitting a PINNED edge must keep every chain node pinned (the
+    resident request reads through the new mid node), and an ancestor-
+    chain unpin — what the allocator's release does — must balance."""
+    from torchpruner_tpu.serve.allocator import _ancestors
+
+    t = PrefixTrie(page_len=4)
+    pages = iter(range(100))
+    t.insert(seq(12), 12, lambda protect: next(pages))
+    _, _, path = t.match(seq(12), max_tokens=12)
+    t.pin(path)
+    deep = path[-1]
+    # divergence after page 1 splits the pinned edge
+    other = np.concatenate([seq(4), seq(8, base=100)])
+    t.insert(other, 12, lambda protect: next(pages))
+    mid = deep.parent
+    assert mid is not t.root and mid.refcount == 1  # pin carried over
+    assert deep.refcount == 1
+    # the pinned chain refuses eviction; only the divergent tail frees
+    assert sorted(t.evict_lru(protect=[])) == [3, 4]
+    assert t.evict_lru(protect=[]) == []
+    t.unpin(list(_ancestors(deep)))
+    assert all(n.refcount == 0 for n in t.nodes())
+
+
+def test_trie_reset_returns_every_page():
+    t = PrefixTrie(page_len=4)
+    pages = iter(range(100))
+    t.insert(seq(8), 8, lambda protect: next(pages))
+    t.insert(seq(8, base=50), 8, lambda protect: next(pages))
+    freed = t.reset()
+    assert sorted(freed) == [0, 1, 2, 3]
+    assert t.match(seq(8), max_tokens=8)[0] == 0
+
+
+# -- allocator ---------------------------------------------------------------
+
+
+def _alloc(**kw):
+    base = dict(n_slots=2, max_len=32, page_len=8, prefix_pages=4)
+    base.update(kw)
+    return KVCacheAllocator(**base)
+
+
+def test_allocator_miss_publish_hit_release_cycle():
+    a = _alloc()
+    prompt = seq(20)
+    assert a.match_prefix(prompt, max_tokens=19) is None
+    assert a.prefix_misses == 1
+    plan = a.publish_prefix(prompt, 20)  # 2 whole pages of 8
+    assert [i for i, _ in plan] == [0, 1]
+    m = a.match_prefix(prompt, max_tokens=19)
+    assert m is not None and m.tokens == 16 and len(m.pages) == 2
+    assert a.shared_pages == 2
+    # pinned pages refuse eviction even under pool pressure
+    for i in range(10):
+        assert a._acquire_page(protect=[]) is not None \
+            or a.prefix_pool_exhausted > 0
+    a.release_prefix(m)
+    a.release_prefix(m)  # idempotent
+    assert a.shared_pages == 0
+
+
+def test_allocator_refcounts_never_negative_under_random_ops():
+    rng = np.random.default_rng(0)
+    a = _alloc(prefix_pages=8)
+    prompts = [seq(24, base=100 * i) for i in range(4)]
+    live = []
+    for step in range(200):
+        op = rng.integers(0, 3)
+        p = prompts[int(rng.integers(0, len(prompts)))]
+        if op == 0:
+            m = a.match_prefix(p, max_tokens=23)
+            if m is not None:
+                live.append(m)
+        elif op == 1:
+            a.publish_prefix(p, int(p.size))
+        elif live:
+            a.release_prefix(live.pop(int(rng.integers(0, len(live)))))
+        for node in a._trie.nodes():
+            assert node.refcount >= 0
+    for m in live:
+        a.release_prefix(m)
+    assert all(n.refcount == 0 for n in a._trie.nodes())
+
+
+def test_allocator_evict_while_shared_refused():
+    a = _alloc(prefix_pages=2)
+    prompt = seq(20)
+    a.publish_prefix(prompt, 20)           # fills the 2-page pool
+    m = a.match_prefix(prompt, max_tokens=19)
+    assert m is not None
+    # every pool page is pinned: acquisition must FAIL (None), never
+    # steal a shared page out from under the resident request
+    assert a._acquire_page(protect=[]) is None
+    assert a.prefix_pool_exhausted >= 1
+    a.release_prefix(m)
+    assert a._acquire_page(protect=[]) is not None  # now evictable
+
+
+def test_allocator_lru_eviction_order():
+    a = _alloc(prefix_pages=2)
+    a.publish_prefix(seq(8), 8)
+    a.publish_prefix(seq(8, base=50), 8)
+    # touch the first prefix so the SECOND is LRU
+    m = a.match_prefix(seq(8), max_tokens=8)
+    assert m is not None
+    a.release_prefix(m)
+    got = a._acquire_page(protect=[])
+    assert got is not None
+    assert a.prefix_evictions == 1
+    # the surviving prefix is the recently-used one
+    assert a._trie.match(seq(8), 8)[0] == 8
+    assert a._trie.match(seq(8, base=50), 8)[0] == 0
+
+
+def test_allocator_release_unpins_split_inserted_mid():
+    """Regression: a pinned match whose edge is later split by a
+    divergent publish must still release cleanly — the split's mid
+    node inherited the pin, and release walks the CURRENT ancestor
+    chain (not the stale match-time path).  A leaked pin here would
+    make the mid's pages permanently unevictable."""
+    a = _alloc(prefix_pages=8)
+    a.publish_prefix(seq(24), 24)               # 3 pages of 8
+    m = a.match_prefix(seq(24), max_tokens=23)  # pins 2 whole pages
+    assert m is not None and m.tokens == 16
+    divergent = np.concatenate([seq(8), seq(16, base=500)])
+    a.publish_prefix(divergent, 24)             # splits the pinned edge
+    a.release_prefix(m)
+    assert all(n.refcount == 0 for n in a._trie.nodes())
+    # every pool page is now reclaimable (free list + LRU eviction)
+    got = set()
+    while True:
+        p = a._acquire_page(protect=[])
+        if p is None:
+            break
+        got.add(p)
+    assert len(got) == a.prefix_pages
+
+
+def test_allocator_prefix_disabled_by_default():
+    a = KVCacheAllocator(n_slots=2, max_len=32, page_len=8)
+    assert not a.prefix_enabled
+    assert a.match_prefix(seq(16), max_tokens=15) is None
+    assert a.prefix_misses == 0  # disabled ≠ miss: no counters move
+
+
+# -- engine: chunked prefill + sharing parity --------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    from torchpruner_tpu.core.segment import init_model
+    from torchpruner_tpu.models import llama_tiny
+
+    model = llama_tiny()
+    params, _ = init_model(model, seed=0)
+    return model, params
+
+
+def _engine(model, params, **kw):
+    from torchpruner_tpu.serve import ServeEngine
+
+    base = dict(n_slots=2, max_len=64, page_len=8)
+    base.update(kw)
+    return ServeEngine(model, params, **base)
+
+
+def _serve(eng, reqs, max_steps=500):
+    from torchpruner_tpu.serve import OpenLoopTraffic, staggered_arrivals
+
+    eng.run(OpenLoopTraffic(reqs, staggered_arrivals(len(reqs), 2),
+                            by_step=True))
+    assert all(r.state == "done" for r in reqs)
+    return {r.id: list(r.tokens) for r in reqs}
+
+
+def _solo(model, params, req, max_len=64):
+    import jax
+
+    from torchpruner_tpu.generate import generate
+
+    s = req.sampling
+    out = generate(model, params, req.prompt_ids[None], req.max_new,
+                   max_len=max_len, temperature=s.temperature,
+                   top_k=s.top_k, top_p=s.top_p,
+                   rng=jax.random.PRNGKey(s.seed))
+    return np.asarray(out)[0].tolist()
+
+
+def _shared_reqs(vocab, n=4, temperature=0.0):
+    from torchpruner_tpu.serve import shared_prefix_requests
+
+    return shared_prefix_requests(
+        n, vocab=vocab, n_prefixes=2, prefix_len=16,
+        suffix_lens=[3, 5, 9], max_new=[6, 8], seed=11,
+        temperature=temperature)
+
+
+def test_chunked_prefill_parity_with_legacy_and_solo(tiny):
+    """Ragged (non-page-aligned) prompts through the chunked path
+    decode bit-identically to the legacy whole-bucket path AND to
+    solo generate — padded final chunks and parked decode positions
+    leak nothing."""
+    from torchpruner_tpu.serve import vocab_of
+
+    model, params = tiny
+    vocab = vocab_of(model)
+    reqs_c = _shared_reqs(vocab)
+    reqs_l = _shared_reqs(vocab)
+    toks_c = _serve(_engine(model, params, prefill_chunk=8), reqs_c)
+    toks_l = _serve(_engine(model, params), reqs_l)
+    for rc, rl in zip(reqs_c, reqs_l):
+        assert toks_c[rc.id] == toks_l[rl.id]
+        assert toks_c[rc.id] == _solo(model, params, rc)
+
+
+def test_sharing_on_off_bit_identical_poisoned_cache_guard(tiny):
+    """The poisoned-cache parity: identical traffic with sharing ON
+    (hits + COW + publication) and OFF must produce bit-identical
+    tokens — and ON must actually share (hits > 0), or the test
+    proves nothing."""
+    from torchpruner_tpu.serve import vocab_of
+
+    model, params = tiny
+    vocab = vocab_of(model)
+    reqs_on = _shared_reqs(vocab, n=5)
+    reqs_off = _shared_reqs(vocab, n=5)
+    eng_on = _engine(model, params, prefix_pages=8, prefill_chunk=8)
+    toks_on = _serve(eng_on, reqs_on)
+    toks_off = _serve(_engine(model, params, prefill_chunk=8), reqs_off)
+    alloc = eng_on.scheduler.allocator
+    assert alloc.prefix_hits > 0 and alloc.prefix_hit_tokens >= 16
+    for a, b in zip(reqs_on, reqs_off):
+        assert toks_on[a.id] == toks_off[b.id]
+        assert toks_on[a.id] == _solo(model, params, a)
+    # per-request attribution: hit + computed == prompt_len
+    for r in reqs_on:
+        assert r.prefix_hit_tokens + r.prefilled_tokens \
+            == r.prompt_ids.size
+
+
+def test_sampled_requests_share_bit_identically(tiny):
+    """Seeded SAMPLED decode (temperature > 0) over shared prefixes:
+    the first-token sample must come off the same logits/rng stream
+    whether the prefix was computed or mapped."""
+    from torchpruner_tpu.serve import vocab_of
+
+    model, params = tiny
+    vocab = vocab_of(model)
+    reqs = _shared_reqs(vocab, n=4, temperature=0.8)
+    eng = _engine(model, params, prefix_pages=8, prefill_chunk=8)
+    toks = _serve(eng, reqs)
+    assert eng.scheduler.allocator.prefix_hits > 0
+    for r in reqs:
+        assert toks[r.id] == _solo(model, params, r)
+
+
+def test_disjoint_prompts_zero_hits(tiny):
+    """Fully random prompts: the radix cache must never invent a
+    match (hits exactly zero), and decode stays solo-identical."""
+    from torchpruner_tpu.serve import synthetic_requests, vocab_of
+
+    model, params = tiny
+    vocab = vocab_of(model)
+    reqs = synthetic_requests(4, vocab=vocab, prompt_lens=[17, 21],
+                              max_new=[6], seed=5)
+    eng = _engine(model, params, prefix_pages=8, prefill_chunk=8)
+    toks = _serve(eng, reqs)
+    alloc = eng.scheduler.allocator
+    assert alloc.prefix_hits == 0 and alloc.prefix_hit_tokens == 0
+    for r in reqs:
+        assert toks[r.id] == _solo(model, params, r)
+
+
+def test_prefill_cap_is_hard_per_step_budget(tiny):
+    """With a cap, no engine step prefills more than the budget; the
+    floor is one chunk (a smaller cap would deadlock)."""
+    model, params = tiny
+    from torchpruner_tpu.serve import vocab_of
+
+    vocab = vocab_of(model)
+    reqs = _shared_reqs(vocab, n=4)
+    eng = _engine(model, params, prefix_pages=8, prefill_chunk=8,
+                  prefill_token_cap=8)
+    _serve(eng, reqs)
+    assert eng.max_prefill_tokens_step <= 8
+    s = eng.summary()
+    assert s["max_prefill_tokens_step"] <= s["prefill_token_cap"] == 8
+    # cap below the chunk width floors AT the chunk width
+    eng2 = _engine(model, params, prefill_chunk=8, prefill_token_cap=3)
+    assert eng2.scheduler.prefill_budget(8) == 8
+
+
+def test_chunk_must_divide_geometry(tiny):
+    model, params = tiny
+    with pytest.raises(ValueError):
+        _engine(model, params, prefill_chunk=24)   # 24 ∤ page_len 8
+    with pytest.raises(ValueError):
+        _engine(model, params, prefill_chunk=7)    # 7 ∤ max_len 64
+
+
+def test_decode_interleaves_with_chunked_prefill(tiny):
+    """A resident decoding request keeps emitting tokens while a long
+    prompt prefills in capped chunks — the cap's whole purpose."""
+    from torchpruner_tpu.serve import Request, Sampling, vocab_of
+
+    model, params = tiny
+    vocab = vocab_of(model)
+    rng = np.random.default_rng(3)
+    eng = _engine(model, params, prefix_pages=0, prefill_chunk=8,
+                  prefill_token_cap=8)
+    short = Request(prompt_ids=rng.integers(0, vocab, 4).astype(np.int32),
+                    max_new=12, sampling=Sampling(seed=1))
+    long = Request(prompt_ids=rng.integers(0, vocab, 48).astype(np.int32),
+                   max_new=4, sampling=Sampling(seed=2))
+    eng.submit(short)
+    for _ in range(50):
+        eng.step()
+        if len(short.tokens) >= 2:
+            break
+    eng.submit(long)
+    tokens_before = len(short.tokens)
+    # the long prompt needs 6 chunked steps; the short request must
+    # keep decoding during them
+    for _ in range(6):
+        eng.step()
+    assert len(short.tokens) > tokens_before
+    for _ in range(200):
+        if short.state == "done" and long.state == "done":
+            break
+        eng.step()
+    assert short.state == "done" and long.state == "done"
+    assert list(short.tokens) == _solo(model, params, short)
+    assert list(long.tokens) == _solo(model, params, long)
+
+
+def test_swap_resets_prefix_pool(tiny):
+    """A checkpoint hot-swap invalidates every published prefix (the
+    pool holds OLD-weights K/V): the trie must come back empty."""
+    from torchpruner_tpu.serve import vocab_of
+
+    model, params = tiny
+    vocab = vocab_of(model)
+    eng = _engine(model, params, prefix_pages=8, prefill_chunk=8)
+    reqs = _shared_reqs(vocab, n=3)
+    _serve(eng, reqs)
+    alloc = eng.scheduler.allocator
+    assert alloc.prefix_pool_used > 0
+    alloc.reset_prefix()
+    assert alloc.prefix_pool_used == 0 and alloc.shared_pages == 0
+    # and the pool is re-usable after the reset
+    reqs2 = _shared_reqs(vocab, n=3)
+    toks2 = _serve(eng, reqs2)
+    for r in reqs2:
+        assert toks2[r.id] == _solo(model, params, r)
+
+
+# -- fleet affinity ----------------------------------------------------------
+
+
+def _affinity_policy(**kw):
+    from torchpruner_tpu.fleet import RouterPolicy
+
+    base = dict(queue_bound=32, max_attempts=6, attempt_timeout_s=5.0,
+                default_deadline_s=30.0, base_backoff_s=0.001,
+                max_backoff_s=0.01, health_every_s=0.01,
+                max_inflight_per_replica=4, affinity_prefix_tokens=8)
+    base.update(kw)
+    return RouterPolicy(**base)
+
+
+def _mk_router(tmp_path, reps, **kw):
+    from torchpruner_tpu.fleet import FleetRouter, RequestPlane
+
+    plane = RequestPlane(str(tmp_path / "j.json"))
+    return FleetRouter(plane, reps, policy=_affinity_policy(**kw))
+
+
+def _payload(i, session=None, prefix=None):
+    ids = (list(prefix) if prefix is not None else []) + [i, i + 1]
+    out = {"prompt_ids": ids, "max_new": 2, "eos_id": None,
+           "temperature": 0.0, "top_k": None, "top_p": None, "seed": i}
+    if session:
+        out["session_id"] = session
+    return out
+
+
+def test_session_affinity_routes_repeats_to_same_replica(tmp_path):
+    from tests.test_fleet import FakeReplica
+
+    reps = [FakeReplica("replica0"), FakeReplica("replica1")]
+    router = _mk_router(tmp_path, reps)
+    # sequential same-session requests: after the first completes, all
+    # later ones must land on its replica
+    served_by = []
+    for i in range(6):
+        rec = router.submit(_payload(i, session="s1"))
+        router.run_until_drained(poll_s=0.002, timeout_s=10.0)
+        served_by.append(rec.completed_by)
+    assert len(set(served_by[1:])) == 1  # sticky after first contact
+    assert router.affinity_preferred_total == 5
+    assert router.affinity_hits_total == 5
+    assert router.snapshot()["affinity"]["hit_rate"] == 1.0
+    router.close()
+
+
+def test_prefix_affinity_without_session_ids(tmp_path):
+    from tests.test_fleet import FakeReplica
+
+    reps = [FakeReplica("replica0"), FakeReplica("replica1")]
+    router = _mk_router(tmp_path, reps)
+    prefix = list(range(100, 108))  # >= affinity_prefix_tokens
+    served_by = []
+    for i in range(4):
+        rec = router.submit(_payload(i, prefix=prefix))
+        router.run_until_drained(poll_s=0.002, timeout_s=10.0)
+        served_by.append(rec.completed_by)
+    assert len(set(served_by[1:])) == 1
+    assert router.affinity_hits_total == 3
+    # a DIFFERENT leading chunk carries no preference
+    rec = router.submit(_payload(9, prefix=list(range(200, 208))))
+    router.run_until_drained(poll_s=0.002, timeout_s=10.0)
+    assert router.affinity_preferred_total == 3  # unchanged
+    router.close()
+
+
+def test_affinity_forgotten_on_failover(tmp_path):
+    """Keys pointing at a dead replica are dropped: the session's next
+    request routes by load (no preference), completes on the survivor,
+    and re-registers there."""
+    from tests.test_fleet import FakeReplica
+
+    reps = [FakeReplica("replica0", die_after=2),
+            FakeReplica("replica1", state="draining")]
+    router = _mk_router(tmp_path, reps)
+    for i in range(2):
+        router.submit(_payload(i, session="s1"))
+        router.run_until_drained(poll_s=0.002, timeout_s=10.0)
+    assert router.affinity_hits_total == 1
+    preferred_before = router.affinity_preferred_total
+    reps[1].state = "ready"   # survivor becomes routable
+    rec = router.submit(_payload(7, session="s1"))  # kills replica0
+    router.run_until_drained(poll_s=0.002, timeout_s=10.0)
+    assert rec.state == "completed"
+    assert rec.completed_by == "replica1"
+    assert len(router.affinity) >= 1
+    with router._lock:
+        assert router.affinity.preferred(
+            _payload(8, session="s1")) == "replica1"
+    assert router.failovers_total == 1
+    assert preferred_before < router.affinity_preferred_total
+    router.close()
+
+
+def test_affinity_is_hint_not_constraint(tmp_path):
+    """An unusable preferred replica (draining) falls back to least-
+    loaded — affinity must never stall dispatch."""
+    from tests.test_fleet import FakeReplica
+
+    reps = [FakeReplica("replica0"), FakeReplica("replica1")]
+    router = _mk_router(tmp_path, reps)
+    rec0 = router.submit(_payload(0, session="s1"))
+    router.run_until_drained(poll_s=0.002, timeout_s=10.0)
+    home = rec0.completed_by
+    other = {"replica0": reps[1], "replica1": reps[0]}[home]
+    dict(replica0=reps[0], replica1=reps[1])[home].state = "draining"
+    router.check_health(force=True)
+    rec = router.submit(_payload(1, session="s1"))
+    router.run_until_drained(poll_s=0.002, timeout_s=10.0)
+    assert rec.state == "completed"
+    assert rec.completed_by == other.name  # fell back, didn't stall
+    # the MISS is counted (preferred yes, hit no)
+    snap = router.snapshot()["affinity"]
+    assert snap["preferred"] == 1 and snap["hits"] == 0
+    router.close()
+
+
+def test_affinity_registry_lru_bounded(tmp_path):
+    from torchpruner_tpu.fleet.router import PrefixAffinity
+
+    aff = PrefixAffinity(prefix_tokens=4, max_keys=3)
+    for i in range(5):
+        aff.note({"session_id": f"s{i}", "prompt_ids": []}, "replica0")
+    assert len(aff) == 3
+    assert aff.preferred({"session_id": "s0", "prompt_ids": []}) is None
+    assert aff.preferred({"session_id": "s4",
+                          "prompt_ids": []}) == "replica0"
+    # prefix_tokens=0 disables ALL affinity keys
+    off = PrefixAffinity(prefix_tokens=0)
+    off.note({"session_id": "s", "prompt_ids": list(range(9))}, "r0")
+    assert len(off) == 0

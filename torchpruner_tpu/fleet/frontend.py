@@ -94,6 +94,15 @@ def replica_argv(preset: str, port: int, args,
             "--obs-dir", obs_dir, "--run-dir", run_dir,
             "--trace-sample-every", str(args.trace_sample_every),
             "--timeout", str(args.deadline_s)]
+    if args.page_len > 0:
+        argv += ["--page-len", str(args.page_len)]
+    if args.prefix_pages > 0:
+        # Serve v2 on every replica: same pool/chunk geometry fleet-
+        # wide, so any replica serves any session (affinity is a
+        # throughput hint, failover stays free)
+        argv += ["--prefix-pages", str(args.prefix_pages),
+                 "--prefill-chunk", str(args.prefill_chunk),
+                 "--prefill-cap", str(args.prefill_cap)]
     if args.smoke:
         argv.append("--smoke")
     if args.cpu:
@@ -144,10 +153,13 @@ def spawn_fleet(preset: str, args, fleet_dir: str,
 def _payload_of(req) -> dict:
     """serve.Request → the wire dict (request_from_dict schema)."""
     s = req.sampling
-    return {"prompt_ids": req.prompt_ids.tolist(),
-            "max_new": int(req.max_new), "eos_id": req.eos_id,
-            "temperature": s.temperature, "top_k": s.top_k,
-            "top_p": s.top_p, "seed": s.seed}
+    out = {"prompt_ids": req.prompt_ids.tolist(),
+           "max_new": int(req.max_new), "eos_id": req.eos_id,
+           "temperature": s.temperature, "top_k": s.top_k,
+           "top_p": s.top_p, "seed": s.seed}
+    if req.session_id:
+        out["session_id"] = req.session_id
+    return out
 
 
 class _ChaosTrigger:
@@ -249,6 +261,7 @@ def run_drill(preset: str, args, fleet_dir: str,
     from torchpruner_tpu.serve.frontend import _resolve_model
     from torchpruner_tpu.serve.traffic import (
         poisson_arrivals,
+        shared_prefix_requests,
         synthetic_requests,
     )
 
@@ -260,9 +273,17 @@ def run_drill(preset: str, args, fleet_dir: str,
     n = args.synthetic
     prompt_lens = [int(x) for x in args.prompt_lens.split(",") if x]
     max_new = [int(x) for x in args.max_new.split(",") if x]
-    reqs = synthetic_requests(
-        n, vocab=vocab_of(model), prompt_lens=prompt_lens,
-        max_new=max_new, seed=args.seed, temperature=args.temperature)
+    if args.shared_prefixes > 0:
+        reqs = shared_prefix_requests(
+            n, vocab=vocab_of(model), n_prefixes=args.shared_prefixes,
+            prefix_len=args.prefix_len, suffix_lens=prompt_lens,
+            max_new=max_new, seed=args.seed, sessions=args.sessions,
+            temperature=args.temperature)
+    else:
+        reqs = synthetic_requests(
+            n, vocab=vocab_of(model), prompt_lens=prompt_lens,
+            max_new=max_new, seed=args.seed,
+            temperature=args.temperature)
     payloads = [_payload_of(r) for r in reqs]
     arrivals = poisson_arrivals(n, args.rate, seed=args.seed)
 
@@ -351,6 +372,11 @@ def run_drill(preset: str, args, fleet_dir: str,
         "ts_streams": ts_merge["streams"],
         "ts_windows": ts_merge["windows"],
         "slo_burn_alerts": len(burn_alerts),
+        "affinity_preferred": router.affinity_preferred_total,
+        "affinity_hits": router.affinity_hits_total,
+        "affinity_hit_rate": round(
+            router.affinity_hits_total
+            / max(1, router.affinity_preferred_total), 4),
         "wall_s": round(wall, 3),
         **trace_fields,
     }
@@ -620,6 +646,31 @@ def fleet_main(argv=None) -> int:
     p.add_argument("--prompt-lens", default="4,8,6")
     p.add_argument("--max-new", default="8,5,12")
     p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--shared-prefixes", type=int, default=0, metavar="K",
+                   help="drill: draw prompts from a pool of K shared "
+                        "system prompts + random suffixes (--prompt-"
+                        "lens become SUFFIX lengths) — the prefix-"
+                        "affinity workload; 0 = fully random prompts")
+    p.add_argument("--prefix-len", type=int, default=32,
+                   help="drill: shared system-prompt length in tokens")
+    p.add_argument("--sessions", type=int, default=0,
+                   help="drill: tag requests with round-robin session "
+                        "ids — the router's session-affinity signal")
+    p.add_argument("--page-len", type=int, default=0,
+                   help="per-replica KV page size (serve --page-len; "
+                        "0 = lane-aligned default — note the default "
+                        "can be a whole slot at small max-len, which "
+                        "makes 16-token prefixes unshareable)")
+    p.add_argument("--prefix-pages", type=int, default=0,
+                   help="per-replica shared-prefix KV pool pages "
+                        "(serve --prefix-pages on every replica; 0 = "
+                        "sharing off)")
+    p.add_argument("--prefill-chunk", type=int, default=0,
+                   help="per-replica chunked-prefill width (serve "
+                        "--prefill-chunk; 0 = auto with prefix pages)")
+    p.add_argument("--prefill-cap", type=int, default=0,
+                   help="per-replica per-step prefill-token budget "
+                        "(serve --prefill-cap; 0 = uncapped)")
     p.add_argument("--verify", action="store_true",
                    help="drill: re-decode every completed request from "
                         "the journal through solo generate() and "

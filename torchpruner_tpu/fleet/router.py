@@ -32,6 +32,16 @@ non-event:
   background checkpoint hot-swap on one replica at a time, waiting for
   each swap to land (readiness flips through ``staging_swap`` and the
   router routes around it) before touching the next.
+- **Prefix affinity** (Serve v2) — requests carrying a ``session_id``,
+  or whose prompt starts with a previously-seen leading chunk, PREFER
+  the replica that served that key last (:class:`PrefixAffinity`):
+  landing them together compounds that replica's prefix-cache hits
+  (``--prefix-pages``), turning the per-replica radix cache into a
+  fleet-wide one without any cross-replica KV traffic.  Affinity is a
+  ROUTING HINT, never a correctness constraint: an unusable preferred
+  replica falls back to least-loaded, and a failover forgets every key
+  pointing at the corpse.  ``fleet_affinity_*`` counters/gauges feed
+  the PR 17 time-series plane.
 """
 
 from __future__ import annotations
@@ -95,11 +105,78 @@ class RouterPolicy:
     swap_poll_s: float = 0.25
     #: run_until_drained's default tick sleep (drills override per call)
     drain_poll_s: float = 0.02
+    #: leading prompt tokens hashed into the prefix-affinity key (a
+    #: prompt shorter than this registers no prefix key); 0 disables
+    #: prefix-affinity routing entirely (session keys included)
+    affinity_prefix_tokens: int = 16
+    #: affinity-registry bound (LRU past it) — a long-running endpoint
+    #: must not grow router memory with lifetime session count
+    affinity_max_keys: int = 4096
 
     def retry_policy(self) -> RetryPolicy:
         return RetryPolicy(
             tries=self.max_attempts, base_delay_s=self.base_backoff_s,
             max_delay_s=self.max_backoff_s, seed=self.seed)
+
+
+class PrefixAffinity:
+    """Affinity-key → replica-name registry (see module docstring).
+
+    Two key kinds per request, strongest first: ``("s", session_id)``
+    (caller-asserted session) and ``("p", hash(leading tokens))`` (the
+    first ``prefix_tokens`` prompt ids — the same leading chunk the
+    replica's radix trie would match).  :meth:`note` registers both at
+    completion; :meth:`preferred` answers the longest-signal match;
+    :meth:`forget` drops every key pointing at a dead replica.  LRU-
+    bounded at ``max_keys``.  Not thread-safe — callers hold the
+    router's lock."""
+
+    def __init__(self, prefix_tokens: int, max_keys: int = 4096):
+        self.prefix_tokens = int(prefix_tokens)
+        self.max_keys = int(max_keys)
+        from collections import OrderedDict
+        self._map: "OrderedDict[tuple, str]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def keys_of(self, payload: dict) -> List[tuple]:
+        """The request's affinity keys, strongest first."""
+        if self.prefix_tokens <= 0:
+            return []
+        keys: List[tuple] = []
+        sid = payload.get("session_id")
+        if sid:
+            keys.append(("s", str(sid)))
+        ids = payload.get("prompt_ids") or []
+        if len(ids) >= self.prefix_tokens:
+            keys.append(
+                ("p", hash(tuple(ids[:self.prefix_tokens]))))
+        return keys
+
+    def preferred(self, payload: dict) -> Optional[str]:
+        for key in self.keys_of(payload):
+            name = self._map.get(key)
+            if name is not None:
+                self._map.move_to_end(key)
+                return name
+        return None
+
+    def note(self, payload: dict, replica: str) -> None:
+        for key in self.keys_of(payload):
+            self._map[key] = replica
+            self._map.move_to_end(key)
+        while len(self._map) > self.max_keys:
+            self._map.popitem(last=False)
+
+    def forget(self, replica: str) -> int:
+        """Drop every key routed at ``replica`` (failover); returns how
+        many were dropped — stale affinity to a corpse would fight the
+        exclude/least-loaded fallback on every subsequent request."""
+        dead = [k for k, v in self._map.items() if v == replica]
+        for k in dead:
+            del self._map[k]
+        return len(dead)
 
 
 @dataclass
@@ -154,6 +231,13 @@ class FleetRouter:
         self.failovers_total = 0
         self.shed_total = 0
         self.dispatched_total = 0
+        self.affinity = PrefixAffinity(
+            policy.affinity_prefix_tokens,
+            max_keys=policy.affinity_max_keys)
+        #: records that carried a usable affinity preference / of those,
+        #: how many actually landed on the preferred replica
+        self.affinity_preferred_total = 0
+        self.affinity_hits_total = 0
         self._closed = False
 
     # -- admission -----------------------------------------------------------
@@ -354,6 +438,12 @@ class FleetRouter:
             self.failovers_total += 1
         obs.inc("fleet_failover_total",
                 help="replica deaths observed by the health monitor")
+        with self._lock:
+            dropped = self.affinity.forget(view.client.name)
+        if dropped:
+            obs.inc("fleet_affinity_forgotten_total", n=dropped,
+                    help="affinity keys dropped because their replica "
+                         "left the live set")
         rids = self.plane.assigned_to(view.client.name)
         print(f"[fleet] replica {view.client.name} is gone "
               f"({len(rids)} in-flight record(s) redriven)",
@@ -364,16 +454,20 @@ class FleetRouter:
 
     # -- dispatch ------------------------------------------------------------
 
-    def _pick(self, exclude: Optional[str] = None) -> Optional[ReplicaView]:
+    def _pick(self, exclude: Optional[str] = None,
+              prefer: Optional[str] = None) -> Optional[ReplicaView]:
         """Least-loaded routing over the scraped gauges: READY replicas
         first (excluding the just-failed one when another exists), by
         (router in-flight fraction + scraped occupancy + queue depth,
         with a tiny dispatched-count bias that round-robins exact
         ties); degraded-but-live replicas (slo_breach / staging_swap)
         are the fallback so a fully-degraded fleet still serves — only
-        draining and dead replicas are never picked.  The winner's
-        in-flight slot is RESERVED under the lock (the caller must
-        release it), so concurrent picks see each other's load."""
+        draining and dead replicas are never picked.  ``prefer`` names
+        the prefix-affinity replica: taken when usable-and-ready (its
+        warm prefix cache beats a small load delta), otherwise the
+        least-loaded fallback — a hint, never a constraint.  The
+        winner's in-flight slot is RESERVED under the lock (the caller
+        must release it), so concurrent picks see each other's load."""
         with self._lock:
             cap = self.policy.max_inflight_per_replica
 
@@ -388,6 +482,13 @@ class FleetRouter:
                 if v.inflight >= cap:
                     return False
                 return v.ready if ready_only else True
+
+            if prefer is not None and prefer != exclude:
+                v = self.views.get(prefer)
+                if v is not None and usable(v, ready_only=True):
+                    v.inflight += 1
+                    v.dispatched_total += 1
+                    return v
 
             for ready_only in (True, False):
                 pool = [v for v in self.views.values()
@@ -427,6 +528,18 @@ class FleetRouter:
     def _dispatch(self, rec: PlaneRecord) -> None:
         deadline = Deadline.after(rec.remaining_s())
         last_failed: Optional[str] = None
+        # affinity preference resolved ONCE per record (counted once,
+        # however many attempts follow); a retry excludes the failed
+        # replica, which _pick already ranks above the preference
+        with self._lock:
+            prefer = self.affinity.preferred(rec.payload)
+        if prefer is not None:
+            with self._lock:
+                self.affinity_preferred_total += 1
+            obs.inc("fleet_affinity_preferred_total",
+                    help="dispatches that carried a session/prefix "
+                         "affinity preference")
+        hit_counted = [False]
 
         def attempt(timeout_s: Optional[float]):
             nonlocal last_failed
@@ -436,7 +549,7 @@ class FleetRouter:
             # burning retries into a spurious loss
             t_wait = time.perf_counter()
             swap_stall = False
-            view = self._pick(exclude=last_failed)
+            view = self._pick(exclude=last_failed, prefer=prefer)
             while view is None:
                 if any(v.live and v.state == "staging_swap"
                        for v in self.views.values()):
@@ -450,8 +563,18 @@ class FleetRouter:
                 time.sleep(min(0.05, max(0.001,
                                          self.policy.health_every_s)))
                 self.check_health()
-                view = self._pick(exclude=last_failed)
+                view = self._pick(exclude=last_failed, prefer=prefer)
             name = view.client.name
+            if prefer is not None and name == prefer \
+                    and not hit_counted[0]:
+                # once per RECORD, like the preferred counter — a
+                # failed-then-retried landing must not double-count
+                hit_counted[0] = True
+                with self._lock:
+                    self.affinity_hits_total += 1
+                obs.inc("fleet_affinity_hits_total",
+                        help="preferred dispatches that landed on "
+                             "their affinity replica")
             attempt_no = rec.attempts + 1
             wait_s = time.perf_counter() - t_wait
             # the latency cost of WAITING for a usable replica — the
@@ -527,6 +650,22 @@ class FleetRouter:
             self.plane.fail(rec.rid, f"{type(e).__name__}: {e}")
             return
         self.plane.complete(rec.rid, out.get("tokens", []), name)
+        # the request's keys now point at the replica whose radix cache
+        # holds its prefix — the signal the NEXT request of the session
+        # / shared system prompt routes on
+        with self._lock:
+            self.affinity.note(rec.payload, name)
+            preferred = self.affinity_preferred_total
+            hits = self.affinity_hits_total
+            keys = len(self.affinity)
+        obs.gauge_set("fleet_affinity_hit_rate",
+                      round(hits / max(1, preferred), 4),
+                      help="preferred dispatches landed on their "
+                           "affinity replica / dispatches with a "
+                           "preference (0..1)")
+        obs.gauge_set("fleet_affinity_keys", keys,
+                      help="session/prefix keys in the affinity "
+                           "registry (LRU-bounded)")
 
     # -- the loop ------------------------------------------------------------
 
@@ -624,6 +763,14 @@ class FleetRouter:
             "failovers_total": self.failovers_total,
             "shed_total": self.shed_total,
             "dispatched_total": self.dispatched_total,
+            "affinity": {
+                "preferred": self.affinity_preferred_total,
+                "hits": self.affinity_hits_total,
+                "hit_rate": round(
+                    self.affinity_hits_total
+                    / max(1, self.affinity_preferred_total), 4),
+                "keys": len(self.affinity),
+            },
         }
 
 

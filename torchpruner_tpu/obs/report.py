@@ -102,11 +102,17 @@ _SCALARS = {
 #: cadence gauges and ``slo_burn_*`` the multi-window burn-rate
 #: gauges — obs/timeseries.py + serve/slo.py, gated by the CI fleet
 #: drill)
+#: (``serve_prefix_*`` / ``serve_kv_pages_shared*`` are the Serve v2
+#: prefix-sharing cache's hit/publish/evict counters and shared-page
+#: gauges — serve/allocator.py + serve/engine.py, emitted only with
+#: ``--prefix-pages`` on, gated by the CI prefix smoke; the fleet's
+#: ``fleet_affinity_*`` ride the existing ``fleet_`` prefix)
 _DYNAMIC_SCALAR_PREFIXES = ("kernel_", "serve_slo_breach", "zero_",
                             "predicted_", "plan_", "frontier_",
                             "search_", "fleet_", "reqtrace_",
                             "ttft_stage_", "serve_queue_wait",
-                            "host_lint_", "ts_", "slo_burn_")
+                            "host_lint_", "ts_", "slo_burn_",
+                            "serve_prefix_", "serve_kv_pages_shared")
 _DYNAMIC_EXTRA = ("profile_coverage", "profile_windows_total",
                   "profile_steps_total")
 

@@ -26,6 +26,7 @@ tail latency instead of smaller params/FLOPs counters (ROADMAP item 1):
 
 from torchpruner_tpu.serve.allocator import (
     KVCacheAllocator,
+    PrefixTrie,
     aligned_len,
     bucket_for,
     prefill_buckets,
@@ -45,13 +46,15 @@ from torchpruner_tpu.serve.slo import SLOMonitor
 from torchpruner_tpu.serve.traffic import (
     OpenLoopTraffic,
     poisson_arrivals,
+    shared_prefix_requests,
     staggered_arrivals,
     synthetic_requests,
 )
 
 __all__ = [
-    "Request", "Sampling", "KVCacheAllocator", "Scheduler", "ServeEngine",
-    "OpenLoopTraffic", "poisson_arrivals", "staggered_arrivals",
-    "synthetic_requests", "aligned_len", "bucket_for", "prefill_buckets",
-    "sample_tokens", "vocab_of", "SLOMonitor", "request_from_dict",
+    "Request", "Sampling", "KVCacheAllocator", "PrefixTrie", "Scheduler",
+    "ServeEngine", "OpenLoopTraffic", "poisson_arrivals",
+    "staggered_arrivals", "synthetic_requests", "shared_prefix_requests",
+    "aligned_len", "bucket_for", "prefill_buckets", "sample_tokens",
+    "vocab_of", "SLOMonitor", "request_from_dict",
 ]

@@ -19,6 +19,31 @@ array and THREE compiled programs (prefill/decode disaggregation):
   cache with one ``dynamic_update_slice`` per buffer (no retrace, no
   host copy of the cache).
 
+**Serve v2** (``prefill_chunk > 0`` and/or ``prefix_pages > 0``) adds
+two more program families, both shape-bounded the same way the bucket
+ladder is:
+
+- **chunked prefill** — a long prompt prefills in fixed lane-aligned
+  chunks INTERLEAVED with decode steps (one compiled chunk program per
+  chunk width, specialized by jit's shape cache), under a per-step
+  prefill-token cap (scheduler) so a long prompt can never stall the
+  slot array.  Every chunk boundary is a GLOBAL multiple of the chunk
+  width (``chunk | page_len | max_len``), so two requests sharing a
+  prefix apply byte-identical program/position pairs over it — the
+  property that makes published prefix pages canonical.  While a slot
+  is mid-prefill its decode write row is parked on ``max_len - 1``
+  (never attendable before decode overwrites it) and its step outputs
+  are discarded.
+- **prefix page map/publish** — admission-time prefix hits copy pool
+  pages into the slot's rows (one ``dynamic_slice`` +
+  ``dynamic_update_slice`` per page per buffer); completion publishes
+  the prompt's whole pages back into the pool.  The map is a COPY
+  (copy-on-write materialized at admission): decode writes stay in the
+  slot's private rows, the pool page stays canonical, and the compiled
+  decode/prefill programs never learn about pages at all — sharing is
+  pure host bookkeeping + bounded copy programs, which is how it fits
+  the static-shape TPU contract.
+
 Decode shapes ride the pruned model spec exactly like ``generate``:
 pruning FFN channels / heads / experts shrinks the compiled programs and
 the KV buffers with no serving-specific surgery — the runtime exploits
@@ -175,13 +200,118 @@ def make_insert():
     return insert
 
 
+def default_prefill_chunk(max_len: int, page_len: int) -> int:
+    """The largest lane-ladder chunk width dividing BOTH the slot
+    length and the page size — divisibility is what keeps every chunk
+    write in-bounds (no ``dynamic_update_slice`` clamping) and every
+    chunk boundary globally aligned across requests (the prefix-page
+    canonicality requirement)."""
+    import math
+
+    g = math.gcd(int(max_len), int(page_len))
+    for c in (64, 32, 16, 8):
+        if g % c == 0:
+            return c
+    return g
+
+
+def make_chunk_prefill(model):
+    """jit: one prefill chunk in place — ``(params, big_cache,
+    toks (1, chunk), slot, pos0) -> (chunk logits (chunk, V),
+    big_cache')``.  The slot's rows are sliced out as a B=1 cache, the
+    chunk runs ``_decode_seq`` at absolute ``pos0`` (causal within the
+    block, masked against everything beyond — padded tail positions
+    write junk K/V at ``t >= prompt_len`` that decode overwrites before
+    it is ever attendable, the same argument as bucket end-padding),
+    and the rows are written back.  jit's shape cache yields one
+    compiled program per chunk width, never one per prompt."""
+    import jax
+    from jax import lax
+
+    from torchpruner_tpu.generate import _decode_seq
+
+    @jax.jit
+    def chunk(params, big, toks, slot, pos0):
+        def rows(b):
+            return lax.dynamic_slice(
+                b, (slot, 0, 0, 0), (1,) + b.shape[1:])
+
+        small = jax.tree_util.tree_map(rows, big)
+        x, small = _decode_seq(model.layers, params, small, toks, pos0)
+
+        def put(b, s):
+            return lax.dynamic_update_slice(
+                b, s.astype(b.dtype), (slot, 0, 0, 0))
+
+        big = jax.tree_util.tree_map(put, big, small)
+        return x[0], big
+
+    return chunk
+
+
+def make_page_copy(page_len: int):
+    """jit pair moving one K/V page between the serving cache and the
+    prefix pool: ``map_page(big, pool, page, slot, start) -> big'``
+    (admission hit: pool page copied into the slot's rows — the
+    copy-on-write materialization) and ``publish_page(pool, big, slot,
+    start, page) -> pool'`` (completion: a freshly prefilled whole page
+    published for future requests)."""
+    import jax
+    from jax import lax
+
+    @jax.jit
+    def map_page(big, pool, page, slot, start):
+        def upd(b, p):
+            blk = lax.dynamic_slice(
+                p, (page, 0, 0, 0),
+                (1, page_len, p.shape[2], p.shape[3]))
+            return lax.dynamic_update_slice(
+                b, blk.astype(b.dtype), (slot, start, 0, 0))
+
+        return jax.tree_util.tree_map(upd, big, pool)
+
+    @jax.jit
+    def publish_page(pool, big, slot, start, page):
+        def upd(p, b):
+            blk = lax.dynamic_slice(
+                b, (slot, start, 0, 0),
+                (1, page_len, b.shape[2], b.shape[3]))
+            return lax.dynamic_update_slice(
+                p, blk.astype(p.dtype), (page, 0, 0, 0))
+
+        return jax.tree_util.tree_map(upd, pool, big)
+
+    return map_page, publish_page
+
+
+def make_sample_at():
+    """jit: sample the FIRST token from a chunk's logits block at the
+    prompt's last real position — the same split/truncate/sample
+    sequence :func:`make_prefill` fuses, so a chunked prefill emits the
+    bit-identical first token for the same seed."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def sample_at(logits, idx, rng, temp, top_k, top_p):
+        row = jnp.take(logits, idx, axis=0)
+        carry, sub = jax.random.split(rng)
+        tok = sample_tokens(row[None], sub[None], temp[None],
+                            top_k[None], top_p[None])[0]
+        return tok, carry
+
+    return sample_at
+
+
 class _Programs:
     """One checkpoint's compiled surface: model + params + serving cache
     + the three program families.  Swappable as a unit — hot-swap builds
     a fresh ``_Programs`` and warms it before any traffic touches it."""
 
     def __init__(self, model, params, *, n_slots: int, max_len: int,
-                 cache_dtype, meta: Optional[dict] = None):
+                 cache_dtype, meta: Optional[dict] = None,
+                 page_len: int = 0, prefix_pages: int = 0,
+                 prefill_chunk: int = 0):
         import jax.numpy as jnp
 
         from torchpruner_tpu.generate import init_cache
@@ -194,6 +324,21 @@ class _Programs:
         self.insert = make_insert()
         self.buckets = prefill_buckets(max_len)
         self._prefills: Dict[int, Any] = {}
+        self.prefill_chunk = int(prefill_chunk)
+        self.prefix_pages = int(prefix_pages)
+        self.page_len = int(page_len)
+        # the v2 program families + the device page pool — pool buffers
+        # use the SAME layer keying as the cache so tree_map pairs them
+        self.chunk_prefill = (make_chunk_prefill(model)
+                              if self.prefill_chunk else None)
+        self.sample_at = make_sample_at() if self.prefill_chunk else None
+        if self.prefix_pages:
+            self.prefix_pool = init_cache(
+                model, self.prefix_pages, self.page_len, cache_dtype)
+            self.map_page, self.publish_page = make_page_copy(
+                self.page_len)
+        else:
+            self.prefix_pool = None
         self._jnp = jnp
 
     def prefill_for(self, bucket: int):
@@ -206,7 +351,9 @@ class _Programs:
     def warm(self, buckets: Optional[List[int]] = None) -> None:
         """Compile the decode step, the insert, and the given prefill
         buckets on dummy data — the hot-swap contract: every program a
-        request can hit is compiled BEFORE traffic switches."""
+        request can hit is compiled BEFORE traffic switches.  With
+        serve-v2 features on, the chunk program and the page-copy pair
+        are part of that surface."""
         import jax
         import jax.numpy as jnp
 
@@ -227,6 +374,19 @@ class _Programs:
                              jnp.asarray(1.0, jnp.float32))
             jax.block_until_ready(
                 self.insert(cache, small, jnp.asarray(0, jnp.int32)))
+        i0 = jnp.asarray(0, jnp.int32)
+        if self.prefill_chunk:
+            lg, c2 = self.chunk_prefill(
+                self.params, self.cache,
+                jnp.zeros((1, self.prefill_chunk), jnp.int32), i0, i0)
+            t, _ = self.sample_at(lg, i0, key, zero, i0,
+                                  jnp.asarray(1.0, jnp.float32))
+            jax.block_until_ready(t)
+        if self.prefix_pool is not None:
+            pool2 = self.publish_page(self.prefix_pool, self.cache,
+                                      i0, i0, i0)
+            jax.block_until_ready(
+                self.map_page(self.cache, pool2, i0, i0, i0))
 
 
 class ServeEngine:
@@ -238,14 +398,23 @@ class ServeEngine:
                  max_len: int = 256, cache_dtype=None, page_len: int = 0,
                  page_budget: int = 0, run_dir: Optional[str] = None,
                  checkpoint_meta: Optional[dict] = None,
-                 retain_results: bool = True, queue_bound: int = 0):
+                 retain_results: bool = True, queue_bound: int = 0,
+                 prefix_pages: int = 0, prefill_chunk: int = 0,
+                 prefill_token_cap: int = 0):
         """``retain_results=False`` (the long-running HTTP server) stops
         the engine from accumulating completed Request objects — each
         request (and, across a hot-swap, the old checkpoint's program
         set its ``served_by`` pins) is released as soon as its waiter
         collects it, so memory stays bounded by in-flight work.  Batch
         front ends (synthetic/stdin) keep the default: they need the
-        full result list for verification and percentile reporting."""
+        full result list for verification and percentile reporting.
+
+        Serve v2 knobs (all default OFF): ``prefix_pages`` sizes the
+        shared prefix-page pool (> 0 enables prefix sharing and, if
+        ``prefill_chunk`` is unset, auto-picks a chunk width dividing
+        both the page and the slot length); ``prefill_chunk`` enables
+        chunked prefill; ``prefill_token_cap`` bounds prefill work per
+        engine step (floored at one chunk so progress is guaranteed)."""
         import jax
         import jax.numpy as jnp
 
@@ -254,9 +423,31 @@ class ServeEngine:
                 "ServeEngine serves token-sequence (LM) models; "
                 f"got input_dtype={getattr(model, 'input_dtype', None)!r}")
         cache_dtype = jnp.float32 if cache_dtype is None else cache_dtype
+        allocator = KVCacheAllocator(
+            n_slots, max_len, page_len=page_len,
+            page_budget=page_budget, prefix_pages=prefix_pages)
+        if prefix_pages and not prefill_chunk:
+            # sharing REQUIRES chunking: mapped pages are only canonical
+            # when every producer prefilled at the same global chunk
+            # alignment — a whole-bucket prefill would break bit parity
+            prefill_chunk = default_prefill_chunk(
+                max_len, allocator.page_len)
+        if prefill_chunk:
+            if max_len % prefill_chunk or \
+                    allocator.page_len % prefill_chunk:
+                raise ValueError(
+                    f"prefill_chunk {prefill_chunk} must divide both "
+                    f"max_len {max_len} and page_len "
+                    f"{allocator.page_len} (in-bounds chunk writes + "
+                    f"global chunk alignment)")
+        self.prefill_chunk = int(prefill_chunk)
+        self.prefill_token_cap = int(prefill_token_cap)
+        self._prefix_pages = int(prefix_pages)
         self.programs = _Programs(
             model, params, n_slots=n_slots, max_len=max_len,
-            cache_dtype=cache_dtype, meta=checkpoint_meta)
+            cache_dtype=cache_dtype, meta=checkpoint_meta,
+            page_len=allocator.page_len, prefix_pages=prefix_pages,
+            prefill_chunk=self.prefill_chunk)
         # whether the decode step runs the decode-shaped Pallas kernel
         # (ops/decode_attention.py) at this cache geometry — surfaced as
         # a gauge so obs report / bench rows name the attention path
@@ -282,9 +473,8 @@ class ServeEngine:
         self._cost_predicted = False
         self._cost_thread: Optional[threading.Thread] = None
         self.scheduler = Scheduler(
-            KVCacheAllocator(n_slots, max_len, page_len=page_len,
-                             page_budget=page_budget),
-            queue_bound=queue_bound)
+            allocator, queue_bound=queue_bound,
+            prefill_token_cap=prefill_token_cap)
         self.run_dir = run_dir
         self.n_slots, self.max_len = n_slots, max_len
         # host slot tables (the continuous-batching state the compiled
@@ -309,6 +499,17 @@ class ServeEngine:
         self._swap_error: Optional[BaseException] = None
         self._swap_thread: Optional[threading.Thread] = None
         self.swaps_total = 0
+        #: slot -> in-progress chunked-prefill state (insertion order =
+        #: round-robin order; a slot mid-prefill is skipped by decode
+        #: harvesting and its decode write row is parked)
+        self._prefilling: Dict[int, dict] = {}
+        #: lifetime prefill-token work actually computed (chunk real
+        #: tokens / legacy bucket prompt lengths) — the sharing-on/off
+        #: "prefilled tokens drop >= 2x" comparison reads this
+        self.prefill_tokens_total = 0
+        #: the largest per-step prefill-token spend observed — the
+        #: "no step exceeds the cap" bench gate
+        self.max_prefill_tokens_step = 0
         self.drained: List[Request] = []
         self.retain_results = retain_results
         self.completed_count = 0
@@ -400,6 +601,12 @@ class ServeEngine:
         req.served_by = P  # which checkpoint's programs decoded it
         req.tokens.append(tok)
         self.gen_tokens += 1
+        req.prefilled_tokens = n
+        self.prefill_tokens_total += n
+        obs.inc("serve_prefill_tokens_total", n=n,
+                help="prompt tokens actually prefilled (chunked real "
+                     "tokens or whole-bucket prompt lengths; prefix "
+                     "hits skip theirs)")
         reqtrace.stage(req.trace_id, "prefill", dur_s=req.prefill_s,
                        request=req.id, bucket=bucket)
         if req.ttft_s is not None:
@@ -418,6 +625,201 @@ class ServeEngine:
         self._eos[slot] = -1 if req.eos_id is None else req.eos_id
         self._last_token_s[slot] = now
         self._rngs = self._rngs.at[slot].set(carry)
+        if len(req.tokens) >= req.max_new or tok == self._eos[slot]:
+            self._finish(req)
+
+    # -- serve v2: prefix sharing + chunked prefill --------------------------
+
+    def _begin_prefill(self, req: Request) -> None:
+        """Admission under chunked prefill: match + map the prompt's
+        shared prefix pages (pinning the trie path), then enqueue the
+        suffix for chunk-by-chunk prefilling interleaved with decode
+        steps.  The match is capped at ``prompt_len - 1`` so at least
+        one real position is always computed (the first token's logits
+        live there)."""
+        import jax.numpy as jnp
+
+        P = self.programs
+        alloc = self.scheduler.allocator
+        slot = req.slot
+        n = int(req.prompt_ids.size)
+        t_adm = time.perf_counter()
+        if req.admitted_s is not None:
+            reqtrace.stage(req.trace_id, "admission",
+                           dur_s=max(0.0, t_adm - req.admitted_s),
+                           request=req.id)
+        pos0 = 0
+        match = alloc.match_prefix(req.prompt_ids, max_tokens=n - 1)
+        if match is not None:
+            Lp = alloc.page_len
+            with obs.span("serve_prefix_map", request=req.id,
+                          pages=len(match.pages)):
+                for i, pg in enumerate(match.pages):
+                    P.cache = P.map_page(
+                        P.cache, P.prefix_pool,
+                        jnp.asarray(pg, jnp.int32),
+                        jnp.asarray(slot, jnp.int32),
+                        jnp.asarray(i * Lp, jnp.int32))
+            pos0 = match.tokens
+            alloc.lease_of(slot).prefix_match = match
+            req.prefix_hit_tokens = pos0
+            obs.inc("serve_prefix_hits_total",
+                    help="admissions whose prompt matched resident "
+                         "prefix pages")
+            obs.inc("serve_prefix_hit_tokens_total", n=pos0,
+                    help="prompt tokens served by mapping shared "
+                         "prefix pages instead of re-prefilling")
+            # pages the trie held but the cap refused (they straddle
+            # the sampled position / future decode writes): the
+            # copy-on-write boundary, privately re-prefilled
+            cow = -(-(min(getattr(match, "available", pos0), n)
+                      - pos0) // Lp)
+            if cow > 0:
+                obs.inc("serve_prefix_cow_pages_total", n=cow,
+                        help="resident pages re-prefilled privately at "
+                             "the divergence/write boundary (COW)")
+            reqtrace.stage(req.trace_id, "prefix_hit", request=req.id,
+                           tokens=pos0)
+        elif alloc.prefix_enabled:
+            obs.inc("serve_prefix_misses_total",
+                    help="admissions with no resident prefix page")
+        # park the slot's decode write row on max_len - 1: that row is
+        # never attendable before decode overwrites it (the final
+        # decode step's pos is at most total_len - 2), so the junk
+        # writes of interleaved decode steps cannot corrupt this
+        # prefill — and this slot's step outputs are discarded
+        self._pos[slot] = self.max_len - 1
+        self._tok[slot] = 0
+        self._temp[slot] = 0.0
+        self._topk[slot] = 0
+        self._topp[slot] = 1.0
+        self._prefilling[slot] = {
+            "req": req, "pos": pos0, "start": pos0, "t0": t_adm}
+
+    def _advance_prefills(self) -> bool:
+        """One engine step's prefill work: round-robin one chunk per
+        mid-prefill slot while the scheduler's per-step token budget
+        lasts.  Chunk work (padded width) is what the budget meters —
+        the conservative reading of the cap."""
+        chunk = self.prefill_chunk
+        budget = self.scheduler.prefill_budget(chunk)
+        spent = 0
+        progressed = False
+        for slot in list(self._prefilling):
+            if spent + chunk > budget:
+                break
+            st = self._prefilling.get(slot)
+            if st is None:
+                continue
+            self._prefill_one_chunk(slot, st)
+            spent += chunk
+            progressed = True
+            if slot in self._prefilling:  # not finished: rotate to back
+                self._prefilling[slot] = self._prefilling.pop(slot)
+        if spent:
+            self.max_prefill_tokens_step = max(
+                self.max_prefill_tokens_step, spent)
+        return progressed
+
+    def _prefill_one_chunk(self, slot: int, st: dict) -> None:
+        import jax.numpy as jnp
+
+        P = self.programs
+        req: Request = st["req"]
+        n = int(req.prompt_ids.size)
+        c = self.prefill_chunk
+        pos = st["pos"]
+        m = min(c, n - pos)
+        toks = np.zeros((1, c), np.int32)
+        toks[0, :m] = req.prompt_ids[pos:pos + m]
+        with obs.span("serve_prefill_chunk", request=req.id, chunk=c):
+            logits, P.cache = P.chunk_prefill(
+                P.params, P.cache, jnp.asarray(toks),
+                jnp.asarray(slot, jnp.int32),
+                jnp.asarray(pos, jnp.int32))
+        st["pos"] = pos + m
+        self.prefill_tokens_total += m
+        obs.inc("serve_prefill_tokens_total", n=m,
+                help="prompt tokens actually prefilled (chunked real "
+                     "tokens or whole-bucket prompt lengths; prefix "
+                     "hits skip theirs)")
+        obs.inc("serve_prefill_chunks_total",
+                help="chunk-prefill program applications")
+        if st["pos"] >= n:
+            self._finish_prefill(slot, st, logits, pos)
+
+    def _finish_prefill(self, slot: int, st: dict, logits,
+                        last_chunk_pos: int) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        P = self.programs
+        alloc = self.scheduler.allocator
+        req: Request = st["req"]
+        s = req.sampling
+        n = int(req.prompt_ids.size)
+        tok, carry = P.sample_at(
+            logits, jnp.asarray(n - 1 - last_chunk_pos, jnp.int32),
+            jax.random.PRNGKey(s.seed),
+            jnp.asarray(s.temperature, jnp.float32),
+            jnp.asarray(s.top_k or 0, jnp.int32),
+            jnp.asarray(1.0 if s.top_p is None else s.top_p,
+                        jnp.float32))
+        tok = int(tok)
+        now = time.perf_counter()
+        req.first_token_s = now
+        req.prefill_s = now - st["t0"]
+        req.served_by = P
+        req.tokens.append(tok)
+        self.gen_tokens += 1
+        req.prefilled_tokens = n - st["start"]
+        reqtrace.stage(req.trace_id, "prefill", dur_s=req.prefill_s,
+                       request=req.id, chunk=self.prefill_chunk,
+                       hit_tokens=st["start"])
+        if req.ttft_s is not None:
+            obs.observe("serve_ttft_seconds", req.ttft_s,
+                        help="request arrival -> first token")
+            reqtrace.stage(req.trace_id, "first_token", request=req.id,
+                           ttft_s=round(req.ttft_s, 6))
+            if self.slo is not None:
+                self.slo.on_ttft(req.ttft_s)
+        self._pos[slot] = n
+        self._tok[slot] = tok
+        self._temp[slot] = s.temperature
+        self._topk[slot] = s.top_k or 0
+        self._topp[slot] = 1.0 if s.top_p is None else s.top_p
+        self._eos[slot] = -1 if req.eos_id is None else req.eos_id
+        self._last_token_s[slot] = now
+        self._rngs = self._rngs.at[slot].set(carry)
+        if alloc.prefix_enabled:
+            ev0, full0 = alloc.prefix_evictions, \
+                alloc.prefix_pool_exhausted
+            plan = alloc.publish_prefix(req.prompt_ids, n)
+            if plan:
+                Lp = alloc.page_len
+                with obs.span("serve_prefix_publish", request=req.id,
+                              pages=len(plan)):
+                    for pi, pg in plan:
+                        P.prefix_pool = P.publish_page(
+                            P.prefix_pool, P.cache,
+                            jnp.asarray(slot, jnp.int32),
+                            jnp.asarray(pi * Lp, jnp.int32),
+                            jnp.asarray(pg, jnp.int32))
+                obs.inc("serve_prefix_published_pages_total",
+                        n=len(plan),
+                        help="whole prompt pages published into the "
+                             "shared pool")
+            if alloc.prefix_evictions > ev0:
+                obs.inc("serve_prefix_evicted_pages_total",
+                        n=alloc.prefix_evictions - ev0,
+                        help="pool pages reclaimed by refcount-aware "
+                             "LRU eviction")
+            if alloc.prefix_pool_exhausted > full0:
+                obs.inc("serve_prefix_pool_exhausted_total",
+                        n=alloc.prefix_pool_exhausted - full0,
+                        help="publications truncated with every pool "
+                             "page pinned (evict-while-shared refusal)")
+        del self._prefilling[slot]
         if len(req.tokens) >= req.max_new or tok == self._eos[slot]:
             self._finish(req)
 
@@ -471,6 +873,10 @@ class ServeEngine:
         # train step telemetry (obs.profile)
         obs.profile_step(now - t0)
         for slot, req in list(self.scheduler.running.items()):
+            if slot in self._prefilling:
+                # mid-chunked-prefill: this slot decoded junk at its
+                # parked position — discard
+                continue
             tok = int(nxt[slot])
             req.tokens.append(tok)
             self.gen_tokens += 1
@@ -511,9 +917,15 @@ class ServeEngine:
         did = False
         if admit:
             for req in self.scheduler.admit():
-                self._prefill(req)
+                if self.prefill_chunk:
+                    self._begin_prefill(req)
+                else:
+                    self._prefill(req)
                 did = True
-        if self.scheduler.running:
+        if self._prefilling:
+            did = self._advance_prefills() or did
+        if any(s not in self._prefilling
+               for s in self.scheduler.running):
             self._decode_once()
             did = True
         if did:
@@ -556,7 +968,10 @@ class ServeEngine:
                     model, params, n_slots=self.n_slots,
                     max_len=self.max_len,
                     cache_dtype=self.programs.cache_dtype,
-                    meta={**(meta or {}), "checkpoint": path})
+                    meta={**(meta or {}), "checkpoint": path},
+                    page_len=self.scheduler.allocator.page_len,
+                    prefix_pages=self._prefix_pages,
+                    prefill_chunk=self.prefill_chunk)
                 staged.warm(buckets or None)
             self._staged = staged
         except Exception as e:  # surfaced at the next step boundary
@@ -581,6 +996,10 @@ class ServeEngine:
             self.programs = new
             self._staged, self._pending_swap = None, None
             self.swaps_total += 1
+            # pooled prefix K/V was computed under the OLD weights —
+            # a post-swap match would map stale pages; drop the index
+            # (the slot array is empty here, so nothing is pinned)
+            self.scheduler.allocator.reset_prefix()
             obs.inc("serve_swaps_total",
                     help="checkpoint hot-swaps completed")
             obs.record_serve(
@@ -755,6 +1174,27 @@ class ServeEngine:
             "swaps": self.swaps_total,
             "decode_kernel": self.decode_kernel,
         }
+        out["prefilled_tokens"] = self.prefill_tokens_total
+        if self.prefill_chunk:
+            out["prefill_chunk"] = self.prefill_chunk
+            out["max_prefill_tokens_step"] = self.max_prefill_tokens_step
+            out["prefill_token_cap"] = (
+                self.scheduler.prefill_budget(self.prefill_chunk)
+                if self.prefill_token_cap else 0)
+        alloc = self.scheduler.allocator
+        if alloc.prefix_enabled:
+            hit, computed = alloc.prefix_hit_tokens, \
+                self.prefill_tokens_total
+            out.update({
+                "prefix_hits": alloc.prefix_hits,
+                "prefix_misses": alloc.prefix_misses,
+                "prefix_hit_tokens": hit,
+                # fraction of prompt tokens served from the pool
+                "prefix_hit_rate": round(hit / (hit + computed), 4)
+                if hit + computed else 0.0,
+                "prefix_pool_pages": alloc.prefix_pages,
+                "prefix_evictions": alloc.prefix_evictions,
+            })
         if out["sustained_gen_tok_s"] is not None:
             obs.gauge_set("serve_gen_tokens_per_s",
                           out["sustained_gen_tok_s"],

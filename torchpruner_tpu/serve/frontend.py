@@ -171,6 +171,16 @@ def _http_server(engine, port: int, request_timeout_s: float):
                     "admits": sched.admitted_total,
                     "evictions": alloc.total_evictions,
                 }
+                if alloc.prefix_enabled:
+                    stats["prefix"] = {
+                        "hits": alloc.prefix_hits,
+                        "misses": alloc.prefix_misses,
+                        "hit_tokens": alloc.prefix_hit_tokens,
+                        "pool_pages": alloc.prefix_pages,
+                        "pool_used": alloc.prefix_pool_used,
+                        "shared_pages": alloc.shared_pages,
+                        "evictions": alloc.prefix_evictions,
+                    }
                 if engine.slo is not None:
                     stats["slo"] = engine.slo.snapshot()
                 self._json(200, stats)
@@ -287,6 +297,21 @@ def serve_main(argv=None) -> int:
                         "(the serving config)")
     p.add_argument("--page-len", type=int, default=0,
                    help="KV page size (0 = lane-aligned default)")
+    p.add_argument("--prefix-pages", type=int, default=0,
+                   help="Serve v2: device pages reserved for the shared-"
+                        "prefix pool (radix-trie prefix cache; 0 = "
+                        "sharing off).  Prompts matching a published "
+                        "prefix copy whole pages instead of re-running "
+                        "prefill.")
+    p.add_argument("--prefill-chunk", type=int, default=0,
+                   help="Serve v2: chunked-prefill width in tokens (must "
+                        "divide max-len and page-len; 0 = legacy whole-"
+                        "bucket prefill, or an auto gcd pick when "
+                        "--prefix-pages is on)")
+    p.add_argument("--prefill-cap", type=int, default=0,
+                   help="Serve v2: per-engine-step prefill-token budget "
+                        "(floored at one chunk) so long prompts can't "
+                        "starve resident decodes; 0 = uncapped")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--run-dir", metavar="DIR",
                    help="where the SIGTERM drain snapshots the queue")
@@ -332,7 +357,21 @@ def serve_main(argv=None) -> int:
     p.add_argument("--stagger-steps", type=int, default=2,
                    help="synthetic: steps between deterministic arrivals")
     p.add_argument("--prompt-lens", default="4,8,6",
-                   help="synthetic: comma list of prompt lengths (cycled)")
+                   help="synthetic: comma list of prompt lengths (cycled)"
+                        "; with --shared-prefixes these are the SUFFIX "
+                        "lengths after the shared prefix")
+    p.add_argument("--shared-prefixes", type=int, default=0, metavar="K",
+                   help="synthetic: draw prompts from a pool of K shared "
+                        "system prompts (round-robin) + random suffixes "
+                        "— the prefix-heavy workload; 0 = fully random "
+                        "prompts")
+    p.add_argument("--prefix-len", type=int, default=32,
+                   help="synthetic: shared system-prompt length in "
+                        "tokens (with --shared-prefixes)")
+    p.add_argument("--sessions", type=int, default=0,
+                   help="synthetic: tag requests with round-robin "
+                        "session ids (with --shared-prefixes) — the "
+                        "fleet router's session-affinity signal")
     p.add_argument("--max-new", default="8,5,12",
                    help="synthetic: comma list of generation budgets")
     p.add_argument("--temperature", type=float, default=0.0,
@@ -402,6 +441,8 @@ def serve_main(argv=None) -> int:
         cache_dtype=(jnp.bfloat16 if args.kv_dtype == "bfloat16"
                      else jnp.float32),
         page_len=args.page_len, run_dir=args.run_dir,
+        prefix_pages=args.prefix_pages, prefill_chunk=args.prefill_chunk,
+        prefill_token_cap=args.prefill_cap,
         checkpoint_meta=meta, queue_bound=args.queue_bound,
         # a long-running HTTP server must not accumulate completed
         # requests (each pins its prompt/tokens and, across a swap, the
@@ -445,6 +486,7 @@ def _run_synthetic(engine, pre, args, model, params) -> int:
     from torchpruner_tpu.serve.traffic import (
         OpenLoopTraffic,
         poisson_arrivals,
+        shared_prefix_requests,
         staggered_arrivals,
         synthetic_requests,
     )
@@ -455,9 +497,16 @@ def _run_synthetic(engine, pre, args, model, params) -> int:
     vocab = vocab_of(model)
     prompt_lens = [int(x) for x in args.prompt_lens.split(",") if x]
     max_new = [int(x) for x in args.max_new.split(",") if x]
-    reqs = synthetic_requests(
-        n, vocab=vocab, prompt_lens=prompt_lens, max_new=max_new,
-        seed=args.seed, temperature=args.temperature)
+    if args.shared_prefixes > 0:
+        reqs = shared_prefix_requests(
+            n, vocab=vocab, n_prefixes=args.shared_prefixes,
+            prefix_len=args.prefix_len, suffix_lens=prompt_lens,
+            max_new=max_new, seed=args.seed, sessions=args.sessions,
+            temperature=args.temperature)
+    else:
+        reqs = synthetic_requests(
+            n, vocab=vocab, prompt_lens=prompt_lens, max_new=max_new,
+            seed=args.seed, temperature=args.temperature)
     if args.rate > 0:
         traffic = OpenLoopTraffic(
             reqs, poisson_arrivals(n, args.rate, seed=args.seed))

@@ -73,6 +73,12 @@ class Request:
     #: router's on one cross-process waterfall; None = untraced
     trace_id: Optional[str] = None
 
+    #: caller-asserted session key — the fleet router's strongest
+    #: prefix-affinity signal (requests of one session share a growing
+    #: prompt prefix, so landing them on one replica compounds its
+    #: prefix-cache hits); None = route by prompt-page hash / load only
+    session_id: Optional[str] = None
+
     # -- engine-owned runtime state ------------------------------------
     state: str = QUEUED
     slot: Optional[int] = None
@@ -86,6 +92,12 @@ class Request:
     done_s: Optional[float] = None
     #: wall-clock gaps between successive tokens (len == tokens - 1)
     token_gaps_s: List[float] = field(default_factory=list)
+    #: prompt tokens served by mapping shared prefix pages (0 = miss
+    #: or sharing off) / actually computed by prefill programs —
+    #: stamped by the engine; hit + prefilled == prompt_len on the
+    #: chunked path
+    prefix_hit_tokens: int = 0
+    prefilled_tokens: int = 0
     #: the program set (checkpoint) that decoded this request — stamped
     #: at prefill so verification replays against the RIGHT weights
     #: even when a hot-swap landed mid-run
@@ -130,6 +142,7 @@ class Request:
             "prompt_len": int(self.prompt_ids.size),
             "ttft_s": self.ttft_s,
             "token_gaps_s": list(self.token_gaps_s),
+            "prefix_hit_tokens": int(self.prefix_hit_tokens),
         }
 
     def snapshot(self) -> dict:
@@ -139,6 +152,7 @@ class Request:
             "prompt_ids": self.prompt_ids.tolist(),
             "max_new": int(self.max_new),
             "eos_id": self.eos_id,
+            "session_id": self.session_id,
             "sampling": {
                 "temperature": self.sampling.temperature,
                 "top_k": self.sampling.top_k,
@@ -151,6 +165,7 @@ class Request:
     def from_snapshot(cls, d: dict) -> "Request":
         return cls(prompt_ids=np.asarray(d["prompt_ids"], np.int32),
                    max_new=int(d["max_new"]), eos_id=d.get("eos_id"),
+                   session_id=d.get("session_id"),
                    sampling=Sampling(**(d.get("sampling") or {})))
 
 
@@ -167,6 +182,7 @@ def request_from_dict(d: dict) -> Request:
         # the router injects the fleet trace id at dispatch; absent on
         # direct/journal submissions (untraced)
         trace_id=d.get("trace_id"),
+        session_id=d.get("session_id"),
         sampling=Sampling(
             temperature=float(d.get("temperature", 0.0)),
             top_k=d.get("top_k"), top_p=d.get("top_p"),

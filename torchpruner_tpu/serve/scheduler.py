@@ -48,9 +48,14 @@ class Scheduler:
     as its per-replica backpressure signal."""
 
     def __init__(self, allocator: KVCacheAllocator,
-                 queue_bound: int = 0):
+                 queue_bound: int = 0, prefill_token_cap: int = 0):
         self.allocator = allocator
         self.queue_bound = int(queue_bound)
+        #: per-engine-step prefill-token budget (chunked prefill): a
+        #: long prompt spends at most this much prefill work per step,
+        #: so decode cadence for resident requests is bounded below.
+        #: 0 = uncapped.
+        self.prefill_token_cap = int(prefill_token_cap)
         self._queue: Deque[Request] = deque()
         self._lock = threading.Lock()
         #: recent queue-age-at-admission samples (seconds) — the LIVE
@@ -188,6 +193,15 @@ class Scheduler:
         request._event.set()
         self._gauges()
 
+    def prefill_budget(self, chunk: int) -> int:
+        """The step's prefill-token budget, floored at one chunk —
+        a cap below the chunk width would deadlock the prefill, so the
+        floor IS the enforced cap (engine.max_prefill_tokens_step is
+        gated against this value, not the raw knob)."""
+        if self.prefill_token_cap <= 0:
+            return 1 << 30
+        return max(self.prefill_token_cap, int(chunk))
+
     def queue_wait_ms(self) -> Dict[str, float]:
         """Live queue-age percentiles over the recent-admissions window
         (ms) — empty dict before the first admission.  Thread-safe
@@ -228,3 +242,14 @@ class Scheduler:
                       alloc.active_slots / max(1, alloc.n_slots),
                       help="active decode slots / slot-array width "
                            "(0..1)")
+        if alloc.prefix_enabled:
+            # emitted ONLY with sharing on, so sharing-off runs (and
+            # their committed goldens) carry no serve_prefix_*/shared
+            # scalars at all
+            obs.gauge_set("serve_kv_pages_shared", alloc.shared_pages,
+                          help="prefix-pool pages pinned by at least "
+                               "one resident request")
+            obs.gauge_set("serve_prefix_pool_used",
+                          alloc.prefix_pool_used,
+                          help="prefix-pool pages holding published "
+                               "K/V (out of prefix_pages)")

@@ -64,6 +64,38 @@ def synthetic_requests(n: int, *, vocab: int, prompt_lens: Sequence[int],
     return out
 
 
+def shared_prefix_requests(n: int, *, vocab: int, n_prefixes: int,
+                           prefix_len: int, suffix_lens: Sequence[int],
+                           max_new: Sequence[int], seed: int = 0,
+                           sessions: int = 0,
+                           temperature: float = 0.0,
+                           eos_id: Optional[int] = None
+                           ) -> List[Request]:
+    """The prefix-heavy workload every serving PR is judged on: a
+    seeded pool of ``n_prefixes`` shared "system prompts" of
+    ``prefix_len`` tokens, assigned round-robin (so reuse is
+    deterministic, not a sampling accident), each followed by a
+    per-request random suffix (``suffix_lens`` cycled).  With
+    ``sessions > 0`` requests also carry round-robin session ids —
+    the fleet router's session-affinity signal.  Same determinism
+    contract as :func:`synthetic_requests`: one rng seeded by ``seed``,
+    per-request sampling seeds ``seed + i``, replay-identical."""
+    rng = np.random.default_rng(seed)
+    prefixes = [rng.integers(0, vocab, size=int(prefix_len))
+                .astype(np.int32) for _ in range(max(1, n_prefixes))]
+    out = []
+    for i in range(n):
+        prefix = prefixes[i % len(prefixes)]
+        slen = int(suffix_lens[i % len(suffix_lens)])
+        suffix = rng.integers(0, vocab, size=slen).astype(np.int32)
+        out.append(Request(
+            prompt_ids=np.concatenate([prefix, suffix]),
+            max_new=int(max_new[i % len(max_new)]), eos_id=eos_id,
+            session_id=(f"session-{i % sessions}" if sessions else None),
+            sampling=Sampling(temperature=temperature, seed=seed + i)))
+    return out
+
+
 class OpenLoopTraffic:
     """Feeds requests into an engine on an open-loop schedule.
 
