@@ -1135,10 +1135,8 @@ def _leg_serve(smoke: bool, progress=None) -> dict:
     from torchpruner_tpu.core.segment import init_model
     from torchpruner_tpu.models import llama_tiny, mfu_llama
     from torchpruner_tpu.serve import (
-        OpenLoopTraffic,
         ServeEngine,
-        poisson_arrivals,
-        staggered_arrivals,
+        open_loop,
         synthetic_requests,
         vocab_of,
     )
@@ -1165,8 +1163,7 @@ def _leg_serve(smoke: bool, progress=None) -> dict:
                               prompt_lens=prompt_lens, max_new=max_new,
                               seed=0)
     t0 = time.perf_counter()
-    eng.run(OpenLoopTraffic(warm, staggered_arrivals(warm_n, 1),
-                            by_step=True))
+    eng.run(open_loop(warm, stagger_steps=1))
     warm_s = time.perf_counter() - t0
     # capacity from a SECOND warm pass (same shapes, zero compiles) —
     # the first pass's wall is dominated by the compile bill
@@ -1174,8 +1171,7 @@ def _leg_serve(smoke: bool, progress=None) -> dict:
                              prompt_lens=prompt_lens, max_new=max_new,
                              seed=3)
     t0 = time.perf_counter()
-    eng.run(OpenLoopTraffic(cal, staggered_arrivals(warm_n, 1),
-                            by_step=True))
+    eng.run(open_loop(cal, stagger_steps=1))
     capacity = sum(len(r.tokens) for r in cal) \
         / max(time.perf_counter() - t0, 1e-9)
     result = {
@@ -1194,8 +1190,7 @@ def _leg_serve(smoke: bool, progress=None) -> dict:
                                   max_new=max_new, seed=7)
     steps0 = eng.steps
     with _kernel_window(result) as win:
-        eng.run(OpenLoopTraffic(cap_reqs, staggered_arrivals(slots, 1),
-                                by_step=True))
+        eng.run(open_loop(cap_reqs, stagger_steps=1))
         win.steps = max(1, eng.steps - steps0)
     if progress is not None:
         progress(dict(result))
@@ -1235,7 +1230,7 @@ def _leg_serve(smoke: bool, progress=None) -> dict:
             ts_dir = ts_rec = None
     t0 = time.perf_counter()
     try:
-        eng.run(OpenLoopTraffic(reqs, poisson_arrivals(n, rate, seed=2)))
+        eng.run(open_loop(reqs, rate=rate, seed=2))
     finally:
         if ts_rec is not None:
             _sess.timeseries = old_rec
@@ -1316,10 +1311,9 @@ def _leg_serve_prefix(smoke: bool, progress=None) -> dict:
     from torchpruner_tpu.core.segment import init_model
     from torchpruner_tpu.models import llama_tiny, mfu_llama
     from torchpruner_tpu.serve import (
-        OpenLoopTraffic,
         ServeEngine,
+        open_loop,
         shared_prefix_requests,
-        staggered_arrivals,
         vocab_of,
     )
 
@@ -1382,8 +1376,7 @@ def _leg_serve_prefix(smoke: bool, progress=None) -> dict:
                 ts_dir = ts_rec = None
         t0 = time.perf_counter()
         try:
-            eng.run(OpenLoopTraffic(reqs, staggered_arrivals(n, 2),
-                                    by_step=True))
+            eng.run(open_loop(reqs, stagger_steps=2))
         finally:
             if ts_rec is not None:
                 _sess.timeseries = old_rec

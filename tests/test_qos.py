@@ -1,0 +1,441 @@
+"""Isolation tests for the multi-tenant QoS plane, the scenario
+workload library and the autoscaling supervisor's decision core
+(PR 19).  Everything here is host-side and clock-injected — no model,
+no subprocesses — so the properties the fleet chaos drill asserts
+end-to-end (strict step-boundary preemption, quota sheds, no-flap
+hysteresis, digest-pinned replay) are each pinned in isolation first.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from torchpruner_tpu import obs
+from torchpruner_tpu.fleet.supervisor import RUNGS, ScalePolicy, Supervisor
+from torchpruner_tpu.fleet.workload import (
+    build_schedule,
+    schedule_digest,
+    validate_scenario,
+    verify_schedule,
+)
+from torchpruner_tpu.serve.allocator import KVCacheAllocator
+from torchpruner_tpu.serve.qos import (
+    BATCH,
+    INTERACTIVE,
+    QoS,
+    TenantPolicy,
+    TokenBucket,
+)
+from torchpruner_tpu.serve.request import ACTIVE, QUEUED, SHED, Request
+from torchpruner_tpu.serve.scheduler import Scheduler
+
+
+def _req(tenant=None, prompt_len=8, max_new=8, rid=None):
+    ids = np.arange(prompt_len, dtype=np.int32) % 7
+    r = Request(prompt_ids=ids, max_new=max_new, tenant=tenant)
+    if rid is not None:
+        r.id = rid
+    return r
+
+
+# -- token bucket ------------------------------------------------------------
+
+def test_token_bucket_burst_then_throttle():
+    """A fresh bucket holds ``burst`` tokens; the burst+1'th take at the
+    same instant is throttled."""
+    b = TokenBucket(rate=2.0, burst=4.0, now=0.0)
+    assert [b.take(now=0.0) for _ in range(5)] == [True] * 4 + [False]
+    # one token costs 1/rate seconds from empty
+    assert b.retry_after_s(now=0.0) == pytest.approx(0.5)
+
+
+def test_token_bucket_refill_math():
+    b = TokenBucket(rate=2.0, burst=4.0, now=0.0)
+    for _ in range(4):
+        assert b.take(now=0.0)
+    # 1 s at 2 tokens/s refills exactly 2 tokens — and never beyond
+    assert b.take(now=1.0) and b.take(now=1.0) and not b.take(now=1.0)
+    assert b.level == pytest.approx(0.0)
+    b2 = TokenBucket(rate=2.0, burst=4.0, now=0.0)
+    b2.take(now=100.0)  # a long idle period can't overfill the bucket
+    assert b2.level == pytest.approx(3.0)
+
+
+def test_token_bucket_zero_rate_unlimited():
+    b = TokenBucket(rate=0.0, burst=1.0, now=0.0)
+    assert all(b.take(now=0.0) for _ in range(100))
+    assert b.retry_after_s(now=0.0) == 0.0
+
+
+# -- tenant policy parsing ---------------------------------------------------
+
+def test_tenant_policy_from_dict():
+    p = TenantPolicy.from_dict("bulk", {"priority": "batch", "rate": 5,
+                                        "burst": 10, "page_quota": 8})
+    assert p.priority == BATCH
+    assert p.preemptible  # batch defaults preemptible
+    q = TenantPolicy.from_dict("chat", {"priority": "interactive"})
+    assert q.priority == INTERACTIVE and not q.preemptible
+    # explicit preemptible overrides the class default
+    r = TenantPolicy.from_dict("bulk", {"priority": "batch",
+                                        "preemptible": False})
+    assert not r.preemptible
+
+
+def test_tenant_policy_rejects_junk():
+    with pytest.raises(ValueError, match="unknown tenant policy key"):
+        TenantPolicy.from_dict("chat", {"prio": 0})
+    with pytest.raises(ValueError, match="unknown priority class"):
+        TenantPolicy.from_dict("chat", {"priority": "platinum"})
+    with pytest.raises(ValueError, match="must match"):
+        TenantPolicy.from_dict("Bad-Name", {})
+
+
+# -- scheduler: priority admission + preemption ------------------------------
+
+def _qos():
+    return QoS.from_dict({
+        "chat": {"priority": "interactive"},
+        "bulk": {"priority": "batch"},
+    }, now=0.0)
+
+
+def test_priority_class_admission_order():
+    """With both classes queued, interactive is admitted first even
+    though batch was submitted first."""
+    alloc = KVCacheAllocator(n_slots=1, max_len=64, page_len=16)
+    sched = Scheduler(alloc, qos=_qos())
+    bulk = sched.submit(_req("bulk"))
+    chat = sched.submit(_req("chat"))
+    admitted = sched.admit()
+    assert admitted == [chat]
+    assert chat.state == ACTIVE and bulk.state == QUEUED
+
+
+def test_preemption_youngest_lower_class_victim():
+    """An interactive head blocked on capacity evicts the YOUNGEST
+    active batch request — slot + pages released, progress fully reset,
+    victim re-queued at the FRONT of its class."""
+    alloc = KVCacheAllocator(n_slots=2, max_len=64, page_len=16)
+    sched = Scheduler(alloc, qos=_qos())
+    b1, b2 = sched.submit(_req("bulk")), sched.submit(_req("bulk"))
+    assert sched.admit() == [b1, b2]
+    b1.admitted_s, b2.admitted_s = 1.0, 2.0  # pin admission order
+    b2.tokens.extend([3, 4])                 # simulate decode progress
+    chat = sched.submit(_req("chat"))
+    admitted = sched.admit()
+    assert admitted == [chat] and chat.state == ACTIVE
+    # the younger batch request was the victim; the older kept its slot
+    assert b2.state == QUEUED and b2.slot is None
+    assert b1.state == ACTIVE and b1.slot is not None
+    assert b2.preemptions == 1 and sched.preempted_total == 1
+    assert b2.tokens == [] and b2.first_token_s is None
+    assert sched._queues[BATCH][0] is b2  # front of its class queue
+    # capacity restored -> the victim re-admits and restarts cleanly
+    chat.state = ACTIVE  # still holding its slot
+    sched.evict(b1)
+    assert sched.admit() == [b2] and b2.state == ACTIVE
+
+
+def test_preempt_guard_vetoes_mid_prefill_slots():
+    """The engine's guard (slot mid-chunked-prefill) vetoes preemption:
+    admission waits rather than perturbing the compiled step."""
+    alloc = KVCacheAllocator(n_slots=1, max_len=64, page_len=16)
+    sched = Scheduler(alloc, qos=_qos())
+    bulk = sched.submit(_req("bulk"))
+    assert sched.admit() == [bulk]
+    sched.preempt_guard = lambda slot: False
+    chat = sched.submit(_req("chat"))
+    assert sched.admit() == []
+    assert chat.state == QUEUED and bulk.state == ACTIVE
+    assert sched.preempted_total == 0
+    sched.preempt_guard = None  # boundary reached: now it may evict
+    assert sched.admit() == [chat] and bulk.state == QUEUED
+
+
+def test_interactive_never_preempted_by_batch():
+    """Preemption is strictly one-way: a batch head never evicts an
+    active interactive request (equal/higher classes are immune)."""
+    alloc = KVCacheAllocator(n_slots=1, max_len=64, page_len=16)
+    sched = Scheduler(alloc, qos=_qos())
+    chat = sched.submit(_req("chat"))
+    assert sched.admit() == [chat]
+    bulk = sched.submit(_req("bulk"))
+    assert sched.admit() == []
+    assert chat.state == ACTIVE and bulk.state == QUEUED
+    chat2 = sched.submit(_req("chat"))  # same class: also immune
+    assert sched.admit() == []
+    assert chat.state == ACTIVE and chat2.state == QUEUED
+
+
+def test_page_quota_shed(tmp_path):
+    """A head whose footprint would push its tenant past page_quota is
+    SHED with the quota reason (not left blocking the queue); other
+    tenants are untouched."""
+    obs.configure(str(tmp_path / "obs"))
+    try:
+        qos = QoS.from_dict({
+            "chat": {"priority": "interactive"},
+            "bulk": {"priority": "batch", "page_quota": 4},
+        }, now=0.0)
+        alloc = KVCacheAllocator(n_slots=4, max_len=64, page_len=16)
+        sched = Scheduler(alloc, qos=qos)
+        b1 = sched.submit(_req("bulk", prompt_len=32, max_new=32))  # 4 pg
+        b2 = sched.submit(_req("bulk", prompt_len=32, max_new=32))  # over
+        chat = sched.submit(_req("chat", prompt_len=32, max_new=32))
+        admitted = sched.admit()
+        assert admitted == [chat, b1]  # interactive class served first
+        assert b2.state == SHED
+        assert alloc.tenant_pages("bulk") == 4
+        assert obs.counter_value("serve_rejected_quota_total") == 1
+        assert obs.counter_value("tenant_bulk_shed_total") == 1
+        assert obs.counter_value("tenant_bulk_shed_quota_total") == 1
+        # release frees quota: the tenant can admit again afterwards
+        sched.evict(b1)
+        b3 = sched.submit(_req("bulk", prompt_len=32, max_new=32))
+        assert sched.admit() == [b3]
+    finally:
+        obs.shutdown()
+
+
+def test_token_bucket_throttle_shed(tmp_path):
+    """Submissions over a tenant's token bucket are shed at submit time
+    with the throttle reason; an untenanted request never throttles."""
+    obs.configure(str(tmp_path / "obs"))
+    try:
+        qos = QoS.from_dict(
+            {"bulk": {"priority": "batch", "rate": 1.0, "burst": 2}},
+            now=0.0)
+        alloc = KVCacheAllocator(n_slots=2, max_len=64, page_len=16)
+        sched = Scheduler(alloc, qos=qos)
+        outcomes = [sched.submit(_req("bulk")).state for _ in range(3)]
+        assert outcomes == [QUEUED, QUEUED, SHED]
+        assert sched.submit(_req(None)).state == QUEUED
+        assert obs.counter_value("serve_rejected_throttle_total") == 1
+        assert obs.counter_value("tenant_bulk_shed_throttle_total") == 1
+    finally:
+        obs.shutdown()
+
+
+# -- supervisor hysteresis ---------------------------------------------------
+
+def _sig(age=0.0, pending=0, replicas=1, breach=0.0, retiring=0,
+         rung="none"):
+    return {"queue_age_s": age, "pending": pending, "replicas": replicas,
+            "live": replicas, "breach_frac": breach, "retiring": retiring,
+            "rung": rung}
+
+
+def _sup(**kw):
+    knobs = dict(min_replicas=1, max_replicas=2, queue_age_up_s=1.0,
+                 queue_age_down_s=0.1, up_ticks=3, down_ticks=4,
+                 cooldown_s=10.0, degrade_ticks=4)
+    knobs.update(kw)
+    pol = ScalePolicy(**knobs)
+    t = {"now": 0.0}
+    sup = Supervisor(router=None, policy=pol, now=lambda: t["now"])
+    return sup, t
+
+
+def test_supervisor_flapping_signal_never_acts():
+    """Alternating hot/quiet samples reset the consecutive-tick
+    counters: a noisy signal yields NO action, ever."""
+    sup, t = _sup()
+    for i in range(40):
+        t["now"] = float(i)
+        sig = _sig(age=5.0) if i % 2 else _sig(age=0.0, pending=3)
+        assert sup.evaluate(sig, now=t["now"]) is None
+
+
+def test_supervisor_scale_up_after_consecutive_ticks_and_cooldown():
+    sup, t = _sup()
+    assert sup.evaluate(_sig(age=5.0), now=0.0) is None
+    assert sup.evaluate(_sig(age=5.0), now=1.0) is None
+    assert sup.evaluate(_sig(age=5.0), now=2.0) == "scale_up"
+    # tick() would reset + stamp; emulate the actuation bookkeeping
+    sup._last_action_t, sup._up = 2.0, 0
+    # still hot, but inside the cooldown window: no second decision
+    for now in (3.0, 5.0, 8.0, 11.0):
+        assert sup.evaluate(_sig(age=5.0, replicas=2), now=now) is None
+
+
+def test_supervisor_breach_fraction_also_scales_up():
+    sup, _ = _sup()
+    for now in (0.0, 1.0):
+        assert sup.evaluate(_sig(breach=0.6), now=now) is None
+    assert sup.evaluate(_sig(breach=0.6), now=2.0) == "scale_up"
+
+
+def test_supervisor_degrade_only_at_max_replicas():
+    """At max_replicas a sustained hot signal climbs the ladder instead
+    of scaling; retiring replicas don't count toward capacity."""
+    sup, _ = _sup()
+    at_max = _sig(age=5.0, replicas=2)
+    assert sup.evaluate(at_max, now=0.0) is None
+    assert sup.evaluate(at_max, now=1.0) is None
+    # up_ticks (3) satisfied but degrade_ticks (4) also needed at max
+    assert sup.evaluate(at_max, now=2.0) is None
+    assert sup.evaluate(at_max, now=3.0) == "degrade"
+    # a retiring replica means NOT at max -> scale_up instead
+    sup2, _ = _sup()
+    not_max = _sig(age=5.0, replicas=2, retiring=1)
+    for i in range(2):
+        assert sup2.evaluate(not_max, now=float(i)) is None
+    assert sup2.evaluate(not_max, now=2.0) == "scale_up"
+
+
+def test_supervisor_recover_precedes_scale_down():
+    """A quiet fleet first unwinds the degradation ladder, then (rung
+    0, above min_replicas) releases a replica; at min it holds."""
+    sup, _ = _sup()
+    quiet = _sig(age=0.0, pending=0, replicas=2)
+    sup.rung = 1
+    for i in range(3):
+        assert sup.evaluate(quiet, now=float(i)) is None
+    assert sup.evaluate(quiet, now=3.0) == "recover"
+    sup.rung = 0
+    assert sup.evaluate(quiet, now=4.0) == "scale_down"  # counter held
+    assert sup.evaluate(_sig(age=0.0, replicas=1), now=5.0) is None
+    # pending work blocks the quiet path even with a young queue head
+    sup2, _ = _sup()
+    for i in range(20):
+        assert sup2.evaluate(_sig(age=0.0, pending=1, replicas=2),
+                             now=float(i)) is None
+
+
+def test_supervisor_ladder_rungs_are_ordered():
+    assert RUNGS == ("none", "shed_batch", "tighten_admission",
+                     "pruned_swap")
+
+
+# -- workload scenarios ------------------------------------------------------
+
+def _spec(**over):
+    spec = {
+        "version": 1,
+        "name": "unit",
+        "seed": 7,
+        "vocab": 64,
+        "tenants": {
+            "chat": {"priority": "interactive"},
+            "bulk": {"priority": "batch", "rate": 4.0, "burst": 8,
+                     "page_quota": 8},
+        },
+        "classes": {
+            "short": {"tenant": "chat", "prompt_lens": [4, 6, 12],
+                      "max_new": [4, 8], "sessions": 3},
+            "long": {"tenant": "bulk", "prompt_lens": [24],
+                     "max_new": [16]},
+        },
+        "phases": [
+            {"name": "warm", "duration_s": 2.0, "rate": 3.0,
+             "mix": {"short": 0.7, "long": 0.3}},
+            {"name": "crowd", "duration_s": 1.0, "rate": [6.0, 30.0],
+             "mix": {"short": 1.0}},
+        ],
+        "retry": {"max_attempts": 3, "base_delay_s": 0.01,
+                  "max_delay_s": 0.1, "hedge_after_s": 0.5},
+    }
+    spec.update(over)
+    return spec
+
+
+def test_build_schedule_deterministic_and_digest_stable():
+    s1, s2 = build_schedule(_spec()), build_schedule(_spec())
+    assert [(r.t, r.cls, r.payload) for r in s1] \
+        == [(r.t, r.cls, r.payload) for r in s2]
+    assert schedule_digest(s1) == schedule_digest(s2)
+    assert schedule_digest(build_schedule(_spec(seed=8))) \
+        != schedule_digest(s1)
+    # arrivals are sorted and stay inside the total scenario span
+    ts = [r.t for r in s1]
+    assert ts == sorted(ts) and 0.0 < ts[-1] < 3.0
+    # payloads carry the class's tenant and round-robin session ids
+    shorts = [r for r in s1 if r.cls == "short"]
+    assert all(r.payload["tenant"] == "chat" for r in shorts)
+    assert {r.payload["session_id"] for r in shorts} \
+        <= {"short-s0", "short-s1", "short-s2"}
+    longs = [r for r in s1 if r.cls == "long"]
+    assert all(len(r.payload["prompt_ids"]) == 24 for r in longs)
+    # seeds are unique per arrival (spec seed + planned index)
+    seeds = [r.payload["seed"] for r in s1]
+    assert len(set(seeds)) == len(seeds)
+
+
+def test_verify_schedule_digest_mismatch_raises():
+    spec = _spec()
+    sched = build_schedule(spec)
+    digest = verify_schedule(spec, sched)  # no committed digest: passes
+    spec["digest"] = digest
+    assert verify_schedule(spec, sched) == digest
+    spec["digest"] = "0" * 64
+    with pytest.raises(ValueError, match="digest"):
+        verify_schedule(spec, sched)
+
+
+def test_validate_scenario_rejects_junk():
+    with pytest.raises(ValueError, match="unknown scenario key"):
+        validate_scenario(_spec(extra=1))
+    with pytest.raises(ValueError, match="unknown key"):
+        validate_scenario(_spec(classes={
+            "short": {"prompt_lens": [4], "max_new": [4], "burst": 2}}))
+    with pytest.raises(ValueError, match="unknown tenant"):
+        spec = _spec()
+        spec["classes"]["short"]["tenant"] = "ghost"
+        validate_scenario(spec)
+    with pytest.raises(ValueError, match="unknown class"):
+        spec = _spec()
+        spec["phases"][0]["mix"] = {"ghost": 1.0}
+        validate_scenario(spec)
+    with pytest.raises(ValueError, match="version"):
+        validate_scenario(_spec(version=2))
+
+
+def test_committed_scenarios_replay_bit_equal():
+    """Every committed scenario's schedule must rebuild to its pinned
+    digest — the cross-PR apples-to-apples guarantee."""
+    import glob
+    import os
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = sorted(glob.glob(os.path.join(here, "results", "scenarios",
+                                          "*.json")))
+    assert paths, "no committed scenarios found"
+    from torchpruner_tpu.fleet.workload import load_scenario
+    for path in paths:
+        spec = load_scenario(path)
+        assert spec.get("digest"), f"{path}: digest not committed"
+        verify_schedule(spec, build_schedule(spec))
+
+
+# -- plane queue age (the scale-up signal) -----------------------------------
+
+def test_plane_oldest_pending_age(tmp_path):
+    from torchpruner_tpu.fleet.plane import RequestPlane
+    plane = RequestPlane(str(tmp_path / "journal.jsonl"))
+    assert plane.oldest_pending_age_s() == 0.0
+    rec = plane.accept({"prompt_ids": [1, 2], "max_new": 2},
+                       deadline_s=60.0)
+    age = plane.oldest_pending_age_s()
+    assert 0.0 <= age < 5.0
+    # dispatching the only pending record zeroes the signal
+    got = plane.checkout()
+    assert got is not None and got.rid == rec.rid
+    assert plane.oldest_pending_age_s() == 0.0
+
+
+# -- open-loop selector (shared by serve --synthetic / bench / replay) -------
+
+def test_open_loop_selector_modes():
+    from torchpruner_tpu.serve.traffic import open_loop, synthetic_requests
+    reqs = synthetic_requests(4, vocab=64, prompt_lens=[4], max_new=[4])
+    det = open_loop(reqs, rate=0.0, stagger_steps=2)
+    assert det.by_step
+    assert [t for t, _ in det._pending] == [0.0, 2.0, 4.0, 6.0]
+    poisson = open_loop(reqs, rate=100.0, seed=3)
+    assert not poisson.by_step
+    arrivals = [t for t, _ in poisson._pending]
+    assert arrivals == sorted(arrivals)
+    # wall-clock schedules are seeded-deterministic too
+    again = open_loop(reqs, rate=100.0, seed=3)
+    assert arrivals == [t for t, _ in again._pending]

@@ -23,9 +23,18 @@ scheduler and composes the existing subsystems into a plane where a
   SLO-tightened, 429/503 + Retry-After), rolling checkpoint hot-swap.
 - :mod:`~torchpruner_tpu.fleet.report` — every replica's obs shard
   merged into ONE fleet-wide report (PR 5 aggregation).
+- :mod:`~torchpruner_tpu.fleet.workload` — deterministic scenario
+  library: committed JSON specs (diurnal ramps, flash crowds,
+  heavy-tail length mixes, session reuse) compiled to a digest-pinned
+  schedule and replayed open-loop with Retry-After-honoring hedged
+  retries, so every serving PR is judged on the same traffic.
+- :class:`~torchpruner_tpu.fleet.supervisor.Supervisor` — SLO-driven
+  autoscaling (cost-model capacity prediction before launch, ledgered
+  decisions before effects, drain-then-remove scale-down, graceful
+  degradation ladder down to a pruned-checkpoint rolling swap).
 - ``python -m torchpruner_tpu fleet <preset>``
   (:mod:`~torchpruner_tpu.fleet.frontend`) — the endpoint and the
-  kill-9 failover drill CI runs.
+  kill-9 failover / autoscale chaos drills CI runs.
 """
 
 from torchpruner_tpu.fleet.plane import (
@@ -52,6 +61,19 @@ from torchpruner_tpu.fleet.router import (
     ReplicaView,
     RouterPolicy,
 )
+from torchpruner_tpu.fleet.supervisor import (
+    ScalePolicy,
+    Supervisor,
+    predict_replica_capacity,
+)
+from torchpruner_tpu.fleet.workload import (
+    ScheduledRequest,
+    WorkloadReplayer,
+    build_schedule,
+    load_scenario,
+    schedule_digest,
+    verify_schedule,
+)
 
 __all__ = [
     "ACCEPTED", "DISPATCHED", "COMPLETED", "FAILED",
@@ -60,4 +82,7 @@ __all__ = [
     "ReplicaTimeout", "ReplicaBusy", "ReplicaRejected", "free_port",
     "FleetRouter", "RouterPolicy", "ReplicaView",
     "merge_replica_shards",
+    "ScalePolicy", "Supervisor", "predict_replica_capacity",
+    "ScheduledRequest", "WorkloadReplayer", "build_schedule",
+    "load_scenario", "schedule_digest", "verify_schedule",
 ]

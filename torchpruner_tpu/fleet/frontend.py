@@ -115,6 +115,10 @@ def replica_argv(preset: str, port: int, args,
         argv += ["--slo-token-p99-ms", str(args.slo_token_p99_ms)]
     if args.slo_queue_p99_ms is not None:
         argv += ["--slo-queue-p99-ms", str(args.slo_queue_p99_ms)]
+    if getattr(args, "tenants_json", None):
+        # the scenario's QoS table rides to every replica: the tenant
+        # policies are committed WITH the traffic (one contract)
+        argv += ["--tenants", args.tenants_json]
     return argv
 
 
@@ -159,6 +163,8 @@ def _payload_of(req) -> dict:
            "top_p": s.top_p, "seed": s.seed}
     if req.session_id:
         out["session_id"] = req.session_id
+    if req.tenant:
+        out["tenant"] = req.tenant
     return out
 
 
@@ -409,6 +415,256 @@ def run_drill(preset: str, args, fleet_dir: str,
     return 0
 
 
+class _ReplicaLauncher:
+    """The autoscaling supervisor's process-control half: ``launch()``
+    spawns one more replica (same preset/seed/geometry as the seed
+    fleet — the bit-identity contract survives scaling) and blocks
+    until it listens; ``retire(name)`` SIGTERM-drains it.  The shared
+    ``procs`` list keeps every process ever launched so the drill's
+    epilogue can drain/merge them all."""
+
+    def __init__(self, preset: str, args, fleet_dir: str,
+                 procs: List[ReplicaProcess]):
+        self.preset, self.args, self.fleet_dir = preset, args, fleet_dir
+        self.procs = procs
+        self._next = args.replicas
+        self._lock = threading.Lock()
+
+    def launch(self) -> ReplicaProcess:
+        with self._lock:
+            i = self._next
+            self._next += 1
+        args = self.args
+        port = free_port()
+        obs_dir = os.path.join(self.fleet_dir, "obs", f"replica{i}")
+        run_dir = os.path.join(self.fleet_dir, f"replica{i}_run")
+        env = dict(os.environ)
+        env.pop("TORCHPRUNER_CHAOS", None)
+        rep = ReplicaProcess(
+            name=f"replica{i}", port=port,
+            argv=replica_argv(self.preset, port, args, obs_dir, run_dir),
+            env=env,
+            log_path=os.path.join(self.fleet_dir, f"replica{i}.log"))
+        rep.obs_dir = obs_dir
+        rep.spawn()
+        with self._lock:
+            self.procs.append(rep)
+        if not rep.wait_listening(timeout_s=args.startup_timeout_s):
+            rep.kill9()
+            raise RuntimeError(f"{rep.name} never started listening "
+                               f"(see {rep.log_path})")
+        return rep
+
+    def retire(self, name: str) -> None:
+        with self._lock:
+            procs = list(self.procs)
+        for p in procs:
+            if p.name == name:
+                p.drain(timeout_s=self.args.startup_timeout_s)
+                return
+
+
+def run_scenario(preset: str, args, fleet_dir: str,
+                 chaos: FleetChaos) -> int:
+    """The scenario replay / autoscale chaos drill: replay a committed
+    workload scenario (digest-asserted) against the fleet with the
+    SLO-driven supervisor closing the scale loop, then assert the
+    robustness contract — zero accepted-request loss across scale-up
+    AND drain-based scale-down, every scale decision ledgered, batch
+    tier shed then resumed, interactive TTFT p99 within budget."""
+    from torchpruner_tpu import obs
+    from torchpruner_tpu.fleet.supervisor import (
+        RUNGS,
+        ScalePolicy,
+        Supervisor,
+        predict_replica_capacity,
+    )
+    from torchpruner_tpu.fleet.workload import (
+        WorkloadReplayer,
+        build_schedule,
+        load_scenario,
+        verify_schedule,
+    )
+    from torchpruner_tpu.serve.engine import vocab_of
+    from torchpruner_tpu.serve.frontend import _resolve_model
+    from torchpruner_tpu.serve.qos import TenantPolicy
+
+    spec = load_scenario(args.scenario)
+    schedule = build_schedule(spec)
+    digest = verify_schedule(spec, schedule)
+    obs.gauge_set("workload_planned_requests", len(schedule),
+                  help="scenario schedule size (committed, "
+                       "digest-pinned)")
+    model, _params, _meta = _resolve_model(
+        preset, smoke=args.smoke, seed=args.seed,
+        checkpoint=args.checkpoint)
+    if int(spec["vocab"]) > vocab_of(model):
+        raise SystemExit(
+            f"scenario vocab {spec['vocab']} exceeds the served "
+            f"model's vocab {vocab_of(model)} — the committed prompt "
+            f"ids would be out of range")
+    tenants = spec.get("tenants") or {}
+    args.tenants_json = json.dumps(tenants) if tenants else None
+    # rung 1's shed set: the scenario's preemptible batch tier
+    batch_tier = tuple(sorted(
+        name for name, cfg in tenants.items()
+        if TenantPolicy.from_dict(name, cfg).priority > 0))
+
+    procs = spawn_fleet(preset, args, fleet_dir, chaos)
+    plane = RequestPlane(os.path.join(fleet_dir, JOURNAL_FILENAME))
+    router = FleetRouter(plane, procs, policy=_policy_of(args))
+    trigger = _ChaosTrigger(chaos, procs)
+
+    sup = None
+    if args.autoscale:
+        policy = ScalePolicy(
+            min_replicas=args.replicas,
+            max_replicas=args.max_replicas,
+            queue_age_up_s=args.scale_up_age_s,
+            queue_age_down_s=args.scale_down_age_s,
+            cooldown_s=args.scale_cooldown_s,
+            drain_timeout_s=args.startup_timeout_s,
+            shed_tenants=batch_tier,
+            pruned_checkpoint=args.degrade_checkpoint,
+            restore_checkpoint=args.checkpoint)
+        # capacity prediction BEFORE any launch: what the ledger says
+        # one more replica should buy (best-effort, None on CPU-less
+        # exotic models)
+        capacity = predict_replica_capacity(
+            model, n_slots=args.slots, max_len=args.max_len)
+        launcher = _ReplicaLauncher(preset, args, fleet_dir, procs)
+        sup = Supervisor(router, policy, launcher=launcher,
+                         capacity=capacity)
+
+    replayer = WorkloadReplayer.from_spec(router, spec,
+                                          deadline_s=args.deadline_s)
+    t0 = time.monotonic()
+
+    def on_tick():
+        router.tick()
+        trigger(router)
+        if sup is not None:
+            sup.tick()
+
+    try:
+        router.check_health(force=True)
+        rsum = replayer.run(timeout_s=args.drill_timeout_s,
+                            on_tick=on_tick)
+        # settle: keep ticking until the supervisor has recovered every
+        # degradation rung and drained the surge capacity back down —
+        # the drill's "reversible" half (scale_down + recover must both
+        # land, or we time out and the asserts below fail loudly)
+        if sup is not None:
+            deadline = time.monotonic() + args.settle_timeout_s
+            while time.monotonic() < deadline:
+                on_tick()
+                s = sup.summary()
+                with router._lock:
+                    n_views = len(router.views)
+                if s["scale_downs"] >= 1 and s["rung"] == RUNGS[0] \
+                        and n_views <= args.replicas \
+                        and not sup._busy():
+                    break
+                time.sleep(0.02)
+            sup.join(timeout_s=args.settle_timeout_s)
+        tenant_table = router.tenant_summary()
+    finally:
+        router.close()
+        exit_codes = {p.name: p.drain(timeout_s=args.startup_timeout_s)
+                      for p in procs}
+    wall = time.monotonic() - t0
+
+    shards = merge_replica_shards(
+        os.path.join(fleet_dir, "obs"), [p.obs_dir for p in procs])
+    try:
+        ts_merge = merge_timeseries(
+            os.path.join(fleet_dir, "obs"), [p.obs_dir for p in procs])
+    except Exception:
+        ts_merge = {"streams": 0, "windows": 0}
+    trace_fields = _finalize_tracing(os.path.join(fleet_dir, "obs"))
+
+    records = plane.records()
+    completed = [r for r in records if r.state == COMPLETED]
+    lost = [r for r in records if r.state != COMPLETED]
+    ssum = sup.summary() if sup is not None else {}
+    summary = {
+        "mode": "scenario",
+        "scenario": rsum.scenario,
+        "digest": rsum.digest,
+        "replicas_min": args.replicas,
+        "replicas_max": args.max_replicas,
+        **{k: v for k, v in rsum.to_json().items()
+           if k not in ("scenario", "digest")},
+        "accepted": len(records),
+        "completed": len(completed),
+        "lost": len(lost),
+        "redrives": sum(r.redrives for r in records),
+        "replica_exit_codes": exit_codes,
+        "shards_merged": sum(bool(v) for v in shards.values()),
+        "ts_streams": ts_merge["streams"],
+        "ts_windows": ts_merge["windows"],
+        "tenants": tenant_table,
+        "wall_s": round(wall, 3),
+        **trace_fields,
+    }
+    if sup is not None:
+        summary["autoscale"] = ssum
+    obs.record_serve(kind="scenario_drill", **{
+        k: v for k, v in summary.items()
+        if isinstance(v, (int, float, str))})
+    print(json.dumps(summary))
+
+    failures: List[str] = []
+    if lost:
+        failures.append(
+            f"{len(lost)} accepted request(s) lost: "
+            + ", ".join(f"{r.rid}[{r.state}:{r.error}]"
+                        for r in lost[:8]))
+    # batch-tier abandonment under a degrade rung is the ladder WORKING
+    # (that tier is being shed on purpose); any other tenant abandoned
+    # means admission control turned away traffic it must not
+    hard_abandoned = {t or "(none)": n
+                      for t, n in rsum.abandoned_by_tenant.items()
+                      if t not in batch_tier}
+    if hard_abandoned:
+        failures.append(f"non-batch request(s) abandoned after "
+                        f"exhausting their hedged-retry budget: "
+                        f"{hard_abandoned}")
+    if sup is not None:
+        if ssum["scale_ups"] < 1:
+            failures.append("no scale_up decision fired")
+        if ssum["scale_downs"] < 1:
+            failures.append("no scale_down landed (surge capacity "
+                            "never drained back out)")
+        if batch_tier and ssum["degrades"] < 1:
+            failures.append("batch tier was never shed (no degrade "
+                            "rung climbed)")
+        if ssum["degrades"] and ssum["recovers"] < ssum["degrades"]:
+            failures.append("degradation rung(s) never recovered "
+                            f"(rung {ssum['rung']})")
+        if ssum["errors"]:
+            failures.append(f"supervisor errors: {ssum['errors'][:4]}")
+    if args.assert_ttft_p99_ms > 0:
+        interactive = [
+            name for name, cfg in tenants.items()
+            if TenantPolicy.from_dict(name, cfg).priority == 0]
+        for name in interactive:
+            row = tenant_table.get(name) or {}
+            p99 = row.get("ttft_p99_s")
+            if p99 is not None \
+                    and p99 * 1e3 > args.assert_ttft_p99_ms:
+                failures.append(
+                    f"tenant {name!r} TTFT p99 {p99 * 1e3:.0f} ms "
+                    f"exceeds the {args.assert_ttft_p99_ms:.0f} ms "
+                    f"budget")
+    if failures:
+        for f in failures:
+            print(f"SCENARIO DRILL FAILED: {f}", file=sys.stderr,
+                  flush=True)
+        return 1
+    return 0
+
+
 def _collect_burn_alerts(procs) -> List[dict]:
     """Every replica's ledgered ``slo_burn`` records (serve/slo.py's
     multi-window burn-rate alerts), re-recorded into the FLEET session's
@@ -641,6 +897,44 @@ def fleet_main(argv=None) -> int:
                            "accepted-request loss")
     mode.add_argument("--http", type=int, metavar="PORT",
                       help="serve the fleet HTTP endpoint")
+    mode.add_argument("--scenario", metavar="JSON",
+                      help="scenario replay drill: replay a committed "
+                           "workload scenario (results/scenarios/) "
+                           "against the fleet — digest-asserted "
+                           "deterministic traffic, per-tenant QoS from "
+                           "the spec, JSON summary, exit 1 on any "
+                           "accepted-request loss (add --autoscale for "
+                           "the supervisor chaos drill)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="scenario: run the SLO-driven autoscaling "
+                        "supervisor (scale on queue age / breach "
+                        "fraction between --replicas and "
+                        "--max-replicas, degradation ladder at max, "
+                        "every decision ledgered before its effect)")
+    p.add_argument("--max-replicas", type=int, default=4,
+                   help="autoscale: replica ceiling (past it the "
+                        "supervisor climbs the degradation ladder "
+                        "instead)")
+    p.add_argument("--scale-up-age-s", type=float, default=1.0,
+                   help="autoscale: scale up when the oldest pending "
+                        "request is older than this")
+    p.add_argument("--scale-down-age-s", type=float, default=0.1,
+                   help="autoscale: eligible to scale down only below "
+                        "this queue age (plus an empty plane)")
+    p.add_argument("--scale-cooldown-s", type=float, default=2.0,
+                   help="autoscale: quiet period after every action")
+    p.add_argument("--degrade-checkpoint", metavar="DIR",
+                   help="autoscale: degradation-ladder rung 3 — "
+                        "rolling-swap replicas to this PRUNED "
+                        "checkpoint when shedding + tightening were "
+                        "not enough (omit to skip the rung)")
+    p.add_argument("--assert-ttft-p99-ms", type=float, default=0.0,
+                   help="scenario: fail the drill when any INTERACTIVE "
+                        "tenant's TTFT p99 exceeds this budget "
+                        "(0 = no assertion)")
+    p.add_argument("--settle-timeout-s", type=float, default=240.0,
+                   help="autoscale: post-replay budget for recovery + "
+                        "drain-based scale-down to land")
     p.add_argument("--rate", type=float, default=4.0,
                    help="drill: Poisson arrival rate (requests/s)")
     p.add_argument("--prompt-lens", default="4,8,6")
@@ -720,8 +1014,10 @@ def fleet_main(argv=None) -> int:
     p.add_argument("--no-obs", action="store_true")
     args = p.parse_args(argv)
     if args.trace_sample_every is None:
-        # the drill's acceptance contract needs EVERY request's
-        # cross-process waterfall; the long-running endpoint samples
+        # the failover drill's acceptance contract needs EVERY
+        # request's cross-process waterfall; the long-running endpoint
+        # AND the scenario drill sample (a flash crowd must not write
+        # a stage line per shed)
         args.trace_sample_every = 1 if args.synthetic is not None else 16
 
     chaos = FleetChaos.from_any(args.chaos)
@@ -747,6 +1043,8 @@ def fleet_main(argv=None) -> int:
     try:
         if args.http is not None:
             return run_http(args.preset, args, fleet_dir, chaos)
+        if args.scenario is not None:
+            return run_scenario(args.preset, args, fleet_dir, chaos)
         return run_drill(args.preset, args, fleet_dir, chaos)
     finally:
         if session is not None:

@@ -246,6 +246,21 @@ class RequestPlane:
             self._flush_locked()
             return rec
 
+    def checkout_expired(self) -> Optional[PlaneRecord]:
+        """Pop the oldest pending record whose deadline already expired
+        — the capacity-gated router's escape hatch: even with zero
+        dispatch capacity, a record must still FAIL loudly at its
+        deadline rather than age silently in the queue."""
+        with self._lock:
+            for i, rid in enumerate(self._pending):
+                rec = self._records[rid]
+                if rec.remaining_s() <= 0.0:
+                    self._pending.pop(i)
+                    rec.state = DISPATCHED
+                    self._flush_locked()
+                    return rec
+            return None
+
     def assign(self, rid: str, replica: str) -> None:
         """Record which replica the current attempt targets (the
         redrive map's key)."""
@@ -336,6 +351,21 @@ class RequestPlane:
     def pending_depth(self) -> int:
         with self._lock:
             return len(self._pending)
+
+    def oldest_pending_age_s(self, now: Optional[float] = None) -> float:
+        """Age (seconds) of the OLDEST record still awaiting dispatch —
+        the ``fleet_queue_age_seconds`` gauge and the autoscaling
+        supervisor's primary scale-up signal: depth alone can look
+        small while one starved request ages past its deadline.
+        Redriven records re-enter at the FRONT of the FIFO, so their
+        original acceptance time keeps counting (a redrive must not
+        reset the starvation clock).  0.0 when nothing is pending."""
+        with self._lock:
+            if not self._pending:
+                return 0.0
+            oldest = min(self._records[rid].accepted_epoch_s
+                         for rid in self._pending)
+        return max(0.0, (time.time() if now is None else now) - oldest)
 
     def get(self, rid: str) -> Optional[PlaneRecord]:
         with self._lock:

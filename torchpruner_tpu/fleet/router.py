@@ -50,6 +50,7 @@ import json
 import sys
 import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -205,6 +206,10 @@ class ReplicaView:
     failover_done: bool = False
     dispatched_total: int = 0
     inflight: int = 0
+    #: scale-down victim: never picked for NEW dispatches, but still
+    #: probed/live while its in-flight work drains (the supervisor's
+    #: drain-then-remove contract)
+    retiring: bool = False
     extra: dict = field(default_factory=dict)
 
 
@@ -227,6 +232,9 @@ class FleetRouter:
             max_workers=max(2, policy.max_inflight_per_replica
                             * len(replicas)),
             thread_name_prefix="fleet-dispatch")
+        #: records checked out of the plane whose dispatch worker has
+        #: not finished — the pump()'s capacity gate
+        self._workers_out = 0
         self._last_health = 0.0
         self.failovers_total = 0
         self.shed_total = 0
@@ -238,15 +246,99 @@ class FleetRouter:
         #: how many actually landed on the preferred replica
         self.affinity_preferred_total = 0
         self.affinity_hits_total = 0
+        #: tenants currently load-shed at admission (the degradation
+        #: ladder's first rung: the supervisor sheds the batch tier
+        #: here before touching interactive traffic) — mutated under
+        #: the router lock, reversible
+        self.shed_tenants: set = set()
+        #: supervisor-forced admission tightening (degradation-ladder
+        #: rung 2): degraded() answers True while set, shrinking the
+        #: effective queue bound by degraded_queue_factor
+        self.force_degraded = False
+        #: tenant -> live counters + recent latency samples (the
+        #: per-tenant SLO breakdown the drill summary / obs report
+        #: render); guarded by the router lock
+        self._tenants: Dict[str, dict] = {}
         self._closed = False
+
+    # -- per-tenant accounting ----------------------------------------------
+
+    def _tenant_entry_locked(self, tenant: str) -> dict:
+        ent = self._tenants.get(tenant)
+        if ent is None:
+            ent = {"accepted": 0, "completed": 0, "shed": 0,
+                   "deadline_exceeded": 0,
+                   "ttft_s": deque(maxlen=4096),
+                   "e2e_s": deque(maxlen=4096)}
+            self._tenants[tenant] = ent
+        return ent
+
+    def _tenant_note(self, tenant: Optional[str], event: str,
+                     ttft_s: Optional[float] = None,
+                     e2e_s: Optional[float] = None) -> None:
+        if not tenant:
+            return
+        with self._lock:
+            ent = self._tenant_entry_locked(tenant)
+            ent[event] = ent.get(event, 0) + 1
+            if ttft_s is not None:
+                ent["ttft_s"].append(float(ttft_s))
+            if e2e_s is not None:
+                ent["e2e_s"].append(float(e2e_s))
+
+    def tenant_summary(self) -> Dict[str, dict]:
+        """Per-tenant counters + latency percentiles, and the
+        ``tenant_<name>_*`` gauges obs report's per-tenant SLO table is
+        built from (exported here, at read-out time, so the scalars
+        carry final percentiles rather than a racing snapshot)."""
+        def pct(xs, q):
+            if not xs:
+                return None
+            xs = sorted(xs)
+            return xs[min(len(xs) - 1, max(0, round(q * (len(xs) - 1))))]
+
+        with self._lock:
+            tenants = {t: dict(ent, ttft_s=list(ent["ttft_s"]),
+                               e2e_s=list(ent["e2e_s"]))
+                       for t, ent in self._tenants.items()}
+        out: Dict[str, dict] = {}
+        for t, ent in sorted(tenants.items()):
+            row = {"accepted": ent["accepted"],
+                   "completed": ent["completed"],
+                   "shed": ent["shed"],
+                   "deadline_exceeded": ent["deadline_exceeded"],
+                   "ttft_p50_s": pct(ent["ttft_s"], 0.50),
+                   "ttft_p99_s": pct(ent["ttft_s"], 0.99),
+                   "e2e_p50_s": pct(ent["e2e_s"], 0.50),
+                   "e2e_p99_s": pct(ent["e2e_s"], 0.99)}
+            out[t] = row
+            for k in ("accepted", "completed", "shed",
+                      "deadline_exceeded"):
+                obs.gauge_set(f"tenant_{t}_{k}_fleet",
+                              row[k],
+                              help=f"router-observed {k} count for "
+                                   f"this tenant")
+            for k in ("ttft_p50_s", "ttft_p99_s", "e2e_p50_s",
+                      "e2e_p99_s"):
+                if row[k] is not None:
+                    obs.gauge_set(
+                        f"tenant_{t}_{k}", round(row[k], 6),
+                        help="router-observed per-tenant latency "
+                             "percentile (TTFT from replica results, "
+                             "e2e accept -> complete)")
+        return out
 
     # -- admission -----------------------------------------------------------
 
     def degraded(self) -> bool:
         """Admission tightening trigger: not enough ready replicas, or
         a majority of the live ones sitting in an SLO-breach episode
-        (the rolling SLOMonitor p99s, scraped via /healthz state)."""
+        (the rolling SLOMonitor p99s, scraped via /healthz state).
+        ``force_degraded`` is the supervisor's degradation-ladder rung:
+        the same tightened bound, entered deliberately."""
         with self._lock:
+            if self.force_degraded:
+                return True
             live = [v for v in self.views.values() if v.live]
             ready = [v for v in live if v.ready]
             if len(ready) < self.policy.min_ready:
@@ -265,7 +357,11 @@ class FleetRouter:
     def admission(self) -> dict:
         """One consolidated verdict for front ends: ``accepting`` plus
         the shed reason / Retry-After hint when not."""
-        live = [v for v in self.views.values() if v.live]
+        with self._lock:
+            # membership is elastic now (supervisor add/remove):
+            # snapshot under the lock so a resize mid-iteration can't
+            # fault a submitting frontend thread
+            live = [v for v in self.views.values() if v.live]
         if self._closed:
             return {"accepting": False, "reason": "closing",
                     "retry_after_s": 5, "code": 503}
@@ -292,7 +388,17 @@ class FleetRouter:
         if self._last_health == 0.0:
             # first contact: an unprobed fleet must not read as dead
             self.check_health(force=True)
+        tenant = payload.get("tenant")
         verdict = self.admission()
+        if verdict["accepting"] and tenant is not None:
+            with self._lock:
+                tier_shed = tenant in self.shed_tenants
+            if tier_shed:
+                # degradation-ladder rung 1: this tenant's tier is
+                # load-shed while the supervisor buys capacity back —
+                # 503 + Retry-After, reversible, interactive untouched
+                verdict = {"accepting": False, "reason": "tier",
+                           "retry_after_s": 2, "code": 503}
         if not verdict["accepting"]:
             self.shed_total += 1
             self.plane.note_shed()
@@ -301,6 +407,7 @@ class FleetRouter:
                          "twins: fleet_shed_<reason>_total)")
             obs.inc(f"fleet_shed_{verdict['reason']}_total",
                     help=f"fleet admission sheds ({verdict['reason']})")
+            self._tenant_note(tenant, "shed")
             # a shed request never enters the plane; the refusal always
             # counts into the aggregate stage counters, and its trace
             # events reach the stream eagerly (drills) or 1-in-N by the
@@ -314,6 +421,7 @@ class FleetRouter:
         rec = self.plane.accept(
             payload, deadline_s if deadline_s is not None
             else self.policy.default_deadline_s)
+        self._tenant_note(tenant, "accepted")
         return rec
 
     # -- health --------------------------------------------------------------
@@ -365,6 +473,13 @@ class FleetRouter:
                       help="replicas in the ready routing set")
         obs.gauge_set("fleet_pending_depth", self.plane.pending_depth,
                       help="plane records awaiting dispatch")
+        # age, not just depth: one starved record aging toward its
+        # deadline is invisible to a depth gauge — this is the
+        # autoscaling supervisor's primary scale-up signal
+        obs.gauge_set("fleet_queue_age_seconds",
+                      round(self.plane.oldest_pending_age_s(), 6),
+                      help="age of the OLDEST plane record awaiting "
+                           "dispatch (0 when none pending)")
 
     #: replica state → the numeric code the per-replica state gauge
     #: carries (a time-series sample must be a scalar)
@@ -454,6 +569,79 @@ class FleetRouter:
 
     # -- dispatch ------------------------------------------------------------
 
+    # -- elastic membership (the autoscaling supervisor's verbs) -------------
+
+    def add_replica(self, client: ReplicaClient) -> ReplicaView:
+        """Join a freshly-launched replica to the routing set (scale
+        up).  The view starts unprobed; the next health tick flips it
+        live/ready and it begins taking dispatches."""
+        with self._lock:
+            if client.name in self.views:
+                raise ValueError(f"replica {client.name!r} already "
+                                 f"routed")
+            view = ReplicaView(client=client)
+            self.views[client.name] = view
+            # grow the dispatch pool ceiling with membership — the
+            # executor spawns workers lazily, so raising the bound here
+            # is safe (shrinking happens naturally via idle workers)
+            self._pool._max_workers = max(
+                self._pool._max_workers,
+                self.policy.max_inflight_per_replica * len(self.views))
+        obs.inc("fleet_replicas_added_total",
+                help="replicas joined to the routing set (scale-up)")
+        return view
+
+    def begin_retire(self, name: str) -> bool:
+        """Mark a replica as a scale-down victim: it stops receiving
+        NEW dispatches immediately but keeps its in-flight work (and
+        its health probes).  Reversible via :meth:`cancel_retire`."""
+        with self._lock:
+            view = self.views.get(name)
+            if view is None:
+                return False
+            view.retiring = True
+        return True
+
+    def cancel_retire(self, name: str) -> bool:
+        with self._lock:
+            view = self.views.get(name)
+            if view is None:
+                return False
+            view.retiring = False
+        return True
+
+    def retired_idle(self, name: str) -> bool:
+        """True when a retiring replica holds no router in-flight work
+        AND no plane record is assigned to it — the drain-then-remove
+        gate (accepted requests are never lost to a scale-down)."""
+        with self._lock:
+            view = self.views.get(name)
+            if view is None:
+                return True
+            if not view.retiring or view.inflight > 0:
+                return False
+        return not self.plane.assigned_to(name)
+
+    def remove_replica(self, name: str) -> bool:
+        """Drop a drained, retiring replica from the routing set.
+        Refuses (returns False) while work is still assigned — callers
+        must pass the :meth:`retired_idle` gate first."""
+        if not self.retired_idle(name):
+            return False
+        with self._lock:
+            view = self.views.pop(name, None)
+            if view is None:
+                return False
+            n = self.affinity.forget(name)
+        if n:
+            obs.inc("fleet_affinity_forgotten_total", n=n,
+                    help="affinity keys dropped because their replica "
+                         "left the fleet")
+        obs.inc("fleet_replicas_removed_total",
+                help="replicas removed from the routing set after a "
+                     "drain (scale-down)")
+        return True
+
     def _pick(self, exclude: Optional[str] = None,
               prefer: Optional[str] = None) -> Optional[ReplicaView]:
         """Least-loaded routing over the scraped gauges: READY replicas
@@ -477,7 +665,7 @@ class FleetRouter:
                         + 1e-3 * v.dispatched_total)
 
             def usable(v: ReplicaView, ready_only: bool) -> bool:
-                if not v.live or v.state == "draining":
+                if not v.live or v.state == "draining" or v.retiring:
                     return False
                 if v.inflight >= cap:
                     return False
@@ -506,24 +694,53 @@ class FleetRouter:
 
     def pump(self) -> int:
         """Move pending plane records onto dispatch workers; returns
-        how many were started.  Workers wait for capacity themselves
-        (deadline-bounded), so pending work always ends up terminal —
-        completed on a usable replica, or failed LOUDLY when the
-        deadline expires with nothing usable."""
+        how many were started.  Checkout is CAPACITY-GATED: a record
+        leaves the plane only while some non-retiring live replica has
+        a free in-flight slot, so saturation backs up in the plane's
+        FIFO — where queue age (`oldest_pending_age_s`, the autoscale
+        signal), the queue-bound backpressure and the redrive machinery
+        all live — instead of hiding in the dispatch pool's internal
+        queue.  Two escape valves keep pending work terminal anyway:
+        an EXPIRED record is checked out regardless (its worker fails
+        it loudly at the deadline), and a worker that loses the
+        capacity race still waits deadline-bounded inside dispatch."""
         n = 0
         while self._spawn_dispatch():
             n += 1
         return n
 
+    def _dispatch_capacity(self) -> bool:
+        """Could the fleet absorb one more dispatch worker right now?
+        Gated on OUTSTANDING WORKERS (not per-view ``inflight``, which
+        a worker only bumps once it wins a ``_pick`` — gating on it
+        would let one pump() drain the whole backlog into the pool
+        during that window)."""
+        with self._lock:
+            cap = self.policy.max_inflight_per_replica
+            usable = sum(1 for v in self.views.values()
+                         if v.live and not v.retiring
+                         and v.state != "draining")
+            return self._workers_out < cap * usable
+
     def _spawn_dispatch(self) -> bool:
-        rec = self.plane.checkout()
+        rec = self.plane.checkout() if self._dispatch_capacity() \
+            else self.plane.checkout_expired()
         if rec is None:
             return False
+        with self._lock:
+            self._workers_out += 1
         self.dispatched_total += 1
         obs.inc("fleet_dispatch_total",
                 help="plane records handed to a dispatch worker")
-        self._pool.submit(self._dispatch, rec)
+        self._pool.submit(self._dispatch_entry, rec)
         return True
+
+    def _dispatch_entry(self, rec: PlaneRecord) -> None:
+        try:
+            self._dispatch(rec)
+        finally:
+            with self._lock:
+                self._workers_out -= 1
 
     def _dispatch(self, rec: PlaneRecord) -> None:
         deadline = Deadline.after(rec.remaining_s())
@@ -551,8 +768,10 @@ class FleetRouter:
             swap_stall = False
             view = self._pick(exclude=last_failed, prefer=prefer)
             while view is None:
-                if any(v.live and v.state == "staging_swap"
-                       for v in self.views.values()):
+                with self._lock:
+                    staging = any(v.live and v.state == "staging_swap"
+                                  for v in self.views.values())
+                if staging:
                     # the capacity crunch is (at least partly) a hot-
                     # swap taking replicas out of the routing set
                     swap_stall = True
@@ -641,6 +860,12 @@ class FleetRouter:
         except DeadlineExceeded as e:
             obs.inc("fleet_deadline_exceeded_total",
                     help="records failed by deadline expiry")
+            tenant = rec.payload.get("tenant")
+            if tenant:
+                obs.inc(f"tenant_{tenant}_deadline_exceeded_total",
+                        help="this tenant's records failed by deadline "
+                             "expiry")
+            self._tenant_note(tenant, "deadline_exceeded")
             self.plane.fail(rec.rid, f"deadline: {e}")
             return
         except ReplicaError as e:
@@ -650,6 +875,14 @@ class FleetRouter:
             self.plane.fail(rec.rid, f"{type(e).__name__}: {e}")
             return
         self.plane.complete(rec.rid, out.get("tokens", []), name)
+        tenant = rec.payload.get("tenant")
+        if tenant:
+            # the replica's result carries its measured TTFT; e2e is
+            # router-observed accept -> complete — together the
+            # per-tenant SLO breakdown
+            self._tenant_note(
+                tenant, "completed", ttft_s=out.get("ttft_s"),
+                e2e_s=max(0.0, time.time() - rec.accepted_epoch_s))
         # the request's keys now point at the replica whose radix cache
         # holds its prefix — the signal the NEXT request of the session
         # / shared system prompt routes on
@@ -709,16 +942,22 @@ class FleetRouter:
 
     # -- fleet upgrade -------------------------------------------------------
 
-    def rolling_swap(self, checkpoint: str, *,
-                     wait_s: float = 600.0) -> int:
+    def rolling_swap(self, checkpoint: str, *, wait_s: float = 600.0,
+                     only: Optional[List[str]] = None) -> int:
         """Staggered checkpoint hot-swap: one replica at a time, POST
         /swap then wait for its swap counter to tick (readiness passes
         through ``staging_swap`` and the router routes around it), then
         the next — the zero-downtime fleet upgrade loop.  Returns how
-        many replicas swapped."""
+        many replicas swapped.  ``only`` restricts the pass to named
+        replicas (the degradation ladder's pruned-checkpoint rung swaps
+        just the batch tier)."""
         swapped = 0
-        for view in self.views.values():
+        with self._lock:
+            views = list(self.views.values())
+        for view in views:
             if not view.live:
+                continue
+            if only is not None and view.client.name not in only:
                 continue
             c = view.client
             before = int(c.stats(timeout=5.0).get("swaps", 0) or 0)

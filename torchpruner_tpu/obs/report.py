@@ -107,12 +107,20 @@ _SCALARS = {
 #: gauges — serve/allocator.py + serve/engine.py, emitted only with
 #: ``--prefix-pages`` on, gated by the CI prefix smoke; the fleet's
 #: ``fleet_affinity_*`` ride the existing ``fleet_`` prefix)
+#: (``workload_*`` are the scenario replayer's submitted/shed/retry/
+#: hedge/abandoned counters — fleet/workload.py; ``tenant_*`` the
+#: multi-tenant QoS plane's per-tenant completion/shed/preemption
+#: counters and SLO-percentile gauges — serve/scheduler.py +
+#: fleet/router.py; ``scale_*`` the autoscaling supervisor's decision
+#: counters and replica/rung gauges — fleet/supervisor.py; all three
+#: gated by the CI autoscale chaos drill)
 _DYNAMIC_SCALAR_PREFIXES = ("kernel_", "serve_slo_breach", "zero_",
                             "predicted_", "plan_", "frontier_",
                             "search_", "fleet_", "reqtrace_",
                             "ttft_stage_", "serve_queue_wait",
                             "host_lint_", "ts_", "slo_burn_",
-                            "serve_prefix_", "serve_kv_pages_shared")
+                            "serve_prefix_", "serve_kv_pages_shared",
+                            "workload_", "tenant_", "scale_")
 _DYNAMIC_EXTRA = ("profile_coverage", "profile_windows_total",
                   "profile_steps_total")
 
@@ -123,6 +131,33 @@ def _dynamic_scalars(metrics: Dict[str, Any]) -> Dict[str, Optional[float]]:
         if k.startswith(_DYNAMIC_SCALAR_PREFIXES) or k in _DYNAMIC_EXTRA:
             out[k] = _finite(v)
     return out
+
+
+#: ``tenant_<name>_<field>`` scalar suffixes the per-tenant QoS table
+#: regroups (tenant names may themselves contain underscores, so the
+#: parse is suffix-anchored, never split-on-underscore)
+_TENANT_FIELDS = ("accepted_fleet", "completed_fleet", "shed_fleet",
+                  "deadline_exceeded_fleet", "ttft_p50_s", "ttft_p99_s",
+                  "e2e_p50_s", "e2e_p99_s", "preempted_total",
+                  "completed_total", "shed_total")
+
+
+def _tenant_table(metrics: Dict[str, Any]) -> List[tuple]:
+    """``[(tenant, {field: value})]`` rebuilt from the ``tenant_*``
+    scalars — the report's per-tenant SLO breakdown source."""
+    rows: Dict[str, Dict[str, float]] = {}
+    for k, v in (metrics or {}).items():
+        if not k.startswith("tenant_"):
+            continue
+        for f in _TENANT_FIELDS:
+            if k.endswith("_" + f):
+                name = k[len("tenant_"):-(len(f) + 1)]
+                if name:
+                    val = _finite(v)
+                    if val is not None:
+                        rows.setdefault(name, {})[f] = val
+                break
+    return sorted(rows.items())
 
 
 def load_run(run_dir: str) -> Dict[str, Any]:
@@ -490,6 +525,65 @@ def format_report(report: Dict[str, Any]) -> str:
                 f"{_i(s.get('requests_drained'))}, swaps "
                 f"{_i(s.get('swaps'))}, checkpoint digest "
                 f"{str(s.get('checkpoint_digest') or '')[:12]}")
+        lines.append("")
+
+    # per-tenant QoS / SLO breakdown (serve/scheduler.py +
+    # fleet/router.py): the `tenant_<name>_*` scalars regrouped into
+    # one table per tenant — completions, sheds (throttle / quota /
+    # tier), preemptions, and the router-observed latency percentiles
+    tenant_rows = _tenant_table(metrics)
+    if tenant_rows:
+        lines.append("tenants (QoS breakdown, fleet-observed):")
+        lines.append("")
+        lines.append("| tenant | accepted | completed | shed "
+                     "| preempted | deadline | TTFT p50/p99 ms "
+                     "| e2e p50/p99 ms |")
+        lines.append("|---|---|---|---|---|---|---|---|")
+        for t, row in tenant_rows:
+            def ms(key):
+                v = row.get(key)
+                return f"{1e3 * v:.1f}" if v is not None else ""
+            done = row.get("completed_fleet", row.get("completed_total"))
+            shed = row.get("shed_fleet", row.get("shed_total"))
+            lines.append(
+                f"| {t} | {_i(row.get('accepted_fleet'))} "
+                f"| {_i(done)} | {_i(shed)} "
+                f"| {_i(row.get('preempted_total'))} "
+                f"| {_i(row.get('deadline_exceeded_fleet'))} "
+                f"| {ms('ttft_p50_s')}/{ms('ttft_p99_s')} "
+                f"| {ms('e2e_p50_s')}/{ms('e2e_p99_s')} |")
+        lines.append("")
+
+    # autoscaling supervisor (fleet/supervisor.py): every ledgered
+    # scale decision with its triggering signal — decision BEFORE
+    # effect, so this table exists even for a run that died mid-action
+    decisions = [r for r in serve
+                 if r.get("kind") == "scale_decision"]
+    if decisions:
+        ups = sum(r.get("action") == "scale_up" for r in decisions)
+        downs = sum(r.get("action") == "scale_down" for r in decisions)
+        degrades = sum(r.get("action") == "degrade" for r in decisions)
+        recovers = sum(r.get("action") == "recover" for r in decisions)
+        lines.append(f"autoscale: {len(decisions)} decision(s) — "
+                     f"{ups} up, {downs} down, {degrades} degrade, "
+                     f"{recovers} recover")
+        for r in decisions[:12]:
+            trig = r.get("trigger") or {}
+            bit = (f"- t+{_f(r.get('t_s'), '.1f')}s "
+                   f"**{r.get('action')}**")
+            if r.get("rung"):
+                bit += f" → rung `{r['rung']}`"
+            if r.get("replica"):
+                bit += f" ({r['replica']})"
+            bit += (f": queue age {_f(trig.get('queue_age_s'), '.2f')}s,"
+                    f" pending {_i(trig.get('pending'))}, "
+                    f"{_i(trig.get('live'))}/{_i(trig.get('replicas'))}"
+                    f" live, breach {_f(trig.get('breach_frac'), '.2f')}")
+            cap = r.get("capacity") or {}
+            if cap.get("predicted_tok_s") is not None:
+                bit += (f" (predicted +{_f(cap['predicted_tok_s'], '.0f')}"
+                        f" tok/s per replica)")
+            lines.append(bit)
         lines.append("")
 
     # request-trace latency budget (obs/reqtrace.py): per-stage TTFT /

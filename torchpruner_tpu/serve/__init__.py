@@ -36,6 +36,11 @@ from torchpruner_tpu.serve.engine import (
     sample_tokens,
     vocab_of,
 )
+from torchpruner_tpu.serve.qos import (
+    QoS,
+    TenantPolicy,
+    TokenBucket,
+)
 from torchpruner_tpu.serve.request import (
     Request,
     Sampling,
@@ -45,6 +50,7 @@ from torchpruner_tpu.serve.scheduler import Scheduler
 from torchpruner_tpu.serve.slo import SLOMonitor
 from torchpruner_tpu.serve.traffic import (
     OpenLoopTraffic,
+    open_loop,
     poisson_arrivals,
     shared_prefix_requests,
     staggered_arrivals,
@@ -53,8 +59,9 @@ from torchpruner_tpu.serve.traffic import (
 
 __all__ = [
     "Request", "Sampling", "KVCacheAllocator", "PrefixTrie", "Scheduler",
-    "ServeEngine", "OpenLoopTraffic", "poisson_arrivals",
+    "ServeEngine", "OpenLoopTraffic", "open_loop", "poisson_arrivals",
     "staggered_arrivals", "synthetic_requests", "shared_prefix_requests",
     "aligned_len", "bucket_for", "prefill_buckets", "sample_tokens",
     "vocab_of", "SLOMonitor", "request_from_dict",
+    "QoS", "TenantPolicy", "TokenBucket",
 ]

@@ -336,6 +336,8 @@ class SlotLease:
     #: pinned prefix-pool mapping (sharing enabled + admission hit) —
     #: released with the slot
     prefix_match: Optional[PrefixMatch] = None
+    #: QoS tenant charged for these pages (per-tenant quota accounting)
+    tenant: Optional[str] = None
 
 
 @dataclass
@@ -379,6 +381,8 @@ class KVCacheAllocator:
             self.page_budget = self.n_slots * self.pages_per_slot
         self._trie = PrefixTrie(self.page_len)
         self._free_prefix = list(range(self.prefix_pages))[::-1]
+        #: tenant -> pages currently leased (QoS quota accounting)
+        self._tenant_pages: Dict[str, int] = {}
 
     @property
     def pages_per_slot(self) -> int:
@@ -394,18 +398,41 @@ class KVCacheAllocator:
         return bool(self._free_slots) and \
             self.pages_in_use + need <= self.page_budget
 
-    def allocate(self, request_id: int,
-                 total_len: int) -> Optional[SlotLease]:
+    def tenant_pages(self, tenant: Optional[str]) -> int:
+        """Pages currently leased to ``tenant`` (0 for None/unknown)."""
+        if tenant is None:
+            return 0
+        return self._tenant_pages.get(tenant, 0)
+
+    def exceeds_quota(self, tenant: Optional[str], total_len: int,
+                      quota: int) -> bool:
+        """Would leasing ``total_len`` push ``tenant`` past its KV-page
+        ``quota``?  The quota is the QoS table's per-tenant ceiling
+        (``quota <= 0`` disables).  Distinct from :meth:`can_admit`
+        (transient global pressure → WAIT): an over-quota admission is
+        the tenant's own footprint → SHED, so it never head-of-line
+        blocks the other tenants."""
+        if tenant is None or quota <= 0:
+            return False
+        return self.tenant_pages(tenant) \
+            + self.pages_needed(total_len) > quota
+
+    def allocate(self, request_id: int, total_len: int,
+                 tenant: Optional[str] = None) -> Optional[SlotLease]:
         """Lease a slot (+ pages) for a request of ``total_len``
         resident positions, or ``None`` when nothing fits.  The slot's
-        device buffer is untouched — see the recycling note above."""
+        device buffer is untouched — see the recycling note above.
+        ``tenant`` charges the pages to a QoS tenant's quota account."""
         if not self.can_admit(total_len):
             return None
         slot = self._free_slots.pop()
         lease = SlotLease(slot=slot, pages=self.pages_needed(total_len),
-                          request_id=request_id)
+                          request_id=request_id, tenant=tenant)
         self._leases[slot] = lease
         self.pages_in_use += lease.pages
+        if tenant is not None:
+            self._tenant_pages[tenant] = \
+                self._tenant_pages.get(tenant, 0) + lease.pages
         return lease
 
     def release(self, slot: int) -> None:
@@ -421,6 +448,12 @@ class KVCacheAllocator:
             self.release_prefix(lease.prefix_match)
             lease.prefix_match = None
         self.pages_in_use -= lease.pages
+        if lease.tenant is not None:
+            left = self._tenant_pages.get(lease.tenant, 0) - lease.pages
+            if left > 0:
+                self._tenant_pages[lease.tenant] = left
+            else:
+                self._tenant_pages.pop(lease.tenant, None)
         self._free_slots.append(slot)
         self.total_evictions += 1
 

@@ -400,7 +400,7 @@ class ServeEngine:
                  checkpoint_meta: Optional[dict] = None,
                  retain_results: bool = True, queue_bound: int = 0,
                  prefix_pages: int = 0, prefill_chunk: int = 0,
-                 prefill_token_cap: int = 0):
+                 prefill_token_cap: int = 0, qos=None):
         """``retain_results=False`` (the long-running HTTP server) stops
         the engine from accumulating completed Request objects — each
         request (and, across a hot-swap, the old checkpoint's program
@@ -474,7 +474,11 @@ class ServeEngine:
         self._cost_thread: Optional[threading.Thread] = None
         self.scheduler = Scheduler(
             allocator, queue_bound=queue_bound,
-            prefill_token_cap=prefill_token_cap)
+            prefill_token_cap=prefill_token_cap, qos=qos)
+        # preemption (qos) may only reclaim slots whose prefill is NOT
+        # mid-flight: a preempted slot mid-chunked-prefill would leave
+        # _prefilling state pointing at an evicted request
+        self.scheduler.preempt_guard = self._slot_preemptible
         self.run_dir = run_dir
         self.n_slots, self.max_len = n_slots, max_len
         # host slot tables (the continuous-batching state the compiled
@@ -564,6 +568,9 @@ class ServeEngine:
         return self.scheduler.submit(request, arrival_s=arrival_s)
 
     # -- the step-boundary machine -----------------------------------------
+
+    def _slot_preemptible(self, slot: int) -> bool:
+        return slot not in self._prefilling
 
     def _prefill(self, req: Request) -> None:
         import jax
@@ -828,6 +835,15 @@ class ServeEngine:
         if self.retain_results:
             self._results.append(req)
         self.scheduler.evict(req, state=DONE)
+        if req.tenant:
+            # per-tenant SLO breakdown inputs (obs report groups the
+            # tenant_* scalars into one table per tenant)
+            obs.inc(f"tenant_{req.tenant}_completed_total",
+                    help="this tenant's completed requests")
+            if req.ttft_s is not None:
+                obs.observe(f"tenant_{req.tenant}_ttft_seconds",
+                            req.ttft_s,
+                            help="this tenant's arrival -> first token")
         if req.first_token_s is not None and req.done_s is not None:
             # unconditional like the other stages: an untraced serve
             # run's latency budget still needs the decode aggregate

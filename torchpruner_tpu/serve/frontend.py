@@ -398,6 +398,15 @@ def serve_main(argv=None) -> int:
                         "of queueing unboundedly; 0 = unbounded "
                         "(batch modes).  The fleet router passes its "
                         "own bound here.")
+    p.add_argument("--tenants", metavar="JSON",
+                   help="multi-tenant QoS policy table (serve.qos), "
+                        "e.g. '{\"chat\": {\"priority\": "
+                        "\"interactive\"}, \"batch\": {\"priority\": "
+                        "\"batch\", \"rate\": 4, \"burst\": 8, "
+                        "\"page_quota\": 16}}' — per-tenant token "
+                        "buckets, strict step-boundary priority "
+                        "preemption, KV-page quotas; requests opt in "
+                        "via their \"tenant\" field")
     args = p.parse_args(argv)
 
     if args.profile_every is not None and not args.obs_dir:
@@ -433,6 +442,16 @@ def serve_main(argv=None) -> int:
 
         reqtrace.configure(sample_every=args.trace_sample_every)
 
+    qos = None
+    if args.tenants:
+        from torchpruner_tpu.serve.qos import QoS
+
+        try:
+            qos = QoS.from_dict(json.loads(args.tenants))
+        except (ValueError, TypeError, KeyError,
+                json.JSONDecodeError) as e:
+            p.error(f"--tenants: {e}")
+
     model, params, meta = _resolve_model(
         args.preset, smoke=args.smoke, seed=args.seed,
         checkpoint=args.checkpoint)
@@ -442,7 +461,7 @@ def serve_main(argv=None) -> int:
                      else jnp.float32),
         page_len=args.page_len, run_dir=args.run_dir,
         prefix_pages=args.prefix_pages, prefill_chunk=args.prefill_chunk,
-        prefill_token_cap=args.prefill_cap,
+        prefill_token_cap=args.prefill_cap, qos=qos,
         checkpoint_meta=meta, queue_bound=args.queue_bound,
         # a long-running HTTP server must not accumulate completed
         # requests (each pins its prompt/tokens and, across a swap, the
@@ -484,10 +503,8 @@ def serve_main(argv=None) -> int:
 
 def _run_synthetic(engine, pre, args, model, params) -> int:
     from torchpruner_tpu.serve.traffic import (
-        OpenLoopTraffic,
-        poisson_arrivals,
+        open_loop,
         shared_prefix_requests,
-        staggered_arrivals,
         synthetic_requests,
     )
 
@@ -507,13 +524,12 @@ def _run_synthetic(engine, pre, args, model, params) -> int:
         reqs = synthetic_requests(
             n, vocab=vocab, prompt_lens=prompt_lens, max_new=max_new,
             seed=args.seed, temperature=args.temperature)
-    if args.rate > 0:
-        traffic = OpenLoopTraffic(
-            reqs, poisson_arrivals(n, args.rate, seed=args.seed))
-    else:
-        traffic = OpenLoopTraffic(
-            reqs, staggered_arrivals(n, every_steps=args.stagger_steps),
-            by_step=True)
+    # ONE arrival-process selector shared with the bench serve legs and
+    # the fleet workload replayer (serve.traffic.open_loop): Poisson at
+    # --rate, else deterministic step staggering
+    traffic = open_loop(reqs, rate=args.rate,
+                        stagger_steps=args.stagger_steps,
+                        seed=args.seed)
     if args.swap_checkpoint:
         traffic = _SwapAt(traffic, args.swap_checkpoint, args.swap_after)
     # sync line for wrappers (the CI SIGTERM drill keys off it): printed

@@ -79,6 +79,11 @@ class Request:
     #: prefix-cache hits); None = route by prompt-page hash / load only
     session_id: Optional[str] = None
 
+    #: QoS tenant (serve.qos): traffic class for token-bucket
+    #: throttling, priority admission/preemption and KV-page quotas;
+    #: None = the unthrottled interactive default
+    tenant: Optional[str] = None
+
     # -- engine-owned runtime state ------------------------------------
     state: str = QUEUED
     slot: Optional[int] = None
@@ -92,6 +97,9 @@ class Request:
     done_s: Optional[float] = None
     #: wall-clock gaps between successive tokens (len == tokens - 1)
     token_gaps_s: List[float] = field(default_factory=list)
+    #: times this request was preempted back to the queue by a
+    #: higher-priority admission (qos) — progress restarts on re-admit
+    preemptions: int = 0
     #: prompt tokens served by mapping shared prefix pages (0 = miss
     #: or sharing off) / actually computed by prefill programs —
     #: stamped by the engine; hit + prefilled == prompt_len on the
@@ -153,6 +161,7 @@ class Request:
             "max_new": int(self.max_new),
             "eos_id": self.eos_id,
             "session_id": self.session_id,
+            "tenant": self.tenant,
             "sampling": {
                 "temperature": self.sampling.temperature,
                 "top_k": self.sampling.top_k,
@@ -166,6 +175,7 @@ class Request:
         return cls(prompt_ids=np.asarray(d["prompt_ids"], np.int32),
                    max_new=int(d["max_new"]), eos_id=d.get("eos_id"),
                    session_id=d.get("session_id"),
+                   tenant=d.get("tenant"),
                    sampling=Sampling(**(d.get("sampling") or {})))
 
 
@@ -183,6 +193,7 @@ def request_from_dict(d: dict) -> Request:
         # direct/journal submissions (untraced)
         trace_id=d.get("trace_id"),
         session_id=d.get("session_id"),
+        tenant=d.get("tenant"),
         sampling=Sampling(
             temperature=float(d.get("temperature", 0.0)),
             top_k=d.get("top_k"), top_p=d.get("top_p"),

@@ -25,6 +25,7 @@ from typing import Deque, Dict, List, Optional
 from torchpruner_tpu import obs
 from torchpruner_tpu.obs import reqtrace
 from torchpruner_tpu.serve.allocator import KVCacheAllocator
+from torchpruner_tpu.serve.qos import QoS
 from torchpruner_tpu.serve.request import (
     ACTIVE,
     DONE,
@@ -48,7 +49,8 @@ class Scheduler:
     as its per-replica backpressure signal."""
 
     def __init__(self, allocator: KVCacheAllocator,
-                 queue_bound: int = 0, prefill_token_cap: int = 0):
+                 queue_bound: int = 0, prefill_token_cap: int = 0,
+                 qos: Optional[QoS] = None):
         self.allocator = allocator
         self.queue_bound = int(queue_bound)
         #: per-engine-step prefill-token budget (chunked prefill): a
@@ -56,7 +58,12 @@ class Scheduler:
         #: so decode cadence for resident requests is bounded below.
         #: 0 = uncapped.
         self.prefill_token_cap = int(prefill_token_cap)
-        self._queue: Deque[Request] = deque()
+        #: multi-tenant QoS table (serve.qos) — an empty table makes
+        #: every path below behave exactly like the pre-QoS FIFO
+        self.qos = qos if qos is not None else QoS()
+        #: priority class -> FIFO of waiting requests; admission serves
+        #: ascending class numbers, FIFO (head-of-line) within a class
+        self._queues: Dict[int, Deque[Request]] = {}
         self._lock = threading.Lock()
         #: recent queue-age-at-admission samples (seconds) — the LIVE
         #: p50/p99 the /stats endpoint serves; the full distribution
@@ -71,6 +78,13 @@ class Scheduler:
         self.admitted_total = 0
         self.completed_total = 0
         self.shed_total = 0
+        #: requests preempted back to the queue by a higher-priority
+        #: admission (progress restarts on re-admit)
+        self.preempted_total = 0
+        #: engine-installed guard: ``guard(slot) -> bool`` answers
+        #: whether that slot may be preempted RIGHT NOW (the engine
+        #: refuses slots mid-chunked-prefill); None = any active slot
+        self.preempt_guard = None
         #: set when a drain begins: later submissions are REJECTED
         #: (marked drained, event set) instead of queueing forever —
         #: an HTTP client racing a SIGTERM gets an immediate "resubmit
@@ -87,6 +101,7 @@ class Scheduler:
         would for a real caller."""
         request.arrival_s = (time.perf_counter() if arrival_s is None
                              else arrival_s)
+        pol = self.qos.policy(request.tenant)
         with self._lock:
             # the closed check shares the queue lock with drain_queue:
             # checked outside it, a submission racing the drain could
@@ -100,7 +115,17 @@ class Scheduler:
                 obs.inc("serve_rejected_drain_total",
                         help="submissions rejected after a drain began")
                 return request
-            if self.queue_bound and len(self._queue) >= self.queue_bound:
+            if not self.qos.admit_now(request.tenant):
+                request.state = SHED
+                request._event.set()
+                self.shed_total += 1
+                obs.inc("serve_rejected_total", help=_REJECTED_HELP)
+                obs.inc("serve_rejected_throttle_total",
+                        help="submissions shed by a tenant's token "
+                             "bucket (rate throttling)")
+                self._tenant_shed(request.tenant, "throttle")
+                return request
+            if self.queue_bound and self._depth_locked() >= self.queue_bound:
                 request.state = SHED
                 request._event.set()
                 self.shed_total += 1
@@ -108,11 +133,21 @@ class Scheduler:
                 obs.inc("serve_rejected_backpressure_total",
                         help="submissions shed by the queue bound "
                              "(503 + Retry-After backpressure)")
+                self._tenant_shed(request.tenant, "backpressure")
                 return request
             request.state = QUEUED
-            self._queue.append(request)
+            self._queues.setdefault(pol.priority, deque()).append(request)
         obs.inc("serve_requests_total", help="requests submitted")
         return request
+
+    def _tenant_shed(self, tenant: Optional[str], reason: str) -> None:
+        """Per-tenant shed twins of the serve_rejected_* counters."""
+        if not tenant:
+            return
+        obs.inc(f"tenant_{tenant}_shed_total",
+                help="this tenant's shed submissions (all reasons)")
+        obs.inc(f"tenant_{tenant}_shed_{reason}_total",
+                help=f"this tenant's submissions shed by {reason}")
 
     def close(self) -> None:
         """Begin a drain: flip ``closed`` under the queue lock.  Set
@@ -125,29 +160,121 @@ class Scheduler:
 
     # -- engine side (step boundaries only) ---------------------------------
 
+    def _depth_locked(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
     @property
     def queue_depth(self) -> int:
         with self._lock:
-            return len(self._queue)
+            return self._depth_locked()
 
     def has_work(self) -> bool:
         return bool(self.running) or self.queue_depth > 0
 
+    def _head_locked(self):
+        """Highest-priority non-empty queue and its head request."""
+        for prio in sorted(self._queues):
+            q = self._queues[prio]
+            if q:
+                return q, q[0]
+        return None, None
+
+    def _pick_victim_locked(self, priority: int) -> Optional[Request]:
+        """The preemption victim for an admission at ``priority``: the
+        YOUNGEST active request of a strictly lower (larger-number)
+        preemptible class — last in, first preempted, so long-running
+        batch work accumulates the least wasted progress.  The engine's
+        ``preempt_guard`` vetoes slots mid-chunked-prefill."""
+        victim: Optional[Request] = None
+        for req in self.running.values():
+            pol = self.qos.policy(req.tenant)
+            if pol.priority <= priority or not pol.preemptible:
+                continue
+            if req.state != ACTIVE or req.slot is None:
+                continue
+            if self.preempt_guard is not None \
+                    and not self.preempt_guard(req.slot):
+                continue
+            if victim is None or (req.admitted_s or 0.0) \
+                    > (victim.admitted_s or 0.0):
+                victim = req
+        return victim
+
+    def _preempt_locked(self, victim: Request) -> None:
+        """Evict an ACTIVE request back to the FRONT of its class
+        queue, releasing slot + pages and resetting generation progress
+        (tokens restart from the prompt on re-admission).  Called only
+        from :meth:`admit` — i.e. only at a decode-step boundary, so
+        the compiled step never observes a half-evicted slot."""
+        slot = victim.slot
+        if slot is not None and self.running.get(slot) is victim:
+            del self.running[slot]
+            self.allocator.release(slot)
+        victim.slot = None
+        victim.state = QUEUED
+        victim.tokens.clear()
+        victim.token_gaps_s.clear()
+        victim.first_token_s = None
+        victim.prefill_s = None
+        victim.admitted_s = None
+        victim.done_s = None
+        victim.prefix_hit_tokens = 0
+        victim.prefilled_tokens = 0
+        victim.served_by = None
+        victim.preemptions += 1
+        self.preempted_total += 1
+        pol = self.qos.policy(victim.tenant)
+        self._queues.setdefault(pol.priority, deque()).appendleft(victim)
+        obs.inc("serve_preempted_total",
+                help="active requests preempted back to the queue by a "
+                     "higher-priority admission (step boundary only)")
+        if victim.tenant:
+            obs.inc(f"tenant_{victim.tenant}_preempted_total",
+                    help="this tenant's requests preempted by a "
+                         "higher-priority admission")
+        reqtrace.stage(victim.trace_id, "preempted", request=victim.id,
+                       preemptions=victim.preemptions)
+
     def admit(self) -> List[Request]:
         """Pop queued requests while a slot (and KV pages) are free;
         returns the newly-admitted batch for the engine to prefill.
-        FIFO head-of-line: a too-long request at the head blocks the
-        queue rather than being overtaken (no starvation)."""
+        Admission serves priority classes in ascending order, FIFO
+        head-of-line WITHIN a class: a too-long request at the head
+        blocks its queue rather than being overtaken (no starvation).
+        When the head is blocked on capacity and a strictly lower
+        (preemptible) class holds slots, the youngest such active
+        request is preempted — here and only here, so preemption is
+        step-boundary-exact by construction.  An over-quota head is
+        SHED (``serve_rejected_quota_total``) instead of blocking: its
+        footprint is the tenant's own doing."""
         out: List[Request] = []
         while True:
             with self._lock:
-                if not self._queue:
+                q, head = self._head_locked()
+                if head is None:
                     break
-                head = self._queue[0]
-                lease = self.allocator.allocate(head.id, head.total_len)
+                pol = self.qos.policy(head.tenant)
+                if self.allocator.exceeds_quota(
+                        head.tenant, head.total_len, pol.page_quota):
+                    q.popleft()
+                    head.state = SHED
+                    head._event.set()
+                    self.shed_total += 1
+                    obs.inc("serve_rejected_total", help=_REJECTED_HELP)
+                    obs.inc("serve_rejected_quota_total",
+                            help="admissions shed because the tenant "
+                                 "would exceed its KV-page quota")
+                    self._tenant_shed(head.tenant, "quota")
+                    continue
+                lease = self.allocator.allocate(
+                    head.id, head.total_len, tenant=head.tenant)
                 if lease is None:
-                    break
-                self._queue.popleft()
+                    victim = self._pick_victim_locked(pol.priority)
+                    if victim is None:
+                        break
+                    self._preempt_locked(victim)
+                    continue
+                q.popleft()
             head.slot = lease.slot
             head.state = ACTIVE
             # queue age is recorded AT ADMISSION, not at completion —
@@ -167,7 +294,10 @@ class Scheduler:
                     self.on_queue_wait(wait)
                 reqtrace.stage(head.trace_id, "replica_queue",
                                dur_s=wait, request=head.id)
-            self.running[lease.slot] = head
+            with self._lock:
+                # /stats and preemption scans read running under the
+                # lock — publish the slot assignment the same way
+                self.running[lease.slot] = head
             self.admitted_total += 1
             out.append(head)
         if out:
@@ -222,8 +352,10 @@ class Scheduler:
         preemption path: in-flight requests finish, queued ones are
         snapshotted for resubmission."""
         with self._lock:
-            out = list(self._queue)
-            self._queue.clear()
+            out = [r for prio in sorted(self._queues)
+                   for r in self._queues[prio]]
+            for q in self._queues.values():
+                q.clear()
         self._gauges()
         return out
 

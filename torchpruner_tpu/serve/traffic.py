@@ -96,6 +96,24 @@ def shared_prefix_requests(n: int, *, vocab: int, n_prefixes: int,
     return out
 
 
+def open_loop(requests: Sequence[Request], *, rate: float = 0.0,
+              stagger_steps: int = 2, seed: int = 0
+              ) -> "OpenLoopTraffic":
+    """THE open-loop schedule selector, shared by the serve frontend's
+    ``--synthetic`` mode, the bench serve legs and the fleet workload
+    replayer (one copy of the rate>0 → Poisson, else step-staggered
+    choice): ``rate > 0`` drives wall-clock Poisson arrivals at that
+    rate; ``rate == 0`` pins arrivals to engine ticks every
+    ``stagger_steps`` steps (fully deterministic)."""
+    if rate > 0:
+        return OpenLoopTraffic(
+            requests, poisson_arrivals(len(requests), rate, seed=seed))
+    return OpenLoopTraffic(
+        requests,
+        staggered_arrivals(len(requests), every_steps=stagger_steps),
+        by_step=True)
+
+
 class OpenLoopTraffic:
     """Feeds requests into an engine on an open-loop schedule.
 
