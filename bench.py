@@ -1491,6 +1491,11 @@ def _leg_fleet(smoke: bool) -> dict:
         "ts_streams": s.get("ts_streams"),
         "ts_windows": s.get("ts_windows"),
         "slo_burn_alerts": s.get("slo_burn_alerts"),
+        # incident-correlation verdicts (obs.incident): a kill drill
+        # plants no SLO breach, so the false-positive contract is
+        # incidents == 0
+        "incidents": s.get("incidents"),
+        "anomalies": s.get("anomalies"),
     }
 
 

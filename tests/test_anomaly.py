@@ -1,0 +1,289 @@
+"""Changepoint detection over the delta-window time-series
+(torchpruner_tpu.obs.anomaly): the rolling median/MAD robust z-score,
+score-then-admit warmup, hysteresis open/close with the dead band,
+warmup-excluded offline replay, the fleet per-process split, and the
+online hook on the recorder's tick."""
+
+import os
+
+import pytest
+
+from torchpruner_tpu import obs
+from torchpruner_tpu.obs.anomaly import (
+    AnomalyDetector,
+    RollingMAD,
+    detect_anomalies,
+    detect_series,
+    window_signals,
+)
+from torchpruner_tpu.obs.metrics import MetricsRegistry
+from torchpruner_tpu.obs.timeseries import TimeseriesRecorder
+
+
+@pytest.fixture(autouse=True)
+def _clean_session():
+    obs.shutdown()
+    yield
+    obs.shutdown()
+
+
+def _window(seq, ts, sig=None, counters=None, gauges=None, dur_s=1.0):
+    w = {"kind": "ts_window", "seq": seq, "ts": ts, "dur_s": dur_s}
+    g = dict(gauges or {})
+    if sig is not None:
+        g["sig_latency"] = sig
+    if g:
+        w["gauges"] = g
+    if counters:
+        w["counters"] = counters
+    return w
+
+
+def _detector(**kw):
+    kw.setdefault("gauge_prefixes", ("sig_",))
+    kw.setdefault("min_history", 4)
+    kw.setdefault("k", 2)
+    kw.setdefault("z_threshold", 8.0)
+    return AnomalyDetector(**kw)
+
+
+# -- RollingMAD --------------------------------------------------------------
+
+
+def test_rolling_mad_warms_up_then_scores():
+    tr = RollingMAD(min_history=4)
+    assert [tr.push(v) for v in (10, 10, 11, 9)] == [None] * 4
+    z = tr.push(10)  # in-family value: small z
+    assert z is not None and abs(z) < 2
+    z = tr.push(100)  # a 10x spike scored BEFORE admission
+    assert z > 8
+
+
+def test_rolling_mad_flat_baseline_uses_median_floor():
+    """A perfectly flat history has MAD 0 — the 5%-of-median floor
+    keeps epsilon jitter from scoring as infinite z."""
+    tr = RollingMAD(min_history=4)
+    for _ in range(8):
+        tr.push(10.0)
+    assert abs(tr.push(10.001)) < 1  # noise, not anomaly
+    assert tr.push(20.0) > 8  # a genuine 2x step still trips
+
+
+def test_spike_does_not_absorb_into_its_own_baseline():
+    tr = RollingMAD(min_history=4)
+    for _ in range(6):
+        tr.push(1.0)
+    first = tr.push(50.0)
+    second = tr.push(50.0)  # the spike is IN history now, but median holds
+    assert first > 8 and second > 8
+
+
+# -- window_signals ----------------------------------------------------------
+
+
+def test_window_signals_hist_p99_counters_and_gauges():
+    w = {
+        "kind": "ts_window", "seq": 1, "ts": 1.0, "dur_s": 2.0,
+        "hist": {"lat_seconds": {"le": [0.1, 1.0], "c": [0, 4],
+                                 "n": 4, "sum": 2.0}},
+        "counters": {"fleet_shed_total": 6, "steps_total": 100},
+        "gauges": {"sig_depth": 3.0, "other": 1.0},
+    }
+    sig = window_signals(w, gauge_prefixes=("sig_",))
+    assert sig["lat_seconds_p99"] == pytest.approx(1.0, rel=0.2)
+    # watchlist counters become rates; arbitrary counters do not
+    assert sig["fleet_shed_total_rate"] == pytest.approx(3.0)
+    assert "steps_total_rate" not in sig
+    # gauges are opt-in by prefix
+    assert sig["sig_depth"] == 3.0 and "other" not in sig
+
+
+# -- hysteresis --------------------------------------------------------------
+
+
+def test_anomaly_opens_after_k_deviant_windows_and_closes():
+    det = _detector()
+    t = 100.0
+    for i in range(6):  # baseline
+        det.observe_window(_window(i, t + i, sig=10.0))
+    assert det.counts() == {"opened": 0, "open": 0}
+    # first deviant window: streak 1 of K=2 — not yet open
+    det.observe_window(_window(10, t + 10, sig=100.0))
+    assert det.counts()["open"] == 0
+    out = det.observe_window(_window(11, t + 11, sig=100.0))
+    assert [a["state"] for a in out] == ["open"]
+    a = det.open_anomalies()[0]
+    assert a["metric"] == "sig_latency" and a["anomaly_id"] == "anom-1"
+    assert a["z"] > 8 and a["windows_deviant"] == 2
+    # recovery: K consecutive recovered windows close it
+    det.observe_window(_window(12, t + 12, sig=10.0))
+    assert det.counts()["open"] == 1
+    out = det.observe_window(_window(13, t + 13, sig=10.0))
+    assert [a["state"] for a in out] == ["closed"]
+    assert det.counts() == {"opened": 1, "open": 0}
+    assert det.anomalies[0]["closed_ts"] == pytest.approx(t + 13)
+
+
+def test_single_window_blip_never_opens():
+    det = _detector()
+    for i in range(6):
+        det.observe_window(_window(i, 100.0 + i, sig=10.0))
+    det.observe_window(_window(10, 110.0, sig=100.0))  # one blip
+    for i in range(11, 15):
+        det.observe_window(_window(i, 100.0 + i, sig=10.0))
+    assert det.counts() == {"opened": 0, "open": 0}
+
+
+def test_dead_band_resets_both_streaks():
+    """Values between the recover and open thresholds must neither
+    extend the deviant streak nor count toward recovery — no flapping."""
+    det = _detector()
+    for i in range(8):
+        det.observe_window(_window(i, 100.0 + i, sig=10.0))
+    det.observe_window(_window(10, 110.0, sig=100.0))  # deviant 1/2
+    det.observe_window(_window(11, 111.0, sig=13.0))   # dead band
+    det.observe_window(_window(12, 112.0, sig=100.0))  # deviant 1/2 again
+    assert det.counts()["open"] == 0
+
+
+def test_open_callback_fires_outside_lock_and_once():
+    seen = []
+    det = _detector(on_open=lambda a: seen.append(a["anomaly_id"]))
+    for i in range(6):
+        det.observe_window(_window(i, 100.0 + i, sig=10.0))
+    for i in range(6, 10):
+        det.observe_window(_window(i, 100.0 + i, sig=100.0))
+    assert seen == ["anom-1"]  # open once, not once per deviant window
+
+
+def test_gauge_history_and_gauges_between():
+    det = _detector()
+    for i in range(5):
+        det.observe_window(_window(i, 100.0 + i, sig=1.0,
+                                   gauges={"fleet_replica_r0_occupancy":
+                                           float(i)}))
+    hist = det.gauges_between(101.0, 103.0)
+    assert [ts for ts, _ in hist] == [101.0, 102.0, 103.0]
+    assert hist[0][1]["fleet_replica_r0_occupancy"] == 1.0
+
+
+# -- offline replay ----------------------------------------------------------
+
+
+def test_detect_series_excludes_warmup():
+    """A level shift inside the warmup quarter must not open; the same
+    shift in steady state must."""
+    warm = [_window(i, 100.0 + i, sig=50.0) for i in range(5)]
+    steady = [_window(10 + i, 110.0 + i, sig=10.0) for i in range(8)]
+    spike = [_window(30 + i, 130.0 + i, sig=100.0) for i in range(3)]
+    got = detect_series(warm + steady + spike, min_history=4, k=2,
+                        gauge_prefixes=("sig_",))
+    assert len(got) == 1
+    assert got[0]["metric"] == "sig_latency"
+    assert got[0]["opened_ts"] >= 130.0
+
+
+def test_detect_anomalies_reads_recorded_run(tmp_path):
+    """End to end through a real recorder file: flat latency then a
+    sustained 50x shift must be detected offline from the run dir."""
+    reg = MetricsRegistry()
+    rec = TimeseriesRecorder(reg, str(tmp_path), interval_s=0.01)
+    h = reg.histogram("serve_token_seconds")
+    for i in range(30):
+        for _ in range(4):
+            h.observe(0.010 if i < 22 else 0.500)
+        rec.tick()
+    rec.close()
+    got = detect_anomalies(str(tmp_path), min_history=4, k=2)
+    assert any(a["metric"] == "serve_token_seconds_p99" for a in got), got
+
+
+def test_detector_ids_carry_proc_prefix():
+    det = _detector(proc="replica1", min_history=2, k=1)
+    for i in range(4):
+        det.observe_window(_window(i, 100.0 + i, sig=10.0))
+    det.observe_window(_window(9, 109.0, sig=500.0))
+    a = det.anomalies[0]
+    assert a["anomaly_id"] == "anom-replica1-1" and a["proc"] == "replica1"
+
+
+# -- online hook -------------------------------------------------------------
+
+
+def test_recorder_on_window_feeds_detector(tmp_path):
+    reg = MetricsRegistry()
+    rec = TimeseriesRecorder(reg, str(tmp_path), interval_s=0.01)
+    det = _detector(min_history=2, k=1, gauge_prefixes=("serve_",))
+    rec.on_window = det.observe_window
+    g = reg.gauge("serve_depth")
+    for i in range(6):
+        g.set(1.0)
+        rec.tick()
+    g.set(500.0)
+    rec.tick()
+    rec.close()
+    assert det.counts()["opened"] == 1
+    assert det.anomalies[0]["metric"] == "serve_depth"
+
+
+def test_hot_path_overhead_with_detector_hook_installed(tmp_path):
+    """The PR 17 recorder budgets re-gated WITH the anomaly hook wired:
+    a not-due ``maybe_tick`` stays a clock read + compare (<100 µs),
+    and a due tick — registry walk + per-window scoring pass — stays
+    under 1% of a 1 Hz window."""
+    import time
+
+    reg = MetricsRegistry()
+    for i in range(8):
+        reg.counter(f"c{i}").inc()
+        reg.gauge(f"g{i}").set(i)
+        reg.histogram(f"h{i}").observe(0.001 * (i + 1))
+    rec = TimeseriesRecorder(reg, str(tmp_path), interval_s=3600.0)
+    det = AnomalyDetector(gauge_prefixes=("g",), min_history=4, k=2)
+    rec.on_window = det.observe_window
+    n = 5000
+    rec.maybe_tick()  # warm
+    t0 = time.perf_counter()
+    for _ in range(n):
+        rec.maybe_tick()
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 100e-6, f"maybe_tick cost {per_call * 1e6:.1f} µs"
+
+    m = 50
+    t0 = time.perf_counter()
+    for _ in range(m):
+        rec.tick()
+    per_tick = (time.perf_counter() - t0) / m
+    rec.close()
+    assert per_tick < 0.01, f"tick+score cost {per_tick * 1e3:.2f} ms"
+
+
+def test_session_wires_detector_and_ledgers_open(tmp_path):
+    """The configured session hooks detector → recorder → ledger: an
+    anomaly open lands in the ledger and assembles an incident."""
+    os.environ["TORCHPRUNER_ANOMALY_MIN_HISTORY"] = "2"
+    os.environ["TORCHPRUNER_ANOMALY_K"] = "1"
+    os.environ["TORCHPRUNER_ANOMALY_GAUGES"] = "probe_"
+    try:
+        s = obs.configure(str(tmp_path), process_index=0, annotate=False,
+                          watch_compiles=False, ts_interval_s=1000.0)
+        assert s.anomaly is not None and s.incidents is not None
+        g = s.metrics.gauge("probe_sig")
+        for _ in range(5):
+            g.set(1.0)
+            s.timeseries.tick()
+        g.set(400.0)
+        s.timeseries.tick()
+        assert s.anomaly.counts()["opened"] == 1
+        assert len(s.incidents.incidents) == 1
+        assert s.incidents.incidents[0]["kind"] == "anomaly"
+        obs.shutdown()
+        from torchpruner_tpu.obs.ledger import LEDGER_FILENAME, load_ledger
+        recs = load_ledger(os.path.join(str(tmp_path), LEDGER_FILENAME))
+        assert any(r.get("event") == "anomaly" for r in recs)
+        assert any(r.get("event") == "incident" for r in recs)
+    finally:
+        for k in ("TORCHPRUNER_ANOMALY_MIN_HISTORY",
+                  "TORCHPRUNER_ANOMALY_K",
+                  "TORCHPRUNER_ANOMALY_GAUGES"):
+            os.environ.pop(k, None)

@@ -1,0 +1,344 @@
+"""Incident correlation (torchpruner_tpu.obs.incident): deterministic
+suspect scoring (proximity x prior x replica match), trigger-echo
+exclusion, absorb-coalescing (exactly one incident per episode), the
+online correlator through the session's ``record_serve`` hook, the
+supervisor's ``correlation_id``, the SLO burn-episode histogram, offline
+reconstruction from a run dir's artifacts, and the ``obs incident`` CLI
+exit-code contract."""
+
+import json
+import os
+
+import pytest
+
+from torchpruner_tpu import obs
+from torchpruner_tpu.obs.incident import (
+    IncidentCorrelator,
+    assemble_incident,
+    assemble_run_incidents,
+    correlate,
+    rank_suspects,
+    replica_hint,
+    score_candidate,
+    sparkline,
+    triggers_of,
+)
+from torchpruner_tpu.obs.ledger import LEDGER_FILENAME, load_ledger
+from torchpruner_tpu.obs.metrics import MetricsRegistry
+from torchpruner_tpu.obs.report import obs_main
+from torchpruner_tpu.serve.slo import SLOMonitor
+
+
+@pytest.fixture(autouse=True)
+def _clean_session():
+    obs.shutdown()
+    yield
+    obs.shutdown()
+
+
+class _Ledger:
+    def __init__(self, recs=None):
+        self.recs = list(recs or [])
+
+    def records(self, event=None):
+        return [r for r in self.recs
+                if event is None or r.get("event") == event]
+
+    def record(self, rec):
+        self.recs.append(dict(rec))
+
+
+def _trigger(ts=1000.0, replica="replica0"):
+    return {"kind": "slo_burn", "ts": ts, "metric": "token",
+            "replica": replica, "burn_fast": 50.0, "burn_slow": 20.0}
+
+
+# -- scoring -----------------------------------------------------------------
+
+
+def test_score_candidate_horizon_and_factors():
+    rec = {"event": "serve", "kind": "chaos_injection",
+           "replica": "replica0", "ts": 990.0}
+    score, f = score_candidate(rec, 1000.0, "replica0", 100.0)
+    # proximity 0.9 x prior 1.0 x same-replica 1.0
+    assert score == pytest.approx(0.9)
+    assert f == {"proximity": 0.9, "prior": 1.0, "replica_match": 1.0,
+                 "dt_s": -10.0}
+    # outside the horizon: not a candidate at all
+    assert score_candidate(rec, 2000.0, "replica0", 100.0) is None
+    # replica mismatch quarters the score; unknown replica halves it
+    s_mismatch, _ = score_candidate(rec, 1000.0, "replica1", 100.0)
+    assert s_mismatch == pytest.approx(0.9 * 0.25)
+    s_unknown, _ = score_candidate(
+        {"event": "serve", "kind": "scale_decision", "ts": 990.0},
+        1000.0, "replica0", 100.0)
+    assert s_unknown == pytest.approx(0.9 * 0.8 * 0.5)
+
+
+def test_rank_suspects_planted_cause_wins_and_echo_excluded():
+    records = [
+        # the trigger's own ledger record: must NOT rank
+        {"event": "serve", "kind": "slo_burn", "replica": "replica0",
+         "ts": 1000.2, "metric": "token"},
+        {"event": "serve", "kind": "chaos_injection",
+         "replica": "replica0", "ts": 978.0, "chaos": "slow_replica",
+         "slow_steps_ms": 250},
+        {"event": "serve", "kind": "scale_decision", "ts": 995.0,
+         "action": "scale_up"},
+        {"event": "serve", "kind": "hot_swap", "replica": "replica1",
+         "ts": 999.0},
+        # excluded event classes never rank
+        {"event": "reqtrace", "ts": 999.5, "exemplars": []},
+        {"event": "round", "ts": 999.6},
+    ]
+    got = rank_suspects(records, _trigger(), 120.0)
+    assert [s["class"] for s in got] == [
+        "chaos_injection", "scale_decision", "hot_swap"]
+    assert [s["rank"] for s in got] == [1, 2, 3]
+    top = got[0]
+    assert top["replica"] == "replica0"
+    assert "slow_steps_ms=250" in top["evidence"]
+    # deterministic: same input, same order
+    assert got == rank_suspects(records, _trigger(), 120.0)
+
+
+def test_rank_ties_break_by_time_then_class():
+    records = [
+        {"event": "serve", "kind": "preemption", "ts": 990.0},
+        {"event": "serve", "kind": "preemption", "ts": 980.0},
+    ]
+    got = rank_suspects(records, _trigger(), 120.0)
+    # equal class/prior: nearer in time scores higher
+    assert got[0]["ts"] == 990.0 and got[0]["rank"] == 1
+
+
+def test_replica_hint_parses_router_scrape_gauges():
+    assert replica_hint("fleet_replica_replica2_occupancy") == "replica2"
+    assert replica_hint("fleet_replica_r0_queue_depth") == "r0"
+    assert replica_hint("serve_token_seconds_p99") is None
+
+
+def test_assemble_incident_shape():
+    records = [{"event": "serve", "kind": "chaos_injection",
+                "replica": "replica0", "ts": 990.0}]
+    inc = assemble_incident(_trigger(), records, incident_id="inc-1",
+                            lookback_s=100.0)
+    assert inc["event"] == "incident" and inc["incident_id"] == "inc-1"
+    assert inc["span"] == {"t0": 900.0, "t1": 1100.0}
+    assert inc["top_suspect"]["class"] == "chaos_injection"
+    assert inc["triggers_absorbed"] == 0
+    # strict JSON round-trip (it is a ledger record)
+    json.dumps(inc)
+
+
+# -- online correlator -------------------------------------------------------
+
+
+def test_correlator_absorbs_triggers_within_lookback():
+    led = _Ledger([{"event": "serve", "kind": "chaos_injection",
+                    "replica": "replica0", "ts": 990.0}])
+    c = IncidentCorrelator(ledger=led, lookback_s=100.0)
+    inc = c.trigger(kind="slo_burn", ts=1000.0, metric="token",
+                    replica="replica0")
+    assert inc is not None and inc["incident_id"] == "inc-1"
+    # a second trigger in-window folds in instead of opening a new one
+    assert c.trigger(kind="slo_burn", ts=1050.0, metric="ttft") is None
+    assert c.trigger(kind="anomaly", ts=1080.0,
+                     anomaly_id="anom-7") is None
+    assert len(c.incidents) == 1
+    assert c.incidents[0]["triggers_absorbed"] == 2
+    assert "anom-7" in c.incidents[0]["anomalies"]
+    # far outside the window: a fresh incident
+    assert c.trigger(kind="slo_burn", ts=5000.0)["incident_id"] == "inc-2"
+    # both ledgered exactly once each
+    assert len(led.records(event="incident")) == 2
+
+
+def test_correlator_active_id_window():
+    c = IncidentCorrelator(lookback_s=100.0)
+    assert c.active_id(now=1000.0) is None
+    c.trigger(kind="slo_burn", ts=1000.0, replica="replica0")
+    assert c.active_id(now=1050.0) == "inc-1"
+    assert c.active_id(now=2000.0) is None
+
+
+def test_correlator_finalize_sets_gauges_even_when_zero():
+    reg = MetricsRegistry()
+    IncidentCorrelator(lookback_s=10.0).finalize(reg)
+    snap = reg.snapshot()
+    assert snap["incident_count"] == 0.0
+    assert snap["incident_top_suspect_score"] == 0.0
+    c = IncidentCorrelator(ledger=_Ledger([
+        {"event": "serve", "kind": "chaos_injection",
+         "replica": "replica0", "ts": 995.0}]), lookback_s=100.0)
+    c.trigger(kind="slo_burn", ts=1000.0, replica="replica0")
+    c.trigger(kind="slo_burn", ts=1001.0)
+    c.finalize(reg)
+    snap = reg.snapshot()
+    assert snap["incident_count"] == 1.0
+    assert snap["incident_absorbed_triggers"] == 1.0
+    assert snap["incident_top_suspect_score"] > 0.9
+
+
+def test_record_serve_burn_hook_opens_incident(tmp_path):
+    """The wiring serve AND fleet frontends get for free: any ledgered
+    ``slo_burn`` through ``record_serve`` triggers the correlator,
+    anchored at the carried ``burn_ts`` (not the re-record time)."""
+    obs.configure(str(tmp_path), process_index=0, annotate=False,
+                  watch_compiles=False, ts_interval_s=0)
+    s = obs.get()
+    obs.record_serve(kind="chaos_injection", replica="replica0",
+                     chaos="slow_replica", slow_steps_ms=250,
+                     ts=990.0)
+    obs.record_serve(kind="slo_burn", metric="token",
+                     replica="replica0", burn_fast=50.0,
+                     burn_slow=20.0, ts=2000.0, burn_ts=1000.0)
+    assert len(s.incidents.incidents) == 1
+    inc = s.incidents.incidents[0]
+    assert inc["ts"] == 1000.0  # anchored at burn_ts
+    assert inc["top_suspect"]["class"] == "chaos_injection"
+    assert obs.active_incident_id() is None  # wall clock far past 1000
+    obs.shutdown()
+    m = json.load(open(os.path.join(str(tmp_path),
+                                    "report.json")))["metrics"]
+    assert m["incident_count"] == 1.0
+    recs = load_ledger(os.path.join(str(tmp_path), LEDGER_FILENAME))
+    assert sum(1 for r in recs if r.get("event") == "incident") == 1
+
+
+def test_active_incident_id_rides_scale_decisions(tmp_path):
+    """Satellite: the supervisor stamps ``correlation_id`` from
+    ``obs.active_incident_id()`` — live incident id inside the lookback
+    window, null otherwise."""
+    assert obs.active_incident_id() is None  # no session: never raises
+    obs.configure(str(tmp_path), process_index=0, annotate=False,
+                  watch_compiles=False, ts_interval_s=0)
+    s = obs.get()
+    import time
+    s.incidents.trigger(kind="slo_burn", ts=time.time(),
+                        metric="token", replica="replica0")
+    assert obs.active_incident_id() == "inc-1"
+    obs.record_serve(kind="scale_decision", action="scale_up",
+                     correlation_id=obs.active_incident_id())
+    obs.shutdown()
+    recs = load_ledger(os.path.join(str(tmp_path), LEDGER_FILENAME))
+    dec = [r for r in recs if r.get("kind") == "scale_decision"]
+    assert dec and dec[0]["correlation_id"] == "inc-1"
+
+
+# -- SLO burn episode metrics (satellite) ------------------------------------
+
+
+def test_burn_episode_duration_histogram_and_active_gauge(tmp_path):
+    obs.configure(str(tmp_path), process_index=0, annotate=False,
+                  watch_compiles=False, ts_interval_s=0)
+    m = SLOMonitor(token_p99_s=0.010, check_every_steps=1,
+                   min_samples=8)
+    t0 = 1000.0
+    for i in range(20):  # sustained breach fires the episode
+        t = t0 + i * 0.1
+        m.on_token(0.050, ts=t)
+        m.check(step=i, now=t)
+    snap = obs.get().metrics.snapshot()
+    assert snap["slo_burn_active"] == 1.0
+    assert snap.get("slo_burn_episode_seconds_count", 0) == 0
+    for i in range(200):  # recovery re-arms and observes the duration
+        t = t0 + 4.0 + i * 0.1
+        m.on_token(0.001, ts=t)
+        m.check(step=100 + i, now=t)
+    snap = obs.get().metrics.snapshot()
+    assert snap["slo_burn_active"] == 0.0
+    assert snap["slo_burn_episode_seconds_count"] == 1
+    # fired at ~t0+1.9s, recovered within the sweep: a sane duration
+    assert 0.0 < snap["slo_burn_episode_seconds_sum"] < 30.0
+
+
+# -- offline -----------------------------------------------------------------
+
+
+def test_triggers_of_prefers_original_burn_ts():
+    records = [{"event": "serve", "kind": "slo_burn", "metric": "token",
+                "replica": "replica0", "ts": 2000.0, "burn_ts": 1000.0}]
+    anomalies = [{"anomaly_id": "anom-replica1-1", "opened_ts": 1500.0,
+                  "metric": "serve_token_seconds_p99",
+                  "proc": "replica1", "z": 12.0}]
+    got = triggers_of(records, anomalies)
+    assert got[0]["ts"] == 1000.0 and got[0]["kind"] == "slo_burn"
+    assert got[1]["replica"] == "replica1"  # proc names the replica
+
+
+def test_correlate_coalesces_like_online():
+    triggers = [_trigger(ts=1000.0), _trigger(ts=1050.0),
+                {"kind": "anomaly", "ts": 5000.0,
+                 "anomaly_id": "anom-1", "metric": "x_p99"}]
+    incidents = correlate(triggers, [], lookback_s=100.0)
+    assert [i["incident_id"] for i in incidents] == ["inc-1", "inc-2"]
+    assert incidents[0]["triggers_absorbed"] == 1
+    assert incidents[1]["kind"] == "anomaly"
+    assert incidents[1]["anomalies"] == ["anom-1"]
+
+
+def test_assemble_run_incidents_from_artifacts(tmp_path):
+    """Offline reconstruction from a dir holding only a ledger — the
+    kill -9 path: no session close, no finalize, still a postmortem."""
+    with open(os.path.join(str(tmp_path), LEDGER_FILENAME), "w") as f:
+        for rec in (
+            {"event": "serve", "kind": "chaos_injection",
+             "replica": "replica0", "chaos": "slow_replica",
+             "slow_steps_ms": 250, "ts": 990.0},
+            {"event": "serve", "kind": "slo_burn", "metric": "token",
+             "replica": "replica0", "burn_fast": 50.0,
+             "burn_slow": 20.0, "ts": 1000.0, "burn_ts": 1000.0},
+        ):
+            f.write(json.dumps(rec) + "\n")
+        f.write('{"event": "serve", "kind": "slo_burn", "tor')  # torn
+    out = assemble_run_incidents(str(tmp_path), lookback_s=100.0)
+    assert len(out["incidents"]) == 1
+    inc = out["incidents"][0]
+    assert inc["top_suspect"]["class"] == "chaos_injection"
+    assert inc["top_suspect"]["replica"] == "replica0"
+    assert len(out["burns"]) == 1
+
+
+def test_incident_cli_renders_and_exit_codes(tmp_path, capsys):
+    # covered burn: exit 0, postmortem names the planted cause
+    with open(os.path.join(str(tmp_path), LEDGER_FILENAME), "w") as f:
+        f.write(json.dumps(
+            {"event": "serve", "kind": "chaos_injection",
+             "replica": "replica0", "chaos": "slow_replica",
+             "ts": 990.0}) + "\n")
+        f.write(json.dumps(
+            {"event": "serve", "kind": "slo_burn", "metric": "token",
+             "replica": "replica0", "burn_fast": 50.0,
+             "burn_slow": 20.0, "ts": 1000.0,
+             "burn_ts": 1000.0}) + "\n")
+    assert obs_main(["incident", str(tmp_path)]) == 0
+    md = capsys.readouterr().out
+    assert "chaos_injection" in md and "| rank |" in md
+    assert "reconstructed offline" in md  # no ledgered incident record
+    # --json emits machine-readable output
+    assert obs_main(["incident", str(tmp_path), "--json"]) == 0
+    j = json.loads(capsys.readouterr().out)
+    assert j["reconstructed"] and len(j["incidents"]) == 1
+
+
+def test_incident_cli_exit_1_on_unexplained_burn(tmp_path, capsys):
+    """A ledgered incident that does NOT cover a ledgered burn means
+    the postmortem is incomplete — the CLI must say so loudly."""
+    inc = assemble_incident(_trigger(ts=1000.0), [],
+                            incident_id="inc-1", lookback_s=100.0)
+    with open(os.path.join(str(tmp_path), LEDGER_FILENAME), "w") as f:
+        f.write(json.dumps(inc) + "\n")
+        f.write(json.dumps(
+            {"event": "serve", "kind": "slo_burn", "metric": "ttft",
+             "replica": "replica1", "burn_fast": 30.0,
+             "burn_slow": 15.0, "ts": 9000.0,
+             "burn_ts": 9000.0}) + "\n")
+    assert obs_main(["incident", str(tmp_path)]) == 1
+    err = capsys.readouterr().err
+    assert "UNEXPLAINED BURN" in err
+
+
+def test_sparkline_renders_range():
+    s = sparkline([0.0, 0.5, 1.0])
+    assert len(s) == 3 and s[0] == "▁" and s[-1] == "█"

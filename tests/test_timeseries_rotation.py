@@ -1,0 +1,150 @@
+"""Reader coverage across rotated time-series streams (satellite of the
+incident-correlation PR): ``load_series`` / ``segment_percentiles`` /
+``aggregate_windows`` must behave identically whether a run's windows
+live in one ``metrics_ts.jsonl`` or straddle rotated backups — including
+the interaction of a torn tail (kill -9 mid-write) with histogram bucket
+bounds that shipped once in a window now living in an older backup."""
+
+import json
+import os
+
+import pytest
+
+from torchpruner_tpu.obs.metrics import MetricsRegistry
+from torchpruner_tpu.obs.timeseries import (
+    TS_FILENAME,
+    TimeseriesRecorder,
+    aggregate_windows,
+    load_series,
+    segment_percentiles,
+    series_paths,
+    split_warmup,
+    window_quantile,
+)
+
+
+def _record_run(tmp_path, n_windows=24, per_window=3, value=0.010,
+                **kw):
+    """A run with a histogram observed in EVERY window, forced through
+    rotation with a tiny byte budget."""
+    reg = MetricsRegistry()
+    # enough backups to keep EVERY window: these tests exercise the
+    # read seam between files, not the pruning policy
+    rec = TimeseriesRecorder(reg, str(tmp_path), interval_s=0.01,
+                             rotate_bytes=kw.pop("rotate_bytes", 1000),
+                             backups=kw.pop("backups", 8), **kw)
+    h = reg.histogram("lat_seconds")
+    c = reg.counter("reqs_total")
+    for i in range(n_windows):
+        for _ in range(per_window):
+            h.observe(value)
+            c.inc()
+        rec.tick()
+    rec.close()
+    return os.path.join(str(tmp_path), TS_FILENAME)
+
+
+def test_bounds_carry_forward_across_rotation_boundary(tmp_path):
+    """The ``le`` bounds ship once (first window, oldest backup after
+    rotation); every later window — including those in a different
+    file — must still reconstruct per-window quantiles."""
+    path = _record_run(tmp_path)
+    assert len(series_paths(path)) > 1, "rotation never happened"
+    _, windows = load_series(str(tmp_path))
+    with_hist = [w for w in windows if "lat_seconds" in
+                 (w.get("hist") or {})]
+    assert len(with_hist) >= 20
+    # raw on-disk: only the FIRST occurrence carries bounds...
+    raw = [json.loads(line) for p in series_paths(path)
+           for line in open(p) if line.strip()]
+    raw_hists = [r["hist"]["lat_seconds"] for r in raw
+                 if r.get("kind") == "ts_window"
+                 and "lat_seconds" in (r.get("hist") or {})]
+    assert "le" in raw_hists[0]
+    assert all("le" not in h for h in raw_hists[1:])
+    # ...but the reader re-attaches them to every window, so quantile
+    # reconstruction works on windows from the NEWEST file too
+    for w in with_hist:
+        assert window_quantile(w, "lat_seconds", 0.99) is not None
+
+
+def test_aggregate_and_segment_span_rotation_boundary(tmp_path):
+    _record_run(tmp_path, n_windows=24, per_window=3)
+    _, windows = load_series(str(tmp_path))
+    agg = aggregate_windows(windows, "lat_seconds")
+    assert agg is not None
+    assert agg["n"] == 24 * 3  # no window lost at the boundary
+    assert agg["sum"] == pytest.approx(24 * 3 * 0.010, rel=1e-6)
+    seg = segment_percentiles(windows, "lat_seconds")
+    assert seg["n"] == 72
+    assert seg["mean"] == pytest.approx(0.010, rel=1e-6)
+    assert seg["p50"] is not None and seg["p99"] is not None
+    # a segment drawn ONLY from late windows (all in the newest file,
+    # none of which shipped bounds on disk) still reconstructs
+    _, steady = split_warmup(windows, 0.5)
+    late = segment_percentiles(steady, "lat_seconds")
+    assert late is not None and late["n"] == sum(
+        w["hist"]["lat_seconds"]["n"] for w in steady
+        if "lat_seconds" in (w.get("hist") or {}))
+
+
+def test_torn_tail_on_newest_file_keeps_rotated_history(tmp_path):
+    """kill -9 mid-append: the torn final line is dropped, every intact
+    window in the live file AND the backups survives, and bucket bounds
+    carried from the rotated prefix still apply to the kept windows."""
+    path = _record_run(tmp_path, n_windows=24)
+    _, before = load_series(str(tmp_path))
+    with open(path, "a") as f:
+        f.write('{"kind": "ts_window", "seq": 999, "hist": {"lat')
+    _, after = load_series(str(tmp_path))
+    assert [w["seq"] for w in after] == [w["seq"] for w in before]
+    # aggregation unchanged by the torn tail
+    assert aggregate_windows(after, "lat_seconds")["n"] == \
+        aggregate_windows(before, "lat_seconds")["n"]
+    last = [w for w in after
+            if "lat_seconds" in (w.get("hist") or {})][-1]
+    assert window_quantile(last, "lat_seconds", 0.5) is not None
+
+
+def test_torn_tail_in_rotated_backup_is_skipped_too(tmp_path):
+    """Rotation can race a kill: a torn line at the end of a BACKUP
+    (not just the live file) must be skipped without losing the rest
+    of that backup or the files after it."""
+    path = _record_run(tmp_path, n_windows=24)
+    backups = [p for p in series_paths(path) if p != path]
+    assert backups
+    with open(backups[0], "a") as f:
+        f.write('{"kind": "ts_window", "seq": 998, "coun')
+    _, windows = load_series(str(tmp_path))
+    seqs = [w["seq"] for w in windows]
+    assert seqs == sorted(seqs)
+    # windows after the torn backup (later backups + live file) kept
+    assert seqs[-1] == 25  # 24 ticks + forced close window
+    assert aggregate_windows(windows, "lat_seconds")["n"] == 72
+
+
+def test_value_shift_across_boundary_is_visible_in_segments(tmp_path):
+    """Percentile reconstruction must see a latency shift that happens
+    to coincide with a file rotation — the reader seam can't smooth or
+    drop it (this is the signal the anomaly detector scores)."""
+    reg = MetricsRegistry()
+    rec = TimeseriesRecorder(reg, str(tmp_path), interval_s=0.01,
+                             rotate_bytes=1000, backups=8)
+    h = reg.histogram("lat_seconds")
+    for i in range(30):
+        for _ in range(3):
+            h.observe(0.010 if i < 20 else 0.500)
+        rec.tick()
+    rec.close()
+    path = os.path.join(str(tmp_path), TS_FILENAME)
+    assert len(series_paths(path)) > 1
+    _, windows = load_series(str(tmp_path))
+    hist_windows = [w for w in windows
+                    if "lat_seconds" in (w.get("hist") or {})]
+    early = segment_percentiles(hist_windows[:20], "lat_seconds")
+    late = segment_percentiles(hist_windows[20:], "lat_seconds")
+    assert early["p99"] < 0.1 < late["p50"]
+    full = segment_percentiles(hist_windows, "lat_seconds")
+    assert full["n"] == 90
+    assert full["mean"] == pytest.approx(
+        (20 * 3 * 0.010 + 10 * 3 * 0.500) / 90, rel=1e-6)

@@ -20,6 +20,7 @@ Examples::
     python -m torchpruner_tpu obs report logs/fleet/obs   # latency budget
     python -m torchpruner_tpu obs report logs/obs
     python -m torchpruner_tpu obs watch logs/obs       # live time-series
+    python -m torchpruner_tpu obs incident logs/fleet/obs  # postmortem
     python -m torchpruner_tpu --preset mnist_mlp_shapley --smoke \\
         --obs-dir logs/obs --profile-every 20
     python -m torchpruner_tpu obs profile logs/obs

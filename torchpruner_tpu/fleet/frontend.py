@@ -126,6 +126,8 @@ def spawn_fleet(preset: str, args, fleet_dir: str,
                 chaos: FleetChaos) -> List[ReplicaProcess]:
     """Spawn + wait-listening on every replica.  All replicas share the
     seed/checkpoint and geometry — the redrive bit-identity contract."""
+    from torchpruner_tpu import obs
+
     procs: List[ReplicaProcess] = []
     for i in range(args.replicas):
         port = free_port()
@@ -136,6 +138,14 @@ def spawn_fleet(preset: str, args, fleet_dir: str,
         if chaos.slow_replica_ms > 0 and i == chaos.replica_index:
             env["TORCHPRUNER_CHAOS"] = json.dumps(
                 {"slow_steps_ms": chaos.slow_replica_ms})
+            # the planted fault is provenance: ledgered at injection
+            # time, so the incident correlator can NAME it — the CI
+            # planted-cause drill asserts the top-ranked suspect is
+            # this record, by replica and event class (obs.incident)
+            obs.record_serve(kind="chaos_injection",
+                             chaos="slow_replica",
+                             replica=f"replica{i}",
+                             slow_steps_ms=chaos.slow_replica_ms)
         rep = ReplicaProcess(
             name=f"replica{i}", port=port,
             argv=replica_argv(preset, port, args, obs_dir, run_dir),
@@ -177,6 +187,8 @@ class _ChaosTrigger:
         self.hung: List[str] = []
 
     def __call__(self, router: FleetRouter) -> None:
+        from torchpruner_tpu import obs
+
         c = self.chaos
         idx = c.replica_index
         if 0 <= c.kill_replica_at_step <= router.dispatched_total \
@@ -185,6 +197,9 @@ class _ChaosTrigger:
             print(f"[fleet] chaos: kill -9 {victim.name} at dispatch "
                   f"{router.dispatched_total}", file=sys.stderr,
                   flush=True)
+            obs.record_serve(kind="chaos_injection",
+                             chaos="kill_replica", replica=victim.name,
+                             at_dispatch=router.dispatched_total)
             victim.kill9()
             self.killed.append(victim.name)
         if 0 <= c.hang_replica_at_step <= router.dispatched_total \
@@ -193,6 +208,9 @@ class _ChaosTrigger:
             print(f"[fleet] chaos: SIGSTOP {victim.name} at dispatch "
                   f"{router.dispatched_total}", file=sys.stderr,
                   flush=True)
+            obs.record_serve(kind="chaos_injection",
+                             chaos="hang_replica", replica=victim.name,
+                             at_dispatch=router.dispatched_total)
             victim.hang()
             self.hung.append(victim.name)
 
@@ -346,11 +364,15 @@ def run_drill(preset: str, args, fleet_dir: str,
             os.path.join(fleet_dir, "obs"), [p.obs_dir for p in procs])
     except Exception:
         ts_merge = {"streams": 0, "windows": 0}
-    # replica-ledgered burn-rate alerts re-homed into the FLEET ledger
-    # (the merged report's provenance of the incident), and the drill's
-    # pass/fail signal: the planted slow_replica_ms drill must fire one
-    burn_alerts = _collect_burn_alerts(procs)
+    # tracing BEFORE burn collection: the ledgered reqtrace record
+    # (slowest-K exemplars) must exist when a re-recorded burn alert
+    # triggers the incident correlator, so the incident carries them
     trace_fields = _finalize_tracing(os.path.join(fleet_dir, "obs"))
+    # replica-ledgered burn-rate alerts re-homed into the FLEET ledger
+    # (each re-record fires the obs.record_serve incident hook — this
+    # is where fleet incidents assemble), and the drill's pass/fail
+    # signal: the planted slow_replica_ms drill must fire one
+    burn_alerts = _collect_burn_alerts(procs)
 
     records = plane.records()
     completed = [r for r in records if r.state == COMPLETED]
@@ -378,6 +400,7 @@ def run_drill(preset: str, args, fleet_dir: str,
         "ts_streams": ts_merge["streams"],
         "ts_windows": ts_merge["windows"],
         "slo_burn_alerts": len(burn_alerts),
+        **_incident_counts(),
         "affinity_preferred": router.affinity_preferred_total,
         "affinity_hits": router.affinity_hits_total,
         "affinity_hit_rate": round(
@@ -582,6 +605,10 @@ def run_scenario(preset: str, args, fleet_dir: str,
     except Exception:
         ts_merge = {"streams": 0, "windows": 0}
     trace_fields = _finalize_tracing(os.path.join(fleet_dir, "obs"))
+    # same epilogue as the drill: replica burns re-homed into the fleet
+    # ledger (incident correlation included) — informational here, the
+    # scenario's verdict stays with the robustness asserts below
+    burn_alerts = _collect_burn_alerts(procs)
 
     records = plane.records()
     completed = [r for r in records if r.state == COMPLETED]
@@ -603,6 +630,8 @@ def run_scenario(preset: str, args, fleet_dir: str,
         "shards_merged": sum(bool(v) for v in shards.values()),
         "ts_streams": ts_merge["streams"],
         "ts_windows": ts_merge["windows"],
+        "slo_burn_alerts": len(burn_alerts),
+        **_incident_counts(),
         "tenants": tenant_table,
         "wall_s": round(wall, 3),
         **trace_fields,
@@ -663,6 +692,21 @@ def run_scenario(preset: str, args, fleet_dir: str,
                   flush=True)
         return 1
     return 0
+
+
+def _incident_counts() -> dict:
+    """The fleet session's incident/anomaly tallies for the summary
+    line (zeros without a session — e.g. unit tests calling the run_*
+    helpers directly)."""
+    from torchpruner_tpu import obs
+
+    s = obs.get()
+    out = {"incidents": 0, "anomalies": 0}
+    if s is not None and s.incidents is not None:
+        out["incidents"] = len(s.incidents.incidents)
+    if s is not None and s.anomaly is not None:
+        out["anomalies"] = s.anomaly.counts()["opened"]
+    return out
 
 
 def _collect_burn_alerts(procs) -> List[dict]:
@@ -852,8 +896,10 @@ def run_http(preset: str, args, fleet_dir: str,
         except Exception:
             pass
         trace_fields = _finalize_tracing(os.path.join(fleet_dir, "obs"))
+        burn_alerts = _collect_burn_alerts(procs)
         print(json.dumps({"mode": "http", **router.snapshot(),
-                          **trace_fields}),
+                          "slo_burn_alerts": len(burn_alerts),
+                          **_incident_counts(), **trace_fields}),
               file=sys.stderr, flush=True)
     return rc
 

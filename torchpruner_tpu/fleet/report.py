@@ -279,6 +279,64 @@ def merge_timeseries(fleet_obs_dir: str,
     return {"streams": streams, "windows": len(merged)}
 
 
+def assemble_fleet_incidents(fleet_obs_dir: str,
+                             lookback_s: Optional[float] = None
+                             ) -> Dict[str, object]:
+    """Offline fleet-merged incident assembly **on the router clock**
+    (the ``obs incident DIR`` reconstruction path for fleet dirs): the
+    fleet ledger's burn alerts (re-homed with their original
+    ``burn_ts``) plus per-process offline anomaly detection over
+    ``metrics_ts_fleet.jsonl`` become triggers; suspects come from the
+    fleet ledger (chaos injections, scale decisions, swaps); gauge
+    deltas from the router process's windows (the scrape history —
+    ``fleet_replica_*`` gauges); exemplars from the ledgered reqtrace
+    record.  Same coalescing as the online correlator, so a kill -9'd
+    drill reconstructs the same postmortem.  Returns
+    ``{"incidents", "anomalies", "burns", "records"}``."""
+    from torchpruner_tpu.obs import incident
+    from torchpruner_tpu.obs.anomaly import detect_anomalies
+    from torchpruner_tpu.obs.ledger import LEDGER_FILENAME, load_ledger
+    from torchpruner_tpu.obs.timeseries import (
+        TS_FLEET_FILENAME,
+        load_series,
+    )
+
+    path = os.path.join(fleet_obs_dir, LEDGER_FILENAME)
+    records = load_ledger(path) if os.path.exists(path) else []
+    try:
+        anomalies = detect_anomalies(fleet_obs_dir)
+    except Exception:
+        anomalies = []
+    try:
+        _, windows = load_series(
+            os.path.join(fleet_obs_dir, TS_FLEET_FILENAME))
+    except Exception:
+        windows = []
+    router_windows = [w for w in windows
+                      if (w.get("proc") or "router") == "router"]
+    gauge_history = [(w.get("ts") or 0.0, w["gauges"])
+                     for w in router_windows if w.get("gauges")]
+    exemplars = None
+    for rec in reversed(records):
+        if rec.get("event") == "reqtrace" and rec.get("exemplars"):
+            exemplars = rec["exemplars"]
+            break
+    tenants: List[str] = []
+    if gauge_history:
+        try:
+            tenants = incident.affected_tenants(gauge_history[-1][1])
+        except Exception:
+            tenants = []
+    burns = [r for r in records
+             if r.get("event") == "serve" and r.get("kind") == "slo_burn"]
+    incidents = incident.correlate(
+        incident.triggers_of(records, anomalies), records,
+        lookback_s=lookback_s, gauge_history=gauge_history,
+        exemplars=exemplars, tenants=tenants or None)
+    return {"incidents": incidents, "anomalies": anomalies,
+            "burns": burns, "records": records}
+
+
 def replica_summary_line(log_path: str) -> Optional[dict]:
     """The last JSON line a serve front end printed (its run summary),
     scraped from the replica's captured output — best-effort."""

@@ -190,8 +190,14 @@ class Supervisor:
     # -- actuation -----------------------------------------------------------
 
     def _ledger(self, action: str, sig: dict, **detail) -> None:
-        """Journal the decision (ledger + counters) BEFORE its effect."""
-        rec = {"action": action, "trigger": sig, **detail}
+        """Journal the decision (ledger + counters) BEFORE its effect.
+        Every record carries ``correlation_id`` — the incident/anomaly
+        in effect when the decision was taken (or null) — so a
+        postmortem links decision→signal without timestamp guessing,
+        and the incident correlator ranks the decision as a suspect
+        with the link already in the evidence (obs.incident)."""
+        rec = {"action": action, "trigger": sig,
+               "correlation_id": obs.active_incident_id(), **detail}
         obs.record_serve(kind="scale_decision", t_s=round(
             self._now() - self._t0, 3), **rec)
         obs.inc(f"scale_{action}_total",

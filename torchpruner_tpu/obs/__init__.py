@@ -73,7 +73,7 @@ __all__ = [
     "timeseries_tick",
     "record_scores", "record_prune", "record_round", "record_epoch",
     "record_sweep_layer", "record_serve", "record_reqtrace",
-    "ledger_backfill",
+    "ledger_backfill", "active_incident_id",
     "annotate_run", "set_trial", "record_trial", "record_frontier",
     "MetricsRegistry", "StepTelemetry",
     "SpanTracer", "SpanRecord", "train_flops_per_step",
@@ -140,6 +140,8 @@ class ObsSession:
         self.events: Optional[JsonlWriter] = None
         self.ledger: Optional[ProvenanceRecorder] = None
         self.timeseries = None
+        self.anomaly = None
+        self.incidents = None
         self.profiler = None
         self.hbm = None
         self.profile: Optional[Dict[str, Any]] = None
@@ -181,6 +183,29 @@ class ObsSession:
                                               DEFAULT_ROTATE_BYTES))
                 except Exception:
                     self.timeseries = None
+            # anomaly detection + incident correlation (obs.anomaly /
+            # obs.incident): the detector rides the recorder's
+            # per-window hook; any burn alert (record_serve) or anomaly
+            # open routes to the correlator, which assembles a ledgered
+            # incident from this session's evidence
+            try:
+                from torchpruner_tpu.obs.anomaly import AnomalyDetector
+                from torchpruner_tpu.obs.incident import (
+                    IncidentCorrelator,
+                )
+
+                self.incidents = IncidentCorrelator(
+                    ledger=self.ledger, registry=self.metrics)
+                if self.timeseries is not None:
+                    self.anomaly = AnomalyDetector(
+                        on_open=self._on_anomaly_open,
+                        on_close=self._on_anomaly_close)
+                    self.incidents.detector = self.anomaly
+                    self.timeseries.on_window = \
+                        self.anomaly.observe_window
+            except Exception:
+                self.anomaly = None
+                self.incidents = None
         self.tracer = SpanTracer(sink=self.events, annotate=annotate)
         if obs_dir and self.is_emitter:
             # continuous profiling: the profiler exists whenever the
@@ -209,6 +234,30 @@ class ObsSession:
                 "event": "obs_init", "ts": time.time(), "pid": os.getpid(),
                 "process_index": self.process_index,
             })
+
+    def _on_anomaly_open(self, rec: Dict[str, Any]) -> None:
+        """Detector callback (invoked outside its lock): ledger the
+        anomaly and let it trigger an incident."""
+        if self.ledger is not None:
+            try:
+                self.ledger.record(dict(rec))
+            except Exception:
+                pass
+        if self.incidents is not None:
+            try:
+                self.incidents.trigger(
+                    kind="anomaly", ts=rec.get("opened_ts"),
+                    metric=rec.get("metric"),
+                    anomaly_id=rec.get("anomaly_id"), z=rec.get("z"))
+            except Exception:
+                pass
+
+    def _on_anomaly_close(self, rec: Dict[str, Any]) -> None:
+        if self.ledger is not None:
+            try:
+                self.ledger.record(dict(rec))
+            except Exception:
+                pass
 
     def clear_stale_profile(self) -> None:
         """Invalidate a previous run's capture windows in a reused obs
@@ -278,6 +327,16 @@ class ObsSession:
                 except Exception:
                     pass
             self._finalize_profile()      # kernel gauges BEFORE export
+            if self.incidents is not None:
+                # incident/anomaly count gauges BEFORE the final window
+                # and shard ship — they must ride the merge into
+                # report.json, `obs diff`, and the CI gates (set even
+                # when 0, so the clean-run false-positive gate compares
+                # a real number, not an absent metric)
+                try:
+                    self.incidents.finalize(self.metrics)
+                except Exception:
+                    pass
             if self.timeseries is not None:
                 # final forced window + ts_* gauges, BEFORE the shard
                 # ships (the gauges must ride the merge into report.json
@@ -713,6 +772,37 @@ def record_serve(*, kind: str, **fields) -> None:
     s = _session
     if s is not None and s.ledger is not None:
         s.ledger.record({"event": "serve", "kind": kind, **fields})
+        if kind == "slo_burn" and s.incidents is not None:
+            # burn alerts open incidents wherever --obs-dir is set —
+            # serve frontends ledger burns directly, the fleet's
+            # _collect_burn_alerts re-records replica burns (carrying
+            # the original timestamp), so both planes correlate through
+            # this one hook (obs.incident)
+            try:
+                s.incidents.trigger(
+                    kind="slo_burn",
+                    ts=fields.get("burn_ts") or fields.get("ts"),
+                    metric=fields.get("metric"),
+                    replica=fields.get("replica"),
+                    burn_fast=fields.get("burn_fast"),
+                    burn_slow=fields.get("burn_slow"))
+            except Exception:
+                pass
+
+
+def active_incident_id() -> Optional[str]:
+    """The correlation id in effect right now — the incident still
+    inside its lookback horizon, else the oldest open anomaly, else
+    ``None``.  The supervisor stamps this onto every ``scale_decision``
+    record so postmortems link decision→signal without timestamp
+    guessing.  No-op ``None`` without a session/correlator."""
+    s = _session
+    if s is None or s.incidents is None:
+        return None
+    try:
+        return s.incidents.active_id()
+    except Exception:
+        return None
 
 
 def record_reqtrace(**fields) -> None:

@@ -356,6 +356,8 @@ def build_report(*, run_meta: Optional[Dict[str, Any]] = None,
         "trials": picked("trial"),
         "frontier": picked("frontier"),
         "reqtrace": picked("reqtrace"),
+        "incidents": picked("incident"),
+        "anomalies": picked("anomaly"),
         "derived": dict(derived or {}),
         "phases": dict(phases or {}),
         "compiles": dict(compiles or {}),

@@ -114,13 +114,18 @@ _SCALARS = {
 #: fleet/router.py; ``scale_*`` the autoscaling supervisor's decision
 #: counters and replica/rung gauges — fleet/supervisor.py; all three
 #: gated by the CI autoscale chaos drill)
+#: (``anomaly_*`` / ``incident_*`` are the changepoint detector's and
+#: incident correlator's close-time count/score gauges — obs/anomaly.py
+#: + obs/incident.py; gated exact-zero on the clean fleet run and
+#: exact-one on the planted-cause CI drill)
 _DYNAMIC_SCALAR_PREFIXES = ("kernel_", "serve_slo_breach", "zero_",
                             "predicted_", "plan_", "frontier_",
                             "search_", "fleet_", "reqtrace_",
                             "ttft_stage_", "serve_queue_wait",
                             "host_lint_", "ts_", "slo_burn_",
                             "serve_prefix_", "serve_kv_pages_shared",
-                            "workload_", "tenant_", "scale_")
+                            "workload_", "tenant_", "scale_",
+                            "anomaly_", "incident_")
 _DYNAMIC_EXTRA = ("profile_coverage", "profile_windows_total",
                   "profile_steps_total")
 
@@ -583,6 +588,39 @@ def format_report(report: Dict[str, Any]) -> str:
             if cap.get("predicted_tok_s") is not None:
                 bit += (f" (predicted +{_f(cap['predicted_tok_s'], '.0f')}"
                         f" tok/s per replica)")
+            if r.get("correlation_id"):
+                bit += f" [corr {r['correlation_id']}]"
+            lines.append(bit)
+        lines.append("")
+
+    # incidents (obs/incident.py): every ledgered incident with its
+    # trigger and top-ranked suspect — the postmortem headline; the
+    # full evidence table is `obs incident DIR`
+    incidents = report.get("incidents") or []
+    anomalies = report.get("anomalies") or []
+    if incidents or anomalies:
+        opened = [a for a in anomalies if a.get("state") == "open"]
+        lines.append(f"incidents: {len(incidents)} — "
+                     f"{len(anomalies)} anomaly record(s), "
+                     f"{len(opened)} still open at close")
+        for inc in incidents[:8]:
+            trig = inc.get("trigger") or {}
+            bit = (f"- **{inc.get('incident_id')}** {inc.get('kind')}"
+                   + (f" ({trig.get('metric')})" if trig.get("metric")
+                      else "")
+                   + (f" on {trig.get('replica')}"
+                      if trig.get("replica") else ""))
+            top = inc.get("top_suspect") or {}
+            if top:
+                bit += (f" → top suspect `{top.get('class')}`"
+                        + (f" on {top.get('replica')}"
+                           if top.get("replica") else "")
+                        + f" (score {_f(top.get('score'), '.3f')})")
+            absorbed = inc.get("triggers_absorbed") or 0
+            if absorbed:
+                bit += f", {absorbed} trigger(s) absorbed"
+            if inc.get("tenants"):
+                bit += f", tenants: {', '.join(inc['tenants'])}"
             lines.append(bit)
         lines.append("")
 
@@ -1003,7 +1041,27 @@ def obs_main(argv=None) -> int:
                     help="redraw cadence, seconds")
     pw.add_argument("--once", action="store_true",
                     help="render one frame and exit (CI smoke)")
+    pi = sub.add_parser(
+        "incident",
+        help="postmortem timeline: ledgered (or offline-reconstructed) "
+             "incidents with ranked root-cause suspects, anomaly "
+             "windows, gauge deltas, and slowest-request exemplars "
+             "(obs.anomaly + obs.incident; exits 1 on an unexplained "
+             "SLO burn)")
+    pi.add_argument("dir", help="obs dir (single run or fleet router "
+                                "dir with metrics_ts_fleet.jsonl)")
+    pi.add_argument("--lookback", type=float, default=0.0,
+                    help="correlation horizon in seconds (default: "
+                         "TORCHPRUNER_INCIDENT_LOOKBACK_S or 120)")
+    pi.add_argument("--json", action="store_true",
+                    help="emit the raw incident/anomaly JSON instead "
+                         "of the markdown postmortem")
     args = p.parse_args(argv)
+
+    if args.cmd == "incident":
+        from torchpruner_tpu.obs.incident import incident_main
+
+        return incident_main(args)
 
     if args.cmd == "watch":
         from torchpruner_tpu.obs.timeseries import watch as ts_watch

@@ -91,6 +91,13 @@ class TimeseriesRecorder:
                                    backups=backups)
         self._seq = 0
         self._closed = False
+        #: optional per-window hook (the anomaly detector's
+        #: ``observe_window``) — invoked OUTSIDE the lock with the
+        #: just-emitted window record, exceptions swallowed; not fired
+        #: for the forced final window at close (its partial span skews
+        #: rate signals)
+        self.on_window = None
+        self._last_window: Optional[Dict[str, Any]] = None
         #: delta baselines: counter values / histogram (counts, sum,
         #: count) as of the last emitted window
         self._prev_counters: Dict[str, float] = {}
@@ -118,7 +125,9 @@ class TimeseriesRecorder:
             # unlocked gate must not emit two near-empty windows
             if t < self._next_due or self._closed:
                 return False
-            return self._tick_locked(t)
+            emitted = self._tick_locked(t)
+        self._fire_on_window()
+        return emitted
 
     def tick(self, now: Optional[float] = None) -> bool:
         """Force a window now (the final flush at session close)."""
@@ -126,7 +135,18 @@ class TimeseriesRecorder:
         with self._lock:
             if self._closed:
                 return False
-            return self._tick_locked(t)
+            emitted = self._tick_locked(t)
+        self._fire_on_window()
+        return emitted
+
+    def _fire_on_window(self) -> None:
+        cb, rec = self.on_window, self._last_window
+        if cb is None or rec is None:
+            return
+        try:
+            cb(rec)
+        except Exception:
+            pass  # a broken detector must never kill the recorder
 
     # -- the window ----------------------------------------------------------
 
@@ -178,6 +198,7 @@ class TimeseriesRecorder:
         if hists:
             rec["hist"] = hists
         self._writer(rec)
+        self._last_window = rec
         self._last_ts = t
         self._next_due = t + self.interval_s
         return True
@@ -457,7 +478,53 @@ def format_watch(run_dir: str, tail: int = 1) -> str:
         lines.append(f"{'gauge':<44}{'value':>22}")
         for name in sorted(gauges):
             lines.append(f"{name:<44}{gauges[name]:>22.6g}")
+    alert_lines = _watch_alerts(run_dir)
+    if alert_lines:
+        lines.append("")
+        lines.extend(alert_lines)
     return "\n".join(lines)
+
+
+def _watch_alerts(run_dir: str, tail: int = 6) -> List[str]:
+    """The live incidents/alerts pane: the ledger tail's anomaly /
+    incident / burn-alert records (the ledger flushes per line, so the
+    pane is current to the last event even mid-run)."""
+    if not os.path.isdir(run_dir):
+        return []
+    from torchpruner_tpu.obs.ledger import LEDGER_FILENAME, load_ledger
+
+    try:
+        led = load_ledger(os.path.join(run_dir, LEDGER_FILENAME))
+    except Exception:
+        return []
+    alerts = [r for r in led
+              if r.get("event") in ("anomaly", "incident")
+              or (r.get("event") == "serve"
+                  and r.get("kind") == "slo_burn")]
+    if not alerts:
+        return []
+    lines = [f"incidents / alerts ({len(alerts)} total, last {tail})"]
+    for r in alerts[-tail:]:
+        ev = r.get("event")
+        if ev == "incident":
+            top = r.get("top_suspect") or {}
+            lines.append(
+                f"  INCIDENT {r.get('incident_id')} ({r.get('kind')})"
+                f"  top suspect: {top.get('class', '?')}"
+                f" on {top.get('replica') or 'fleet'}"
+                f" score {top.get('score', 0.0):.3f}")
+        elif ev == "anomaly":
+            z = r.get("z")
+            lines.append(
+                f"  ANOMALY  {r.get('anomaly_id')} {r.get('state')}"
+                f"  {r.get('metric')}"
+                + (f" z={z:.1f}" if isinstance(z, (int, float)) else ""))
+        else:
+            lines.append(
+                f"  BURN     {r.get('replica') or ''}:{r.get('metric')}"
+                f"  fast {r.get('burn_fast')}x"
+                f" slow {r.get('burn_slow')}x")
+    return lines
 
 
 def watch(run_dir: str, interval_s: float = 2.0,

@@ -90,6 +90,10 @@ class SLOMonitor:
             m: False for m in self.METRICS}
         self._in_burn: Dict[str, bool] = {
             m: False for m in self.METRICS}
+        #: wall-clock start of the current burn episode per metric —
+        #: observed into ``slo_burn_episode_seconds`` at re-arm
+        self._burn_started: Dict[str, Optional[float]] = {
+            m: None for m in self.METRICS}
         self._last_check_step = -1
         #: check() runs on the engine thread (maybe_check) AND on
         #: /metrics scrape threads, while on_ttft/on_token append from
@@ -169,6 +173,10 @@ class SLOMonitor:
             elif p99 <= limit:
                 self._in_breach[metric] = False
             self._burn_locked(metric, samples, limit, now, step)
+        obs.gauge_set(
+            "slo_burn_active", float(sum(self._in_burn.values())),
+            help="gated metrics currently inside a burn episode "
+                 "(0 = healthy; rides obs diff and the watch board)")
         return dict(self.rolling)
 
     def _burn_locked(self, metric: str, samples, limit: float,
@@ -196,6 +204,7 @@ class SLOMonitor:
                   and burns["slow"] >= self.burn_threshold)
         if firing and not self._in_burn[metric]:
             self._in_burn[metric] = True
+            self._burn_started[metric] = now
             self.burn_alerts_total += 1
             obs.inc("slo_burn_alerts_total",
                     help="multi-window burn-rate alert episodes (fast "
@@ -209,8 +218,22 @@ class SLOMonitor:
                 burn_threshold=self.burn_threshold,
                 fast_window_s=self.burn_fast_window_s,
                 slow_window_s=self.burn_slow_window_s,
-                threshold_s=limit, step=step)
+                threshold_s=limit, step=step,
+                # the trigger instant, carried verbatim when the fleet
+                # epilogue re-records this alert — the incident
+                # correlator anchors its lookback here, not at the
+                # re-record time (obs.incident)
+                burn_ts=round(now, 6))
         elif (burns["fast"] or 0.0) < self.burn_threshold:
+            if self._in_burn[metric]:
+                started = self._burn_started.get(metric)
+                if started is not None:
+                    obs.observe(
+                        "slo_burn_episode_seconds",
+                        max(0.0, now - started),
+                        help="burn-episode duration: alert fire → fast-"
+                             "window recovery (observed at re-arm)")
+                self._burn_started[metric] = None
             self._in_burn[metric] = False
 
     def in_breach_any(self) -> bool:
